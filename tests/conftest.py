@@ -1,0 +1,19 @@
+"""Test configuration.
+
+JAX payload tests run on a virtual 8-device CPU mesh — the env vars must be
+set before the first ``import jax`` anywhere in the process, so they are set
+at conftest import time (pytest imports conftest before collecting tests).
+"""
+
+import os
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,199 @@
+"""Defaulting + validation table tests.
+
+Reference test model: pkg/apis/mxnet/validation/validation_test.go:26-113
+(valid spec passes; missing chief / bad type / missing container fail) and
+the defaulting assertions inside training_test.go:186-344 — the reference's
+copies do not even compile (SURVEY.md §4); these do.
+"""
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.apis.tpujob.validation import (
+    ValidationError,
+    validate_tpujob_spec,
+    validate_tpu_resources,
+)
+from tests.test_types import make_spec, make_template
+
+
+# --- defaults ---------------------------------------------------------------
+
+def test_defaults_fill_replicas_port_type():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=0, template=make_template(), tpu_port=None,
+                                        tpu_replica_type="")]
+    )
+    set_defaults(spec)
+    rs = spec.replica_specs[0]
+    assert rs.replicas == 1
+    assert rs.tpu_port == t.DEFAULT_TPU_PORT
+    assert rs.tpu_replica_type == t.TPUReplicaType.WORKER
+
+
+def test_defaults_chief_worker_when_schedulerless():
+    # TPU-native mode: no SCHEDULER → chief is WORKER[0]
+    spec = make_spec()
+    set_defaults(spec)
+    assert spec.termination_policy.chief_replica_name == t.TPUReplicaType.WORKER
+    assert spec.termination_policy.chief_replica_index == 0
+    assert spec.restart_policy == t.RestartPolicy.WHOLE_GROUP
+
+
+def test_defaults_chief_scheduler_in_compat_mode():
+    # ref: training.go:252-257 — chief defaults to SCHEDULER[0]
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=1, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+            t.TPUReplicaSpec(replicas=2, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SERVER),
+            t.TPUReplicaSpec(replicas=2, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.WORKER),
+        ]
+    )
+    set_defaults(spec)
+    assert spec.termination_policy.chief_replica_name == t.TPUReplicaType.SCHEDULER
+    assert spec.restart_policy == t.RestartPolicy.PER_POD
+
+
+def test_defaults_idempotent():
+    spec = make_spec()
+    set_defaults(spec)
+    once = spec.to_dict()
+    set_defaults(spec)
+    assert spec.to_dict() == once
+
+
+def test_defaults_lowercase_role_normalized():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=1, template=make_template(),
+                                        tpu_replica_type="worker")]
+    )
+    set_defaults(spec)
+    assert spec.replica_specs[0].tpu_replica_type == t.TPUReplicaType.WORKER
+
+
+# --- validation -------------------------------------------------------------
+
+def _valid_spec():
+    spec = make_spec()
+    return set_defaults(spec)
+
+
+def test_validate_ok():
+    validate_tpujob_spec(_valid_spec())
+
+
+def test_validate_missing_termination_policy():
+    spec = make_spec()
+    spec.termination_policy = None
+    with pytest.raises(ValidationError, match="termination policy"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_missing_template():
+    spec = _valid_spec()
+    spec.replica_specs[0].template = None
+    with pytest.raises(ValidationError, match="template"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_missing_port():
+    spec = _valid_spec()
+    spec.replica_specs[0].tpu_port = None
+    with pytest.raises(ValidationError, match="tpuPort"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_bad_replica_type():
+    spec = _valid_spec()
+    spec.replica_specs[0].tpu_replica_type = "CHIEFTAIN"
+    with pytest.raises(ValidationError, match="CHIEFTAIN"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_chief_matches_no_replica():
+    # ref: validation.go:79-81
+    spec = _valid_spec()
+    spec.termination_policy = t.TerminationPolicySpec(
+        chief_replica_name=t.TPUReplicaType.SCHEDULER
+    )
+    with pytest.raises(ValidationError, match="matches no replicaSpec"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_container_name_required():
+    # ref: validation.go:68-76 (container named "mxnet" → here "tpu")
+    spec = _valid_spec()
+    spec.replica_specs[0].template = make_template(container_name="main")
+    with pytest.raises(ValidationError, match="container named 'tpu'"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_scheduler_must_be_single():
+    # ref: replicas.go:87-93, hoisted to validation
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=2, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+            t.TPUReplicaSpec(replicas=1, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.WORKER),
+        ]
+    )
+    set_defaults(spec)
+    with pytest.raises(ValidationError, match="SCHEDULER"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_duplicate_role():
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=1, template=make_template()),
+            t.TPUReplicaSpec(replicas=2, template=make_template()),
+        ]
+    )
+    set_defaults(spec)
+    with pytest.raises(ValidationError, match="duplicate"):
+        validate_tpujob_spec(spec)
+
+
+def test_validate_empty_spec():
+    spec = t.TPUJobSpec()
+    set_defaults(spec)
+    with pytest.raises(ValidationError, match="at least one"):
+        validate_tpujob_spec(spec)
+
+
+# --- TPU resource validation ------------------------------------------------
+
+def test_multislice_requires_divisible_workers():
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=3, template=make_template(tpu_chips=4)),
+        ],
+        num_slices=2,
+    )
+    set_defaults(spec)
+    with pytest.raises(ValidationError, match="divisible"):
+        validate_tpu_resources(spec)
+
+
+def test_multislice_requires_chips():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=4, template=make_template())],
+        num_slices=2,
+    )
+    set_defaults(spec)
+    with pytest.raises(ValidationError, match="no TPU chips"):
+        validate_tpu_resources(spec)
+
+
+def test_multislice_ok():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=4, template=make_template(tpu_chips=4))],
+        num_slices=2,
+    )
+    set_defaults(spec)
+    validate_tpu_resources(spec)

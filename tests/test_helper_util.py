@@ -1,0 +1,138 @@
+"""Helper + util table tests.
+
+Reference test model: pkg/apis/mxnet/helper/helpers_test.go:28-248
+(accelerator volume/env injection outcomes) — rebuilt to compile and to cover
+the TPU env-injection path the reference never had.
+"""
+
+from tpu_operator.apis.tpujob import helper
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.util import util
+from tests.test_types import make_spec, make_template
+
+
+def test_as_owner():
+    # ref: helpers.go:40-52
+    owner = helper.as_owner({"name": "job1", "uid": "uid-42"})
+    assert owner == {
+        "apiVersion": "tpuoperator.dev/v1alpha1",
+        "kind": "TPUJob",
+        "name": "job1",
+        "uid": "uid-42",
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def test_configure_accelerators_gpu_style_volumes():
+    # ref: helpers_test.go:28-150 shape — GPU resource gets hostPath volumes
+    spec = make_spec()
+    spec.replica_specs[0].template["spec"]["containers"][0]["resources"] = {
+        "limits": {"alpha.kubernetes.io/nvidia-gpu": 1}
+    }
+    cfg = t.ControllerConfig.from_dict(
+        {
+            "accelerators": {
+                "alpha.kubernetes.io/nvidia-gpu": {
+                    "volumes": [
+                        {"name": "cuda-lib", "hostPath": "/usr/lib/cuda",
+                         "mountPath": "/usr/local/cuda"}
+                    ],
+                    "envVars": {"CUDA_HOME": "/usr/local/cuda"},
+                }
+            }
+        }
+    )
+    helper.configure_accelerators(spec, cfg)
+    pod_spec = spec.replica_specs[0].template["spec"]
+    assert pod_spec["volumes"] == [{"name": "cuda-lib", "hostPath": {"path": "/usr/lib/cuda"}}]
+    container = pod_spec["containers"][0]
+    assert container["volumeMounts"] == [{"name": "cuda-lib", "mountPath": "/usr/local/cuda"}]
+    assert {"name": "CUDA_HOME", "value": "/usr/local/cuda"} in container["env"]
+
+
+def test_configure_accelerators_tpu_env_only():
+    # The TPU path: resource cloud-tpus.google.com/v4 → env only, no volumes
+    spec = make_spec()
+    spec.replica_specs[0].template["spec"]["containers"][0]["resources"] = {
+        "requests": {"cloud-tpus.google.com/v4": 4}
+    }
+    cfg = t.ControllerConfig.from_dict(
+        {"accelerators": {"cloud-tpus.google.com/v4": {"envVars": {"TPU_RUNTIME": "tpu-vm"}}}}
+    )
+    helper.configure_accelerators(spec, cfg)
+    container = spec.replica_specs[0].template["spec"]["containers"][0]
+    assert {"name": "TPU_RUNTIME", "value": "tpu-vm"} in container["env"]
+    assert "volumes" not in spec.replica_specs[0].template["spec"]
+
+
+def test_configure_accelerators_no_match_no_change():
+    spec = make_spec()
+    before = spec.to_dict()
+    cfg = t.ControllerConfig.from_dict(
+        {"accelerators": {"cloud-tpus.google.com/v4": {"envVars": {"X": "y"}}}}
+    )
+    helper.configure_accelerators(spec, cfg)
+    assert spec.to_dict() == before
+
+
+def test_configure_accelerators_does_not_clobber_user_env():
+    spec = make_spec()
+    container = spec.replica_specs[0].template["spec"]["containers"][0]
+    container["resources"] = {"limits": {"cloud-tpus.google.com/v4": 4}}
+    container["env"] = [{"name": "TPU_RUNTIME", "value": "user-set"}]
+    cfg = t.ControllerConfig.from_dict(
+        {"accelerators": {"cloud-tpus.google.com/v4": {"envVars": {"TPU_RUNTIME": "tpu-vm"}}}}
+    )
+    helper.configure_accelerators(spec, cfg)
+    assert container["env"] == [{"name": "TPU_RUNTIME", "value": "user-set"}]
+
+
+def test_tpu_chips_requested():
+    assert helper.tpu_chips_requested(make_template(tpu_chips=4)) == 4
+    assert helper.tpu_chips_requested(make_template()) == 0
+    assert helper.tpu_chips_requested(None) == 0
+    # limits win over requests
+    tmpl = make_template()
+    tmpl["spec"]["containers"][0]["resources"] = {
+        "requests": {"cloud-tpus.google.com/v4": 2},
+        "limits": {"cloud-tpus.google.com/v4": 8},
+    }
+    assert helper.tpu_chips_requested(tmpl) == 8
+
+
+# --- util -------------------------------------------------------------------
+
+def test_rand_string_dns_safe():
+    # ref: util.go:58-74
+    util.seed(7)
+    s = util.rand_string(16)
+    assert len(s) == 16
+    assert s == s.lower()
+    assert all(c.isalnum() for c in s)
+
+
+def test_rand_string_deterministic_with_seed():
+    util.seed(123)
+    a = util.rand_string(8)
+    util.seed(123)
+    assert util.rand_string(8) == a
+
+
+def test_pformat_handles_unserializable():
+    class Odd:
+        pass
+
+    out = util.pformat({"x": 1})
+    assert '"x": 1' in out
+    assert util.pformat(Odd())  # falls back without raising
+
+
+def test_operator_namespace_env(monkeypatch):
+    monkeypatch.delenv("TPU_OPERATOR_NAMESPACE", raising=False)
+    monkeypatch.delenv("MY_POD_NAMESPACE", raising=False)
+    assert util.get_operator_namespace() == "default"
+    monkeypatch.setenv("MY_POD_NAMESPACE", "kube-pods")
+    assert util.get_operator_namespace() == "kube-pods"
+    monkeypatch.setenv("TPU_OPERATOR_NAMESPACE", "tpu-system")
+    assert util.get_operator_namespace() == "tpu-system"

@@ -1,0 +1,206 @@
+"""Informer + controller loop tests over the fake clientset's watch streams.
+
+This is the tier the reference could never run without a cluster: the full
+event-driven loop (informers → workqueue → syncMXJob → reconcile) exercised
+in-process (SURVEY.md §4 lesson: add an envtest-style tier).
+"""
+
+import threading
+import time
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.controller.controller import Controller
+from tests.test_types import make_template
+
+
+def wait_for(predicate, timeout=5.0, interval=0.02):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def worker_job_dict(name="train", replicas=2, runtime_id="ab12"):
+    return t.TPUJob(
+        metadata={"name": name, "namespace": "default"},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=replicas, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER)
+            ],
+            runtime_id=runtime_id,
+        ),
+    ).to_dict()
+
+
+@pytest.fixture
+def harness():
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)  # no resync churn in tests
+    controller = Controller(cs, factory)
+    stop = threading.Event()
+    runner = threading.Thread(
+        target=controller.run, args=(2, stop), daemon=True
+    )
+    runner.start()
+    yield cs, controller
+    stop.set()
+    runner.join(timeout=5.0)
+
+
+# --- informer-level ----------------------------------------------------------
+
+def test_informer_cache_and_handlers():
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)
+    inf = factory.informer_for("tpujobs")
+    seen = {"adds": [], "updates": [], "deletes": []}
+    inf.add_event_handler(
+        on_add=lambda o: seen["adds"].append(o["metadata"]["name"]),
+        on_update=lambda old, new: seen["updates"].append(new["metadata"]["name"]),
+        on_delete=lambda o: seen["deletes"].append(o["metadata"]["name"]),
+    )
+    cs.tpujobs.create("default", worker_job_dict("pre-existing"))
+    stop = threading.Event()
+    factory.start(stop)
+    assert factory.wait_for_cache_sync(timeout=5.0)
+    try:
+        assert wait_for(lambda: "pre-existing" in seen["adds"])
+        cs.tpujobs.create("default", worker_job_dict("late"))
+        assert wait_for(lambda: "late" in seen["adds"])
+        assert inf.store.get("default", "late") is not None
+
+        obj = cs.tpujobs.get("default", "late")
+        obj["status"] = {"phase": "Running"}
+        cs.tpujobs.update("default", obj)
+        assert wait_for(lambda: "late" in seen["updates"])
+
+        cs.tpujobs.delete("default", "late")
+        assert wait_for(lambda: "late" in seen["deletes"])
+        assert inf.store.get("default", "late") is None
+    finally:
+        stop.set()
+
+
+# --- controller end-to-end over fakes ----------------------------------------
+
+def test_controller_reconciles_created_job(harness):
+    cs, controller = harness
+    cs.tpujobs.create("default", worker_job_dict())
+    assert wait_for(lambda: len(cs.pods.list("default")) == 2)
+    assert wait_for(lambda: len(cs.services.list("default")) == 3)
+    stored = cs.tpujobs.get("default", "train")
+    assert stored["status"]["phase"] == t.TPUJobPhase.CREATING
+
+    # Mark pods running → pod informer enqueues owner → phase Running,
+    # without any resync tick (the reference needed the 30s resync here).
+    for p in cs.pods.list("default"):
+        p["status"] = {
+            "phase": "Running",
+            "containerStatuses": [{"name": "tpu", "state": {"running": {}}}],
+        }
+        cs.pods.update("default", p)
+    assert wait_for(
+        lambda: cs.tpujobs.get("default", "train")["status"]["phase"]
+        == t.TPUJobPhase.RUNNING
+    )
+
+
+def test_controller_success_flow(harness):
+    cs, _controller = harness
+    cs.tpujobs.create("default", worker_job_dict())
+    assert wait_for(lambda: len(cs.pods.list("default")) == 2)
+    for p in cs.pods.list("default"):
+        p["status"] = {
+            "phase": "Succeeded",
+            "containerStatuses": [
+                {"name": "tpu", "state": {"terminated": {"exitCode": 0}}}
+            ],
+        }
+        cs.pods.update("default", p)
+    assert wait_for(
+        lambda: cs.tpujobs.get("default", "train")["status"]["phase"]
+        == t.TPUJobPhase.DONE
+    )
+    stored = cs.tpujobs.get("default", "train")
+    assert stored["status"]["state"] == t.State.SUCCEEDED
+    # pods retained for logs
+    assert len(cs.pods.list("default")) == 2
+
+
+def test_controller_group_restart_flow(harness):
+    cs, _controller = harness
+    cs.tpujobs.create("default", worker_job_dict())
+    assert wait_for(lambda: len(cs.pods.list("default")) == 2)
+    victim = cs.pods.list("default")[0]
+    victim["status"] = {
+        "phase": "Failed",
+        "containerStatuses": [
+            {"name": "tpu", "state": {"terminated": {"exitCode": 137}}}
+        ],
+    }
+    cs.pods.update("default", victim)
+    # whole group torn down and recreated under attempt=1
+    assert wait_for(
+        lambda: len(cs.pods.list("default", label_selector="attempt=1")) == 2
+    )
+    assert cs.tpujobs.get("default", "train")["status"]["attempt"] == 1
+
+
+def test_controller_forgets_deleted_job(harness):
+    cs, controller = harness
+    cs.tpujobs.create("default", worker_job_dict())
+    assert wait_for(lambda: "default/train" in controller.jobs)
+    cs.tpujobs.delete("default", "train")
+    assert wait_for(lambda: "default/train" not in controller.jobs)
+
+
+def test_controller_new_uid_rebuilds_job(harness):
+    cs, controller = harness
+    cs.tpujobs.create("default", worker_job_dict(runtime_id="one1"))
+    assert wait_for(lambda: "default/train" in controller.jobs)
+    uid1 = controller.jobs["default/train"].uid
+    cs.tpujobs.delete("default", "train")
+    assert wait_for(lambda: "default/train" not in controller.jobs)
+    cs.tpujobs.create("default", worker_job_dict(runtime_id="two2"))
+    assert wait_for(
+        lambda: "default/train" in controller.jobs
+        and controller.jobs["default/train"].uid != uid1
+    )
+
+
+def test_gc_removes_orphans():
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)
+    controller = Controller(cs, factory)
+    # Child pod whose owner TPUJob does not exist
+    cs.pods.create("default", {
+        "metadata": {
+            "name": "orphan-pod",
+            "labels": {"tpuoperator.dev": "", "job_name": "ghost"},
+            "ownerReferences": [
+                {"kind": "TPUJob", "name": "ghost", "controller": True}
+            ],
+        }
+    })
+    # Child whose owner exists → kept
+    cs.tpujobs.create("default", worker_job_dict("alive"))
+    cs.pods.create("default", {
+        "metadata": {
+            "name": "kept-pod",
+            "labels": {"tpuoperator.dev": "", "job_name": "alive"},
+            "ownerReferences": [
+                {"kind": "TPUJob", "name": "alive", "controller": True}
+            ],
+        }
+    })
+    deleted = controller.run_gc_once()
+    assert deleted == 1
+    names = [p["metadata"]["name"] for p in cs.pods.list("default")]
+    assert names == ["kept-pod"]

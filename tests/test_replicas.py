@@ -1,0 +1,409 @@
+"""TPUReplicaSet tests against the fake clientset.
+
+Reference test model: pkg/trainer/replicas_test.go — create pods/services
+against fakes, then list and assert names/labels/owner refs/env
+(replicas_test.go:90-201), plus the pod-list → state classifier tables
+(replicas_test.go:212-368). The reference's copies don't compile
+(SURVEY.md §4); these run.
+"""
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.trainer import replicas as r
+from tpu_operator.util import util
+from tests.test_types import make_template
+
+
+class StubJob:
+    """Minimal job back-pointer (the reference passes *TrainingJob)."""
+
+    def __init__(self, spec, name="train", namespace="default"):
+        self.metadata = {"name": name, "namespace": namespace, "uid": "uid-1"}
+        self.job_spec = spec
+
+    @property
+    def name(self):
+        return self.metadata["name"]
+
+    @property
+    def namespace(self):
+        return self.metadata["namespace"]
+
+
+def worker_spec(replicas=2, **kw):
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=replicas, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.WORKER)
+        ],
+        runtime_id="a1b2",
+        **kw,
+    )
+    return set_defaults(spec)
+
+
+def ps_spec():
+    """Compat-mode spec: SCHEDULER listed LAST to prove coordinator selection
+    is by role, not position (the reference's replicas.go:240-243 bug)."""
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(replicas=2, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SERVER),
+            t.TPUReplicaSpec(replicas=2, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.WORKER),
+            t.TPUReplicaSpec(replicas=1, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+        ],
+        runtime_id="zz99",
+    )
+    return set_defaults(spec)
+
+
+def make_set(spec=None, role=t.TPUReplicaType.WORKER):
+    spec = spec or worker_spec()
+    cs = FakeClientset()
+    job = StubJob(spec)
+    rs_spec = next(rs for rs in spec.replica_specs if rs.tpu_replica_type == role)
+    return cs, job, r.TPUReplicaSet(cs, None, job, rs_spec)
+
+
+# --- naming -----------------------------------------------------------------
+
+def test_gen_general_name():
+    # ref: replicas.go:570-577 — job-role-runtimeid-index
+    assert r.gen_general_name("train", "WORKER", "a1b2", 3) == "train-worker-a1b2-3"
+
+
+def test_gen_name_truncates_to_dns_label():
+    name = r.gen_general_name("j" * 80, "WORKER", "a1b2", 0)
+    assert len(name) <= 63
+    assert name.endswith("-worker-a1b2-0")
+
+
+def test_gen_pod_name_has_random_suffix():
+    # ref: replicas.go:579-583
+    util.seed(1)
+    a = r.gen_pod_name("train", "WORKER", "a1b2", 0)
+    b = r.gen_pod_name("train", "WORKER", "a1b2", 0)
+    assert a != b
+    assert a.startswith("train-worker-a1b2-0-")
+    assert len(a) <= 63
+
+
+# --- ctor validation (ref: replicas.go:81-117) -------------------------------
+
+def test_ctor_rejects_bad_type():
+    cs = FakeClientset()
+    job = StubJob(worker_spec())
+    with pytest.raises(ValueError, match="invalid replica type"):
+        r.TPUReplicaSet(cs, None, job, t.TPUReplicaSpec(tpu_replica_type="BOSS",
+                                                        template=make_template()))
+
+
+def test_ctor_rejects_multi_scheduler():
+    cs = FakeClientset()
+    job = StubJob(worker_spec())
+    with pytest.raises(ValueError, match="SCHEDULER"):
+        r.TPUReplicaSet(
+            cs, None, job,
+            t.TPUReplicaSpec(replicas=3, template=make_template(),
+                             tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+        )
+
+
+def test_ctor_rejects_none_port():
+    cs = FakeClientset()
+    job = StubJob(worker_spec())
+    with pytest.raises(ValueError, match="tpuPort"):
+        r.TPUReplicaSet(cs, None, job,
+                        t.TPUReplicaSpec(template=make_template(), tpu_port=None))
+
+
+# --- env contract ------------------------------------------------------------
+
+def env_map(pod):
+    container = next(c for c in pod["spec"]["containers"] if c["name"] == "tpu")
+    return {e["name"]: e["value"] for e in container.get("env", [])}
+
+
+def test_worker_env_contract_schedulerless():
+    _cs, _job, rset = make_set()
+    pod = rset.pod_spec_with_index(1)
+    env = env_map(pod)
+    # Coordinator is WORKER[0]'s per-index service
+    assert env["JAX_COORDINATOR_ADDRESS"] == "train-worker-a1b2-0:8476"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["JAX_NUM_PROCESSES"] == "2"
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "train-worker-a1b2-0,train-worker-a1b2-1"
+    assert env["TPUJOB_REPLICA_TYPE"] == "worker"
+    assert env["TPUJOB_ATTEMPT"] == "0"
+    assert "MEGASCALE_NUM_SLICES" not in env
+
+
+def test_coordinator_is_scheduler_by_role_not_position():
+    # Fixes ref replicas.go:240-243 (hardcoded Replicas[0])
+    spec = ps_spec()
+    cs = FakeClientset()
+    job = StubJob(spec)
+    worker_rs = r.TPUReplicaSet(cs, None, job, spec.replica_specs[1])
+    env = env_map(worker_rs.pod_spec_with_index(0))
+    assert env["JAX_COORDINATOR_ADDRESS"] == "train-scheduler-zz99-0:8476"
+    # Global process ids follow spec order: SERVERs 0-1, WORKERs 2-3, SCHED 4
+    assert env["JAX_PROCESS_ID"] == "2"
+    assert env["JAX_NUM_PROCESSES"] == "5"
+
+
+def test_multislice_env():
+    spec = worker_spec(replicas=4)
+    spec.num_slices = 2
+    spec.tpu_topology = "2x2x1"
+    cs = FakeClientset()
+    job = StubJob(spec)
+    rset = r.TPUReplicaSet(cs, None, job, spec.replica_specs[0])
+    env = env_map(rset.pod_spec_with_index(3))
+    assert env["MEGASCALE_NUM_SLICES"] == "2"
+    assert env["MEGASCALE_SLICE_ID"] == "1"
+    assert env["MEGASCALE_COORDINATOR_ADDRESS"] == "train-worker-a1b2-0"
+    # Slice-local worker identity
+    assert env["TPU_WORKER_ID"] == "1"
+    assert env["TPU_WORKER_HOSTNAMES"] == "train-worker-a1b2-2,train-worker-a1b2-3"
+    assert env["TPU_TOPOLOGY"] == "2x2x1"
+
+
+def test_user_env_not_clobbered():
+    spec = worker_spec()
+    spec.replica_specs[0].template["spec"]["containers"][0]["env"] = [
+        {"name": "JAX_COORDINATOR_ADDRESS", "value": "user-override:1"}
+    ]
+    cs = FakeClientset()
+    rset = r.TPUReplicaSet(cs, None, StubJob(spec), spec.replica_specs[0])
+    env = env_map(rset.pod_spec_with_index(0))
+    assert env["JAX_COORDINATOR_ADDRESS"] == "user-override:1"
+
+
+def test_env_only_into_tpu_container():
+    # ref: replicas.go:235 injects only into the container named "mxnet"
+    spec = worker_spec()
+    spec.replica_specs[0].template["spec"]["containers"].append(
+        {"name": "sidecar", "image": "busybox"}
+    )
+    cs = FakeClientset()
+    rset = r.TPUReplicaSet(cs, None, StubJob(spec), spec.replica_specs[0])
+    pod = rset.pod_spec_with_index(0)
+    sidecar = next(c for c in pod["spec"]["containers"] if c["name"] == "sidecar")
+    assert "env" not in sidecar
+
+
+# --- pod construction --------------------------------------------------------
+
+def test_pod_metadata_and_spec():
+    spec = worker_spec(scheduler_name="gang-scheduler")
+    cs = FakeClientset()
+    rset = r.TPUReplicaSet(cs, None, StubJob(spec), spec.replica_specs[0])
+    pod = rset.pod_spec_with_index(1, attempt=2)
+    md = pod["metadata"]
+    assert md["labels"]["job_name"] == "train"
+    assert md["labels"]["task_index"] == "1"
+    assert md["labels"]["attempt"] == "2"
+    assert md["labels"]["job_type"] == "worker"
+    assert md["ownerReferences"][0]["uid"] == "uid-1"
+    assert md["ownerReferences"][0]["blockOwnerDeletion"] is True
+    ps = pod["spec"]
+    assert ps["schedulerName"] == "gang-scheduler"  # ref: replicas.go:178
+    assert ps["hostname"] == "train-worker-a1b2-1"
+    assert ps["subdomain"] == "train-a1b2"
+    # whole-group default → operator owns restarts
+    assert ps["restartPolicy"] == "Never"
+
+
+def test_pod_keeps_template_restart_policy_in_per_pod_mode():
+    spec = ps_spec()  # compat → PER_POD
+    cs = FakeClientset()
+    rset = r.TPUReplicaSet(cs, None, StubJob(spec), spec.replica_specs[1])
+    pod = rset.pod_spec_with_index(0)
+    assert pod["spec"]["restartPolicy"] == "OnFailure"  # from template
+
+
+# --- service construction ----------------------------------------------------
+
+def test_service_spec():
+    _cs, _job, rset = make_set()
+    svc = rset.service_spec_with_index(0)
+    assert svc["metadata"]["name"] == "train-worker-a1b2-0"
+    assert svc["spec"]["ports"][0]["port"] == 8476
+    sel = svc["spec"]["selector"]
+    assert sel["task_index"] == "0"
+    assert "attempt" not in sel  # must keep matching across group restarts
+    assert svc["metadata"]["ownerReferences"][0]["name"] == "train"
+
+
+# --- sync loops --------------------------------------------------------------
+
+def test_sync_services_idempotent():
+    cs, _job, rset = make_set()
+    rset.sync_services()
+    assert len(cs.services.list("default")) == 2
+    rset.sync_services()
+    assert len(cs.services.list("default")) == 2
+
+
+def test_sync_pods_creates_and_is_idempotent():
+    cs, _job, rset = make_set()
+    rset.sync_pods()
+    pods = cs.pods.list("default")
+    assert len(pods) == 2
+    rset.sync_pods()
+    assert len(cs.pods.list("default")) == 2
+    indices = sorted(p["metadata"]["labels"]["task_index"] for p in pods)
+    assert indices == ["0", "1"]
+
+
+def test_sync_pods_replaces_failed_in_per_pod_mode():
+    # ref: replicas.go:497 filters phase==Failed so a new pod is created
+    spec = ps_spec()
+    cs = FakeClientset()
+    rset = r.TPUReplicaSet(cs, None, StubJob(spec), spec.replica_specs[1])
+    rset.sync_pods()
+    pods = cs.pods.list("default", label_selector="job_type=worker")
+    victim = next(p for p in pods if p["metadata"]["labels"]["task_index"] == "0")
+    victim["status"] = {"phase": "Failed"}
+    cs.pods.update("default", victim)
+    rset.sync_pods()
+    alive = [
+        p for p in cs.pods.list("default", label_selector="job_type=worker,task_index=0")
+    ]
+    assert len(alive) == 2  # failed original + fresh replacement
+    assert any((p.get("status") or {}).get("phase") != "Failed" for p in alive)
+
+
+def test_sync_pods_does_not_replace_failed_in_whole_group_mode():
+    cs, _job, rset = make_set()
+    rset.sync_pods()
+    victim = cs.pods.list("default")[0]
+    victim["status"] = {"phase": "Failed"}
+    cs.pods.update("default", victim)
+    rset.sync_pods()
+    idx = victim["metadata"]["labels"]["task_index"]
+    same_idx = cs.pods.list("default", label_selector=f"task_index={idx}")
+    assert len(same_idx) == 1  # no silent replacement; group restart decides
+
+
+# --- delete ------------------------------------------------------------------
+
+def test_delete_removes_pods_and_services():
+    cs, _job, rset = make_set()
+    rset.sync_pods()
+    rset.sync_services()
+    rset.delete()
+    assert cs.pods.list("default") == []
+    assert cs.services.list("default") == []
+
+
+def test_delete_pods_for_attempt_keeps_services():
+    cs, _job, rset = make_set()
+    rset.sync_services()
+    rset.sync_pods(attempt=0)
+    rset.delete_pods_for_attempt(0)
+    assert cs.pods.list("default") == []
+    assert len(cs.services.list("default")) == 2
+
+
+# --- classifier tables (ref: replicas_test.go:212-368) -----------------------
+
+def pod_with(phase="Running", container_state=None, last_state=None,
+             name="p1", ts="2026-07-29T00:00:00Z", container="tpu"):
+    cstatus = {"name": container}
+    if container_state:
+        cstatus["state"] = container_state
+    if last_state:
+        cstatus["lastState"] = last_state
+    return {
+        "metadata": {"name": name, "creationTimestamp": ts},
+        "status": {"phase": phase, "containerStatuses": [cstatus]},
+    }
+
+
+CLASSIFIER_CASES = [
+    # (pods, expected)
+    ([], t.ReplicaState.STARTING),  # fixed: ref reported Running (replicas.go:358-360)
+    ([pod_with(phase="Pending")], t.ReplicaState.STARTING),
+    ([pod_with(container_state={"running": {}})], t.ReplicaState.RUNNING),
+    ([pod_with(phase="Succeeded",
+               container_state={"terminated": {"exitCode": 0}})], t.ReplicaState.SUCCEEDED),
+    # permanent failure: exit 1
+    ([pod_with(phase="Failed",
+               container_state={"terminated": {"exitCode": 1}})], t.ReplicaState.FAILED),
+    # retryable: exit 137 (SIGKILL) → replacement coming
+    ([pod_with(phase="Failed",
+               container_state={"terminated": {"exitCode": 137}})], t.ReplicaState.STARTING),
+    # OOMKilled never retryable even at exit 137 (training.go:183-192)
+    ([pod_with(phase="Failed",
+               container_state={"terminated": {"exitCode": 137, "reason": "OOMKilled"}})],
+     t.ReplicaState.FAILED),
+    # CrashLoopBackOff waiting + lastState override (replicas.go:372-388)
+    ([pod_with(container_state={"waiting": {"reason": "CrashLoopBackOff"}},
+               last_state={"terminated": {"exitCode": 1}})], t.ReplicaState.FAILED),
+    # waiting, never run
+    ([pod_with(container_state={"waiting": {"reason": "ContainerCreating"}})],
+     t.ReplicaState.STARTING),
+    # no tpu-named container status → fall back to pod phase
+    ([pod_with(phase="Running", container="other")], t.ReplicaState.RUNNING),
+]
+
+
+@pytest.mark.parametrize("pods,expected", CLASSIFIER_CASES)
+def test_replica_state_from_pod_list(pods, expected):
+    assert r.TPUReplicaSet.replica_state_from_pod_list(pods) == expected
+
+
+def test_classifier_uses_newest_pod():
+    # ref: replicas_test.go newest-pod case — old failed pod superseded
+    old = pod_with(phase="Failed", container_state={"terminated": {"exitCode": 1}},
+                   name="old", ts="2026-07-29T00:00:00Z")
+    new = pod_with(container_state={"running": {}}, name="new",
+                   ts="2026-07-29T01:00:00Z")
+    assert r.TPUReplicaSet.replica_state_from_pod_list([old, new]) == t.ReplicaState.RUNNING
+
+
+# --- status roll-up ----------------------------------------------------------
+
+def set_pod_state(cs, pod, phase, terminated=None):
+    pod["status"] = {
+        "phase": phase,
+        "containerStatuses": [
+            {"name": "tpu",
+             "state": {"terminated": terminated} if terminated else {"running": {}}}
+        ],
+    }
+    cs.pods.update("default", pod)
+
+
+def test_get_status_all_running():
+    cs, _job, rset = make_set()
+    rset.sync_pods()
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Running")
+    st = rset.get_status()
+    assert st.state == t.ReplicaState.RUNNING
+    assert st.replicas_states == {t.ReplicaState.RUNNING: 2}
+
+
+def test_get_status_mixed_failure_wins():
+    cs, _job, rset = make_set()
+    rset.sync_pods()
+    pods = cs.pods.list("default")
+    set_pod_state(cs, pods[0], "Running")
+    set_pod_state(cs, pods[1], "Failed", terminated={"exitCode": 1})
+    st = rset.get_status()
+    assert st.state == t.ReplicaState.FAILED
+
+
+def test_get_status_starting_before_pods_exist():
+    _cs, _job, rset = make_set()
+    st = rset.get_status()
+    assert st.state == t.ReplicaState.STARTING
+    assert st.replicas_states == {t.ReplicaState.STARTING: 2}

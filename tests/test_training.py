@@ -1,0 +1,389 @@
+"""TrainingJob lifecycle tests.
+
+Reference test model: pkg/trainer/training_test.go — exit-code tables
+(:31-87), ClusterSpec naming (:89-184), setup/defaulting outcomes (:186-344)
+— rebuilt to compile, plus the TPU-native gang/whole-group behaviors the
+reference never had.
+"""
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.client import errors
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.controller.events import EventRecorder
+from tpu_operator.trainer import policy
+from tpu_operator.trainer.training import TrainingJob
+from tests.test_types import make_template
+
+
+# --- exit-code contract tables (ref: training_test.go:31-87) -----------------
+
+EXIT_CASES = [
+    # (terminated_state, retryable, permanent, success)
+    (None, False, False, False),
+    ({"exitCode": 0}, False, False, True),
+    ({"exitCode": 1}, False, True, False),
+    ({"exitCode": 127}, False, True, False),
+    ({"exitCode": 128}, True, False, False),
+    ({"exitCode": 137}, True, False, False),
+    ({"exitCode": 255}, True, False, False),
+    # OOMKilled is never retryable, even with a "retryable" exit code
+    # (ref: training.go:183-192)
+    ({"exitCode": 137, "reason": "OOMKilled"}, False, True, False),
+    ({"exitCode": 0, "reason": "OOMKilled"}, False, False, False),
+]
+
+
+@pytest.mark.parametrize("term,retryable,permanent,success", EXIT_CASES)
+def test_exit_code_contract(term, retryable, permanent, success):
+    assert policy.is_retryable_termination_state(term) is retryable
+    assert policy.is_permanent_failure(term) is permanent
+    assert policy.is_success(term) is success
+
+
+# --- fixtures ----------------------------------------------------------------
+
+def worker_job(replicas=2, name="train", max_restarts=3):
+    return t.TPUJob(
+        metadata={"name": name, "namespace": "default", "uid": "uid-9"},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=replicas, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER)
+            ],
+            runtime_id="r1d2",
+            max_restarts=max_restarts,
+        ),
+    )
+
+
+def new_training_job(job=None):
+    cs = FakeClientset()
+    job = job or worker_job()
+    cs.tpujobs.create(job.namespace, job.to_dict())
+    recorder = EventRecorder(cs)
+    return cs, TrainingJob(cs, recorder, job)
+
+
+def set_container_state(cs, pod, phase, state=None, last_state=None):
+    cstatus = {"name": "tpu"}
+    if state is not None:
+        cstatus["state"] = state
+    if last_state is not None:
+        cstatus["lastState"] = last_state
+    pod["status"] = {"phase": phase, "containerStatuses": [cstatus]}
+    cs.pods.update("default", pod)
+
+
+def all_running(cs):
+    for p in cs.pods.list("default"):
+        set_container_state(cs, p, "Running", state={"running": {}})
+
+
+# --- setup (ref: training_test.go:186-344) -----------------------------------
+
+def test_setup_generates_runtime_id_and_phase():
+    cs, tj = new_training_job()
+    tj.job.spec.runtime_id = ""
+    tj.setup()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    assert len(tj.job.spec.runtime_id) == 4
+    assert tj.job.spec.termination_policy.chief_replica_name == "WORKER"
+
+
+def test_setup_skipped_when_phase_set():
+    # ref: training.go:220-223 — idempotent across operator restarts
+    cs, tj = new_training_job()
+    tj.job.status.phase = t.TPUJobPhase.RUNNING
+    tj.job.spec.runtime_id = "keep"
+    tj.setup()
+    assert tj.job.spec.runtime_id == "keep"
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+
+
+def test_setup_invalid_spec_fails_job_with_event():
+    job = worker_job()
+    job.spec.replica_specs[0].template = make_template(container_name="wrong")
+    cs, tj = new_training_job(job)
+    tj.setup()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "invalid job spec" in tj.job.status.reason
+    events = cs.events.list("default")
+    assert any(e["reason"] == "InvalidSpec" for e in events)
+
+
+# --- cluster spec (ref: training_test.go:89-184) -----------------------------
+
+def test_cluster_spec_names():
+    _cs, tj = new_training_job()
+    tj.setup()
+    assert tj.cluster_spec() == {
+        "worker": ["train-worker-r1d2-0:8476", "train-worker-r1d2-1:8476"]
+    }
+
+
+def test_cluster_spec_compat_roles():
+    job = t.TPUJob(
+        metadata={"name": "ps", "namespace": "default", "uid": "u"},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=1, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+                t.TPUReplicaSpec(replicas=2, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.SERVER),
+                t.TPUReplicaSpec(replicas=2, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER),
+            ],
+            runtime_id="q7",
+        ),
+    )
+    _cs, tj = new_training_job(job)
+    tj.setup()
+    spec = tj.cluster_spec()
+    assert spec["scheduler"] == ["ps-scheduler-q7-0:8476"]
+    assert spec["server"] == ["ps-server-q7-0:8476", "ps-server-q7-1:8476"]
+    assert len(spec["worker"]) == 2
+
+
+# --- reconcile lifecycle -----------------------------------------------------
+
+def test_reconcile_creates_children_and_transitions():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    # services: 2 per-index + 1 headless; pods: 2 workers
+    assert len(cs.services.list("default")) == 3
+    assert len(cs.pods.list("default")) == 2
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+
+    # pods come up → RUNNING
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.state == t.State.RUNNING
+
+    # CRD status was written back (ref: training.go:326-343)
+    stored = cs.tpujobs.get("default", "train")
+    assert stored["status"]["phase"] == t.TPUJobPhase.RUNNING
+    assert stored["spec"]["runtimeId"] == "r1d2"
+
+
+def test_reconcile_headless_service_spec():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    svc = cs.services.get("default", "train-r1d2")
+    assert svc["spec"]["clusterIP"] == "None"
+    assert svc["spec"]["selector"]["job_name"] == "train"
+
+
+def test_reconcile_success_path():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    # chief (worker 0) exits 0; others too
+    for p in cs.pods.list("default"):
+        set_container_state(cs, p, "Succeeded", state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+    assert tj.job.status.state == t.State.SUCCEEDED
+    # pods retained for kubectl logs (tf_job_design_doc.md:86)
+    assert len(cs.pods.list("default")) == 2
+    assert any(e["reason"] == "JobSucceeded" for e in cs.events.list("default"))
+
+
+def test_reconcile_permanent_failure_fails_job():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    victim = cs.pods.list("default")[0]
+    set_container_state(cs, victim, "Failed", state={"terminated": {"exitCode": 1}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert tj.job.status.state == t.State.FAILED
+    assert any(e["reason"] == "JobFailed" for e in cs.events.list("default"))
+
+
+def test_reconcile_oom_never_retried():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    victim = cs.pods.list("default")[0]
+    set_container_state(cs, victim, "Failed",
+                        state={"terminated": {"exitCode": 137, "reason": "OOMKilled"}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert tj.job.status.attempt == 0  # no group restart burned
+
+
+# --- whole-group restart (TPU-native) ----------------------------------------
+
+def test_group_restart_on_retryable_death():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    gen0 = {p["metadata"]["name"] for p in cs.pods.list("default")}
+    victim = cs.pods.list("default")[0]
+    # preemption: SIGKILL → exit 137, no OOM
+    set_container_state(cs, victim, "Failed", state={"terminated": {"exitCode": 137}})
+    tj.reconcile()
+    assert tj.job.status.attempt == 1
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    assert any(e["reason"] == "GroupRestart" for e in cs.events.list("default"))
+    # old generation gone
+    assert all(p["metadata"]["name"] not in gen0 for p in cs.pods.list("default"))
+
+    # next reconcile creates attempt-1 pods for every index
+    tj.reconcile()
+    pods = cs.pods.list("default")
+    assert len(pods) == 2
+    assert all(p["metadata"]["labels"]["attempt"] == "1" for p in pods)
+    # env reflects the attempt
+    env = {e["name"]: e["value"]
+           for e in pods[0]["spec"]["containers"][0]["env"]}
+    assert env["TPUJOB_ATTEMPT"] == "1"
+
+
+def test_group_restart_on_eviction_without_container_status():
+    """Kubelet-level eviction (no containerStatuses at all) is routine TPU
+    preemption and must burn a group restart, not fail the job."""
+    cs, tj = new_training_job()
+    tj.reconcile()
+    victim = cs.pods.list("default")[0]
+    victim["status"] = {"phase": "Failed", "reason": "Evicted",
+                        "message": "node is being preempted"}
+    cs.pods.update("default", victim)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    assert tj.job.status.attempt == 1
+
+
+def test_permanent_failure_frees_live_pods():
+    """A permanently-failed group must not strand the slice: still-running
+    pods are deleted; terminated pods are kept for their logs."""
+    cs, tj = new_training_job(worker_job(replicas=3))
+    tj.reconcile()
+    pods = cs.pods.list("default")
+    set_container_state(cs, pods[0], "Failed", state={"terminated": {"exitCode": 1}})
+    set_container_state(cs, pods[1], "Running", state={"running": {}})
+    set_container_state(cs, pods[2], "Running", state={"running": {}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    remaining = cs.pods.list("default")
+    assert len(remaining) == 1  # only the failed pod's logs survive
+    assert remaining[0]["status"]["phase"] == "Failed"
+
+
+def test_group_restart_budget_exhausted():
+    cs, tj = new_training_job(worker_job(max_restarts=1))
+    tj.reconcile()
+    for round_ in range(2):
+        victim = cs.pods.list("default")[0]
+        set_container_state(cs, victim, "Failed",
+                            state={"terminated": {"exitCode": 137}})
+        tj.reconcile()
+        tj.reconcile()  # recreate next generation if restarted
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "retry budget exhausted" in tj.job.status.reason
+
+
+def test_per_pod_mode_no_group_restart():
+    # compat spec: retryable failure handled by pod recreation, not teardown
+    job = t.TPUJob(
+        metadata={"name": "ps", "namespace": "default", "uid": "u"},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=1, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+                t.TPUReplicaSpec(replicas=2, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER),
+            ],
+            runtime_id="q7",
+        ),
+    )
+    cs, tj = new_training_job(job)
+    tj.reconcile()
+    assert tj.job.spec.restart_policy == t.RestartPolicy.PER_POD
+    n_before = len(cs.pods.list("default"))
+    victim = next(p for p in cs.pods.list("default")
+                  if p["metadata"]["labels"]["job_type"] == "worker")
+    victim["status"] = {"phase": "Failed"}
+    cs.pods.update("default", victim)
+    tj.reconcile()
+    assert tj.job.status.attempt == 0
+    assert len(cs.pods.list("default")) == n_before + 1  # replacement created
+
+
+def test_refresh_keeps_in_memory_status_over_stale_cache():
+    """Regression: the informer cache lags the operator's own status writes;
+    refresh() must not regress the attempt counter or phase (found by
+    driving the live control loop — group restart raced back to attempt 0)."""
+    cs, tj = new_training_job()
+    tj.reconcile()
+    victim = cs.pods.list("default")[0]
+    set_container_state(cs, victim, "Failed", state={"terminated": {"exitCode": 137}})
+    tj.reconcile()
+    assert tj.job.status.attempt == 1
+
+    # Stale cached copy: status from before the restart, spec from before setup
+    stale = worker_job()
+    stale.spec.runtime_id = ""
+    stale.status.attempt = 0
+    stale.status.phase = t.TPUJobPhase.RUNNING
+    tj.refresh(stale)
+    assert tj.job.status.attempt == 1          # in-memory status kept
+    assert tj.job.spec.runtime_id == "r1d2"    # stale empty runtimeId repaired
+    assert tj.job.spec.restart_policy == t.RestartPolicy.WHOLE_GROUP  # defaults re-applied
+    tj.reconcile()  # must create attempt-1 generation, not resurrect attempt 0
+    pods = cs.pods.list("default", label_selector="job_name=train,attempt=1")
+    assert len(pods) == 2
+
+
+# --- gang creation -----------------------------------------------------------
+
+class QuotaLimitedPods:
+    """Wraps the fake pods client to fail after N creates (simulates a full
+    TPU slice / quota rejection)."""
+
+    def __init__(self, inner, allow):
+        self._inner = inner
+        self._allow = allow
+
+    def create(self, namespace, obj):
+        if self._allow <= 0:
+            raise errors.ApiError(403, "Forbidden", "quota exceeded")
+        self._allow -= 1
+        return self._inner.create(namespace, obj)
+
+    def __getattr__(self, name):
+        return getattr(self._inner, name)
+
+
+def test_gang_create_rolls_back_partial_generation():
+    cs, tj = new_training_job(worker_job(replicas=4))
+    cs.pods = QuotaLimitedPods(cs.pods, allow=2)
+    with pytest.raises(errors.ApiError):
+        tj.reconcile()
+    # nothing stranded: the two created pods were rolled back
+    assert cs.pods.list("default") == []
+    assert any(e["reason"] == "GangCreateFailed" for e in cs.events.list("default"))
+
+
+# --- delete (ref: training.go:305-323) ---------------------------------------
+
+def test_delete_removes_children_and_marks_done():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    assert cs.pods.list("default")
+    tj.delete()
+    assert cs.pods.list("default") == []
+    assert cs.services.list("default") == []
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+
+
+def test_reconcile_cleanup_phase_deletes_then_done():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    tj.job.status.phase = t.TPUJobPhase.CLEANUP
+    tj.reconcile()
+    assert cs.pods.list("default") == []
+    assert tj.job.status.phase == t.TPUJobPhase.DONE

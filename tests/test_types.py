@@ -1,0 +1,103 @@
+"""Round-trip and schema tests for the v1alpha1 API types
+(ref test model: pkg/apis tests, SURVEY.md §4 tier-1 tables)."""
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+
+
+def make_template(container_name=t.DEFAULT_CONTAINER_NAME, tpu_chips=None):
+    container = {"name": container_name, "image": "img:latest"}
+    if tpu_chips is not None:
+        container["resources"] = {"limits": {"cloud-tpus.google.com/v4": tpu_chips}}
+    return {"spec": {"containers": [container], "restartPolicy": "OnFailure"}}
+
+
+def make_spec(**kw):
+    spec = t.TPUJobSpec(
+        replica_specs=[
+            t.TPUReplicaSpec(
+                replicas=2,
+                template=make_template(),
+                tpu_port=t.DEFAULT_TPU_PORT,
+                tpu_replica_type=t.TPUReplicaType.WORKER,
+            )
+        ]
+    )
+    for k, v in kw.items():
+        setattr(spec, k, v)
+    return spec
+
+
+def test_job_roundtrip():
+    job = t.TPUJob(
+        metadata={"name": "mnist", "namespace": "team-a", "uid": "u-123"},
+        spec=make_spec(runtime_id="a1b2"),
+    )
+    job.status.phase = t.TPUJobPhase.RUNNING
+    job.status.attempt = 2
+    wire = job.to_dict()
+    assert wire["apiVersion"] == "tpuoperator.dev/v1alpha1"
+    assert wire["kind"] == "TPUJob"
+
+    back = t.TPUJob.from_dict(wire)
+    assert back.name == "mnist"
+    assert back.namespace == "team-a"
+    assert back.uid == "u-123"
+    assert back.spec.runtime_id == "a1b2"
+    assert back.spec.replica_specs[0].replicas == 2
+    assert back.status.phase == t.TPUJobPhase.RUNNING
+    assert back.status.attempt == 2
+    assert back.to_dict() == wire
+
+
+def test_deepcopy_isolation():
+    job = t.TPUJob(metadata={"name": "j"}, spec=make_spec())
+    cp = job.deepcopy()
+    cp.spec.replica_specs[0].template["spec"]["containers"][0]["image"] = "other"
+    cp.metadata["name"] = "changed"
+    assert job.spec.replica_specs[0].template["spec"]["containers"][0]["image"] == "img:latest"
+    assert job.name == "j"
+
+
+def test_replica_status_roundtrip():
+    st = t.TPUJobStatus(
+        phase=t.TPUJobPhase.CREATING,
+        state=t.State.RUNNING,
+        replica_statuses=[
+            t.TPUReplicaStatus(
+                tpu_replica_type=t.TPUReplicaType.WORKER,
+                state=t.ReplicaState.RUNNING,
+                replicas_states={t.ReplicaState.RUNNING: 3, t.ReplicaState.STARTING: 1},
+            )
+        ],
+    )
+    back = t.TPUJobStatus.from_dict(st.to_dict())
+    assert back.replica_statuses[0].replicas_states[t.ReplicaState.RUNNING] == 3
+
+
+def test_controller_config_from_dict_map_and_list_env():
+    cfg = t.ControllerConfig.from_dict(
+        {
+            "accelerators": {
+                "cloud-tpus.google.com/v4": {
+                    "envVars": {"TPU_RUNTIME": "tpu-vm"},
+                },
+                "alpha.kubernetes.io/nvidia-gpu": {
+                    "volumes": [
+                        {"name": "lib", "hostPath": "/usr/lib/nvidia", "mountPath": "/usr/local/nvidia/lib64"}
+                    ],
+                    "envVars": [{"name": "LD_LIBRARY_PATH", "value": "/usr/local/nvidia/lib64"}],
+                },
+            }
+        }
+    )
+    assert cfg.accelerators["cloud-tpus.google.com/v4"].env_vars == {"TPU_RUNTIME": "tpu-vm"}
+    gpu = cfg.accelerators["alpha.kubernetes.io/nvidia-gpu"]
+    assert gpu.volumes[0].mount_path == "/usr/local/nvidia/lib64"
+    assert gpu.env_vars["LD_LIBRARY_PATH"] == "/usr/local/nvidia/lib64"
+
+
+def test_termination_policy_default_none():
+    assert t.TerminationPolicySpec.from_dict(None) is None
+    assert t.TerminationPolicySpec.from_dict({}) is None
+    tp = t.TerminationPolicySpec.from_dict({"chief": {"replicaName": "SCHEDULER", "replicaIndex": 0}})
+    assert tp.chief_replica_name == "SCHEDULER"

@@ -1,0 +1,100 @@
+"""Rate-limited workqueue semantics tests (client-go-equivalent behavior the
+reference depended on but never tested; backoff constants from
+controller.go:60-63)."""
+
+from tpu_operator.client.workqueue import RateLimitingQueue
+
+
+class FakeClock:
+    def __init__(self):
+        self.now = 0.0
+
+    def __call__(self):
+        return self.now
+
+    def advance(self, dt):
+        self.now += dt
+
+
+def make_queue():
+    clock = FakeClock()
+    return clock, RateLimitingQueue(base_delay=10.0, max_delay=360.0, clock=clock)
+
+
+def test_add_get_done():
+    _clock, q = make_queue()
+    q.add("a")
+    q.add("b")
+    assert q.get(timeout=0) == "a"
+    assert q.get(timeout=0) == "b"
+    assert q.get(timeout=0) is None
+
+
+def test_dedup_while_queued():
+    _clock, q = make_queue()
+    q.add("a")
+    q.add("a")
+    assert q.get(timeout=0) == "a"
+    assert q.get(timeout=0) is None
+
+
+def test_readd_while_processing_requeues_after_done():
+    # The invariant that makes concurrent reconciles of one key impossible.
+    _clock, q = make_queue()
+    q.add("a")
+    item = q.get(timeout=0)
+    q.add("a")  # event arrives mid-reconcile
+    assert q.get(timeout=0) is None  # not handed out again yet
+    q.done(item)
+    assert q.get(timeout=0) == "a"  # re-delivered exactly once
+
+
+def test_rate_limited_backoff_progression():
+    clock, q = make_queue()
+    q.add_rate_limited("a")  # 10s
+    assert q.get(timeout=0) is None
+    clock.advance(10.1)
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+
+    q.add_rate_limited("a")  # 20s now
+    clock.advance(10.1)
+    assert q.get(timeout=0) is None
+    clock.advance(10.1)
+    assert q.get(timeout=0) == "a"
+    q.done("a")
+
+    assert q.num_requeues("a") == 2
+    q.forget("a")
+    assert q.num_requeues("a") == 0
+    q.add_rate_limited("a")  # back to 10s
+    clock.advance(10.1)
+    assert q.get(timeout=0) == "a"
+
+
+def test_backoff_capped_at_max():
+    clock, q = make_queue()
+    for _ in range(10):  # 10 * 2^9 = 5120s uncapped
+        q.add_rate_limited("a")
+        clock.advance(400.0)
+        assert q.get(timeout=0) == "a"
+        q.done("a")
+    q.add_rate_limited("a")
+    clock.advance(360.1)  # capped at 360s
+    assert q.get(timeout=0) == "a"
+
+
+def test_add_after():
+    clock, q = make_queue()
+    q.add_after("x", 5.0)
+    assert q.get(timeout=0) is None
+    clock.advance(5.1)
+    assert q.get(timeout=0) == "x"
+
+
+def test_shutdown_unblocks():
+    _clock, q = make_queue()
+    q.shutdown()
+    assert q.get(timeout=None) is None
+    q.add("a")  # ignored after shutdown
+    assert q.get(timeout=0) is None

@@ -1,0 +1,40 @@
+"""tpu_operator — a TPU-native Kubernetes job operator.
+
+A brand-new implementation of the capability set of the reference
+mx-operator (StefanoFioravanzo/tf-operator): a ``TPUJob`` custom resource
+plus a reconciling controller that turns a declarative replica spec into
+pods and discovery services, forms a single JAX multi-controller process
+group over a TPU pod slice, and manages the full job lifecycle.
+
+Where the reference (pure Go, ``pkg/...``) bootstraps MXNet parameter-server
+topologies through ``DMLC_*`` environment variables, this operator bootstraps
+JAX/XLA process groups over TPU ICI/DCN: replica pods request
+``cloud-tpus.google.com/v*`` chips and receive ``jax.distributed`` coordinator
+env (``JAX_COORDINATOR_ADDRESS``, ``TPU_WORKER_ID``, ``TPU_WORKER_HOSTNAMES``,
+megascale DCN discovery vars). Collective bytes ride the TPU fabric itself,
+so — exactly like the reference — the operator's communication surface is
+bootstrap-only.
+
+Layer map (mirrors SURVEY.md §1 for the reference):
+
+- ``apis/``       CRD schema, defaults, validation, helpers
+                  (ref: pkg/apis/mxnet/{v1alpha1,validation,helper})
+- ``client/``     REST client, typed clientset, informers, workqueue, fakes
+                  (ref: pkg/client/** generated stack — hand-built here)
+- ``controller/`` reconcile engine, leader election, event recording
+                  (ref: pkg/controller/controller.go, cmd/.../server.go)
+- ``trainer/``    job domain logic: TrainingJob lifecycle + TPUReplicaSet
+                  (ref: pkg/trainer/{training,replicas,labels}.go)
+- ``util/``       tracing, naming, kubeconfig resolution
+                  (ref: pkg/util/**, go-tracey)
+- ``payload/``    the data plane the reference keeps in user images:
+                  JAX bootstrap + reference workloads (linear regression,
+                  data-parallel CIFAR-10 ResNet on a device mesh)
+- ``cmd/``        process entry: flags, server bootstrap, leader election
+                  (ref: cmd/mx-operator/**)
+- ``testing/``    in-process fake apiserver (envtest-style tier)
+"""
+
+from tpu_operator.version import VERSION
+
+__version__ = VERSION

@@ -1,0 +1,105 @@
+"""Helpers shared by the trainer and controller.
+
+Reference parity: pkg/apis/mxnet/helper/helpers.go:
+- ``as_owner`` ← AsOwner (helpers.go:40-52): OwnerReference stamped on every
+  child pod/service so Kubernetes garbage collection cascades deletes.
+- ``configure_accelerators`` ← ConfigureAcceleratorsForTFJobSpec
+  (helpers.go:55-110): match container resource requests/limits against the
+  admin accelerator map; inject volumes and env.
+- ``crd_name`` lives in register.py.
+
+TPU-native additions: ``tpu_chips_requested`` (counts
+``cloud-tpus.google.com/*`` requests) and topology env derivation used by the
+replica env injection.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    ControllerConfig,
+    TPU_RESOURCE_PREFIX,
+    TPUJobSpec,
+)
+
+
+def as_owner(job_metadata: Dict[str, Any]) -> Dict[str, Any]:
+    """Build the controller OwnerReference for a TPUJob's children
+    (ref: helpers.go:40-52; BlockOwnerDeletion=true as in the reference)."""
+    from tpu_operator.apis.tpujob.v1alpha1.types import CRD_API_VERSION, CRD_KIND
+
+    return {
+        "apiVersion": CRD_API_VERSION,
+        "kind": CRD_KIND,
+        "name": job_metadata.get("name", ""),
+        "uid": job_metadata.get("uid", ""),
+        "controller": True,
+        "blockOwnerDeletion": True,
+    }
+
+
+def _container_accelerator_names(container: Dict[str, Any], config: ControllerConfig):
+    """Resource names in this container's requests/limits that appear in the
+    admin accelerator map (ref: helpers.go:62-83 scans both maps)."""
+    resources = container.get("resources") or {}
+    names = []
+    for section in ("requests", "limits"):
+        for res_name in (resources.get(section) or {}):
+            if res_name in config.accelerators and res_name not in names:
+                names.append(res_name)
+    return names
+
+
+def configure_accelerators(spec: TPUJobSpec, config: ControllerConfig) -> None:
+    """Inject admin-configured volumes/env for matched accelerator resources
+    (ref: helpers.go:55-110).
+
+    The reference appends hostPath volumes + mounts + env for GPU resources;
+    for TPU resource names the recipe is usually env-only (topology vars),
+    but both paths are supported uniformly.
+    """
+    if not config.accelerators:
+        return
+    for rs in spec.replica_specs:
+        template = rs.template
+        if not template:
+            continue
+        pod_spec = template.setdefault("spec", {})
+        for container in pod_spec.get("containers") or []:
+            for res_name in _container_accelerator_names(container, config):
+                acc = config.accelerators[res_name]
+                # Volumes (ref: helpers.go:84-100)
+                for vol in acc.volumes:
+                    pod_spec.setdefault("volumes", []).append(
+                        {"name": vol.name, "hostPath": {"path": vol.host_path}}
+                    )
+                    container.setdefault("volumeMounts", []).append(
+                        {"name": vol.name, "mountPath": vol.mount_path}
+                    )
+                # Env (ref: helpers.go:101-106)
+                env = container.setdefault("env", [])
+                existing = {e.get("name") for e in env}
+                for k, v in acc.env_vars.items():
+                    if k not in existing:
+                        env.append({"name": k, "value": v})
+
+
+def tpu_chips_requested(template: Dict[str, Any] | None) -> int:
+    """Total ``cloud-tpus.google.com/*`` chips requested by a pod template
+    (TPU-native; the analogue of the reference's GPU-resource scan,
+    helpers.go:62-83)."""
+    total = 0
+    pod_spec = (template or {}).get("spec") or {}
+    for container in pod_spec.get("containers") or []:
+        resources = container.get("resources") or {}
+        merged: Dict[str, Any] = {}
+        merged.update(resources.get("requests") or {})
+        merged.update(resources.get("limits") or {})  # limits win, like kube
+        for res_name, qty in merged.items():
+            if res_name.startswith(TPU_RESOURCE_PREFIX):
+                try:
+                    total += int(qty)
+                except (TypeError, ValueError):
+                    pass
+    return total

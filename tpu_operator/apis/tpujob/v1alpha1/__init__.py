@@ -1,0 +1,1 @@
+from tpu_operator.apis.tpujob.v1alpha1.types import *  # noqa: F401,F403

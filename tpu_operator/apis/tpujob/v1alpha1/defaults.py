@@ -1,0 +1,65 @@
+"""Defaulting for TPUJob specs.
+
+Reference parity: the reference applies defaults inside ``TrainingJob.setup``
+via an inline closure (pkg/trainer/training.go:229-261): replica count
+defaults to 1, port defaults to 9000, replica type defaults to the job kind's
+worker, and the termination policy defaults to chief = SCHEDULER replica 0
+(training.go:252-257). Hoisted into a standalone, idempotent function here so
+it is unit-testable on its own (the reference's closure shape made it
+untestable without a full TrainingJob).
+"""
+
+from __future__ import annotations
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_TPU_PORT,
+    DEFAULT_TPU_REPLICAS,
+    RestartPolicy,
+    TerminationPolicySpec,
+    TPUJobSpec,
+    TPUReplicaType,
+)
+
+
+def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
+    """Fill unset fields in place and return the spec.
+
+    Chief defaulting (ref: training.go:252-257): if a SCHEDULER replica set
+    exists the chief is SCHEDULER[0] (compat mode); otherwise — the
+    TPU-native scheduler-less case — the chief is WORKER[0], whose pod also
+    hosts the jax.distributed coordinator.
+
+    Restart-policy defaulting (TPU-native): WORKER-only jobs default to
+    WHOLE_GROUP (a JAX process group cannot lose a member); specs containing
+    SCHEDULER/SERVER roles default to PER_POD, matching the reference's
+    per-pod recreate behavior (replicas.go:497-525).
+    """
+    roles = set()
+    for rs in spec.replica_specs:
+        if not rs.tpu_replica_type:
+            rs.tpu_replica_type = TPUReplicaType.WORKER
+        rs.tpu_replica_type = rs.tpu_replica_type.upper()
+        roles.add(rs.tpu_replica_type)
+        if not rs.replicas or rs.replicas < 1:
+            rs.replicas = DEFAULT_TPU_REPLICAS
+        if rs.tpu_port is None:
+            rs.tpu_port = DEFAULT_TPU_PORT
+
+    if spec.termination_policy is None:
+        if TPUReplicaType.SCHEDULER in roles:
+            chief = TPUReplicaType.SCHEDULER
+        else:
+            chief = TPUReplicaType.WORKER
+        spec.termination_policy = TerminationPolicySpec(
+            chief_replica_name=chief, chief_replica_index=0
+        )
+
+    if not spec.restart_policy:
+        ps_mode = bool(roles & {TPUReplicaType.SCHEDULER, TPUReplicaType.SERVER})
+        spec.restart_policy = RestartPolicy.PER_POD if ps_mode else RestartPolicy.WHOLE_GROUP
+
+    if spec.max_restarts < 0:
+        spec.max_restarts = 0
+    if spec.num_slices < 1:
+        spec.num_slices = 1
+    return spec

@@ -1,0 +1,43 @@
+"""Scheme registration: GroupVersionKind ↔ Python type mapping.
+
+Reference parity: pkg/apis/mxnet/v1alpha1/register.go:27-68 (SchemeBuilder,
+GroupVersion, addKnownTypes) — the Go scheme machinery exists to let generic
+client code decode wire objects into typed structs; this module is the
+Python equivalent used by the clientset and the fake apiserver.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Dict, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as v1alpha1
+
+# (apiVersion, kind) -> decoder
+_SCHEME: Dict[Tuple[str, str], Callable[[Dict[str, Any]], Any]] = {}
+
+
+def add_known_type(api_version: str, kind: str, decoder: Callable[[Dict[str, Any]], Any]) -> None:
+    _SCHEME[(api_version, kind)] = decoder
+
+
+def decode(obj: Dict[str, Any]) -> Any:
+    """Decode a wire dict into its registered type; returns the dict
+    unchanged for unregistered kinds (raw passthrough, like runtime.Unknown)."""
+    key = (obj.get("apiVersion", ""), obj.get("kind", ""))
+    dec = _SCHEME.get(key)
+    return dec(obj) if dec else obj
+
+
+def group_version() -> str:
+    return v1alpha1.CRD_API_VERSION
+
+
+def crd_name() -> str:
+    """Full CRD name ``tpujobs.tpuoperator.dev``
+    (ref: helper/helpers.go:120-123 CRDName)."""
+    return f"{v1alpha1.CRD_KIND_PLURAL}.{v1alpha1.CRD_GROUP}"
+
+
+# Register known types (ref: register.go:55-66 addKnownTypes registers
+# MXJob and MXJobList).
+add_known_type(v1alpha1.CRD_API_VERSION, v1alpha1.CRD_KIND, v1alpha1.TPUJob.from_dict)

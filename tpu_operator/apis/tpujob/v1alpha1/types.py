@@ -1,0 +1,434 @@
+"""TPUJob CRD schema (group ``tpuoperator.dev``, version ``v1alpha1``).
+
+Reference parity: pkg/apis/mxnet/v1alpha1/types.go (entire file).
+The reference defines one CRD, ``MXJob`` (types.go:41-104), with replica
+specs typed SCHEDULER/SERVER/WORKER (types.go:78-82), a chief-based
+termination policy (types.go:65-73), job phases (types.go:106-115), job and
+replica states (types.go:117-155), and an admin ``ControllerConfig`` mapping
+accelerator resource names to injected volumes/env (types.go:170-196).
+
+This file is the TPU-native re-design, not a translation:
+
+- Replica pods request ``cloud-tpus.google.com/v*`` chips; the admin config
+  maps TPU resource names to **topology env injection** (``TPUAcceleratorConfig``)
+  instead of the reference's CUDA hostPath mounts (types.go:182-196).
+- The default port is the JAX distributed-coordinator port (8476), replacing
+  the MXNet PS-Lite port 9000 (types.go:30).
+- WORKER-only ("scheduler-less") jobs are first-class: a pure JAX
+  multi-controller group needs no SCHEDULER/SERVER roles. Those roles remain
+  accepted for compatibility with reference-shaped specs.
+- Pod templates are raw Kubernetes ``PodTemplateSpec`` dicts — we keep the
+  reference's "don't hide Kubernetes" design decision
+  (tf_job_design_doc.md:73).
+
+Everything round-trips through plain dicts (``to_dict``/``from_dict``) because
+the wire format is JSON against the apiserver.
+"""
+
+from __future__ import annotations
+
+import copy
+from dataclasses import dataclass, field
+from typing import Any, Dict, List, Optional
+
+# --- Constants (ref: types.go:22-32) ---------------------------------------
+
+CRD_KIND = "TPUJob"
+CRD_KIND_PLURAL = "tpujobs"
+CRD_GROUP = "tpuoperator.dev"
+CRD_VERSION = "v1alpha1"
+CRD_API_VERSION = f"{CRD_GROUP}/{CRD_VERSION}"
+
+# The container that receives coordinator env injection must have this name,
+# mirroring the reference's requirement of a container named "mxnet"
+# (validation.go:68-76, replicas.go:235-260).
+DEFAULT_CONTAINER_NAME = "tpu"
+
+# Default rendezvous port: jax.distributed coordinator (libtpu convention),
+# replacing the reference's MXNet PS port 9000 (types.go:30).
+DEFAULT_TPU_PORT = 8476
+
+# Label keys stamped on every child pod/service (ref: replicas.go:120-129
+# uses "fioravanzo.org=", "job_type=", "runtime_id=", "task_index=").
+LABEL_GROUP_KEY = CRD_GROUP
+LABEL_JOB_NAME = "job_name"
+LABEL_JOB_TYPE = "job_type"
+LABEL_RUNTIME_ID = "runtime_id"
+LABEL_TASK_INDEX = "task_index"
+LABEL_ATTEMPT = "attempt"
+
+# TPU resource-name prefix (the analogue of "alpha.kubernetes.io/nvidia-gpu").
+TPU_RESOURCE_PREFIX = "cloud-tpus.google.com/"
+
+
+# --- Replica types (ref: types.go:78-87) -----------------------------------
+
+class TPUReplicaType:
+    """Roles a replica set can take.
+
+    WORKER is the TPU-native role: every worker is one JAX process in a
+    single multi-controller group. SCHEDULER and SERVER are accepted for
+    compatibility with reference-shaped parameter-server specs
+    (ref: types.go:78-82); in that mode the SCHEDULER doubles as the JAX
+    coordinator and SERVERs join the group as ordinary processes.
+    """
+
+    SCHEDULER = "SCHEDULER"
+    SERVER = "SERVER"
+    WORKER = "WORKER"
+
+    ALL = (SCHEDULER, SERVER, WORKER)
+
+
+DEFAULT_TPU_REPLICAS = 1  # ref: types.go:84-87 (Replicas default 1)
+
+
+# --- Phases and states (ref: types.go:106-155) ------------------------------
+
+class TPUJobPhase:
+    NONE = ""
+    CREATING = "Creating"
+    RUNNING = "Running"
+    CLEANUP = "CleanUp"
+    FAILED = "Failed"
+    DONE = "Done"
+
+
+class State:
+    UNKNOWN = "Unknown"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+class ReplicaState:
+    UNKNOWN = "Unknown"
+    STARTING = "Starting"
+    RUNNING = "Running"
+    SUCCEEDED = "Succeeded"
+    FAILED = "Failed"
+
+
+# --- Restart / gang policy (TPU-native addition) ----------------------------
+
+class RestartPolicy:
+    """Group-level restart semantics.
+
+    The reference delegates restart to each pod's own ``restartPolicy`` and
+    recreates fully-failed pods one at a time (replicas.go:497-525). A JAX
+    multi-controller group cannot survive a single member dying — any process
+    loss requires restarting the whole group (SURVEY.md §5 failure notes).
+    ``WHOLE_GROUP`` (the default for WORKER-only jobs) therefore tears down
+    and recreates every replica on a retryable failure, bumping the attempt
+    counter; ``PER_POD`` preserves the reference behavior for compat specs.
+    """
+
+    WHOLE_GROUP = "WholeGroup"
+    PER_POD = "PerPod"
+
+    ALL = (WHOLE_GROUP, PER_POD)
+
+
+# --- Spec types -------------------------------------------------------------
+
+@dataclass
+class TerminationPolicySpec:
+    """Which replica decides job completion (ref: types.go:65-76)."""
+
+    chief_replica_name: str = TPUReplicaType.WORKER
+    chief_replica_index: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "chief": {
+                "replicaName": self.chief_replica_name,
+                "replicaIndex": self.chief_replica_index,
+            }
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> Optional["TerminationPolicySpec"]:
+        if not d or "chief" not in d:
+            return None
+        chief = d["chief"] or {}
+        return cls(
+            chief_replica_name=chief.get("replicaName", TPUReplicaType.WORKER),
+            chief_replica_index=int(chief.get("replicaIndex", 0)),
+        )
+
+
+@dataclass
+class TPUReplicaSpec:
+    """One replica set: N pods of one role (ref: types.go:93-104).
+
+    ``template`` is a raw Kubernetes PodTemplateSpec dict, passed through to
+    created pods (the reference embeds v1.PodTemplateSpec the same way).
+    ``tpu_port`` is the rendezvous port the coordinator listens on.
+    """
+
+    replicas: int = DEFAULT_TPU_REPLICAS
+    template: Optional[Dict[str, Any]] = None
+    tpu_port: Optional[int] = DEFAULT_TPU_PORT
+    tpu_replica_type: str = TPUReplicaType.WORKER
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "replicas": self.replicas,
+            "template": self.template,
+            "tpuPort": self.tpu_port,
+            "tpuReplicaType": self.tpu_replica_type,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUReplicaSpec":
+        # An explicit ``tpuPort: null`` on the wire is kept as None so
+        # defaulting (set_defaults) and validation see what the user wrote.
+        port = d["tpuPort"] if "tpuPort" in d else DEFAULT_TPU_PORT
+        return cls(
+            replicas=int(d.get("replicas", DEFAULT_TPU_REPLICAS)),
+            template=copy.deepcopy(d.get("template")),
+            tpu_port=port,
+            tpu_replica_type=str(d.get("tpuReplicaType", TPUReplicaType.WORKER)),
+        )
+
+
+@dataclass
+class TPUJobSpec:
+    """Job spec (ref: types.go:54-63).
+
+    ``runtime_id`` is generated once at setup and persisted so child-resource
+    names stay stable across operator restarts (ref: training.go:272-274).
+    ``scheduler_name`` passes through to pods (ref: types.go:61-62 →
+    replicas.go:178). ``restart_policy`` and ``max_restarts`` are TPU-native
+    additions for whole-group restart semantics.
+    """
+
+    replica_specs: List[TPUReplicaSpec] = field(default_factory=list)
+    termination_policy: Optional[TerminationPolicySpec] = None
+    runtime_id: str = ""
+    scheduler_name: str = ""
+    restart_policy: str = ""
+    max_restarts: int = 3
+    # TPU slice topology hint, e.g. "2x2x4" for v4-32; injected as
+    # TPU_TOPOLOGY when set (multislice jobs also get MEGASCALE_* vars).
+    tpu_topology: str = ""
+    num_slices: int = 1
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {
+            "replicaSpecs": [r.to_dict() for r in self.replica_specs],
+        }
+        if self.termination_policy is not None:
+            d["terminationPolicy"] = self.termination_policy.to_dict()
+        if self.runtime_id:
+            d["runtimeId"] = self.runtime_id
+        if self.scheduler_name:
+            d["schedulerName"] = self.scheduler_name
+        if self.restart_policy:
+            d["restartPolicy"] = self.restart_policy
+        d["maxRestarts"] = self.max_restarts
+        if self.tpu_topology:
+            d["tpuTopology"] = self.tpu_topology
+        if self.num_slices != 1:
+            d["numSlices"] = self.num_slices
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJobSpec":
+        return cls(
+            replica_specs=[TPUReplicaSpec.from_dict(r) for r in d.get("replicaSpecs", [])],
+            termination_policy=TerminationPolicySpec.from_dict(d.get("terminationPolicy")),
+            runtime_id=str(d.get("runtimeId", "")),
+            scheduler_name=str(d.get("schedulerName", "")),
+            restart_policy=str(d.get("restartPolicy", "")),
+            max_restarts=int(d.get("maxRestarts", 3)),
+            tpu_topology=str(d.get("tpuTopology", "")),
+            num_slices=int(d.get("numSlices", 1)),
+        )
+
+
+# --- Status types (ref: types.go:117-155) -----------------------------------
+
+@dataclass
+class TPUReplicaStatus:
+    """Status of one replica set (ref: types.go:137-149)."""
+
+    tpu_replica_type: str = TPUReplicaType.WORKER
+    state: str = ReplicaState.UNKNOWN
+    # Map of ReplicaState -> count of replicas in that state.
+    replicas_states: Dict[str, int] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "tpuReplicaType": self.tpu_replica_type,
+            "state": self.state,
+            "replicasStates": dict(self.replicas_states),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUReplicaStatus":
+        return cls(
+            tpu_replica_type=str(d.get("tpuReplicaType", TPUReplicaType.WORKER)),
+            state=str(d.get("state", ReplicaState.UNKNOWN)),
+            replicas_states={str(k): int(v) for k, v in (d.get("replicasStates") or {}).items()},
+        )
+
+
+@dataclass
+class TPUJobStatus:
+    """Job status written back to the CRD (ref: types.go:117-135)."""
+
+    phase: str = TPUJobPhase.NONE
+    reason: str = ""
+    state: str = State.UNKNOWN
+    replica_statuses: List[TPUReplicaStatus] = field(default_factory=list)
+    # TPU-native: whole-group restart attempt counter.
+    attempt: int = 0
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "phase": self.phase,
+            "reason": self.reason,
+            "state": self.state,
+            "replicaStatuses": [r.to_dict() for r in self.replica_statuses],
+            "attempt": self.attempt,
+        }
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TPUJobStatus":
+        d = d or {}
+        return cls(
+            phase=str(d.get("phase", TPUJobPhase.NONE)),
+            reason=str(d.get("reason", "")),
+            state=str(d.get("state", State.UNKNOWN)),
+            replica_statuses=[
+                TPUReplicaStatus.from_dict(r) for r in d.get("replicaStatuses", [])
+            ],
+            attempt=int(d.get("attempt", 0)),
+        )
+
+
+# --- The CRD object ---------------------------------------------------------
+
+@dataclass
+class TPUJob:
+    """A TPUJob object (ref: types.go:41-52)."""
+
+    metadata: Dict[str, Any] = field(default_factory=dict)
+    spec: TPUJobSpec = field(default_factory=TPUJobSpec)
+    status: TPUJobStatus = field(default_factory=TPUJobStatus)
+
+    @property
+    def name(self) -> str:
+        return self.metadata.get("name", "")
+
+    @property
+    def namespace(self) -> str:
+        return self.metadata.get("namespace", "default")
+
+    @property
+    def uid(self) -> str:
+        return self.metadata.get("uid", "")
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "apiVersion": CRD_API_VERSION,
+            "kind": CRD_KIND,
+            "metadata": copy.deepcopy(self.metadata),
+            "spec": self.spec.to_dict(),
+            "status": self.status.to_dict(),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUJob":
+        return cls(
+            metadata=copy.deepcopy(d.get("metadata") or {}),
+            spec=TPUJobSpec.from_dict(d.get("spec") or {}),
+            status=TPUJobStatus.from_dict(d.get("status")),
+        )
+
+    def deepcopy(self) -> "TPUJob":
+        """Value-semantics copy (ref: zz_generated.deepcopy.go)."""
+        return TPUJob.from_dict(self.to_dict())
+
+
+# --- Controller config (ref: types.go:170-196) ------------------------------
+
+@dataclass
+class TPUAcceleratorVolume:
+    """A hostPath mount injected for a matched accelerator
+    (ref: types.go:188-196 AcceleratorVolume)."""
+
+    name: str
+    host_path: str
+    mount_path: str
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"name": self.name, "hostPath": self.host_path, "mountPath": self.mount_path}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUAcceleratorVolume":
+        return cls(
+            name=str(d.get("name", "")),
+            host_path=str(d.get("hostPath", "")),
+            mount_path=str(d.get("mountPath", "")),
+        )
+
+
+@dataclass
+class TPUAcceleratorConfig:
+    """Per-accelerator injection recipe (ref: types.go:182-186).
+
+    For TPU resource names (``cloud-tpus.google.com/v4`` etc.) the useful
+    payload is **env injection** (topology, runtime addresses) rather than the
+    CUDA hostPath volumes the reference mounts for
+    ``alpha.kubernetes.io/nvidia-gpu``; both are supported.
+    """
+
+    volumes: List[TPUAcceleratorVolume] = field(default_factory=list)
+    env_vars: Dict[str, str] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {
+            "volumes": [v.to_dict() for v in self.volumes],
+            "envVars": dict(self.env_vars),
+        }
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "TPUAcceleratorConfig":
+        env = d.get("envVars") or {}
+        # Accept both map form {NAME: value} and list form [{name,value}]
+        # (the reference uses a list of EnvironmentVariableConfig,
+        # types.go:182-186; the map form is friendlier YAML).
+        if isinstance(env, list):
+            env = {e.get("name", ""): str(e.get("value", "")) for e in env}
+        return cls(
+            volumes=[TPUAcceleratorVolume.from_dict(v) for v in d.get("volumes", [])],
+            env_vars={str(k): str(v) for k, v in env.items()},
+        )
+
+
+@dataclass
+class ControllerConfig:
+    """Admin-provided operator config (ref: types.go:170-178).
+
+    ``accelerators`` maps a Kubernetes resource name to its injection recipe.
+    The reference also carried an unused ``GrpcServerFilePath`` field
+    (types.go:176-177) — deliberately dropped here (SURVEY.md "quirks to
+    fix, not copy").
+    """
+
+    accelerators: Dict[str, TPUAcceleratorConfig] = field(default_factory=dict)
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"accelerators": {k: v.to_dict() for k, v in self.accelerators.items()}}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ControllerConfig":
+        d = d or {}
+        return cls(
+            accelerators={
+                str(k): TPUAcceleratorConfig.from_dict(v or {})
+                for k, v in (d.get("accelerators") or {}).items()
+            }
+        )

@@ -1,0 +1,125 @@
+"""Spec validation for TPUJob.
+
+Reference parity: pkg/apis/mxnet/validation/validation.go:30-84
+(``ValidateTFJobSpec``): termination policy + chief required (:32-34,45-47),
+replica template and port non-nil (:41-51), replica type in the allowed set
+(:54-66), a container with the magic name present (:68-76), and the chief
+replica set must exist (:79-81).
+
+TPU-native additions: SCHEDULER replica count must be exactly 1 (the
+reference enforces this later, in the replica-set constructor,
+replicas.go:87-93 — hoisted here so invalid specs fail validation instead of
+reconcile), duplicate role detection, whole-group restart-policy validity,
+and TPU resource-request sanity (a WORKER template requesting
+``cloud-tpus.google.com/*`` must request the same count on every worker).
+"""
+
+from __future__ import annotations
+
+from typing import List
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_CONTAINER_NAME,
+    RestartPolicy,
+    TPUJobSpec,
+    TPUReplicaType,
+)
+
+
+class ValidationError(ValueError):
+    """Raised when a TPUJobSpec is invalid (ref: fmt.Errorf returns)."""
+
+
+def validate_tpujob_spec(spec: TPUJobSpec) -> None:
+    """Raise ValidationError on the first invalid field.
+
+    Mirrors ValidateTFJobSpec (validation.go:30-84); call after defaulting.
+    """
+    if spec.termination_policy is None or not spec.termination_policy.chief_replica_name:
+        # ref: validation.go:32-34
+        raise ValidationError("invalid termination policy: a chief replica must be specified")
+    chief_name = spec.termination_policy.chief_replica_name
+
+    if not spec.replica_specs:
+        raise ValidationError("job spec must contain at least one replicaSpec")
+
+    seen_roles: List[str] = []
+    chief_found = False
+    for i, r in enumerate(spec.replica_specs):
+        # ref: validation.go:41-44 (TFPort non-nil)
+        if r.tpu_port is None:
+            raise ValidationError(f"replicaSpec[{i}]: tpuPort can't be None")
+        # ref: validation.go:45-47 (chief membership check, one branch)
+        if r.tpu_replica_type == chief_name:
+            chief_found = True
+        # ref: validation.go:48-51 (Template non-nil except legacy MASTER case;
+        # no legacy case here — template is always required)
+        if r.template is None:
+            raise ValidationError(f"replicaSpec[{i}]: template can't be None")
+        # ref: validation.go:54-66 (valid replica type)
+        if r.tpu_replica_type not in TPUReplicaType.ALL:
+            raise ValidationError(
+                f"replicaSpec[{i}]: tpuReplicaType {r.tpu_replica_type!r} is not in "
+                f"{list(TPUReplicaType.ALL)}"
+            )
+        if r.tpu_replica_type in seen_roles:
+            raise ValidationError(
+                f"replicaSpec[{i}]: duplicate replica type {r.tpu_replica_type!r}"
+            )
+        seen_roles.append(r.tpu_replica_type)
+        # ref: replicas.go:87-93 (SCHEDULER must have exactly 1 replica) —
+        # hoisted from the replica-set constructor into validation.
+        if r.tpu_replica_type == TPUReplicaType.SCHEDULER and r.replicas != 1:
+            raise ValidationError("the SCHEDULER replica set must have exactly 1 replica")
+        if r.replicas < 1:
+            raise ValidationError(f"replicaSpec[{i}]: replicas must be >= 1")
+
+        _validate_template(i, r.template)
+
+    if not chief_found:
+        # ref: validation.go:79-81
+        raise ValidationError(
+            f"terminationPolicy chief replica {chief_name!r} matches no replicaSpec"
+        )
+
+    if spec.restart_policy and spec.restart_policy not in RestartPolicy.ALL:
+        raise ValidationError(
+            f"restartPolicy {spec.restart_policy!r} is not in {list(RestartPolicy.ALL)}"
+        )
+    if spec.num_slices < 1:
+        raise ValidationError("numSlices must be >= 1")
+
+
+def _validate_template(index: int, template: dict) -> None:
+    """Template must contain a container named DEFAULT_CONTAINER_NAME
+    (ref: validation.go:68-76 requires a container named "mxnet")."""
+    pod_spec = (template or {}).get("spec") or {}
+    containers = pod_spec.get("containers") or []
+    if not any(c.get("name") == DEFAULT_CONTAINER_NAME for c in containers):
+        raise ValidationError(
+            f"replicaSpec[{index}]: template must contain a container named "
+            f"{DEFAULT_CONTAINER_NAME!r}"
+        )
+
+
+def validate_tpu_resources(spec: TPUJobSpec) -> None:
+    """TPU-native sanity: all replicas of a set share the template, so the
+    per-set TPU chip request is uniform by construction; across WORKER sets
+    of a multi-slice job, slice sizes must match (megascale requires equal
+    slices). Called from setup after defaulting."""
+    from tpu_operator.apis.tpujob.helper import tpu_chips_requested
+
+    if spec.num_slices > 1:
+        worker = next(
+            (r for r in spec.replica_specs if r.tpu_replica_type == TPUReplicaType.WORKER),
+            None,
+        )
+        if worker is None:
+            raise ValidationError("multi-slice jobs require a WORKER replicaSpec")
+        if worker.replicas % spec.num_slices != 0:
+            raise ValidationError(
+                f"WORKER replicas ({worker.replicas}) must be divisible by "
+                f"numSlices ({spec.num_slices})"
+            )
+        if tpu_chips_requested(worker.template) == 0:
+            raise ValidationError("multi-slice WORKER template requests no TPU chips")

@@ -1,0 +1,62 @@
+"""API error model + predicates.
+
+Reference parity: the reference relies on k8s.io/apimachinery StatusError and
+the predicates in pkg/util/k8sutil/k8sutil.go:76-82 (IsKubernetesResourceAlreadyExistError,
+IsKubernetesResourceNotFoundError). Both the real REST client and the fake
+clientset raise ``ApiError`` with the HTTP status code, so call sites use one
+error model everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+class ApiError(Exception):
+    """A Kubernetes API error carrying the HTTP status code and Status body."""
+
+    def __init__(self, code: int, reason: str = "", message: str = "",
+                 status: Optional[Dict[str, Any]] = None):
+        self.code = code
+        self.reason = reason or _default_reason(code)
+        self.message = message
+        self.status = status or {}
+        super().__init__(f"{self.code} {self.reason}: {message}")
+
+
+def _default_reason(code: int) -> str:
+    return {
+        400: "BadRequest",
+        401: "Unauthorized",
+        403: "Forbidden",
+        404: "NotFound",
+        409: "Conflict",
+        410: "Gone",
+        422: "Invalid",
+    }.get(code, "Unknown")
+
+
+def not_found(kind: str, name: str) -> ApiError:
+    return ApiError(404, "NotFound", f'{kind} "{name}" not found')
+
+
+def already_exists(kind: str, name: str) -> ApiError:
+    return ApiError(409, "AlreadyExists", f'{kind} "{name}" already exists')
+
+
+def conflict(kind: str, name: str, message: str = "") -> ApiError:
+    return ApiError(409, "Conflict", message or f'operation on {kind} "{name}" conflicted')
+
+
+def is_not_found(err: BaseException) -> bool:
+    """ref: k8sutil.go:80-82 IsKubernetesResourceNotFoundError."""
+    return isinstance(err, ApiError) and err.code == 404 and err.reason != "Conflict"
+
+
+def is_already_exists(err: BaseException) -> bool:
+    """ref: k8sutil.go:76-78 IsKubernetesResourceAlreadyExistError."""
+    return isinstance(err, ApiError) and err.code == 409 and err.reason == "AlreadyExists"
+
+
+def is_conflict(err: BaseException) -> bool:
+    return isinstance(err, ApiError) and err.code == 409 and err.reason == "Conflict"

@@ -1,0 +1,231 @@
+"""In-memory fake clientset for tests.
+
+Reference parity: the generated fakes in
+pkg/client/clientset/versioned/fake/clientset_generated.go and
+typed/mxnet/v1alpha1/fake/fake_mxjob.go:42-124, plus
+k8s.io/client-go/kubernetes/fake — the trio the reference's test strategy is
+built on (SURVEY.md §4: fake clientsets are load-bearing; reconcile tests
+create pods/services against the fake and assert on the results).
+
+Hand-built rather than generated. Two deliberate upgrades over client-go's
+fake noted in the reference's own tests:
+
+- ``delete_collection`` is implemented (the client-go fake didn't support it,
+  forcing the reference to defer delete coverage to E2E —
+  replicas_test.go:203-209).
+- ``watch`` streams real events through per-watcher queues, so informers can
+  be tested in-process.
+
+Every mutation bumps a monotonically increasing resourceVersion, and an
+action log (``actions``) records (verb, resource, namespace, name) tuples for
+assertions, like client-go's ``Actions()``.
+"""
+
+from __future__ import annotations
+
+import copy
+import queue
+import threading
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
+
+from tpu_operator.client import errors
+from tpu_operator.client.selectors import matches
+
+
+class Watch:
+    """A cancellable watch stream yielding (event_type, object) pairs."""
+
+    def __init__(self, q: "queue.Queue[Optional[Tuple[str, dict]]]",
+                 on_stop: Callable[[], None]):
+        self._q = q
+        self._on_stop = on_stop
+        self._stopped = False
+
+    def stop(self) -> None:
+        if not self._stopped:
+            self._stopped = True
+            self._on_stop()
+            self._q.put(None)  # unblock consumer
+
+    def __iter__(self) -> Iterator[Tuple[str, dict]]:
+        while True:
+            item = self._q.get()
+            if item is None or self._stopped:
+                return
+            yield item
+
+
+class FakeResourceClient:
+    """Typed CRUD+watch over one namespaced resource kind."""
+
+    def __init__(self, kind: str, clientset: "FakeClientset"):
+        self.kind = kind
+        self._cs = clientset
+        self._store: Dict[Tuple[str, str], dict] = {}
+        self._watchers: List[Tuple[queue.Queue, str, Optional[str]]] = []  # (q, ns, selector)
+
+    # -- helpers -------------------------------------------------------------
+
+    def _key(self, namespace: str, obj_or_name: Any) -> Tuple[str, str]:
+        name = obj_or_name if isinstance(obj_or_name, str) else (
+            (obj_or_name.get("metadata") or {}).get("name", "")
+        )
+        return (namespace, name)
+
+    def _notify(self, event_type: str, obj: dict, namespace: str) -> None:
+        lbls = (obj.get("metadata") or {}).get("labels") or {}
+        for q, ns, selector in list(self._watchers):
+            if ns not in ("", namespace):
+                continue
+            if selector and not matches(selector, lbls):
+                continue
+            q.put((event_type, copy.deepcopy(obj)))
+
+    # -- CRUD ----------------------------------------------------------------
+
+    def create(self, namespace: str, obj: dict) -> dict:
+        with self._cs.lock:
+            key = self._key(namespace, obj)
+            if not key[1]:
+                raise errors.ApiError(422, "Invalid", f"{self.kind} must have metadata.name")
+            if key in self._store:
+                raise errors.already_exists(self.kind, key[1])
+            stored = copy.deepcopy(obj)
+            md = stored.setdefault("metadata", {})
+            md["namespace"] = namespace
+            md.setdefault("uid", f"uid-{self._cs.next_version()}")
+            md["resourceVersion"] = str(self._cs.next_version())
+            self._store[key] = stored
+            self._cs.record("create", self.kind, namespace, key[1])
+            self._notify("ADDED", stored, namespace)
+            return copy.deepcopy(stored)
+
+    def get(self, namespace: str, name: str) -> dict:
+        with self._cs.lock:
+            obj = self._store.get((namespace, name))
+            if obj is None:
+                raise errors.not_found(self.kind, name)
+            self._cs.record("get", self.kind, namespace, name)
+            return copy.deepcopy(obj)
+
+    def list(self, namespace: str = "", label_selector: str = "") -> List[dict]:
+        with self._cs.lock:
+            self._cs.record("list", self.kind, namespace, "")
+            out = []
+            for (ns, _name), obj in sorted(self._store.items()):
+                if namespace and ns != namespace:
+                    continue
+                lbls = (obj.get("metadata") or {}).get("labels") or {}
+                if label_selector and not matches(label_selector, lbls):
+                    continue
+                out.append(copy.deepcopy(obj))
+            return out
+
+    def update(self, namespace: str, obj: dict) -> dict:
+        with self._cs.lock:
+            key = self._key(namespace, obj)
+            existing = self._store.get(key)
+            if existing is None:
+                raise errors.not_found(self.kind, key[1])
+            incoming_rv = (obj.get("metadata") or {}).get("resourceVersion")
+            current_rv = (existing.get("metadata") or {}).get("resourceVersion")
+            if incoming_rv and current_rv and incoming_rv != current_rv:
+                raise errors.conflict(
+                    self.kind, key[1],
+                    f"resourceVersion {incoming_rv} is stale (current {current_rv})",
+                )
+            stored = copy.deepcopy(obj)
+            md = stored.setdefault("metadata", {})
+            md["namespace"] = namespace
+            md.setdefault("uid", (existing.get("metadata") or {}).get("uid", ""))
+            md["resourceVersion"] = str(self._cs.next_version())
+            self._store[key] = stored
+            self._cs.record("update", self.kind, namespace, key[1])
+            self._notify("MODIFIED", stored, namespace)
+            return copy.deepcopy(stored)
+
+    def update_status(self, namespace: str, obj: dict) -> dict:
+        """Status-subresource write; merges only .status onto the stored object."""
+        with self._cs.lock:
+            key = self._key(namespace, obj)
+            existing = self._store.get(key)
+            if existing is None:
+                raise errors.not_found(self.kind, key[1])
+            existing = copy.deepcopy(existing)
+            existing["status"] = copy.deepcopy(obj.get("status") or {})
+            existing["metadata"]["resourceVersion"] = str(self._cs.next_version())
+            self._store[key] = existing
+            self._cs.record("update_status", self.kind, namespace, key[1])
+            self._notify("MODIFIED", existing, namespace)
+            return copy.deepcopy(existing)
+
+    def delete(self, namespace: str, name: str, options: Optional[dict] = None) -> None:
+        with self._cs.lock:
+            key = (namespace, name)
+            obj = self._store.pop(key, None)
+            if obj is None:
+                raise errors.not_found(self.kind, name)
+            self._cs.record("delete", self.kind, namespace, name)
+            self._notify("DELETED", obj, namespace)
+
+    def delete_collection(self, namespace: str, label_selector: str = "") -> int:
+        """Delete all matching objects; returns count. (The reference's fake
+        lacked this — replicas_test.go:203-209.)"""
+        with self._cs.lock:
+            victims = []
+            for (ns, name), obj in list(self._store.items()):
+                if namespace and ns != namespace:
+                    continue
+                lbls = (obj.get("metadata") or {}).get("labels") or {}
+                if label_selector and not matches(label_selector, lbls):
+                    continue
+                victims.append(((ns, name), obj))
+            for key, obj in victims:
+                del self._store[key]
+                self._cs.record("delete", self.kind, key[0], key[1])
+                self._notify("DELETED", obj, key[0])
+            return len(victims)
+
+    # -- watch ---------------------------------------------------------------
+
+    def watch(self, namespace: str = "", label_selector: str = "",
+              resource_version: str = "") -> Watch:
+        q: "queue.Queue[Optional[Tuple[str, dict]]]" = queue.Queue()
+        entry = (q, namespace, label_selector or None)
+        with self._cs.lock:
+            self._watchers.append(entry)
+
+        def _unregister() -> None:
+            with self._cs.lock:
+                if entry in self._watchers:
+                    self._watchers.remove(entry)
+
+        return Watch(q, _unregister)
+
+
+class FakeClientset:
+    """The full fake clientset: pods, services, events, endpoints, leases,
+    and the TPUJob CRD (ref: fake.NewSimpleClientset +
+    fake/clientset_generated.go)."""
+
+    def __init__(self) -> None:
+        self.lock = threading.RLock()
+        self._version = 0
+        self.actions: List[Tuple[str, str, str, str]] = []
+        self.pods = FakeResourceClient("Pod", self)
+        self.services = FakeResourceClient("Service", self)
+        self.events = FakeResourceClient("Event", self)
+        self.endpoints = FakeResourceClient("Endpoints", self)
+        self.leases = FakeResourceClient("Lease", self)
+        self.configmaps = FakeResourceClient("ConfigMap", self)
+        self.tpujobs = FakeResourceClient("TPUJob", self)
+
+    def next_version(self) -> int:
+        self._version += 1
+        return self._version
+
+    def record(self, verb: str, resource: str, namespace: str, name: str) -> None:
+        self.actions.append((verb, resource, namespace, name))
+
+    def clear_actions(self) -> None:
+        self.actions.clear()

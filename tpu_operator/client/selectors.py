@@ -1,0 +1,43 @@
+"""Label-selector parsing and matching.
+
+Both the fake clientset and the in-process apiserver need server-side label
+selection; the real client only serializes selectors. Supports the equality
+subset of Kubernetes selector grammar (``k=v,k2=v2``, ``k!=v``, bare ``k``),
+which is all the operator uses (ref: trainer/labels.go ToSelector emits
+``k=v`` pairs; hack/scripts/cleanup_clusters.sh uses a bare equality
+selector).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict
+
+
+def matches(selector: str, labels: Dict[str, Any] | None) -> bool:
+    """True if `labels` satisfies the comma-separated equality selector."""
+    labels = labels or {}
+    selector = (selector or "").strip()
+    if not selector:
+        return True
+    for term in selector.split(","):
+        term = term.strip()
+        if not term:
+            continue
+        if "!=" in term:
+            k, v = term.split("!=", 1)
+            if str(labels.get(k.strip())) == v.strip():
+                return False
+        elif "=" in term:
+            k, v = term.split("=", 1)
+            k = k.strip().rstrip("=")  # tolerate "==" form
+            if k not in labels or str(labels[k]) != v.strip():
+                return False
+        else:
+            if term not in labels:
+                return False
+    return True
+
+
+def format_selector(labels: Dict[str, Any]) -> str:
+    """Serialize a label map to ``k=v,...`` (ref: labels.go:28-33 ToSelector)."""
+    return ",".join(f"{k}={v}" for k, v in sorted(labels.items()))

@@ -1,0 +1,152 @@
+"""Rate-limited work queue.
+
+Reference parity: the controller's workqueue
+(pkg/controller/controller.go:60-63,105): client-go's
+``workqueue.NewRateLimitingQueue`` with per-item exponential backoff — base
+10 s, cap 360 s (controller.go:60-63; BASELINE.md "workqueue backoff").
+
+Semantics preserved from client-go because the controller's correctness
+depends on them:
+- an item present in the queue is never duplicated (dirty-set dedup);
+- an item being processed that is re-added is re-queued after ``done``
+  (processing-set), so no two workers ever reconcile the same job
+  concurrently;
+- ``add_rate_limited`` applies per-item exponential backoff;
+- ``forget`` resets the item's failure count.
+
+The clock is injectable for tests (the reference's tests never covered its
+queue; these do).
+"""
+
+from __future__ import annotations
+
+import heapq
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Set
+
+DEFAULT_BASE_DELAY = 10.0   # seconds (ref: controller.go:61)
+DEFAULT_MAX_DELAY = 360.0   # seconds (ref: controller.go:62)
+
+
+class RateLimitingQueue:
+    def __init__(
+        self,
+        base_delay: float = DEFAULT_BASE_DELAY,
+        max_delay: float = DEFAULT_MAX_DELAY,
+        clock: Callable[[], float] = time.monotonic,
+    ):
+        self._base = base_delay
+        self._max = max_delay
+        self._clock = clock
+        self._cond = threading.Condition()
+        self._queue: List[Any] = []
+        self._dirty: Set[Any] = set()
+        self._processing: Set[Any] = set()
+        self._failures: Dict[Any, int] = {}
+        self._delayed: List[tuple] = []  # heap of (ready_at, seq, item)
+        self._seq = 0
+        self._shutdown = False
+
+    # -- core queue -----------------------------------------------------------
+
+    def add(self, item: Any) -> None:
+        with self._cond:
+            if self._shutdown or item in self._dirty:
+                return
+            self._dirty.add(item)
+            if item in self._processing:
+                return  # will be re-queued on done()
+            self._queue.append(item)
+            self._cond.notify()
+
+    def get(self, timeout: Optional[float] = None) -> Optional[Any]:
+        """Blocks until an item is available (moving due delayed items in),
+        the timeout elapses, or the queue is shut down. Returns None on
+        timeout/shutdown."""
+        deadline = None if timeout is None else self._clock() + timeout
+        with self._cond:
+            while True:
+                self._drain_delayed_locked()
+                if self._queue:
+                    item = self._queue.pop(0)
+                    self._processing.add(item)
+                    self._dirty.discard(item)
+                    return item
+                if self._shutdown:
+                    return None
+                now = self._clock()
+                if deadline is not None and now >= deadline:
+                    return None  # timeout — never conflated with a due item
+                waits = []
+                if self._delayed:
+                    waits.append(self._delayed[0][0] - now)
+                if deadline is not None:
+                    waits.append(deadline - now)
+                wait = min(waits) if waits else None
+                if wait is not None and wait <= 0:
+                    continue  # a delayed item became due; loop re-drains it
+                self._cond.wait(wait if wait is not None else 0.05)
+
+    def done(self, item: Any) -> None:
+        with self._cond:
+            self._processing.discard(item)
+            if item in self._dirty:
+                self._queue.append(item)
+                self._cond.notify()
+
+    # -- rate limiting --------------------------------------------------------
+
+    def num_requeues(self, item: Any) -> int:
+        with self._cond:
+            return self._failures.get(item, 0)
+
+    def add_rate_limited(self, item: Any) -> None:
+        """Re-queue after exponential per-item backoff
+        (ref: AddRateLimited at controller.go:200)."""
+        with self._cond:
+            if self._shutdown:
+                return
+            failures = self._failures.get(item, 0)
+            delay = min(self._base * (2 ** failures), self._max)
+            self._failures[item] = failures + 1
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._cond.notify()
+
+    def add_after(self, item: Any, delay: float) -> None:
+        with self._cond:
+            if self._shutdown:
+                return
+            self._seq += 1
+            heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
+            self._cond.notify()
+
+    def forget(self, item: Any) -> None:
+        """Reset backoff state (ref: Forget at controller.go:261-265)."""
+        with self._cond:
+            self._failures.pop(item, None)
+
+    # -- lifecycle ------------------------------------------------------------
+
+    def shutdown(self) -> None:
+        with self._cond:
+            self._shutdown = True
+            self._cond.notify_all()
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._queue)
+
+    # -- internals (call with lock held) --------------------------------------
+
+    def _drain_delayed_locked(self) -> None:
+        now = self._clock()
+        while self._delayed and self._delayed[0][0] <= now:
+            _, _, item = heapq.heappop(self._delayed)
+            if item in self._dirty:
+                continue
+            self._dirty.add(item)
+            if item not in self._processing:
+                self._queue.append(item)
+

@@ -1,0 +1,91 @@
+"""Kubernetes Event recording.
+
+Reference parity: the event broadcaster wired in controller.New
+(controller.go:96-100) and the SuccessfulCreate/FailedCreate events recorded
+on the MXJob from the replica sync paths (replicas.go:520-524,553-557).
+client-go's broadcaster machinery (watch fan-out, aggregation, rate limits)
+exists because many controllers share one stream; this operator needs the
+recorder surface only, so events are written directly through the clientset
+with per-(object,reason) aggregation counts — same API-visible result
+(``kubectl describe tpujob`` shows the event trail), far less machinery.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import threading
+from typing import Any, Dict, Tuple
+
+from tpu_operator.client import errors
+from tpu_operator.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+
+def _now() -> str:
+    return (
+        datetime.datetime.now(datetime.timezone.utc)
+        .strftime("%Y-%m-%dT%H:%M:%SZ")
+    )
+
+
+class EventRecorder:
+    """Records events against involved objects (ref: record.EventRecorder as
+    used at controller.go:97-100; component name "tpu-operator")."""
+
+    def __init__(self, clientset: Any, component: str = "tpu-operator"):
+        self.clientset = clientset
+        self.component = component
+        self._lock = threading.Lock()
+        # (ns, name, reason, message) -> (event_name, count)
+        self._seen: Dict[Tuple[str, str, str, str], Tuple[str, int]] = {}
+
+    def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        """``obj`` is anything with .metadata/.name/.namespace (TrainingJob or
+        TPUJob). Failures to record never break reconcile (events are
+        best-effort, as in client-go)."""
+        try:
+            self._record(obj, event_type, reason, message)
+        except Exception as e:  # noqa: BLE001 — best-effort by design
+            log.debug("dropping event %s/%s: %s", reason, message, e)
+
+    def _record(self, obj: Any, event_type: str, reason: str, message: str) -> None:
+        namespace = obj.namespace
+        involved = {
+            "apiVersion": obj.metadata.get("apiVersion", "tpuoperator.dev/v1alpha1"),
+            "kind": "TPUJob",
+            "name": obj.name,
+            "namespace": namespace,
+            "uid": obj.metadata.get("uid", ""),
+        }
+        key = (namespace, obj.name, reason, message)
+        with self._lock:
+            prior = self._seen.get(key)
+            if prior:
+                name, count = prior
+                try:
+                    ev = self.clientset.events.get(namespace, name)
+                    ev["count"] = count + 1
+                    ev["lastTimestamp"] = _now()
+                    self.clientset.events.update(namespace, ev)
+                    self._seen[key] = (name, count + 1)
+                    return
+                except errors.ApiError:
+                    pass  # fall through to create fresh
+            name = f"{obj.name}.{rand_string(10)}"
+            event = {
+                "apiVersion": "v1",
+                "kind": "Event",
+                "metadata": {"name": name, "namespace": namespace},
+                "involvedObject": involved,
+                "reason": reason,
+                "message": message,
+                "type": event_type,
+                "count": 1,
+                "firstTimestamp": _now(),
+                "lastTimestamp": _now(),
+                "source": {"component": self.component},
+            }
+            self.clientset.events.create(namespace, event)
+            self._seen[key] = (name, 1)

@@ -1,0 +1,57 @@
+"""Label book-keeping for child resources.
+
+Reference parity: pkg/trainer/labels.go:23-33 (KubernetesLabels map +
+ToSelector) and the label set stamped in replicas.go:120-129
+(``fioravanzo.org=``, ``job_type``, ``runtime_id`` — plus ``task_index``
+added per pod/service at replicas.go:135,175).
+
+The reference's cleanup script selected on a stale ``kubeflow.org=`` key
+(hack/scripts/cleanup_clusters.sh:5-7) — a quirk fixed here by exporting the
+group key as a constant used everywhere.
+"""
+
+from __future__ import annotations
+
+from typing import Dict
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    LABEL_ATTEMPT,
+    LABEL_GROUP_KEY,
+    LABEL_JOB_NAME,
+    LABEL_JOB_TYPE,
+    LABEL_RUNTIME_ID,
+    LABEL_TASK_INDEX,
+)
+from tpu_operator.client.selectors import format_selector
+
+
+def job_labels(job_name: str, runtime_id: str) -> Dict[str, str]:
+    """Labels shared by every child of a job (group key carried bare,
+    like the reference's ``fioravanzo.org=``)."""
+    return {
+        LABEL_GROUP_KEY: "",
+        LABEL_JOB_NAME: job_name,
+        LABEL_RUNTIME_ID: runtime_id,
+    }
+
+
+def replica_labels(job_name: str, runtime_id: str, replica_type: str) -> Dict[str, str]:
+    """Labels for one replica set (ref: replicas.go:120-129)."""
+    labels = job_labels(job_name, runtime_id)
+    labels[LABEL_JOB_TYPE] = replica_type.lower()
+    return labels
+
+
+def index_labels(job_name: str, runtime_id: str, replica_type: str, index: int,
+                 attempt: int = 0) -> Dict[str, str]:
+    """Labels for one replica index (ref: replicas.go:135,175 add task_index).
+    ``attempt`` tags the whole-group restart generation (TPU-native)."""
+    labels = replica_labels(job_name, runtime_id, replica_type)
+    labels[LABEL_TASK_INDEX] = str(index)
+    labels[LABEL_ATTEMPT] = str(attempt)
+    return labels
+
+
+def to_selector(labels: Dict[str, str]) -> str:
+    """ref: labels.go:28-33."""
+    return format_selector(labels)

@@ -1,0 +1,87 @@
+"""Termination-state classification: the exit-code contract.
+
+Reference parity: pkg/trainer/training.go:172-208
+(``isRetryableTerminationState``) and README.md:107-121 — the user-facing
+contract the whole restart machinery hangs off:
+
+- exit code 0        → success
+- exit codes 1-127   → permanent failure (job fails if the chief dies this way)
+- exit codes 128-255 → retryable (typically signal deaths / preemption);
+                       the replica is restarted
+- OOMKilled          → NEVER retryable, regardless of exit code
+                       (training.go:183-192: MXNet's SIGKILL exit code 137
+                       would otherwise look retryable)
+
+Kept in its own module (the reference buried it in training.go) because both
+the replica classifier and the job-level status logic need it, and because it
+is the most table-testable function in the system
+(ref tests: training_test.go:31-87).
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Optional
+
+
+# Pod-level failure reasons that carry no container exit code but are
+# transient infrastructure events — on TPU these are routine (slice
+# preemption, maintenance drains) and MUST trigger a group restart, not a
+# permanent job failure. The reference never faced these: kubelet restarts
+# MXNet pods in place, and GPU boxes aren't preempted the way TPU slices are.
+RETRYABLE_POD_REASONS = frozenset(
+    {"Evicted", "Preempted", "NodeLost", "Shutdown", "NodeShutdown",
+     "UnexpectedAdmissionError", "DeadlineExceeded"}
+)
+
+
+def pod_failed_retryably(pod: Dict[str, Any], container_name: str = "tpu") -> bool:
+    """True if this pod's failure is transient: either its magic container
+    terminated with a retryable exit code, or the pod failed at the kubelet
+    level (Evicted/Preempted/...) without any container termination record."""
+    status = pod.get("status") or {}
+    saw_container = False
+    for cs in status.get("containerStatuses") or []:
+        if cs.get("name") != container_name:
+            continue
+        term = (cs.get("state") or {}).get("terminated") or \
+            (cs.get("lastState") or {}).get("terminated")
+        if term:
+            saw_container = True
+            if is_retryable_termination_state(term):
+                return True
+    if saw_container:
+        return False
+    return (
+        status.get("phase") == "Failed"
+        and status.get("reason", "") in RETRYABLE_POD_REASONS
+    )
+
+
+def is_retryable_termination_state(terminated: Optional[Dict[str, Any]]) -> bool:
+    """Given a containerStateTerminated dict, decide retryability
+    (ref: training.go:172-208)."""
+    if not terminated:
+        return False
+    if terminated.get("reason") == "OOMKilled":
+        # ref: training.go:183-192 — OOM is never retryable
+        return False
+    exit_code = terminated.get("exitCode")
+    if exit_code is None:
+        return False
+    return 128 <= int(exit_code) <= 255
+
+
+def is_permanent_failure(terminated: Optional[Dict[str, Any]]) -> bool:
+    """Non-zero, non-retryable termination (ref: training.go:172-208 inverse)."""
+    if not terminated:
+        return False
+    exit_code = terminated.get("exitCode")
+    if exit_code is None or int(exit_code) == 0:
+        return False
+    return not is_retryable_termination_state(terminated)
+
+
+def is_success(terminated: Optional[Dict[str, Any]]) -> bool:
+    if not terminated:
+        return False
+    return terminated.get("exitCode") == 0 and terminated.get("reason") != "OOMKilled"

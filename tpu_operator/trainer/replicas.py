@@ -1,0 +1,571 @@
+"""TPUReplicaSet: per-role reconciliation of pods and discovery services.
+
+Reference parity: pkg/trainer/replicas.go:45-588 (MXReplicaSet) — one
+instance per replicaSpec, responsible for:
+
+- DNS-safe child naming ``{job}-{role}-{runtimeid}-{index}``
+  (replicas.go:570-577), pods with an extra random suffix
+  (replicas.go:579-583);
+- one ClusterIP Service per replica index, selector = labels + task_index
+  (replicas.go:132-159);
+- pod creation from the user PodTemplateSpec with schedulerName passthrough
+  and env injection into the magic container (replicas.go:162-276);
+- create-if-absent sync loops (replicas.go:481-535, 538-568);
+- deletion by label selector (replicas.go:279-342);
+- pod-list → replica-state classification (replicas.go:345-398) and status
+  roll-up (replicas.go:400-478).
+
+The TPU-native redesign replaces the MXNet ``DMLC_*`` parameter-server env
+contract (replicas.go:235-260) with the JAX/XLA process-group contract: every
+replica receives ``JAX_COORDINATOR_ADDRESS``/``JAX_PROCESS_ID``/
+``JAX_NUM_PROCESSES`` plus ``TPU_WORKER_ID``/``TPU_WORKER_HOSTNAMES`` (and
+``MEGASCALE_*`` DCN-discovery vars for multi-slice jobs), so
+``jax.distributed.initialize()`` inside the container forms one process group
+over the slice. Collective bytes ride TPU ICI — the operator's surface stays
+bootstrap-only, exactly like the reference.
+
+Reference quirks deliberately fixed (SURVEY.md "quirks to fix, not copy"):
+- coordinator address derives from the SCHEDULER *role* (or WORKER[0] in
+  scheduler-less mode), not blindly ``Replicas[0]``  (bug at replicas.go:240-243);
+- an empty pod list classifies as STARTING, not Running (bug at replicas.go:358-360);
+- per-replica status queries go through the label selector that actually
+  matches (the reference's Get-by-name at replicas.go:402 could never hit,
+  because pods carry a random suffix, replicas.go:579-583);
+- ``delete`` issues one pod DeleteCollection, not two (copy-paste bug at
+  replicas.go:292-302);
+- no stray debug prints (replicas.go:208-210,506).
+"""
+
+from __future__ import annotations
+
+import copy
+import logging
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_operator.apis.tpujob import helper
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_CONTAINER_NAME,
+    RestartPolicy,
+    ReplicaState,
+    TPUJobSpec,
+    TPUReplicaSpec,
+    TPUReplicaStatus,
+    TPUReplicaType,
+)
+from tpu_operator.client import errors
+from tpu_operator.trainer import labels as labels_mod
+from tpu_operator.trainer import policy
+from tpu_operator.util.tracing import traced
+from tpu_operator.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+# Service port name (the reference left its port unnamed; naming it makes
+# multi-port templates unambiguous).
+PORT_NAME = "tpujob-port"
+
+_MAX_DNS_LABEL = 63
+
+
+# --- Naming (ref: replicas.go:570-583) --------------------------------------
+
+def gen_general_name(job_name: str, replica_type: str, runtime_id: str, index: int) -> str:
+    """Stable child name ``{job}-{role}-{runtimeid}-{index}``
+    (ref: replicas.go:570-577), truncated from the front of the job name if
+    needed to stay a valid DNS-1035 label."""
+    suffix = f"-{replica_type.lower()}-{runtime_id}-{index}"
+    room = _MAX_DNS_LABEL - len(suffix)
+    return f"{job_name[:room]}{suffix}"
+
+
+def gen_pod_name(job_name: str, replica_type: str, runtime_id: str, index: int) -> str:
+    """Pod name = general name + random suffix so a replacement pod never
+    collides with a terminating one (ref: replicas.go:579-583)."""
+    base = gen_general_name(job_name, replica_type, runtime_id, index)
+    suffix = f"-{rand_string(5)}"
+    return f"{base[: _MAX_DNS_LABEL - len(suffix)]}{suffix}"
+
+
+def headless_service_name(job_name: str, runtime_id: str) -> str:
+    """Job-scoped headless Service for worker enumeration (TPU-native; the
+    megascale/DCN analogue of the reference's per-replica Services)."""
+    suffix = f"-{runtime_id}"
+    return f"{job_name[: _MAX_DNS_LABEL - len(suffix)]}{suffix}"
+
+
+# --- Cluster topology / env contract ----------------------------------------
+
+def process_table(job_name: str, runtime_id: str, spec: TPUJobSpec) -> List[Tuple[str, int, str, int]]:
+    """Ordered (role, index, dns_name, port) for every process in the job.
+
+    The analogue of the reference's ClusterSpec name map
+    (training.go:103-118), with a stable global ordering: replica sets in
+    spec order, indices within. The reference computed DMLC_NUM_SERVER /
+    DMLC_NUM_WORKER by scanning replica sets the same way
+    (replicas.go:215-233).
+    """
+    table = []
+    for rs in spec.replica_specs:
+        for i in range(rs.replicas):
+            table.append(
+                (
+                    rs.tpu_replica_type,
+                    i,
+                    gen_general_name(job_name, rs.tpu_replica_type, runtime_id, i),
+                    int(rs.tpu_port or 0),
+                )
+            )
+    return table
+
+
+def coordinator_address(job_name: str, runtime_id: str, spec: TPUJobSpec) -> Tuple[str, int]:
+    """(dns, port) of the jax.distributed coordinator.
+
+    SCHEDULER[0] if a SCHEDULER role exists (compat mode), else WORKER[0].
+    This fixes the reference's hardcoded ``Replicas[0]``
+    (replicas.go:240-243), which silently mis-pointed jobs whose scheduler
+    was not listed first.
+    """
+    chosen: Optional[TPUReplicaSpec] = None
+    for rs in spec.replica_specs:
+        if rs.tpu_replica_type == TPUReplicaType.SCHEDULER:
+            chosen = rs
+            break
+    if chosen is None:
+        for rs in spec.replica_specs:
+            if rs.tpu_replica_type == TPUReplicaType.WORKER:
+                chosen = rs
+                break
+    if chosen is None:
+        chosen = spec.replica_specs[0]
+    return (
+        gen_general_name(job_name, chosen.tpu_replica_type, runtime_id, 0),
+        int(chosen.tpu_port or 0),
+    )
+
+
+def build_replica_env(
+    job_name: str,
+    runtime_id: str,
+    spec: TPUJobSpec,
+    replica_type: str,
+    index: int,
+    attempt: int = 0,
+) -> Dict[str, str]:
+    """The env contract injected into the ``tpu`` container — the TPU-native
+    replacement for the six ``DMLC_*`` vars (ref: replicas.go:235-260).
+
+    Single-slice: all workers share one jax.distributed group.
+    Multi-slice (spec.num_slices > 1): workers partition into equal slices;
+    ``TPU_WORKER_*`` becomes slice-local and ``MEGASCALE_*`` carries the
+    cross-slice DCN discovery info.
+    """
+    table = process_table(job_name, runtime_id, spec)
+    coord_dns, coord_port = coordinator_address(job_name, runtime_id, spec)
+
+    # Global process id: position in the stable table.
+    process_id = next(
+        gi for gi, (role, i, _dns, _p) in enumerate(table)
+        if role == replica_type and i == index
+    )
+
+    workers = [(role, i, dns, port) for role, i, dns, port in table
+               if role == TPUReplicaType.WORKER]
+
+    env = {
+        "TPUJOB_NAME": job_name,
+        "TPUJOB_RUNTIME_ID": runtime_id,
+        "TPUJOB_REPLICA_TYPE": replica_type.lower(),
+        "TPUJOB_REPLICA_INDEX": str(index),
+        "TPUJOB_ATTEMPT": str(attempt),
+        "JAX_COORDINATOR_ADDRESS": f"{coord_dns}:{coord_port}",
+        "JAX_COORDINATOR_PORT": str(coord_port),
+        "JAX_PROCESS_ID": str(process_id),
+        "JAX_NUM_PROCESSES": str(len(table)),
+    }
+    if spec.tpu_topology:
+        env["TPU_TOPOLOGY"] = spec.tpu_topology
+
+    if replica_type == TPUReplicaType.WORKER and workers:
+        num_slices = max(1, spec.num_slices)
+        per_slice = max(1, len(workers) // num_slices)
+        slice_id = index // per_slice
+        slice_workers = workers[slice_id * per_slice : (slice_id + 1) * per_slice]
+        env["TPU_WORKER_ID"] = str(index % per_slice)
+        env["TPU_WORKER_HOSTNAMES"] = ",".join(dns for _r, _i, dns, _p in slice_workers)
+        if num_slices > 1:
+            # Megascale DCN discovery: slice 0's first worker coordinates.
+            env["MEGASCALE_COORDINATOR_ADDRESS"] = workers[0][2]
+            env["MEGASCALE_NUM_SLICES"] = str(num_slices)
+            env["MEGASCALE_SLICE_ID"] = str(slice_id)
+    return env
+
+
+def headless_service_spec(job: Any) -> Dict[str, Any]:
+    """Job-scoped headless Service selecting every WORKER pod — gives each
+    pod a stable ``hostname.subdomain`` DNS record for megascale/DCN worker
+    enumeration (TPU-native addition; the reference only had per-index
+    ClusterIP Services, replicas.go:132-159)."""
+    spec: TPUJobSpec = job.job_spec
+    name = headless_service_name(job.name, spec.runtime_id)
+    selector = labels_mod.job_labels(job.name, spec.runtime_id)
+    port = 0
+    for rs in spec.replica_specs:
+        if rs.tpu_replica_type == TPUReplicaType.WORKER:
+            port = int(rs.tpu_port or 0)
+            break
+    return {
+        "apiVersion": "v1",
+        "kind": "Service",
+        "metadata": {
+            "name": name,
+            "labels": dict(selector),
+            "ownerReferences": [helper.as_owner(job.metadata)],
+        },
+        "spec": {
+            "clusterIP": "None",
+            "selector": selector,
+            "ports": [{"name": PORT_NAME, "port": port or 8476}],
+        },
+    }
+
+
+# --- The replica set --------------------------------------------------------
+
+class TPUReplicaSet:
+    """Reconciles one replica set's pods + services
+    (ref: MXReplicaSet, replicas.go:45-79)."""
+
+    def __init__(self, clientset: Any, recorder: Any, job: Any, spec: TPUReplicaSpec):
+        """``job`` provides .name/.namespace/.metadata/.job_spec (the
+        reference holds the same back-pointer, replicas.go:49-56).
+
+        The constructor re-checks invariants validation already enforces
+        (ref ctor: replicas.go:81-117) — defensively, since replica sets can
+        be built from cached CRD objects that predate stricter validation.
+        """
+        if spec.tpu_port is None:
+            raise ValueError("tpuPort can't be None")
+        if spec.tpu_replica_type not in TPUReplicaType.ALL:
+            raise ValueError(f"invalid replica type {spec.tpu_replica_type!r}")
+        if spec.tpu_replica_type == TPUReplicaType.SCHEDULER and spec.replicas != 1:
+            raise ValueError("SCHEDULER replica set must have exactly 1 replica")
+        self.clientset = clientset
+        self.recorder = recorder
+        self.job = job
+        self.spec = spec
+
+    # -- identity ------------------------------------------------------------
+
+    @property
+    def replica_type(self) -> str:
+        return self.spec.tpu_replica_type
+
+    def labels(self) -> Dict[str, str]:
+        return labels_mod.replica_labels(
+            self.job.name, self.job.job_spec.runtime_id, self.replica_type
+        )
+
+    def index_labels(self, index: int, attempt: int = 0) -> Dict[str, str]:
+        return labels_mod.index_labels(
+            self.job.name, self.job.job_spec.runtime_id, self.replica_type, index, attempt
+        )
+
+    def gen_name(self, index: int) -> str:
+        return gen_general_name(
+            self.job.name, self.replica_type, self.job.job_spec.runtime_id, index
+        )
+
+    # -- services (ref: replicas.go:132-159, 538-568) -------------------------
+
+    def service_spec_with_index(self, index: int) -> Dict[str, Any]:
+        # Selector deliberately excludes the attempt label: the Service must
+        # keep routing to replacement pods across whole-group restarts.
+        selector = self.index_labels(index)
+        selector.pop("attempt", None)
+        return {
+            "apiVersion": "v1",
+            "kind": "Service",
+            "metadata": {
+                "name": self.gen_name(index),
+                "labels": self.index_labels(index),
+                "ownerReferences": [helper.as_owner(self.job.metadata)],
+            },
+            "spec": {
+                "selector": selector,
+                "ports": [
+                    {
+                        "name": PORT_NAME,
+                        "port": int(self.spec.tpu_port),
+                        "targetPort": int(self.spec.tpu_port),
+                    }
+                ],
+            },
+        }
+
+    @traced
+    def create_service_with_index(self, index: int) -> Dict[str, Any]:
+        """ref: replicas.go:132-159."""
+        svc = self.service_spec_with_index(index)
+        created = self.clientset.services.create(self.job.namespace, svc)
+        if self.recorder:
+            self.recorder.event(
+                self.job, "Normal", "SuccessfulCreate",
+                f"Created service: {svc['metadata']['name']}",
+            )
+        return created
+
+    @traced
+    def sync_services(self) -> None:
+        """Create-if-absent per index (ref: replicas.go:538-568)."""
+        for index in range(self.spec.replicas):
+            name = self.gen_name(index)
+            try:
+                self.clientset.services.get(self.job.namespace, name)
+            except errors.ApiError as e:
+                if errors.is_not_found(e):
+                    self.create_service_with_index(index)
+                else:
+                    raise
+
+    # -- pods (ref: replicas.go:162-276, 481-535) -----------------------------
+
+    def pod_spec_with_index(self, index: int, attempt: int = 0) -> Dict[str, Any]:
+        """Build the pod manifest for one replica index
+        (ref: CreatePodWithIndex, replicas.go:162-276)."""
+        job_spec: TPUJobSpec = self.job.job_spec
+        template = copy.deepcopy(self.spec.template) or {}
+        pod: Dict[str, Any] = {
+            "apiVersion": "v1",
+            "kind": "Pod",
+            "metadata": copy.deepcopy(template.get("metadata") or {}),
+            "spec": copy.deepcopy(template.get("spec") or {}),
+        }
+        md = pod["metadata"]
+        md["name"] = gen_pod_name(
+            self.job.name, self.replica_type, job_spec.runtime_id, index
+        )
+        user_labels = md.get("labels") or {}
+        user_labels.update(self.index_labels(index, attempt))
+        md["labels"] = user_labels
+        md["ownerReferences"] = [helper.as_owner(self.job.metadata)]
+
+        pod_spec = pod["spec"]
+        # schedulerName passthrough (ref: types.go:61-62 → replicas.go:178)
+        if job_spec.scheduler_name:
+            pod_spec["schedulerName"] = job_spec.scheduler_name
+        # Stable per-pod DNS behind the job's headless Service (TPU-native:
+        # megascale DCN discovery resolves hostname.subdomain).
+        pod_spec["hostname"] = self.gen_name(index)
+        pod_spec["subdomain"] = headless_service_name(self.job.name, job_spec.runtime_id)
+        # Whole-group restart: the operator owns restarts, so container
+        # restarts must surface as pod failure, not kubelet-local restart
+        # (SURVEY.md §5: a JAX group cannot survive member loss).
+        if job_spec.restart_policy == RestartPolicy.WHOLE_GROUP:
+            pod_spec["restartPolicy"] = "Never"
+
+        env = build_replica_env(
+            self.job.name, job_spec.runtime_id, job_spec,
+            self.replica_type, index, attempt,
+        )
+        injected = False
+        for container in pod_spec.get("containers") or []:
+            # Only the magic container gets the contract (ref: replicas.go:235
+            # injects only into the container named "mxnet").
+            if container.get("name") != DEFAULT_CONTAINER_NAME:
+                continue
+            existing = container.setdefault("env", [])
+            user_set = {e.get("name") for e in existing}
+            for k, v in env.items():
+                if k not in user_set:
+                    existing.append({"name": k, "value": v})
+            injected = True
+        if not injected:
+            raise ValueError(
+                f"pod template has no container named {DEFAULT_CONTAINER_NAME!r}"
+            )
+        return pod
+
+    @traced
+    def create_pod_with_index(self, index: int, attempt: int = 0) -> Dict[str, Any]:
+        pod = self.pod_spec_with_index(index, attempt)
+        created = self.clientset.pods.create(self.job.namespace, pod)
+        if self.recorder:
+            self.recorder.event(
+                self.job, "Normal", "SuccessfulCreate",
+                f"Created pod: {pod['metadata']['name']}",
+            )
+        return created
+
+    def pods_for_index(self, index: int, attempt: Optional[int] = None) -> List[dict]:
+        sel_labels = self.index_labels(index)
+        sel_labels.pop("attempt", None)
+        selector = labels_mod.to_selector(sel_labels)
+        if attempt is not None:
+            selector += f",attempt={attempt}"
+        return self.clientset.pods.list(self.job.namespace, label_selector=selector)
+
+    def missing_pod_indices(self, attempt: int = 0) -> List[int]:
+        """Indices that need a pod created for this generation — the single
+        home of the live-pod filter shared by ``sync_pods`` and the
+        TrainingJob's gang creation.
+
+        Per-pod mode (the reference behavior): fully-failed pods are filtered
+        out (ref: replicas.go:497 ``status.phase != Failed``) so a fresh pod
+        with a new random suffix replaces them.
+        Whole-group mode: a failed pod does NOT make its index "missing" —
+        the group restart decision belongs to the TrainingJob, which bumps
+        the attempt and deletes the whole generation.
+        """
+        per_pod = self.job.job_spec.restart_policy != RestartPolicy.WHOLE_GROUP
+        missing = []
+        for index in range(self.spec.replicas):
+            pods = self.pods_for_index(index, attempt)
+            live = [
+                p for p in pods
+                if (p.get("status") or {}).get("phase") != "Failed"
+                and not (p.get("metadata") or {}).get("deletionTimestamp")
+            ]
+            if live:
+                continue
+            if pods and not per_pod:
+                continue  # failed generation member; restart logic decides
+            missing.append(index)
+        return missing
+
+    @traced
+    def sync_pods(self, attempt: int = 0) -> None:
+        """Create-if-absent per index (ref: SyncPods, replicas.go:481-535)."""
+        for index in self.missing_pod_indices(attempt):
+            self.create_pod_with_index(index, attempt)
+
+    # -- delete (ref: replicas.go:279-342) ------------------------------------
+
+    @traced
+    def delete(self) -> None:
+        """Delete this replica set's children. One pod DeleteCollection (the
+        reference issued it twice — copy-paste bug, replicas.go:292-302),
+        then per-index services."""
+        selector = labels_mod.to_selector(self.labels())
+        try:
+            self.clientset.pods.delete_collection(self.job.namespace, selector)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                log.warning("deleting pods for %s: %s", self.replica_type, e)
+        for index in range(self.spec.replicas):
+            try:
+                self.clientset.services.delete(self.job.namespace, self.gen_name(index))
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("deleting service %s: %s", self.gen_name(index), e)
+
+    @traced
+    def delete_pods_for_attempt(self, attempt: int) -> None:
+        """Whole-group restart support: delete one generation's pods, keep
+        services (their selectors span attempts)."""
+        selector = labels_mod.to_selector(self.labels()) + f",attempt={attempt}"
+        self.clientset.pods.delete_collection(self.job.namespace, selector)
+
+    # -- status (ref: replicas.go:345-478) ------------------------------------
+
+    @staticmethod
+    def replica_state_from_pod_list(pods: List[dict],
+                                    container_name: str = DEFAULT_CONTAINER_NAME) -> str:
+        """Classify one replica's state from its pod list
+        (ref: replicaStatusFromPodList, replicas.go:345-398).
+
+        Differences from the reference, per SURVEY.md quirks: an empty list
+        is STARTING (the ref returned Running, replicas.go:358-360), and a
+        retryably-terminated container reports STARTING (a replacement is
+        coming) while a permanent non-zero exit reports FAILED — the
+        exit-code contract from policy.py (training.go:172-208).
+        """
+        if not pods:
+            return ReplicaState.STARTING
+        newest = max(
+            pods,
+            key=lambda p: ((p.get("metadata") or {}).get("creationTimestamp") or "",
+                           (p.get("metadata") or {}).get("name") or ""),
+        )
+        status = newest.get("status") or {}
+        phase = status.get("phase", "")
+        if phase == "Pending":
+            return ReplicaState.STARTING
+
+        statuses = [
+            c for c in (status.get("containerStatuses") or [])
+            if c.get("name") == container_name
+        ]
+        if not statuses:
+            if phase == "Failed":
+                # Kubelet-level failure with no container record: Evicted /
+                # Preempted etc. are transient on TPU → a replacement (or
+                # group restart) is coming, not a permanent failure.
+                reason = (newest.get("status") or {}).get("reason", "")
+                if reason in policy.RETRYABLE_POD_REASONS:
+                    return ReplicaState.STARTING
+                return ReplicaState.FAILED
+            return {
+                "Running": ReplicaState.RUNNING,
+                "Succeeded": ReplicaState.SUCCEEDED,
+            }.get(phase, ReplicaState.UNKNOWN)
+
+        cs = statuses[0]
+        state = cs.get("state") or {}
+        # LastTerminationState override: a waiting (e.g. CrashLoopBackOff)
+        # container is judged by how it last died (ref: replicas.go:372-388).
+        terminated = state.get("terminated") or (cs.get("lastState") or {}).get("terminated")
+        if "running" in state:
+            return ReplicaState.RUNNING
+        if terminated is not None:
+            if policy.is_success(terminated):
+                return ReplicaState.SUCCEEDED
+            if policy.is_retryable_termination_state(terminated):
+                return ReplicaState.STARTING
+            return ReplicaState.FAILED
+        if "waiting" in state:
+            return ReplicaState.STARTING
+        return ReplicaState.UNKNOWN
+
+    def has_retryable_failure(self, attempt: int) -> bool:
+        """True if any pod of this generation died retryably — the
+        whole-group restart trigger. Covers both a retryable container exit
+        (128-255, not OOM) and kubelet-level failures with no container
+        record at all (Evicted/Preempted/NodeLost — routine TPU slice
+        preemption). In WHOLE_GROUP mode pods run with restartPolicy Never,
+        so every such death surfaces as a Failed pod."""
+        for index in range(self.spec.replicas):
+            for pod in self.pods_for_index(index, attempt):
+                if policy.pod_failed_retryably(pod, DEFAULT_CONTAINER_NAME):
+                    return True
+        return False
+
+    def get_single_replica_status(self, index: int, attempt: Optional[int] = None) -> str:
+        """ref: GetSingleReplicaStatus (replicas.go:400-434), minus the
+        dead Get-by-name path (see module docstring)."""
+        return self.replica_state_from_pod_list(self.pods_for_index(index, attempt))
+
+    @traced
+    def get_status(self, attempt: Optional[int] = None) -> TPUReplicaStatus:
+        """Roll up per-index states (ref: GetStatus, replicas.go:436-478)."""
+        counts: Dict[str, int] = {}
+        for index in range(self.spec.replicas):
+            st = self.get_single_replica_status(index, attempt)
+            counts[st] = counts.get(st, 0) + 1
+
+        n = self.spec.replicas
+        succeeded = counts.get(ReplicaState.SUCCEEDED, 0)
+        running = counts.get(ReplicaState.RUNNING, 0)
+        if counts.get(ReplicaState.FAILED, 0) > 0:
+            state = ReplicaState.FAILED
+        elif succeeded == n:
+            state = ReplicaState.SUCCEEDED
+        elif running + succeeded == n:
+            state = ReplicaState.RUNNING
+        elif running > 0 or counts.get(ReplicaState.STARTING, 0) > 0:
+            state = ReplicaState.STARTING
+        else:
+            state = ReplicaState.UNKNOWN
+        return TPUReplicaStatus(
+            tpu_replica_type=self.replica_type, state=state, replicas_states=counts
+        )

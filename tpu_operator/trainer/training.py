@@ -1,0 +1,425 @@
+"""TrainingJob: in-memory lifecycle of one TPUJob.
+
+Reference parity: pkg/trainer/training.go:45-457 — the in-memory job object
+the controller keeps per CRD UID, the status source of truth (training.go:56-59),
+and the reconcile driver:
+
+- ``setup``: defaulting + validation + accelerator injection + RuntimeId
+  generation + phase transition None→Creating/Failed (training.go:229-285);
+  skipped when the persisted phase shows setup already ran
+  (training.go:220-223), which is what makes reconcile idempotent across
+  operator restarts.
+- ``setup_replicas`` (training.go:289-303).
+- ``reconcile``: sync services/pods, roll up status, drive phase transitions,
+  write CRD status back (training.go:346-441).
+- ``get_status``: chief-based job state (training.go:132-168).
+- ``cluster_spec``: role → ordered DNS name map (training.go:103-118).
+- ``delete`` (training.go:305-323).
+
+Phase machine (reference semantics at training.go:154-165,392-430, with the
+TPU whole-group additions):
+
+    NONE ──setup──▶ CREATING ──chief running──▶ RUNNING
+      │ invalid spec                │ chief succeeded ▶ DONE  (state Succeeded)
+      ▼                            │ permanent failure ▶ FAILED
+    FAILED                         │ retryable group failure:
+                                   │   attempt < maxRestarts ▶ group restart
+                                   │   else ▶ FAILED (RetryBudgetExhausted)
+    CLEANUP (explicit Delete) ──▶ DONE after children removed
+
+Completed pods are retained so ``kubectl logs`` keeps working
+(tf_job_design_doc.md:86); children are removed by Kubernetes GC through the
+OwnerReferences when the TPUJob itself is deleted, or explicitly via
+``delete()``.
+
+TPU-native hardening baked in (SURVEY.md §7 "hard parts"):
+- **gang pod creation**: each generation's pods are created all-or-none;
+  on any failure the partial generation is rolled back so a TPU pod slice is
+  never left stranded half-acquired (the reference's create-if-absent loop
+  happily created partial jobs, replicas.go:509-525);
+- **whole-group restart**: any retryable worker death tears down and
+  recreates the entire generation under a bumped attempt label — a JAX
+  process group cannot survive member loss, unlike MXNet's PS topology;
+- **coordinator-first ordering**: services are created before pods, so the
+  coordinator's DNS name resolves by the time any worker starts
+  (the reference relied on MXNet client retry).
+"""
+
+from __future__ import annotations
+
+import logging
+from typing import Any, Dict, List, Optional
+
+from tpu_operator.apis.tpujob import helper, validation
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    ControllerConfig,
+    RestartPolicy,
+    ReplicaState,
+    State,
+    TPUJob,
+    TPUJobPhase,
+    TPUJobSpec,
+    TPUReplicaType,
+)
+from tpu_operator.client import errors
+from tpu_operator.trainer import replicas as replicas_mod
+from tpu_operator.util.tracing import traced
+from tpu_operator.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+
+class TrainingJob:
+    """One reconciled TPUJob (ref: TrainingJob, training.go:45-86)."""
+
+    def __init__(self, clientset: Any, recorder: Any, job: TPUJob,
+                 config: Optional[ControllerConfig] = None):
+        self.clientset = clientset
+        self.recorder = recorder
+        self.job = job
+        self.config = config or ControllerConfig()
+        self.replica_sets: List[replicas_mod.TPUReplicaSet] = []
+        # True only while setup's spec mutations (defaults, runtimeId) await
+        # persistence; status writebacks must not overwrite user spec edits.
+        self._spec_dirty = False
+
+    # -- identity passthrough -------------------------------------------------
+
+    @property
+    def name(self) -> str:
+        return self.job.name
+
+    @property
+    def namespace(self) -> str:
+        return self.job.namespace
+
+    @property
+    def uid(self) -> str:
+        return self.job.uid
+
+    @property
+    def metadata(self) -> Dict[str, Any]:
+        return self.job.metadata
+
+    @property
+    def job_spec(self) -> TPUJobSpec:
+        return self.job.spec
+
+    def refresh(self, job: TPUJob) -> None:
+        """Adopt the latest cluster state of this job (same UID).
+
+        The in-memory **status** stays the source of truth (ref:
+        training.go:56-59): the informer cache can lag our own status
+        writes, and adopting a stale status regresses the attempt counter —
+        observed as a whole-group restart racing back to attempt 0 and
+        re-creating the already-deleted generation. Spec is adopted from the
+        cluster (users may edit it), but guarded against the same staleness:
+        a cached object that predates setup has no runtimeId yet, and
+        defaults are re-applied (idempotent) so derived fields like
+        restartPolicy never silently revert.
+        """
+        if not job.spec.runtime_id and self.job.spec.runtime_id:
+            job.spec.runtime_id = self.job.spec.runtime_id
+        set_defaults(job.spec)
+        if job.spec.to_dict() != self.job.spec.to_dict():
+            self.replica_sets = []
+        job.status = self.job.status
+        self.job = job
+
+    # -- setup (ref: training.go:216-303) -------------------------------------
+
+    @traced
+    def setup(self) -> None:
+        """Defaults → validation → accelerators → runtime id → phase.
+
+        Idempotent: a phase other than NONE means setup already ran on a
+        previous operator incarnation; the persisted runtimeId keeps child
+        names stable (ref: training.go:220-223, 272-274).
+        """
+        if self.job.status.phase != TPUJobPhase.NONE:
+            return
+        try:
+            set_defaults(self.job.spec)
+            validation.validate_tpujob_spec(self.job.spec)
+            validation.validate_tpu_resources(self.job.spec)
+            helper.configure_accelerators(self.job.spec, self.config)
+        except validation.ValidationError as e:
+            self.job.status.phase = TPUJobPhase.FAILED
+            self.job.status.state = State.FAILED
+            self.job.status.reason = f"invalid job spec: {e}"
+            if self.recorder:
+                self.recorder.event(self, "Warning", "InvalidSpec", str(e))
+            return
+        if not self.job.spec.runtime_id:
+            self.job.spec.runtime_id = rand_string(4)
+        self._spec_dirty = True
+        self.job.status.phase = TPUJobPhase.CREATING
+        self.job.status.state = State.RUNNING
+
+    @traced
+    def setup_replicas(self) -> None:
+        """Build TPUReplicaSet instances once (ref: training.go:289-303)."""
+        if self.replica_sets:
+            return
+        for rs_spec in self.job.spec.replica_specs:
+            self.replica_sets.append(
+                replicas_mod.TPUReplicaSet(self.clientset, self.recorder, self, rs_spec)
+            )
+
+    # -- cluster spec (ref: training.go:103-118) -------------------------------
+
+    def cluster_spec(self) -> Dict[str, List[str]]:
+        """role → ordered list of ``dns:port`` entries."""
+        out: Dict[str, List[str]] = {}
+        for role, _i, dns, port in replicas_mod.process_table(
+            self.name, self.job.spec.runtime_id, self.job.spec
+        ):
+            out.setdefault(role.lower(), []).append(f"{dns}:{port}")
+        return out
+
+    # -- gang pod creation ----------------------------------------------------
+
+    @traced
+    def sync_pods_gang(self, attempt: int) -> None:
+        """Create every missing pod of this generation, all-or-none.
+
+        If any creation fails, the pods created *in this call* are rolled
+        back and the error propagates (→ rate-limited requeue). Without this,
+        two jobs contending for one TPU pod slice each grab part of it and
+        deadlock (SURVEY.md §7 hard part (a); BASELINE.md config 5).
+        """
+        created: List[tuple] = []
+        try:
+            for rs in self.replica_sets:
+                for index in rs.missing_pod_indices(attempt):
+                    pod = rs.create_pod_with_index(index, attempt)
+                    created.append((rs, pod["metadata"]["name"]))
+        except Exception:
+            # Roll back on ANY failure — API rejection (quota, forbidden) or
+            # a local pod-build error — never leave a partial generation
+            # holding part of a slice.
+            for rs, pod_name in created:
+                try:
+                    self.clientset.pods.delete(self.namespace, pod_name)
+                except errors.ApiError:
+                    pass
+            if self.recorder:
+                self.recorder.event(
+                    self, "Warning", "GangCreateFailed",
+                    f"rolled back {len(created)} pods of attempt {attempt}",
+                )
+            raise
+
+    # -- status (ref: training.go:132-168) -------------------------------------
+
+    def _chief_replica_set(self) -> Optional[replicas_mod.TPUReplicaSet]:
+        tp = self.job.spec.termination_policy
+        if tp is None:
+            return None
+        for rs in self.replica_sets:
+            if rs.replica_type == tp.chief_replica_name:
+                return rs
+        return None
+
+    @traced
+    def get_status(self) -> tuple:
+        """(job_state, replica_statuses) — chief-based completion
+        (ref: training.go:132-168): the chief replica's state decides
+        Running/Succeeded/Failed. In WHOLE_GROUP mode any permanently-failed
+        replica also fails the job (a JAX group without one worker computes
+        nothing), which the reference's per-role independence never needed.
+        """
+        attempt = self.job.status.attempt
+        statuses = [rs.get_status(attempt) for rs in self.replica_sets]
+
+        state = State.RUNNING
+        chief_rs = self._chief_replica_set()
+        if chief_rs is not None:
+            tp = self.job.spec.termination_policy
+            chief_state = chief_rs.get_single_replica_status(tp.chief_replica_index, attempt)
+            if chief_state == ReplicaState.RUNNING:
+                state = State.RUNNING
+            elif chief_state == ReplicaState.SUCCEEDED:
+                state = State.SUCCEEDED
+            elif chief_state == ReplicaState.FAILED:
+                state = State.FAILED
+
+        if self.job.spec.restart_policy == RestartPolicy.WHOLE_GROUP:
+            if any(s.state == ReplicaState.FAILED for s in statuses):
+                state = State.FAILED
+        return state, statuses
+
+    # -- CRD status writeback (ref: training.go:326-343) -----------------------
+
+    @traced
+    def update_crd_status(self) -> None:
+        """Write status to the apiserver only when it changed (the reference
+        diffs get vs in-memory the same way to avoid hot-looping on its own
+        updates, training.go:326-343)."""
+        try:
+            current = self.clientset.tpujobs.get(self.namespace, self.name)
+        except errors.ApiError as e:
+            if errors.is_not_found(e):
+                return
+            raise
+        wire = self.job.status.to_dict()
+        if current.get("status") == wire and not self._spec_dirty:
+            return
+        current["status"] = wire
+        if self._spec_dirty:
+            # Persist setup's spec mutations (defaults, runtimeId) exactly
+            # once; routine status writebacks must never carry the in-memory
+            # spec, or a concurrent user spec edit gets silently reverted.
+            current["spec"] = self.job.spec.to_dict()
+        self.clientset.tpujobs.update(self.namespace, current)
+        self._spec_dirty = False
+
+    # -- reconcile (ref: training.go:346-441) ----------------------------------
+
+    @traced
+    def reconcile(self) -> None:
+        """One idempotent reconcile pass."""
+        phase = self.job.status.phase
+
+        if phase == TPUJobPhase.NONE:
+            self.setup()
+            self.update_crd_status()
+            phase = self.job.status.phase
+
+        if phase in (TPUJobPhase.FAILED, TPUJobPhase.DONE):
+            self.update_crd_status()
+            return
+
+        if phase == TPUJobPhase.CLEANUP:
+            self.delete_resources()
+            self.job.status.phase = TPUJobPhase.DONE
+            self.update_crd_status()
+            return
+
+        self.setup_replicas()
+        attempt = self.job.status.attempt
+
+        # Services first: the coordinator's DNS name must resolve before any
+        # worker calls jax.distributed.initialize (SURVEY.md hard part (c)).
+        self._sync_headless_service()
+        for rs in self.replica_sets:
+            rs.sync_services()
+        self.sync_pods_gang(attempt)
+
+        state, statuses = self.get_status()
+        self.job.status.replica_statuses = statuses
+
+        if state == State.FAILED:
+            self._fail("chief or group replica failed permanently")
+        elif state == State.SUCCEEDED:
+            self.job.status.state = State.SUCCEEDED
+            self.job.status.phase = TPUJobPhase.DONE
+            self.job.status.reason = ""
+            if self.recorder:
+                self.recorder.event(self, "Normal", "JobSucceeded",
+                                    f"chief exited 0 on attempt {attempt}")
+        else:
+            # Whole-group restart check: retryable member death?
+            if (
+                self.job.spec.restart_policy == RestartPolicy.WHOLE_GROUP
+                and any(rs.has_retryable_failure(attempt) for rs in self.replica_sets)
+            ):
+                self._group_restart(attempt)
+            else:
+                running = all(
+                    s.state in (ReplicaState.RUNNING, ReplicaState.SUCCEEDED)
+                    for s in statuses
+                )
+                self.job.status.state = State.RUNNING
+                self.job.status.phase = (
+                    TPUJobPhase.RUNNING if running else TPUJobPhase.CREATING
+                )
+
+        self.update_crd_status()
+
+    def _fail(self, reason: str) -> None:
+        self.job.status.state = State.FAILED
+        self.job.status.phase = TPUJobPhase.FAILED
+        self.job.status.reason = reason
+        if self.recorder:
+            self.recorder.event(self, "Warning", "JobFailed", reason)
+        # Free the slice: surviving workers of a permanently-failed group sit
+        # blocked in collectives holding TPU hardware forever. Delete the
+        # still-live pods; terminated ones are kept so their logs survive
+        # (tf_job_design_doc.md:86).
+        self._delete_live_pods()
+
+    def _delete_live_pods(self) -> None:
+        for rs in self.replica_sets:
+            for index in range(rs.spec.replicas):
+                for pod in rs.pods_for_index(index):
+                    phase = (pod.get("status") or {}).get("phase", "")
+                    if phase in ("Succeeded", "Failed"):
+                        continue
+                    try:
+                        self.clientset.pods.delete(
+                            self.namespace, pod["metadata"]["name"]
+                        )
+                    except errors.ApiError as e:
+                        if not errors.is_not_found(e):
+                            log.warning("freeing pod %s: %s",
+                                        pod["metadata"]["name"], e)
+
+    def _group_restart(self, attempt: int) -> None:
+        """Tear down the failed generation and start the next one
+        (TPU-native; no reference equivalent — MXNet PS restarts per-pod)."""
+        if attempt >= self.job.spec.max_restarts:
+            self._fail(
+                f"retry budget exhausted: attempt {attempt} of "
+                f"{self.job.spec.max_restarts} failed retryably"
+            )
+            return
+        for rs in self.replica_sets:
+            rs.delete_pods_for_attempt(attempt)
+        self.job.status.attempt = attempt + 1
+        self.job.status.phase = TPUJobPhase.CREATING
+        self.job.status.state = State.RUNNING
+        self.job.status.reason = f"group restart: attempt {attempt + 1}"
+        if self.recorder:
+            self.recorder.event(
+                self, "Normal", "GroupRestart",
+                f"worker died retryably; restarting whole group "
+                f"(attempt {attempt + 1}/{self.job.spec.max_restarts})",
+            )
+
+    def _sync_headless_service(self) -> None:
+        svc = replicas_mod.headless_service_spec(self)
+        try:
+            self.clientset.services.get(self.namespace, svc["metadata"]["name"])
+        except errors.ApiError as e:
+            if errors.is_not_found(e):
+                self.clientset.services.create(self.namespace, svc)
+            else:
+                raise
+
+    # -- delete (ref: training.go:305-323) -------------------------------------
+
+    @traced
+    def delete_resources(self) -> None:
+        """Delete children (ref: deleteResources via each replica set's
+        Delete, training.go:423-430 → replicas.go:279-342)."""
+        self.setup_replicas()
+        for rs in self.replica_sets:
+            rs.delete()
+        name = replicas_mod.headless_service_name(self.name, self.job.spec.runtime_id)
+        try:
+            self.clientset.services.delete(self.namespace, name)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                log.warning("deleting headless service %s: %s", name, e)
+
+    @traced
+    def delete(self) -> None:
+        """Explicit teardown: phase → CLEANUP, remove children, → DONE
+        (ref: training.go:305-323; K8s GC via OwnerReferences covers the
+        CRD-deletion path without any operator action)."""
+        self.job.status.phase = TPUJobPhase.CLEANUP
+        self.delete_resources()
+        self.job.status.phase = TPUJobPhase.DONE
+        self.update_crd_status()

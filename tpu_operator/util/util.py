@@ -1,0 +1,60 @@
+"""Small shared utilities.
+
+Reference parity: pkg/util/util.go:
+- ``rand_string`` ← RandString (util.go:58-74): DNS-safe lowercase suffixes
+  for runtime ids and pod names. The reference seeds math/rand with
+  time.Now; here the module-level RNG is seeded per-process and injectable
+  for tests.
+- ``pformat`` ← Pformat (util.go:33-44): pretty JSON for log lines.
+- ``get_operator_namespace`` ← the KUBEFLOW_NAMESPACE env lookup
+  (util.go:29, server.go:61-65) — renamed to TPU_OPERATOR_NAMESPACE with the
+  downward-API ``MY_POD_NAMESPACE`` fallback the chart sets
+  (build/chart/.../deployment.yaml:24-37).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import random
+import string
+from typing import Any
+
+# DNS-1035-safe alphabet (ref: util.go:55 uses lowercase letters+digits; we
+# keep letters-only first char responsibility at call sites).
+_ALPHABET = string.ascii_lowercase + string.digits
+
+_rng = random.Random()
+
+
+def seed(n: int) -> None:
+    """Deterministic randomness for tests."""
+    _rng.seed(n)
+
+
+def rand_string(n: int) -> str:
+    """Random DNS-safe string of length n (ref: util.go:58-74)."""
+    return "".join(_rng.choice(_ALPHABET) for _ in range(n))
+
+
+def pformat(value: Any) -> str:
+    """Pretty-print a value as indented JSON, falling back to repr
+    (ref: util.go:33-44 marshals with indent and falls back to %+v)."""
+    try:
+        return json.dumps(value, indent=2, sort_keys=True, default=str)
+    except (TypeError, ValueError):
+        return repr(value)
+
+
+def get_operator_namespace() -> str:
+    """Namespace the operator watches/records events in.
+
+    Resolution order: TPU_OPERATOR_NAMESPACE env (ref: KUBEFLOW_NAMESPACE,
+    util.go:29) → downward-API MY_POD_NAMESPACE (chart deployment.yaml:24-31)
+    → "default" (ref: server.go:61-65).
+    """
+    return (
+        os.environ.get("TPU_OPERATOR_NAMESPACE")
+        or os.environ.get("MY_POD_NAMESPACE")
+        or "default"
+    )

@@ -1,0 +1,24 @@
+"""Version metadata for the operator binary.
+
+Reference parity: version/version.go:22-40 (Version/GitSHA + runtime info,
+``--version`` prints and exits).
+"""
+
+import platform
+import sys
+
+VERSION = "0.1.0"
+GIT_SHA = "dev"
+
+
+def info() -> str:
+    """Human-readable version block, printed by ``--version``."""
+    return "\n".join(
+        [
+            f"tpu-operator Version: {VERSION}",
+            f"Git SHA: {GIT_SHA}",
+            f"Python Version: {platform.python_version()}",
+            f"Python Compiler: {platform.python_compiler()}",
+            f"Platform: {sys.platform}/{platform.machine()}",
+        ]
+    )
