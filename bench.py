@@ -1,0 +1,97 @@
+#!/usr/bin/env python3
+"""Headline benchmark: CIFAR-10 ResNet training throughput per chip.
+
+Prints ONE JSON line:
+    {"metric": ..., "value": N, "unit": ..., "vs_baseline": N}
+
+BASELINE.md: the reference publishes no performance numbers at all (it is a
+control-plane operator; its compute lived in user MXNet images). The
+BASELINE.json target metric is "CIFAR-10 steps/sec/chip vs GPU spec" — the
+GPU spec being the reference's single-GPU CIFAR example
+(/root/reference/README.md:126-167, `alpha.kubernetes.io/nvidia-gpu: 1`,
+NVIDIA K80-class, 2017-era MXNet). Published MXNet ResNet/CIFAR-10 numbers
+for that setup cluster around ~1.2k images/sec, which we pin as the
+baseline denominator below (documented assumption, reference ships none).
+
+The benched step is the flagship payload exactly as the operator launches it
+(tpu_operator/payload/cifar.py): ResNet-20, bf16 compute on the MXU, f32
+master params, one jit with sharding over the (data, model) mesh — on
+whatever accelerator is attached (single TPU chip under the driver; falls
+back to CPU with --quick for smoke runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import itertools
+import json
+import os
+import sys
+
+
+# The reference's GPU config throughput assumption (see module docstring).
+BASELINE_IMAGES_PER_SEC = 1200.0
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--quick", action="store_true",
+                   help="tiny CPU-friendly config (smoke test, not a benchmark)")
+    p.add_argument("--batch", type=int, default=0, help="override global batch")
+    p.add_argument("--steps", type=int, default=0, help="override timed steps")
+    return p.parse_args(argv)
+
+
+def main(argv=None) -> int:
+    args = parse_args(argv)
+    if args.quick:
+        # Force CPU even when a TPU plugin pinned the platform at boot
+        # (backend clients initialize lazily, so this override wins).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+
+    import jax
+
+    if args.quick:
+        jax.config.update("jax_platforms", "cpu")
+
+    from tpu_operator.payload import cifar, train
+
+    n_devices = len(jax.devices())
+    platform = jax.devices()[0].platform
+
+    if args.quick:
+        batch = args.batch or 64
+        steps = args.steps or 5
+        cfg = ["--blocks", "1", "--widths", "8", "16", "32"]
+    else:
+        batch = args.batch or 1024
+        steps = args.steps or 30
+        cfg = ["--blocks", "3", "--widths", "16", "32", "64"]  # ResNet-20
+
+    cargs = cifar.parse_args(["--batch", str(batch), *cfg])
+    mesh, _model, state, step, batches = cifar.build(cargs)
+
+    # Pre-generate a handful of host batches and cycle them so host-side
+    # numpy RNG is off the timed path; device transfer stays on it (that is
+    # part of real step time).
+    pregen = list(itertools.islice(batches, 8))
+    cycled = itertools.cycle(pregen)
+
+    state, steps_per_sec = train.throughput(
+        mesh, step, state, cycled, steps=steps, warmup=5
+    )
+    images_per_sec = steps_per_sec * batch
+    per_chip = images_per_sec / n_devices
+
+    result = {
+        "metric": f"cifar10_resnet20_bf16_images_per_sec_per_chip_{platform}",
+        "value": round(per_chip, 2),
+        "unit": "images/sec/chip",
+        "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC, 3),
+    }
+    print(json.dumps(result))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

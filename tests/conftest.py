@@ -6,14 +6,26 @@ at conftest import time (pytest imports conftest before collecting tests).
 """
 
 import os
+import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the surrounding environment may pin a real accelerator platform
+# (a tunneled TPU whose PJRT plugin a sitecustomize hook registers — and jax
+# imports — at interpreter boot, before any conftest runs). Backend *clients*
+# initialize lazily, so overriding the platform config here, before the first
+# jax.devices() call, still wins. XLA_FLAGS is read at client creation.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
         _flags + " --xla_force_host_platform_device_count=8"
     ).strip()
 
-import sys
+import jax
+
+jax.config.update("jax_platforms", "cpu")
+assert not jax._src.xla_bridge.backends_are_initialized(), (
+    "a plugin initialized JAX backends before conftest; tests would run on "
+    "the real accelerator — aborting"
+)
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))

@@ -1,0 +1,165 @@
+"""Data-plane tests on the virtual 8-device CPU mesh.
+
+Covers the BASELINE configs' compute side: linear regression convergence
+(config 2), data-parallel CIFAR ResNet (config 3) including the loss-parity
+check (sharded run matches single-device run), tensor-parallel sharding, and
+the bootstrap env-contract parsing (the consumer of replicas.py's injection).
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from tpu_operator.payload import bootstrap
+from tpu_operator.payload import data as data_mod
+from tpu_operator.payload import models, train
+
+
+@pytest.fixture(scope="module")
+def devices():
+    ds = jax.devices()
+    assert len(ds) >= 8, "conftest must provide 8 virtual CPU devices"
+    return ds
+
+
+# --- bootstrap env contract ---------------------------------------------------
+
+def test_process_info_parses_operator_env():
+    env = {
+        "JAX_COORDINATOR_ADDRESS": "train-worker-ab12-0:8476",
+        "JAX_PROCESS_ID": "2",
+        "JAX_NUM_PROCESSES": "4",
+        "TPU_WORKER_ID": "2",
+        "TPU_WORKER_HOSTNAMES": "w0,w1,w2,w3",
+        "TPUJOB_NAME": "train",
+        "TPUJOB_REPLICA_TYPE": "worker",
+        "TPUJOB_ATTEMPT": "1",
+    }
+    info = bootstrap.process_info_from_env(env)
+    assert info.coordinator_address == "train-worker-ab12-0:8476"
+    assert info.process_id == 2
+    assert info.num_processes == 4
+    assert info.worker_hostnames == ("w0", "w1", "w2", "w3")
+    assert info.attempt == 1
+
+
+def test_initialize_single_process_skips_distributed():
+    info = bootstrap.initialize(bootstrap.ProcessInfo(
+        coordinator_address="", process_id=0, num_processes=1,
+        worker_id=0, worker_hostnames=()))
+    assert info.num_processes == 1
+
+
+def test_run_payload_exit_codes():
+    assert bootstrap.run_payload(lambda info: None) == 0
+    assert bootstrap.run_payload(
+        lambda info: (_ for _ in ()).throw(RuntimeError("boom"))) == 1
+    assert bootstrap.run_payload(
+        lambda info: (_ for _ in ()).throw(SystemExit(143))) == 143
+
+
+# --- mesh construction --------------------------------------------------------
+
+def test_make_mesh_shapes(devices):
+    mesh = train.make_mesh(8)
+    assert mesh.devices.shape == (8, 1)
+    assert mesh.axis_names == ("data", "model")
+    mesh_tp = train.make_mesh(8, model_parallel=2)
+    assert mesh_tp.devices.shape == (4, 2)
+    with pytest.raises(ValueError, match="divisible"):
+        train.make_mesh(6, model_parallel=4)
+
+
+# --- linear regression (BASELINE config 2) -----------------------------------
+
+def test_linear_regression_converges_on_mesh(devices):
+    from tpu_operator.payload.linear import parse_args, run
+
+    args = parse_args(["--steps", "150", "--batch", "256", "--dim", "4"])
+    info = bootstrap.ProcessInfo("", 0, 1, 0, ())
+    loss = run(info, args)
+    assert loss < 1e-3
+
+
+# --- CIFAR ResNet (BASELINE config 3) ----------------------------------------
+
+def tiny_args(extra=()):
+    from tpu_operator.payload.cifar import parse_args
+
+    return parse_args([
+        "--steps", "6", "--batch", "32", "--blocks", "1",
+        "--widths", "8", "8", "8", "--log-every", "0", *extra,
+    ])
+
+
+def test_cifar_resnet_loss_descends(devices):
+    from tpu_operator.payload.cifar import build
+
+    args = tiny_args()
+    mesh, _model, state, step, batches = build(args)
+    first = None
+    for i in range(args.steps):
+        arrays = data_mod.put_global_batch(mesh, *next(batches))
+        state, metrics = step(state, *arrays)
+        if first is None:
+            first = float(metrics["loss"])
+    last = float(metrics["loss"])
+    assert last < first, f"loss did not descend: {first} -> {last}"
+
+
+def test_cifar_batch_is_sharded_over_data_axis(devices):
+    from tpu_operator.payload.cifar import build
+
+    args = tiny_args()
+    mesh, *_ = build(args)
+    images, _labels = data_mod.put_global_batch(
+        mesh, *next(data_mod.synthetic_cifar(0, 32)))
+    # 8-way data mesh → each device holds batch/8
+    assert len(images.addressable_shards) == 8
+    assert images.addressable_shards[0].data.shape[0] == 4
+
+
+def test_loss_parity_single_vs_sharded(devices):
+    """BASELINE correctness: the 8-device data-parallel run computes the
+    same math as a single-device run (same seed, same batches)."""
+    from tpu_operator.payload.cifar import build
+
+    losses = {}
+    for n in (1, 8):
+        args = tiny_args()
+        mesh = train.make_mesh(n)
+        mesh, _m, state, step, batches = build(args, mesh=mesh)
+        for _ in range(4):
+            arrays = data_mod.put_global_batch(mesh, *next(batches))
+            state, metrics = step(state, *arrays)
+        losses[n] = float(metrics["loss"])
+    assert losses[1] == pytest.approx(losses[8], rel=2e-2), losses
+
+
+def test_tensor_parallel_head_is_sharded(devices):
+    from tpu_operator.payload.cifar import build
+
+    args = tiny_args(["--model-parallel", "2"])
+    mesh, _model, state, step, batches = build(args)
+    arrays = data_mod.put_global_batch(mesh, *next(batches))
+    state, metrics = step(state, *arrays)  # compiles + runs with TP constraint
+    head_kernel = state.params["head"]["kernel"]
+    # sharded over the model axis: each shard holds half the classes
+    shards = head_kernel.addressable_shards
+    assert any(s.data.shape[1] == head_kernel.shape[1] // 2 for s in shards)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_train_step_donation_no_leak(devices):
+    """Donated state means the old buffers are consumed — re-using the stale
+    handle must raise, proving in-place HBM update."""
+    from tpu_operator.payload.cifar import build
+
+    args = tiny_args()
+    mesh, _m, state, step, batches = build(args)
+    arrays = data_mod.put_global_batch(mesh, *next(batches))
+    new_state, _ = step(state, *arrays)
+    with pytest.raises(RuntimeError):
+        _ = np.asarray(jax.tree_util.tree_leaves(state.params)[0])

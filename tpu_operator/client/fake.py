@@ -224,6 +224,17 @@ class FakeClientset:
         self._version += 1
         return self._version
 
+    def close_watches(self) -> None:
+        """Terminate every open watch stream (unblocks consumers waiting on
+        quiet resources). The apiserver harness calls this on shutdown so
+        handler threads parked in a watch iteration always exit."""
+        for client in (self.pods, self.services, self.events, self.endpoints,
+                       self.leases, self.configmaps, self.tpujobs):
+            with self.lock:
+                watchers = list(client._watchers)
+            for q, _ns, _sel in watchers:
+                q.put(None)
+
     def record(self, verb: str, resource: str, namespace: str, name: str) -> None:
         self.actions.append((verb, resource, namespace, name))
 

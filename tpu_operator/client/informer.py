@@ -111,6 +111,15 @@ class Informer:
                              name=f"informer-{getattr(self._client, 'kind', '?')}")
         t.start()
         self._threads.append(t)
+        # ONE watch-stopper for the informer's lifetime (not one per
+        # list/watch cycle — that leaked a parked thread per re-list): on
+        # shutdown it tears down whichever stream is current.
+        stopper = threading.Thread(
+            target=self._stop_current_watch_on, args=(stop_event,),
+            daemon=True, name="informer-watch-stopper",
+        )
+        stopper.start()
+        self._threads.append(stopper)
         if self._resync_period > 0:
             rt = threading.Thread(target=self._resync_loop, args=(stop_event,),
                                   daemon=True, name="informer-resync")
@@ -135,10 +144,9 @@ class Informer:
         watch = self._client.watch(self._namespace)
         with self._lock:
             self._watch = watch
-        # A stopper thread breaks the blocking iteration on shutdown.
-        threading.Thread(
-            target=lambda: (stop_event.wait(), watch.stop()), daemon=True
-        ).start()
+        if stop_event.is_set():  # raced shutdown between create and register
+            watch.stop()
+            return
 
         objs = self._client.list(self._namespace)
         self.store.replace(objs)
@@ -162,6 +170,13 @@ class Informer:
                 self._dispatch_delete(obj)
             elif event_type == "ERROR":
                 return  # re-list
+
+    def _stop_current_watch_on(self, stop_event: threading.Event) -> None:
+        stop_event.wait()
+        with self._lock:
+            watch = self._watch
+        if watch is not None:
+            watch.stop()
 
     def _resync_loop(self, stop_event: threading.Event) -> None:
         """Periodic re-list + re-delivery so missed edge cases self-heal

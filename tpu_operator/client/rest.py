@@ -1,0 +1,272 @@
+"""Kubernetes REST client, from scratch on the standard library.
+
+Reference parity: the generated typed clientset
+(pkg/client/clientset/versioned/typed/mxnet/v1alpha1/mxjob.go:37-47 —
+CRUD + Watch + Patch over the apiserver REST API) plus the kubernetes and
+apiextensions clientsets the server creates (cmd/mx-operator/app/server.go:155-173).
+The reference vendors 88 MB of client-go for this; the operator's actual
+needs are six resource kinds with CRUD + watch + label selection, which this
+module provides in one file over ``http.client``.
+
+Wire behavior:
+- JSON bodies both ways; non-2xx responses decode the Kubernetes ``Status``
+  body into :class:`tpu_operator.client.errors.ApiError`, so call sites share
+  one error model with the fake clientset.
+- ``watch`` issues ``GET ...?watch=true`` and yields (type, object) pairs
+  from the chunked JSON-lines stream; ``resourceVersion`` anchors the stream
+  when given. The returned object matches the fake's Watch protocol
+  (iterable + ``stop()``), which is what lets informers run unchanged
+  against either.
+- Auth: bearer token, client TLS certs, or insecure HTTP for tests — all
+  resolved by util/k8sutil.py, mirroring the reference's
+  kubeconfig-or-in-cluster resolution (pkg/util/k8sutil/k8sutil.go:50-74).
+"""
+
+from __future__ import annotations
+
+import json
+import socket
+import ssl
+import threading
+import urllib.parse
+from dataclasses import dataclass, field
+from http.client import HTTPConnection, HTTPSConnection
+from typing import Any, Dict, Iterator, List, Optional, Tuple
+
+from tpu_operator.client import errors
+
+# Sentinel distinguishing "use the config default" from an explicit None
+# (= no socket timeout, required for long-lived watch streams).
+_DEFAULT_TIMEOUT = object()
+
+
+@dataclass
+class RestConfig:
+    """Connection parameters (client-go's rest.Config equivalent)."""
+
+    host: str  # e.g. "https://10.0.0.1:443" or "http://127.0.0.1:8001"
+    bearer_token: str = ""
+    ca_cert_file: str = ""
+    client_cert_file: str = ""
+    client_key_file: str = ""
+    insecure_skip_tls_verify: bool = False
+    timeout: float = 30.0
+    extra_headers: Dict[str, str] = field(default_factory=dict)
+
+    def ssl_context(self) -> Optional[ssl.SSLContext]:
+        if not self.host.startswith("https"):
+            return None
+        ctx = ssl.create_default_context(
+            cafile=self.ca_cert_file or None
+        )
+        if self.insecure_skip_tls_verify:
+            ctx.check_hostname = False
+            ctx.verify_mode = ssl.CERT_NONE
+        if self.client_cert_file:
+            ctx.load_cert_chain(self.client_cert_file, self.client_key_file or None)
+        return ctx
+
+
+class _StreamWatch:
+    """Watch over a live HTTP chunked-response stream. Iterable of
+    (event_type, object); ``stop()`` closes the socket, unblocking the
+    consumer (same protocol as client.fake.Watch)."""
+
+    def __init__(self, response: Any, connection: Any):
+        self._resp = response
+        self._conn = connection
+        self._stopped = False
+
+    def stop(self) -> None:
+        """Unblock the consumer from another thread. MUST NOT call
+        ``conn.close()``/``response.close()`` here: closing the buffered
+        response reader needs a lock the blocked reader thread holds
+        (observed as a hard deadlock under faulthandler). ``shutdown()`` on
+        the raw socket deterministically wakes the reader, which then closes
+        the connection from its own thread."""
+        self._stopped = True
+        sock = getattr(self._conn, "sock", None)
+        if sock is not None:
+            try:
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
+
+    def __iter__(self) -> Iterator[Tuple[str, dict]]:
+        buf = b""
+        try:
+            while not self._stopped:
+                chunk = self._resp.read1(65536)
+                if not chunk:
+                    return
+                buf += chunk
+                while b"\n" in buf:
+                    line, buf = buf.split(b"\n", 1)
+                    line = line.strip()
+                    if not line:
+                        continue
+                    try:
+                        event = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    yield event.get("type", ""), event.get("object", {})
+        except (OSError, ssl.SSLError, socket.timeout):
+            return  # stream torn down (stop() or server side); caller re-lists
+        finally:
+            # Consumer-side close: safe here (same thread as the reader).
+            try:
+                self._conn.close()
+            except OSError:
+                pass
+
+
+class RestClient:
+    """Low-level request runner; one connection per call (watch holds its
+    own), so it is thread-safe without pooling complexity."""
+
+    def __init__(self, config: RestConfig):
+        self.config = config
+        parsed = urllib.parse.urlparse(config.host)
+        self._https = parsed.scheme == "https"
+        self._netloc = parsed.netloc or parsed.path
+        self._ctx = config.ssl_context()
+
+    def _connect(self, timeout: Any = _DEFAULT_TIMEOUT) -> Any:
+        timeout = self.config.timeout if timeout is _DEFAULT_TIMEOUT else timeout
+        if self._https:
+            return HTTPSConnection(self._netloc, context=self._ctx, timeout=timeout)
+        return HTTPConnection(self._netloc, timeout=timeout)
+
+    def _headers(self) -> Dict[str, str]:
+        headers = {"Accept": "application/json", "Content-Type": "application/json"}
+        if self.config.bearer_token:
+            headers["Authorization"] = f"Bearer {self.config.bearer_token}"
+        headers.update(self.config.extra_headers)
+        return headers
+
+    def request(self, method: str, path: str,
+                params: Optional[Dict[str, str]] = None,
+                body: Optional[dict] = None) -> Any:
+        if params:
+            path = f"{path}?{urllib.parse.urlencode(params)}"
+        conn = self._connect()
+        try:
+            conn.request(
+                method, path,
+                body=json.dumps(body) if body is not None else None,
+                headers=self._headers(),
+            )
+            resp = conn.getresponse()
+            data = resp.read()
+            if resp.status >= 300:
+                raise _status_error(resp.status, data)
+            return json.loads(data) if data else None
+        finally:
+            conn.close()
+
+    def stream(self, path: str, params: Dict[str, str]) -> _StreamWatch:
+        """Open a watch stream (no read timeout — watches are long-lived)."""
+        qs = urllib.parse.urlencode(params)
+        conn = self._connect(timeout=None)
+        conn.request("GET", f"{path}?{qs}", headers=self._headers())
+        resp = conn.getresponse()
+        if resp.status >= 300:
+            data = resp.read()
+            conn.close()
+            raise _status_error(resp.status, data)
+        return _StreamWatch(resp, conn)
+
+
+def _status_error(code: int, data: bytes) -> errors.ApiError:
+    reason, message, status = "", "", {}
+    try:
+        status = json.loads(data)
+        reason = status.get("reason", "")
+        message = status.get("message", "")
+    except (json.JSONDecodeError, AttributeError):
+        message = data.decode("utf-8", "replace")[:500]
+    return errors.ApiError(code, reason, message, status)
+
+
+class RestResourceClient:
+    """Typed CRUD + watch for one namespaced resource; the drop-in HTTP
+    counterpart of client.fake.FakeResourceClient."""
+
+    def __init__(self, rest: RestClient, api_prefix: str, resource: str, kind: str):
+        self._rest = rest
+        self._prefix = api_prefix  # "/api/v1" or "/apis/<group>/<version>"
+        self.resource = resource
+        self.kind = kind
+
+    def _path(self, namespace: str, name: str = "") -> str:
+        base = f"{self._prefix}/namespaces/{namespace}/{self.resource}"
+        return f"{base}/{name}" if name else base
+
+    def create(self, namespace: str, obj: dict) -> dict:
+        return self._rest.request("POST", self._path(namespace), body=obj)
+
+    def get(self, namespace: str, name: str) -> dict:
+        return self._rest.request("GET", self._path(namespace, name))
+
+    def list(self, namespace: str = "", label_selector: str = "") -> List[dict]:
+        params: Dict[str, str] = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if namespace:
+            path = self._path(namespace)
+        else:
+            path = f"{self._prefix}/{self.resource}"  # all namespaces
+        result = self._rest.request("GET", path, params=params)
+        return (result or {}).get("items", [])
+
+    def update(self, namespace: str, obj: dict) -> dict:
+        name = (obj.get("metadata") or {}).get("name", "")
+        return self._rest.request("PUT", self._path(namespace, name), body=obj)
+
+    def update_status(self, namespace: str, obj: dict) -> dict:
+        name = (obj.get("metadata") or {}).get("name", "")
+        return self._rest.request(
+            "PUT", self._path(namespace, name) + "/status", body=obj
+        )
+
+    def delete(self, namespace: str, name: str, options: Optional[dict] = None) -> None:
+        self._rest.request("DELETE", self._path(namespace, name), body=options)
+
+    def delete_collection(self, namespace: str, label_selector: str = "") -> int:
+        params = {"labelSelector": label_selector} if label_selector else {}
+        result = self._rest.request("DELETE", self._path(namespace), params=params)
+        return len((result or {}).get("items", []))
+
+    def watch(self, namespace: str = "", label_selector: str = "",
+              resource_version: str = "") -> _StreamWatch:
+        params: Dict[str, str] = {"watch": "true"}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if resource_version:
+            params["resourceVersion"] = resource_version
+        return self._rest.stream(self._path(namespace) if namespace
+                                 else f"{self._prefix}/{self.resource}", params)
+
+
+class Clientset:
+    """The full typed clientset over one RestConfig (ref: the three clients
+    built at server.go:155-173 collapsed into one surface)."""
+
+    def __init__(self, config: RestConfig):
+        from tpu_operator.apis.tpujob.v1alpha1.types import (
+            CRD_GROUP, CRD_KIND, CRD_KIND_PLURAL, CRD_VERSION,
+        )
+
+        self.rest = RestClient(config)
+        core = "/api/v1"
+        self.pods = RestResourceClient(self.rest, core, "pods", "Pod")
+        self.services = RestResourceClient(self.rest, core, "services", "Service")
+        self.events = RestResourceClient(self.rest, core, "events", "Event")
+        self.endpoints = RestResourceClient(self.rest, core, "endpoints", "Endpoints")
+        self.configmaps = RestResourceClient(self.rest, core, "configmaps", "ConfigMap")
+        self.leases = RestResourceClient(
+            self.rest, "/apis/coordination.k8s.io/v1", "leases", "Lease"
+        )
+        self.tpujobs = RestResourceClient(
+            self.rest, f"/apis/{CRD_GROUP}/{CRD_VERSION}", CRD_KIND_PLURAL, CRD_KIND
+        )

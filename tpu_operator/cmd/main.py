@@ -1,0 +1,42 @@
+"""Process entry point: ``python -m tpu_operator.cmd.main``.
+
+Reference parity: cmd/mx-operator/main.go:34-49 — flag parsing, the
+filename-tagging log hook (main.go:27-32), optional JSON log format for
+Stackdriver (main.go:40-43), ``--version`` (main.go:44-46 → version.go), and
+handoff to app.Run.
+"""
+
+from __future__ import annotations
+
+import logging
+import sys
+
+from tpu_operator import version
+from tpu_operator.cmd.options import build_parser
+from tpu_operator.cmd.server import run
+from tpu_operator.util import tracing
+
+log = logging.getLogger(__name__)
+
+
+def main(argv=None) -> int:
+    opts = build_parser().parse_args(argv)
+    if opts.version:
+        print(version.info())
+        return 0
+    tracing.install_filename_log_format(json_format=opts.json_log_format)
+    if opts.trace:
+        tracing.enable()
+    log.info("tpu-operator %s starting", version.VERSION)
+    try:
+        run(opts)
+    except KeyboardInterrupt:
+        log.info("interrupted; shutting down")
+    except Exception as e:  # noqa: BLE001 — fatal startup/runtime error
+        log.error("fatal: %s", e)
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,50 @@
+"""CLI flags.
+
+Reference parity: cmd/mx-operator/app/options/options.go:23-45. The
+reference declared ``--chaos-level`` and ``--gc-interval`` but wired them to
+nothing (options.go:40,42 — SURVEY.md quirks); here both are functional:
+chaos feeds the fault injector (controller/chaos.py), gc-interval drives the
+orphan sweep (controller.run_gc_once).
+"""
+
+from __future__ import annotations
+
+import argparse
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpu-operator",
+        description="Kubernetes operator for TPU-native JAX training jobs",
+    )
+    # ref flags (options.go:38-45)
+    p.add_argument("--chaos-level", type=int, default=-1,
+                   help="DANGEROUS: fault-injection level; >=0 randomly kills "
+                        "one managed pod per chaos interval (default: off)")
+    p.add_argument("--chaos-interval", type=float, default=30.0,
+                   help="seconds between chaos kills when --chaos-level >= 0")
+    p.add_argument("--gc-interval", type=float, default=600.0,
+                   help="seconds between orphaned-child GC sweeps")
+    p.add_argument("--controller-config-file", default="",
+                   help="path to the admin ControllerConfig YAML "
+                        "(accelerator → volumes/env injection map)")
+    p.add_argument("--json-log-format", action="store_true",
+                   help="structured JSON logs (Stackdriver-friendly)")
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    # connection / runtime flags (the reference hardcoded these or used env)
+    p.add_argument("--master", default="",
+                   help="apiserver URL override (e.g. http://127.0.0.1:8001)")
+    p.add_argument("--kubeconfig", default="",
+                   help="kubeconfig path (default: $KUBECONFIG or in-cluster)")
+    p.add_argument("--namespace", default="",
+                   help="namespace to operate in (default: "
+                        "$TPU_OPERATOR_NAMESPACE / $MY_POD_NAMESPACE / default)")
+    p.add_argument("--threadiness", type=int, default=1,
+                   help="concurrent reconcile workers (ref ran 1; >1 is safe here)")
+    p.add_argument("--resync-period", type=float, default=30.0,
+                   help="informer resync/re-list period in seconds")
+    p.add_argument("--no-leader-elect", action="store_true",
+                   help="skip leader election (single-instance deployments/tests)")
+    p.add_argument("--trace", action="store_true",
+                   help="function-level call tracing (the go-tracey equivalent)")
+    return p

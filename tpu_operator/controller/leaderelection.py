@@ -1,0 +1,214 @@
+"""Leader election on a coordination.k8s.io Lease.
+
+Reference parity: cmd/mx-operator/app/server.go:106-129 — the reference
+runs ``election.RunOrDie`` over an **Endpoints** lock named ``tf-operator``
+with lease 15 s / renew 5 s / retry 3 s (server.go:48-52); exactly one
+operator replica reconciles at a time, and losing the lease kills the
+process (OnStoppedLeading → fatal, server.go:98-102).
+
+Endpoints locks are deprecated upstream; this implementation uses the
+modern Lease resource with the same cadence and the same semantics:
+``run`` blocks, invoking ``on_started_leading(stop_event)`` once acquired,
+and sets the stop event + calls ``on_stopped_leading`` if the lease is lost.
+
+Clock skew note: like client-go, expiry is judged on the *local* clock by
+re-reading ``renewTime``; the margin built into lease_duration−renew_deadline
+absorbs reasonable skew.
+"""
+
+from __future__ import annotations
+
+import datetime
+import logging
+import socket
+import threading
+from typing import Any, Callable, Optional
+
+from tpu_operator.client import errors
+from tpu_operator.util.util import rand_string
+
+log = logging.getLogger(__name__)
+
+LEASE_DURATION = 15.0   # ref: server.go:49
+RENEW_DEADLINE = 5.0    # ref: server.go:50 (renew every 5s while leading)
+RETRY_PERIOD = 3.0      # ref: server.go:51
+
+LOCK_NAME = "tpu-operator"  # ref: the "tf-operator" Endpoints lock, server.go:108
+
+
+def _now() -> datetime.datetime:
+    return datetime.datetime.now(datetime.timezone.utc)
+
+
+def _fmt(ts: datetime.datetime) -> str:
+    return ts.strftime("%Y-%m-%dT%H:%M:%S.%fZ")
+
+
+def _parse(ts: str) -> Optional[datetime.datetime]:
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            return datetime.datetime.strptime(ts, fmt).replace(
+                tzinfo=datetime.timezone.utc
+            )
+        except ValueError:
+            continue
+    return None
+
+
+def default_identity() -> str:
+    """hostname + random suffix (ref: server.go:105 id = hostname)."""
+    return f"{socket.gethostname()}-{rand_string(6)}"
+
+
+class LeaderElector:
+    def __init__(
+        self,
+        clientset: Any,
+        namespace: str,
+        identity: str = "",
+        name: str = LOCK_NAME,
+        lease_duration: float = LEASE_DURATION,
+        renew_deadline: float = RENEW_DEADLINE,
+        retry_period: float = RETRY_PERIOD,
+    ):
+        self.clientset = clientset
+        self.namespace = namespace
+        self.identity = identity or default_identity()
+        self.name = name
+        self.lease_duration = lease_duration
+        self.renew_deadline = renew_deadline
+        self.retry_period = retry_period
+        self.is_leader = threading.Event()
+
+    # -- lease record I/O -----------------------------------------------------
+
+    def _lease_spec(self, transitions: int) -> dict:
+        return {
+            "holderIdentity": self.identity,
+            "leaseDurationSeconds": int(self.lease_duration),
+            "acquireTime": _fmt(_now()),
+            "renewTime": _fmt(_now()),
+            "leaseTransitions": transitions,
+        }
+
+    def try_acquire_or_renew(self) -> bool:
+        """One CAS round against the Lease object. Returns True if we hold
+        the lease after this round (ref: the acquire/renew loop inside
+        election.RunOrDie)."""
+        try:
+            lease = self.clientset.leases.get(self.namespace, self.name)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                raise
+            lease = {
+                "apiVersion": "coordination.k8s.io/v1",
+                "kind": "Lease",
+                "metadata": {"name": self.name, "namespace": self.namespace},
+                "spec": self._lease_spec(0),
+            }
+            try:
+                self.clientset.leases.create(self.namespace, lease)
+                return True
+            except errors.ApiError as e2:
+                if errors.is_already_exists(e2):
+                    return False  # raced another candidate; retry next round
+                raise
+
+        spec = lease.get("spec") or {}
+        holder = spec.get("holderIdentity", "")
+        renew = _parse(spec.get("renewTime", "")) or _now()
+        duration = float(spec.get("leaseDurationSeconds", self.lease_duration))
+        expired = (_now() - renew).total_seconds() > duration
+
+        if holder == self.identity:
+            spec["renewTime"] = _fmt(_now())
+            spec["holderIdentity"] = self.identity
+        elif expired:
+            transitions = int(spec.get("leaseTransitions", 0)) + 1
+            lease["spec"] = self._lease_spec(transitions)
+        else:
+            return False  # someone else holds a live lease
+
+        try:
+            self.clientset.leases.update(self.namespace, lease)
+            return True
+        except errors.ApiError as e:
+            if errors.is_conflict(e):
+                return False  # lost the CAS; retry
+            raise
+
+    # -- run loop -------------------------------------------------------------
+
+    def run(
+        self,
+        on_started_leading: Callable[[threading.Event], None],
+        on_stopped_leading: Optional[Callable[[], None]] = None,
+        stop_event: Optional[threading.Event] = None,
+    ) -> None:
+        """Block: campaign once, then lead until the lease is lost or
+        stop_event fires. ``on_started_leading`` runs in a worker thread and
+        receives a leading-scoped stop event chained to the outer one
+        (ref: OnStartedLeading → controller.Run, server.go:93-95).
+
+        On lost leadership this RETURNS (after ``on_stopped_leading``): the
+        process must exit and be restarted by its Deployment, exactly like
+        the reference's OnStoppedLeading → fatal (server.go:98-102). The
+        controller's workqueue is shut down by then, so re-campaigning in
+        the same process would hold the lease while reconciling nothing.
+        """
+        stop_event = stop_event or threading.Event()
+
+        # Campaign (ref: retry every 3s)
+        while not stop_event.is_set() and not self._try():
+            stop_event.wait(self.retry_period)
+        if stop_event.is_set():
+            return
+
+        log.info("leader election: %s acquired %s/%s",
+                 self.identity, self.namespace, self.name)
+        self.is_leader.set()
+        leading_stop = threading.Event()
+        threading.Thread(
+            target=lambda: (stop_event.wait(), leading_stop.set()), daemon=True,
+            name="leader-stop-forwarder",
+        ).start()
+        worker = threading.Thread(
+            target=on_started_leading, args=(leading_stop,), daemon=True,
+            name="leading",
+        )
+        worker.start()
+
+        # Renew loop: a transient API failure retries every retry_period for
+        # as long as the last successful renewal keeps the lease alive —
+        # leadership drops only when the lease actually expires under us
+        # (client-go semantics; one apiserver blip must not tear down the
+        # controller).
+        import time as _time
+
+        last_renewed = _time.monotonic()
+        lost = False
+        while not stop_event.is_set() and not lost:
+            if stop_event.wait(self.renew_deadline):
+                break
+            while not stop_event.is_set():
+                if self._try():
+                    last_renewed = _time.monotonic()
+                    break
+                if _time.monotonic() - last_renewed > self.lease_duration:
+                    log.warning("leader election: lost lease %s/%s",
+                                self.namespace, self.name)
+                    lost = True
+                    break
+                stop_event.wait(self.retry_period)
+
+        self.is_leader.clear()
+        leading_stop.set()
+        if on_stopped_leading:
+            on_stopped_leading()
+
+    def _try(self) -> bool:
+        try:
+            return self.try_acquire_or_renew()
+        except Exception as e:  # noqa: BLE001 — transient API errors
+            log.warning("leader election round failed: %s", e)
+            return False

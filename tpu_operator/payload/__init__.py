@@ -1,0 +1,21 @@
+"""The data plane: what runs inside the ``tpu`` container.
+
+The reference keeps all training math in user Docker images
+(stefanofioravanzo/mxnet-linear-dist, mxnet-cifar10-dist — README.md:66-96,
+126-180); the repo itself ships none. This package is the TPU-native
+equivalent of those images' contents, shipped in-repo so the BASELINE
+configs are reproducible end-to-end:
+
+- ``bootstrap``     jax.distributed process-group formation from the env the
+                    operator injects (the consumer of replicas.py's contract)
+- ``data``          deterministic on-device data pipeline (synthetic CIFAR-10)
+- ``models``        Flax model zoo (CIFAR ResNet family, linear)
+- ``train``         the generic sharded training loop (DP × TP over a Mesh)
+- ``linear``        distributed linear regression (BASELINE config 2)
+- ``cifar``         data-parallel CIFAR-10 ResNet (BASELINE config 3)
+
+Everything here is jit-first: static shapes, no data-dependent Python control
+flow under jit, bf16 matmul/conv with fp32 accumulation — the MXU-friendly
+defaults — and sharding expressed once via NamedSharding over a Mesh, with
+XLA inserting the ICI collectives.
+"""

@@ -1,0 +1,134 @@
+"""Process-group bootstrap inside the ``tpu`` container.
+
+This is the consumer of the operator's env contract
+(trainer/replicas.py build_replica_env — the TPU-native replacement for the
+MXNet side of the reference's DMLC_* rendezvous, README.md:103-121):
+``jax.distributed.initialize`` pointed at the coordinator Service the
+operator created, with retry while the coordinator's DNS name warms up
+(SURVEY.md §7 hard part (c): the reference leaned on MXNet client retry for
+exactly this window).
+
+Also owns the exit-code side of the contract (training.go:172-208 /
+README.md:107-121): ``run_payload`` maps clean completion → 0, application
+errors → 1 (permanent), and SIGTERM (preemption/eviction) → 143 (retryable),
+so the operator's whole-group restart machinery sees exactly the signals it
+classifies.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+import os
+import signal
+import socket
+import sys
+import time
+from typing import Callable, Optional
+
+log = logging.getLogger(__name__)
+
+
+@dataclasses.dataclass(frozen=True)
+class ProcessInfo:
+    """This process's place in the job (parsed injected env)."""
+
+    coordinator_address: str  # host:port
+    process_id: int
+    num_processes: int
+    worker_id: int
+    worker_hostnames: tuple
+    job_name: str = ""
+    replica_type: str = "worker"
+    attempt: int = 0
+    num_slices: int = 1
+    slice_id: int = 0
+
+
+def process_info_from_env(env: Optional[dict] = None) -> ProcessInfo:
+    e = env if env is not None else os.environ
+    return ProcessInfo(
+        coordinator_address=e.get("JAX_COORDINATOR_ADDRESS", ""),
+        process_id=int(e.get("JAX_PROCESS_ID", "0")),
+        num_processes=int(e.get("JAX_NUM_PROCESSES", "1")),
+        worker_id=int(e.get("TPU_WORKER_ID", e.get("JAX_PROCESS_ID", "0"))),
+        worker_hostnames=tuple(
+            h for h in e.get("TPU_WORKER_HOSTNAMES", "").split(",") if h
+        ),
+        job_name=e.get("TPUJOB_NAME", ""),
+        replica_type=e.get("TPUJOB_REPLICA_TYPE", "worker"),
+        attempt=int(e.get("TPUJOB_ATTEMPT", "0")),
+        num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1")),
+        slice_id=int(e.get("MEGASCALE_SLICE_ID", "0")),
+    )
+
+
+def wait_for_coordinator(address: str, timeout: float = 300.0,
+                         interval: float = 2.0) -> None:
+    """Block until the coordinator's DNS name resolves (the Service exists
+    before any pod by construction — trainer/training.py creates services
+    first — but cluster DNS propagation still takes seconds)."""
+    host = address.rsplit(":", 1)[0]
+    deadline = time.monotonic() + timeout
+    while True:
+        try:
+            socket.getaddrinfo(host, None)
+            return
+        except socket.gaierror:
+            if time.monotonic() >= deadline:
+                raise TimeoutError(
+                    f"coordinator DNS {host!r} did not resolve in {timeout:.0f}s"
+                )
+            log.info("waiting for coordinator DNS %s ...", host)
+            time.sleep(interval)
+
+
+def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
+    """Form the process group. Single-process jobs skip jax.distributed
+    entirely (a v4-8 single-worker job needs no coordinator —
+    BASELINE config 2 degenerates to plain jax)."""
+    info = info or process_info_from_env()
+    if info.num_processes <= 1:
+        log.info("single-process job; skipping jax.distributed")
+        return info
+    import jax
+
+    wait_for_coordinator(info.coordinator_address)
+    jax.distributed.initialize(
+        coordinator_address=info.coordinator_address,
+        num_processes=info.num_processes,
+        process_id=info.process_id,
+    )
+    log.info("process %d/%d joined group at %s (%d devices visible)",
+             info.process_id, info.num_processes, info.coordinator_address,
+             jax.device_count())
+    return info
+
+
+EXIT_RETRYABLE = 143  # 128 + SIGTERM: the retryable band (training.go:172-208)
+
+
+def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
+    """Run a training payload under the exit-code contract. SIGTERM (pod
+    preemption) raises through and exits 143 → retryable → whole-group
+    restart; any other exception exits 1 → permanent failure."""
+
+    def _sigterm(_signum, _frame):
+        raise SystemExit(EXIT_RETRYABLE)
+
+    signal.signal(signal.SIGTERM, _sigterm)
+    try:
+        info = initialize()
+        fn(info)
+        return 0
+    except SystemExit as e:
+        return int(e.code or 0)
+    except Exception:  # noqa: BLE001 — the contract: app error = permanent
+        log.exception("payload failed")
+        return 1
+
+
+def main_wrapper(fn: Callable[[ProcessInfo], None]) -> None:
+    logging.basicConfig(level=logging.INFO,
+                       format="%(asctime)s %(levelname)s %(message)s")
+    sys.exit(run_payload(fn))

@@ -1,0 +1,70 @@
+"""Distributed linear regression — BASELINE config 2.
+
+The TPU-native counterpart of the reference's ``mxnet-linear-dist`` image
+(README.md:66-96): the canonical smallest end-to-end payload. Run as the
+``tpu`` container command::
+
+    python -m tpu_operator.payload.linear --steps 200
+
+Exit code follows the operator contract (bootstrap.run_payload): 0 on
+convergence, 1 on failure, 143 on preemption.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+
+from tpu_operator.payload import bootstrap
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=200)
+    p.add_argument("--batch", type=int, default=1024)
+    p.add_argument("--dim", type=int, default=8)
+    p.add_argument("--lr", type=float, default=0.1)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--target-loss", type=float, default=1e-3,
+                   help="exit nonzero unless final MSE is below this")
+    return p.parse_args(argv)
+
+
+def run(info: bootstrap.ProcessInfo, args=None) -> float:
+    import jax
+    import optax
+
+    from tpu_operator.payload import data as data_mod
+    from tpu_operator.payload import models, train
+
+    args = args or parse_args([])
+    mesh = train.make_mesh()
+    model = models.LinearRegressor()
+    tx = optax.sgd(args.lr)
+    sample = jax.numpy.zeros((args.batch, args.dim), jax.numpy.float32)
+    state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+    # Every process draws the same global stream; put_global_batch shards it
+    # over the data axis (per-process slicing in multi-process jobs).
+    batches = data_mod.synthetic_linear(args.seed, args.batch, args.dim)
+    state, metrics = train.train_loop(
+        mesh, step, state, batches, args.steps,
+        log_every=max(1, args.steps // 10),
+        log_fn=lambda i, m: log.info("step %d loss %.6f", i, m["loss"]),
+    )
+    loss = float(metrics["loss"])
+    log.info("final loss %.6f over %d devices", loss, len(mesh.devices.flat))
+    if loss > args.target_loss:
+        raise RuntimeError(f"did not converge: loss {loss} > {args.target_loss}")
+    return loss
+
+
+def main() -> None:
+    args = parse_args()
+    bootstrap.main_wrapper(lambda info: run(info, args))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,119 @@
+"""Flax model zoo for the payload images.
+
+The reference's model code lives in external MXNet images
+(mxnet-linear-dist: linear regression; mxnet-cifar10-dist: CIFAR-10 ResNet —
+README.md:66-96,126-167). These are their TPU-native counterparts, written
+MXU-first:
+
+- compute in **bfloat16** (matmuls/convs hit the MXU at full rate), params
+  and loss in float32 (stable accumulation);
+- static shapes everywhere; no Python control flow that would retrace;
+- BatchNorm statistics reduce over the *global* batch: under jit with a
+  sharded batch, XLA inserts the cross-device psums automatically — no
+  pmap-style axis_name bookkeeping;
+- optional tensor parallelism expressed purely as sharding constraints
+  (``param_partition_spec``): wide layers shard over the ``model`` mesh
+  axis, and GSPMD derives the collectives.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Callable, Sequence, Tuple
+
+import flax.linen as nn
+import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
+
+
+class BasicBlock(nn.Module):
+    """CIFAR-style residual basic block: two 3x3 convs + identity/projection."""
+
+    features: int
+    strides: int = 1
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        residual = x
+        y = nn.Conv(self.features, (3, 3), strides=(self.strides, self.strides),
+                    padding="SAME", use_bias=False, dtype=self.dtype,
+                    name="conv1")(x)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32,
+                         name="bn1")(y)
+        y = nn.relu(y)
+        y = nn.Conv(self.features, (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="conv2")(y)
+        y = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32,
+                         name="bn2")(y)
+        if residual.shape != y.shape:
+            residual = nn.Conv(self.features, (1, 1),
+                               strides=(self.strides, self.strides),
+                               use_bias=False, dtype=self.dtype,
+                               name="proj")(residual)
+            residual = nn.BatchNorm(use_running_average=not train,
+                                    dtype=jnp.float32, name="bn_proj")(residual)
+        return nn.relu(y + residual.astype(y.dtype))
+
+
+class CifarResNet(nn.Module):
+    """ResNet-6n+2 for 32x32 inputs (He et al. CIFAR variant): 3x3 stem,
+    three stages at widths ``widths`` with ``blocks_per_stage`` blocks each,
+    global average pool, dense head.
+
+    ``depth 20`` = blocks_per_stage 3; the flagship bench config. Tiny
+    configs (blocks 1, widths (8,16,32)) keep CPU-mesh tests fast.
+    """
+
+    num_classes: int = 10
+    blocks_per_stage: int = 3
+    widths: Sequence[int] = (16, 32, 64)
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        x = x.astype(self.dtype)
+        x = nn.Conv(self.widths[0], (3, 3), padding="SAME", use_bias=False,
+                    dtype=self.dtype, name="stem")(x)
+        x = nn.BatchNorm(use_running_average=not train, dtype=jnp.float32,
+                         name="bn_stem")(x)
+        x = nn.relu(x)
+        for stage, width in enumerate(self.widths):
+            for block in range(self.blocks_per_stage):
+                strides = 2 if (stage > 0 and block == 0) else 1
+                x = BasicBlock(width, strides, self.dtype,
+                               name=f"stage{stage}_block{block}")(x, train=train)
+        x = jnp.mean(x, axis=(1, 2))  # global average pool
+        # Head computes in f32: small matmul, and logits feed the loss.
+        x = nn.Dense(self.num_classes, dtype=jnp.float32, name="head")(x)
+        return x
+
+
+class LinearRegressor(nn.Module):
+    """The linear-regression payload (ref image mxnet-linear-dist,
+    README.md:66-96): y = Wx + b."""
+
+    features: int = 1
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
+        return nn.Dense(self.features, dtype=jnp.float32, name="linear")(x)
+
+
+def param_partition_spec(path: Tuple[str, ...], leaf: Any) -> P:
+    """Sharding rule for tensor parallelism over the ``model`` mesh axis.
+
+    DP-only meshes (model axis size 1) make every spec a no-op replication;
+    with model > 1, the classifier head and the widest (stage-2) conv kernels
+    shard their output-channel dimension, and GSPMD inserts the collectives.
+    Conv kernels are HWIO; Dense kernels are (in, out).
+    """
+    names = [p for p in path]
+    if "head" in names and names[-1] == "kernel":
+        return P(None, "model")
+    if any(n.startswith("stage2") for n in names) and names[-1] == "kernel" \
+            and getattr(leaf, "ndim", 0) == 4:
+        return P(None, None, None, "model")
+    return P()  # replicate
+
+
+Model = Callable[..., nn.Module]

