@@ -1,0 +1,14 @@
+#!/usr/bin/env bash
+# Delete all TPUJobs and any orphaned child resources.
+#
+# Reference parity: hack/scripts/cleanup_clusters.sh:5-7 — which used the
+# stale upstream selector `kubeflow.org=` while the fork actually labeled
+# children with `fioravanzo.org=` (SURVEY.md "quirks to fix, not copy").
+# Fixed here: the selector matches the label the operator really stamps
+# (tpu_operator/trainer/labels.py: tpuoperator.dev=).
+set -euo pipefail
+
+NAMESPACE="${1:-default}"
+
+kubectl -n "${NAMESPACE}" delete tpujobs --all --ignore-not-found
+kubectl -n "${NAMESPACE}" delete pods,services -l tpuoperator.dev= --ignore-not-found

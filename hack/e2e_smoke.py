@@ -1,0 +1,185 @@
+#!/usr/bin/env python3
+"""End-to-end smoke test for the TPU job operator.
+
+Reference parity: the reference's helm chart shipped a test hook that ran an
+e2e binary it never included (build/chart/mx-job-operator-chart/templates/
+tests/basic-test.yaml:17-22, SURVEY.md §4 "binary is not in repo"). This is
+that missing binary, in both deployment modes:
+
+- **local** (default): no cluster needed. Starts the in-process apiserver
+  (tpu_operator.testing.apiserver), runs the REAL operator entry path
+  (cmd.server.run — leader election, informers, controller) against it over
+  HTTP, submits ``examples/tpujob-linear.yml``, plays kubelet by walking pod
+  statuses Pending → Running → Succeeded, and asserts the job phase reaches
+  Running and then Done with state Succeeded.
+- **--in-cluster**: runs inside the helm-test pod against the live
+  apiserver; submits the example and polls until the operator (already
+  deployed) drives it to Succeeded.
+
+Exit 0 on pass, 1 on fail — the helm test contract.
+"""
+
+from __future__ import annotations
+
+import argparse
+import pathlib
+import sys
+import threading
+import time
+
+REPO_ROOT = pathlib.Path(__file__).resolve().parent.parent
+sys.path.insert(0, str(REPO_ROOT))
+
+
+def load_example(path: pathlib.Path) -> dict:
+    import yaml
+
+    with open(path, encoding="utf-8") as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    assert len(docs) == 1, f"{path} must contain exactly one TPUJob"
+    return docs[0]
+
+
+def wait_for(predicate, timeout: float, interval: float = 0.2):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        v = predicate()
+        if v:
+            return v
+        time.sleep(interval)
+    return None
+
+
+def job_phase_state(cs, namespace: str, name: str) -> tuple:
+    try:
+        job = cs.tpujobs.get(namespace, name)
+    except Exception:
+        return ("", "")
+    status = job.get("status") or {}
+    return (status.get("phase", ""), status.get("state", ""))
+
+
+def play_kubelet(cs, namespace: str, stop: threading.Event,
+                 succeed_after: float) -> None:
+    """Walk every managed pod Pending → Running, then (after
+    ``succeed_after`` seconds of Running) → Succeeded with a clean exit —
+    the container lifecycle kubelet would produce for a passing payload."""
+    started: dict = {}
+    while not stop.is_set():
+        try:
+            pods = cs.pods.list(namespace)
+        except Exception:
+            time.sleep(0.2)
+            continue
+        now = time.monotonic()
+        for pod in pods:
+            name = pod["metadata"]["name"]
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("", "Pending"):
+                pod["status"] = {
+                    "phase": "Running",
+                    "containerStatuses": [
+                        {"name": "tpu", "state": {"running": {}}}
+                    ],
+                }
+                started[name] = now
+                cs.pods.update_status(namespace, pod)
+            elif phase == "Running" and now - started.get(name, now) >= succeed_after:
+                pod["status"] = {
+                    "phase": "Succeeded",
+                    "containerStatuses": [
+                        {"name": "tpu",
+                         "state": {"terminated": {"exitCode": 0}}}
+                    ],
+                }
+                cs.pods.update_status(namespace, pod)
+        time.sleep(0.2)
+
+
+def run_local(example: pathlib.Path, timeout: float) -> int:
+    from tpu_operator.client.rest import Clientset, RestConfig
+    from tpu_operator.cmd import server
+    from tpu_operator.cmd.options import build_parser
+    from tpu_operator.testing.apiserver import ApiServerHarness
+
+    job = load_example(example)
+    namespace = job["metadata"].get("namespace", "default")
+    name = job["metadata"]["name"]
+
+    with ApiServerHarness() as srv:
+        opts = build_parser().parse_args([
+            "--master", srv.url, "--namespace", namespace,
+            "--resync-period", "2", "--gc-interval", "3600",
+        ])
+        stop = threading.Event()
+        operator = threading.Thread(target=server.run, args=(opts,),
+                                    kwargs={"stop_event": stop}, daemon=True)
+        operator.start()
+        cs = Clientset(RestConfig(host=srv.url, timeout=5.0))
+        kubelet = threading.Thread(target=play_kubelet,
+                                   args=(cs, namespace, stop, 2.0), daemon=True)
+        kubelet.start()
+        try:
+            cs.tpujobs.create(namespace, job)
+            ok_running = wait_for(
+                lambda: job_phase_state(cs, namespace, name)[0] == "Running",
+                timeout)
+            if not ok_running:
+                print(f"FAIL: job never reached Running "
+                      f"(at {job_phase_state(cs, namespace, name)})")
+                return 1
+            print("job reached phase Running")
+            ok_done = wait_for(
+                lambda: job_phase_state(cs, namespace, name)
+                == ("Done", "Succeeded"), timeout)
+            if not ok_done:
+                print(f"FAIL: job never reached Done/Succeeded "
+                      f"(at {job_phase_state(cs, namespace, name)})")
+                return 1
+            pods = cs.pods.list(namespace)
+            print(f"PASS: {name} Done/Succeeded; {len(pods)} pod(s) retained "
+                  f"for log inspection")
+            return 0
+        finally:
+            stop.set()
+            operator.join(timeout=10.0)
+
+
+def run_in_cluster(example: pathlib.Path, timeout: float) -> int:
+    from tpu_operator.client.rest import Clientset
+    from tpu_operator.util import k8sutil
+    from tpu_operator.util.util import get_operator_namespace
+
+    job = load_example(example)
+    namespace = job["metadata"].get("namespace") or get_operator_namespace()
+    name = job["metadata"]["name"]
+    cs = Clientset(k8sutil.get_cluster_config("", ""))
+    try:
+        cs.tpujobs.delete(namespace, name)
+    except Exception:
+        pass
+    cs.tpujobs.create(namespace, job)
+    ok = wait_for(
+        lambda: job_phase_state(cs, namespace, name) == ("Done", "Succeeded"),
+        timeout, interval=2.0)
+    phase, state = job_phase_state(cs, namespace, name)
+    print(f"{'PASS' if ok else 'FAIL'}: {name} phase={phase} state={state}")
+    return 0 if ok else 1
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--in-cluster", action="store_true",
+                   help="run against the live apiserver (helm-test mode)")
+    p.add_argument("--example",
+                   default=str(REPO_ROOT / "examples" / "tpujob-linear.yml"))
+    p.add_argument("--timeout", type=float, default=60.0)
+    args = p.parse_args(argv)
+    example = pathlib.Path(args.example)
+    if args.in_cluster:
+        return run_in_cluster(example, args.timeout)
+    return run_local(example, args.timeout)
+
+
+if __name__ == "__main__":
+    sys.exit(main())

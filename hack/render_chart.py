@@ -1,0 +1,110 @@
+#!/usr/bin/env python3
+"""Render the deploy/chart templates without helm.
+
+``python hack/render_chart.py | kubectl apply -f -`` is the helm-free
+install path (the reference only offered ``helm install``,
+README.md:28-47). Supports exactly the template subset the chart uses:
+
+- ``{{ .Values.path.to.key }}`` / ``{{ .Release.Namespace }}`` substitution
+- ``{{- if .Values.x }}`` … ``{{- end }}`` blocks (truthiness)
+- ``{{- .Values.x | toYaml | nindent N }}``
+
+Also imported by tests/test_manifests.py to assert every rendered template
+is valid YAML with the expected objects.
+"""
+
+from __future__ import annotations
+
+import pathlib
+import re
+import sys
+from typing import Any, Dict, List
+
+import yaml
+
+CHART_DIR = (
+    pathlib.Path(__file__).resolve().parent.parent
+    / "deploy" / "chart" / "tpu-job-operator-chart"
+)
+
+_IF_RE = re.compile(r"^\s*\{\{-\s*if\s+(\S+)\s*\}\}\s*$")
+_END_RE = re.compile(r"^\s*\{\{-\s*end\s*\}\}\s*$")
+_NINDENT_RE = re.compile(
+    r"^(\s*)\{\{-\s*(\S+)\s*\|\s*toYaml\s*\|\s*nindent\s+(\d+)\s*\}\}\s*$"
+)
+_SUBST_RE = re.compile(r"\{\{\s*([^}|]+?)\s*\}\}")
+
+
+def _lookup(expr: str, values: Dict[str, Any], namespace: str) -> Any:
+    expr = expr.strip()
+    if expr == ".Release.Namespace":
+        return namespace
+    if not expr.startswith(".Values."):
+        raise ValueError(f"unsupported template expression: {expr!r}")
+    node: Any = values
+    for part in expr[len(".Values."):].split("."):
+        if not isinstance(node, dict) or part not in node:
+            raise KeyError(f"values key not found: {expr}")
+        node = node[part]
+    return node
+
+
+def render(text: str, values: Dict[str, Any], namespace: str = "default") -> str:
+    out: List[str] = []
+    # Stack of bools: is the current if-block emitting?
+    emitting = [True]
+    for line in text.splitlines():
+        m = _IF_RE.match(line)
+        if m:
+            emitting.append(emitting[-1] and bool(_lookup(m.group(1), values, namespace)))
+            continue
+        if _END_RE.match(line):
+            if len(emitting) == 1:
+                raise ValueError("unbalanced {{- end }}")
+            emitting.pop()
+            continue
+        if not emitting[-1]:
+            continue
+        m = _NINDENT_RE.match(line)
+        if m:
+            _prefix, expr, n = m.group(1), m.group(2), int(m.group(3))
+            dumped = yaml.safe_dump(
+                _lookup(expr, values, namespace), default_flow_style=False
+            ).rstrip("\n")
+            # nindent chomps the preceding newline via {{- and prepends its own.
+            pad = " " * n
+            out.extend(pad + ln for ln in dumped.splitlines())
+            continue
+        out.append(
+            _SUBST_RE.sub(
+                lambda m: str(_lookup(m.group(1), values, namespace)), line
+            )
+        )
+    if len(emitting) != 1:
+        raise ValueError("unclosed {{- if }}")
+    return "\n".join(out) + "\n"
+
+
+def render_chart(namespace: str = "default",
+                 include_tests: bool = False) -> Dict[str, str]:
+    """template-relative-path → rendered text, for every chart template."""
+    with open(CHART_DIR / "values.yaml", encoding="utf-8") as f:
+        values = yaml.safe_load(f)
+    rendered: Dict[str, str] = {}
+    for path in sorted((CHART_DIR / "templates").rglob("*.yaml")):
+        rel = str(path.relative_to(CHART_DIR / "templates"))
+        if rel.startswith("tests/") and not include_tests:
+            continue
+        rendered[rel] = render(path.read_text(encoding="utf-8"), values, namespace)
+    return rendered
+
+
+def main() -> int:
+    namespace = sys.argv[1] if len(sys.argv) > 1 else "default"
+    docs = render_chart(namespace)
+    print("\n---\n".join(docs[k] for k in sorted(docs)))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,12 @@
+#!/usr/bin/env bash
+# Run the operator locally against the current kubeconfig context —
+# the reference's developer loop (developer_guide.md:103-129: build the
+# binary, run it outside the cluster, kubectl create the example job).
+#
+# Usage: hack/run-local.sh [extra operator flags...]
+set -euo pipefail
+
+# Kubeconfig resolution ($KUBECONFIG → ~/.kube/config → in-cluster) is
+# handled by the operator itself (util/k8sutil.get_cluster_config).
+cd "$(dirname "$0")/.."
+exec python -m tpu_operator.cmd.main --no-leader-elect "$@"

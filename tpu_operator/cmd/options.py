@@ -45,6 +45,13 @@ def build_parser() -> argparse.ArgumentParser:
                    help="informer resync/re-list period in seconds")
     p.add_argument("--no-leader-elect", action="store_true",
                    help="skip leader election (single-instance deployments/tests)")
+    # Leader-election cadence (reference hardcoded 15/5/3 s, server.go:48-52).
+    p.add_argument("--lease-duration", type=float, default=15.0,
+                   help="leader-election lease duration in seconds")
+    p.add_argument("--renew-deadline", type=float, default=5.0,
+                   help="leader-election renew deadline in seconds")
+    p.add_argument("--retry-period", type=float, default=3.0,
+                   help="leader-election retry period in seconds")
     p.add_argument("--trace", action="store_true",
                    help="function-level call tracing (the go-tracey equivalent)")
     return p

@@ -67,7 +67,12 @@ def run(opts: Any, clientset: Optional[Any] = None,
         start_leading(stop_event)
         return
 
-    elector = LeaderElector(clientset, namespace)
+    elector = LeaderElector(
+        clientset, namespace,
+        lease_duration=opts.lease_duration,
+        renew_deadline=opts.renew_deadline,
+        retry_period=opts.retry_period,
+    )
     elector.run(on_started_leading=start_leading, stop_event=stop_event)
     if not stop_event.is_set():
         # Lost the lease (ref: OnStoppedLeading → fatal, server.go:98-102):
