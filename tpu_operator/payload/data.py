@@ -3,10 +3,11 @@
 Zero-egress environment: datasets are synthetic but *learnable* — images are
 class prototypes plus noise, so loss curves actually descend and the
 BASELINE loss-parity check (CPU run vs sharded run) is meaningful. The
-pipeline is host-side numpy feeding device arrays sharded over the mesh's
-``data`` axis; in a multi-process job each process materializes only its own
-shard (``make_array_from_process_local_data``), exactly how a real
-per-worker input pipeline feeds a TPU pod slice.
+pipeline is host-side numpy feeding device arrays sharded over the mesh;
+in a multi-process job every process generates the identical global batch
+(seed-deterministic) and contributes its addressable slices
+(``make_array_from_process_local_data`` with explicit ``global_shape``),
+exactly how a real per-worker input pipeline feeds a TPU pod slice.
 """
 
 from __future__ import annotations
@@ -55,24 +56,51 @@ def synthetic_linear(seed: int, batch: int, dim: int = 8,
         yield x, y
 
 
-def batch_sharding(mesh: Mesh) -> NamedSharding:
-    """Batches shard over the ``data`` axis, replicated over ``model``."""
-    return NamedSharding(mesh, P("data"))
+def synthetic_lm(seed: int, batch: int, seq_len: int,
+                 vocab: int = 256) -> Iterator[Tuple[np.ndarray]]:
+    """Infinite stream of token sequences [batch, seq_len] i32 following a
+    fixed affine recurrence x_{t+1} = (a·x_t + b) mod vocab with random
+    starts — a deterministic next-token structure a small LM fits quickly,
+    so long-context loss curves descend and parity checks are meaningful."""
+    rng = np.random.default_rng(seed)
+    # x → a·x + b mod vocab is a bijection iff gcd(a, vocab) == 1; pick the
+    # first odd multiplier coprime to the caller's vocab.
+    a, b = 5, 17
+    while np.gcd(a, vocab) != 1:
+        a += 2
+    while True:
+        seq = np.empty((batch, seq_len), np.int64)
+        seq[:, 0] = rng.integers(0, vocab, size=batch)
+        for t in range(1, seq_len):
+            seq[:, t] = (a * seq[:, t - 1] + b) % vocab
+        yield (seq.astype(np.int32),)
 
 
-def put_global_batch(mesh: Mesh, *arrays: np.ndarray):
-    """Place host arrays as global device arrays sharded on ``data``.
+def batch_sharding(mesh: Mesh, spec: P = None) -> NamedSharding:
+    """Batches shard over the ``data`` axis by default; pass ``spec`` for
+    additional dims (e.g. P("data", "seq") for sequence-sharded tokens)."""
+    return NamedSharding(mesh, spec if spec is not None else P("data"))
 
-    Single-process: a plain sharded device_put. Multi-process: each process
-    holds only its local shard, and the returned jax.Arrays are global views
-    (the pjit programming model for pod slices).
+
+def put_global_batch(mesh: Mesh, *arrays: np.ndarray, spec: P = None):
+    """Place host arrays as global device arrays (default: sharded on
+    ``data``; pass ``spec`` to shard more dims, e.g. sequence).
+
+    Single-process: a plain sharded device_put. Multi-process: the synthetic
+    generators are seed-deterministic, so every process holds the identical
+    *global* batch; passing ``global_shape=arr.shape`` tells JAX exactly
+    that, and each process contributes only its addressable slices (the pjit
+    programming model for pod slices). Without it, JAX would infer a global
+    shape multiplied across processes — wrong on any axis (like ``seq``)
+    that spans processes.
     """
-    sharding = batch_sharding(mesh)
+    sharding = batch_sharding(mesh, spec)
     out = []
     multiprocess = jax.process_count() > 1
     for arr in arrays:
         if multiprocess:
-            out.append(jax.make_array_from_process_local_data(sharding, arr))
+            out.append(jax.make_array_from_process_local_data(
+                sharding, arr, global_shape=arr.shape))
         else:
             out.append(jax.device_put(arr, sharding))
     return tuple(out)

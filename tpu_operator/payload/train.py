@@ -40,8 +40,10 @@ class TrainState(flax.struct.PyTreeNode):
 
 
 def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
-              devices: Optional[list] = None) -> Mesh:
-    """Build the (data, model) mesh over the visible devices. On a real pod
+              devices: Optional[list] = None,
+              axis_names: Tuple[str, str] = ("data", "model")) -> Mesh:
+    """Build a 2-axis mesh over the visible devices (default (data, model);
+    the transformer payload reuses this with ("data", "seq")). On a real pod
     slice ``jax.devices()`` spans every process after
     jax.distributed.initialize; the mesh is global."""
     devices = list(devices if devices is not None else jax.devices())
@@ -49,9 +51,10 @@ def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
         devices = devices[:num_devices]
     n = len(devices)
     if n % model_parallel != 0:
-        raise ValueError(f"{n} devices not divisible by model_parallel={model_parallel}")
+        raise ValueError(
+            f"{n} devices not divisible by {axis_names[1]}={model_parallel}")
     arr = np.array(devices).reshape(n // model_parallel, model_parallel)
-    return Mesh(arr, ("data", "model"))
+    return Mesh(arr, axis_names)
 
 
 def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:

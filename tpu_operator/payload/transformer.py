@@ -1,0 +1,212 @@
+"""Long-context transformer LM payload with sequence parallelism.
+
+``python -m tpu_operator.payload.transformer`` — the third in-repo model
+family (after linear and CIFAR ResNet), exercising the capability the
+reference could only host, never express: long sequences sharded across the
+process group the operator bootstraps.
+
+The reference's data plane was opaque user images (README.md:66-96); its
+operator had no notion of sequence length (SURVEY.md §5 "long-context:
+absent"). Here long-context is first-class payload capability:
+
+- mesh = (data, seq): batch shards over ``data``, the *sequence dimension*
+  shards over ``seq``. Per-device activation memory is O(T / seq_shards).
+- attention is exact ring attention (payload/ring_attention.py): K/V blocks
+  rotate around the ``seq`` axis on neighbor ppermutes (ICI hops), queries
+  stay resident, softmax streams in f32.
+- everything else (LN, QKV/MLP matmuls, embeddings) is position-local, so
+  it runs on sequence-sharded activations with zero communication; XLA
+  inserts the gradient psums over both mesh axes.
+- numerics follow the house style (models.py): bf16 matmul inputs on the
+  MXU, f32 LayerNorm/softmax/loss, f32 master params.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Any, Optional
+
+from tpu_operator.payload import bootstrap
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=8, help="global batch size")
+    p.add_argument("--seq-len", type=int, default=2048, help="global sequence length")
+    p.add_argument("--seq-parallel", type=int, default=1,
+                   help="sequence-parallel shards (mesh seq axis size)")
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=4)
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=50)
+    return p.parse_args(argv)
+
+
+def make_lm_mesh(num_devices: Optional[int] = None, seq_parallel: int = 1,
+                 devices: Optional[list] = None):
+    """(data, seq) mesh: DP outer, sequence-parallel inner (neighboring
+    devices share a ring edge, so K/V rotation stays on adjacent ICI links)."""
+    from tpu_operator.payload import train
+
+    return train.make_mesh(num_devices, model_parallel=seq_parallel,
+                           devices=devices, axis_names=("data", "seq"))
+
+
+def _build_model(args, mesh):
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from tpu_operator.payload import ring_attention as ring
+
+    seq_shards = mesh.shape["seq"]
+
+    def attend(q, k, v):
+        if seq_shards > 1:
+            return ring.ring_attention(q, k, v, mesh, causal=True)
+        return ring.reference_attention(q, k, v, causal=True)
+
+    class Block(nn.Module):
+        dim: int
+        heads: int
+
+        @nn.compact
+        def __call__(self, x):
+            b, t, _ = x.shape
+            head_dim = self.dim // self.heads
+            h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=jnp.bfloat16,
+                           name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
+            shape = (b, t, self.heads, head_dim)
+            out = attend(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+            out = nn.Dense(self.dim, use_bias=False, dtype=jnp.bfloat16,
+                           name="attn_out")(out.reshape(b, t, self.dim))
+            x = x + out
+            h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+            h = nn.Dense(4 * self.dim, dtype=jnp.bfloat16, name="mlp_up")(h)
+            h = nn.gelu(h)
+            h = nn.Dense(self.dim, dtype=jnp.bfloat16, name="mlp_down")(h)
+            return x + h
+
+    class TransformerLM(nn.Module):
+        vocab: int
+        dim: int
+        heads: int
+        layers: int
+        max_seq: int
+
+        @nn.compact
+        def __call__(self, tokens, train: bool = True):
+            _b, t = tokens.shape
+            x = nn.Embed(self.vocab, self.dim, dtype=jnp.bfloat16,
+                         name="tok_embed")(tokens)
+            pos = nn.Embed(self.max_seq, self.dim, dtype=jnp.bfloat16,
+                           name="pos_embed")(jnp.arange(t))
+            x = x + pos[None]
+            for i in range(self.layers):
+                x = Block(self.dim, self.heads, name=f"block{i}")(x)
+            x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+            return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
+                            name="lm_head")(x)
+
+    return TransformerLM(vocab=args.vocab, dim=args.dim, heads=args.heads,
+                         layers=args.layers, max_seq=args.seq_len)
+
+
+def make_lm_train_step(model, tx, mesh, state):
+    """Next-token cross-entropy step, jitted with (data, seq) shardings."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_operator.payload import train
+
+    shardings = train.state_shardings(mesh, state)
+    token_shard = NamedSharding(mesh, P("data", "seq"))
+
+    def step(state, tokens):
+        def loss_fn(params):
+            logits = model.apply({"params": params}, tokens)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            targets = tokens[:, 1:]
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return -jnp.mean(ll)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_state = train.TrainState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=state.batch_stats,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, token_shard),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def build(args, mesh=None):
+    """(mesh, model, state, train_step, batches) for the given config."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_operator.payload import data as data_mod
+    from tpu_operator.payload import train
+
+    mesh = mesh or make_lm_mesh(seq_parallel=args.seq_parallel)
+    model = _build_model(args, mesh)
+    tx = optax.adam(args.lr)
+    sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
+    state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
+    step = make_lm_train_step(model, tx, mesh, state)
+    batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
+                                    vocab=args.vocab)
+    return mesh, model, state, step, batches
+
+
+def run(info: bootstrap.ProcessInfo, args=None) -> dict:
+    import jax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import data as data_mod
+
+    args = args or parse_args([])
+    mesh, _model, state, step, batches = build(args)
+    log.info("mesh: %s over %d devices; batch %d seq %d",
+             dict(zip(mesh.axis_names, mesh.devices.shape)),
+             mesh.devices.size, args.batch, args.seq_len)
+    spec = P("data", "seq")
+    metrics = {}
+    for i in range(args.steps):
+        (tokens,) = next(batches)
+        (dev_tokens,) = data_mod.put_global_batch(mesh, tokens, spec=spec)
+        state, metrics = step(state, dev_tokens)
+        if args.log_every and (i + 1) % args.log_every == 0:
+            m = jax.device_get(metrics)
+            log.info("step %d loss %.4f", i + 1, m["loss"])
+    metrics = jax.device_get(metrics) if metrics else {}
+    log.info("final: loss %.4f", metrics.get("loss", float("nan")))
+    return metrics
+
+
+def main() -> None:
+    args = parse_args()
+    bootstrap.main_wrapper(lambda info: run(info, args))
+
+
+if __name__ == "__main__":
+    main()
