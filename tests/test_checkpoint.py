@@ -1,0 +1,149 @@
+"""Checkpoint/resume tests (payload/checkpoint.py) on the CPU mesh.
+
+The whole-group-restart resume path end-to-end: train → save → simulate a
+group restart (fresh state, fresh process-side objects) → restore → the run
+continues from the saved step and the restored pytree matches exactly.
+Plus the operator side of the contract: spec.checkpointDir →
+TPU_CHECKPOINT_DIR injection.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from tpu_operator.apis.tpujob.v1alpha1 import types
+from tpu_operator.payload import checkpoint, data as data_mod, train
+
+
+def tiny_build(seed=0):
+    from tpu_operator.payload.cifar import build, parse_args
+
+    args = parse_args([
+        "--steps", "6", "--batch", "16", "--blocks", "1",
+        "--widths", "8", "8", "8", "--log-every", "0",
+    ])
+    return args, build(args)
+
+
+def test_from_env_or_args_unconfigured_is_none():
+    assert checkpoint.from_env_or_args("", env={}) is None
+
+
+def test_from_env_or_args_env_fallback(tmp_path):
+    ck = checkpoint.from_env_or_args(
+        "", env={"TPU_CHECKPOINT_DIR": str(tmp_path / "ck")})
+    assert ck is not None
+    assert ck.directory == str(tmp_path / "ck")
+    ck.close()
+
+
+def test_save_restore_roundtrip(tmp_path):
+    args, (mesh, _m, state, step, batches) = tiny_build()
+    for _ in range(3):
+        arrays = data_mod.put_global_batch(mesh, *next(batches))
+        state, _metrics = step(state, *arrays)
+
+    ck = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    assert ck.maybe_save(3, state)
+    ck.close()
+
+    # Simulated whole-group restart: fresh everything.
+    _args2, (mesh2, _m2, fresh, _step2, _b2) = tiny_build()
+    ck2 = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    restored, start = ck2.restore(fresh)
+    ck2.close()
+    assert start == 3
+    assert int(jax.device_get(restored.step)) == 3
+    for a, b in zip(jax.tree_util.tree_leaves(restored.params),
+                    jax.tree_util.tree_leaves(state.params)):
+        np.testing.assert_array_equal(np.asarray(a), np.asarray(b))
+
+
+def test_restore_without_checkpoint_is_identity(tmp_path):
+    _args, (_mesh, _m, state, _step, _b) = tiny_build()
+    ck = checkpoint.Checkpointer(str(tmp_path / "empty"), save_every=1)
+    same, start = ck.restore(state)
+    ck.close()
+    assert start == 0
+    assert same is state
+
+
+def test_train_loop_resumes_to_target_total(tmp_path):
+    """train_loop treats `steps` as target total: a restarted job with a
+    step-4 checkpoint runs only the remaining steps and lands on step 6."""
+    ckdir = str(tmp_path / "ck")
+    args, (mesh, _m, state, step, batches) = tiny_build()
+    ck = checkpoint.Checkpointer(ckdir, save_every=2)
+    state, _ = train.train_loop(mesh, step, state, batches, steps=4,
+                                checkpointer=ck)
+    assert int(jax.device_get(state.step)) == 4
+
+    # Restart: fresh state, new checkpointer over the same dir.
+    _args2, (mesh2, _m2, fresh, step2, batches2) = tiny_build()
+    ck2 = checkpoint.Checkpointer(ckdir, save_every=2)
+    assert ck2.latest_step() == 4
+    final, _ = train.train_loop(mesh2, step2, fresh, batches2, steps=6,
+                                checkpointer=ck2)
+    assert int(jax.device_get(final.step)) == 6
+
+    # The final state is also checkpointed (end-of-run save).
+    ck3 = checkpoint.Checkpointer(ckdir)
+    assert ck3.latest_step() == 6
+    ck3.close()
+
+
+def test_resume_fast_forwards_data_stream(tmp_path):
+    """The resumed run must consume batches start..steps-1, not 0..remaining:
+    the seed-deterministic stream is advanced past what attempt 0 trained on."""
+    ckdir = str(tmp_path / "ck")
+    args, (mesh, _m, state, step, batches) = tiny_build()
+    ck = checkpoint.Checkpointer(ckdir, save_every=1)
+    train.train_loop(mesh, step, state, batches, steps=4, checkpointer=ck)
+
+    consumed = []
+
+    def counting_stream():
+        import itertools
+        for i, b in enumerate(tiny_build()[1][4]):
+            consumed.append(i)
+            yield b
+
+    _args2, (mesh2, _m2, fresh, step2, _b2) = tiny_build()
+    ck2 = checkpoint.Checkpointer(ckdir, save_every=1)
+    train.train_loop(mesh2, step2, fresh, counting_stream(), steps=6,
+                     checkpointer=ck2)
+    # 4 skipped on fast-forward + 2 trained = batches 0..5, in order.
+    assert consumed == [0, 1, 2, 3, 4, 5]
+
+
+def test_interval_policy_skips_off_interval_steps(tmp_path):
+    _args, (mesh, _m, state, step, batches) = tiny_build()
+    arrays = data_mod.put_global_batch(mesh, *next(batches))
+    state, _ = step(state, *arrays)
+    ck = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=10)
+    assert ck.maybe_save(1, state)       # first save always lands
+    assert not ck.maybe_save(2, state)   # off-interval → skipped
+    assert not ck.maybe_save(9, state)
+    assert ck.maybe_save(10, state)      # step % interval == 0 → saved
+    ck.close()
+
+
+def test_spec_checkpoint_dir_roundtrip_and_env_injection():
+    from tpu_operator.trainer import replicas
+
+    spec = types.TPUJobSpec.from_dict({
+        "replicaSpecs": [{
+            "replicas": 2,
+            "tpuReplicaType": "WORKER",
+            "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu"}]}},
+        }],
+        "checkpointDir": "/ckpt/run1",
+    })
+    assert spec.checkpoint_dir == "/ckpt/run1"
+    assert spec.to_dict()["checkpointDir"] == "/ckpt/run1"
+
+    env = replicas.build_replica_env("job", "ab12", spec,
+                                     types.TPUReplicaType.WORKER, 0)
+    assert env["TPU_CHECKPOINT_DIR"] == "/ckpt/run1"

@@ -213,6 +213,13 @@ class TPUJobSpec:
     # TPU_TOPOLOGY when set (multislice jobs also get MEGASCALE_* vars).
     tpu_topology: str = ""
     num_slices: int = 1
+    # Checkpoint directory (a path on a PodTemplate-mounted volume). When
+    # set, injected as TPU_CHECKPOINT_DIR so payloads save/restore through
+    # whole-group restarts. The reference left checkpointing entirely to
+    # user containers (README.md:168-180 azureFile volumes); on TPU the
+    # whole-group restart semantics make operator-advertised resume
+    # first-class.
+    checkpoint_dir: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -231,6 +238,8 @@ class TPUJobSpec:
             d["tpuTopology"] = self.tpu_topology
         if self.num_slices != 1:
             d["numSlices"] = self.num_slices
+        if self.checkpoint_dir:
+            d["checkpointDir"] = self.checkpoint_dir
         return d
 
     @classmethod
@@ -244,6 +253,7 @@ class TPUJobSpec:
             max_restarts=int(d.get("maxRestarts", 3)),
             tpu_topology=str(d.get("tpuTopology", "")),
             num_slices=int(d.get("numSlices", 1)),
+            checkpoint_dir=str(d.get("checkpointDir", "")),
         )
 
 

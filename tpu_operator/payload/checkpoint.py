@@ -1,0 +1,113 @@
+"""Checkpoint/resume for whole-group restart, built on orbax.
+
+The reference operator had no checkpoint layer at all — persistence was the
+user container's job via PodTemplate volumes (SURVEY.md §5; reference
+README.md:168-180 mounts an azureFile share). That was tolerable for MXNet
+parameter servers, where a single dead worker restarts alone and re-pulls
+weights from the servers. A JAX multi-controller group has no such warm
+store: any worker death triggers whole-group restart (trainer/policy.py),
+so every attempt restarts from step 0 unless the payload itself persists
+state. This module makes resume a first-class part of the payload contract:
+
+- the operator injects ``TPU_CHECKPOINT_DIR`` when ``spec.checkpointDir``
+  is set (trainer/replicas.py build_replica_env);
+- payloads call :func:`from_env_or_args` to get a :class:`Checkpointer`
+  (or ``None`` when unconfigured — checkpointing stays opt-in, exactly as
+  in the reference's data-plane contract);
+- ``train.train_loop`` restores the latest step on entry and saves every
+  ``save_every`` steps plus once at the end.
+
+TPU notes: saves go through orbax's async path (device→host copy happens
+at save(); the filesystem write overlaps subsequent steps, keeping the MXU
+busy), and restore is sharding-aware — each process reads only the shards
+it owns, so a resumed TP/DP-sharded state never materialises unsharded on
+one host.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+from typing import Any, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+ENV_VAR = "TPU_CHECKPOINT_DIR"
+
+
+class Checkpointer:
+    """Thin orbax CheckpointManager wrapper bound to one train state shape.
+
+    Steps are the single source of truth: the saved pytree carries its own
+    ``step`` leaf, and orbax names checkpoints by step, so resume needs no
+    sidecar metadata.
+    """
+
+    def __init__(self, directory: str, save_every: int = 100,
+                 max_to_keep: int = 3):
+        import orbax.checkpoint as ocp
+
+        self._ocp = ocp
+        self.directory = os.path.abspath(directory)
+        self.save_every = max(1, int(save_every))
+        self.manager = ocp.CheckpointManager(
+            self.directory,
+            options=ocp.CheckpointManagerOptions(
+                save_interval_steps=self.save_every,
+                max_to_keep=max_to_keep,
+                create=True,
+            ),
+        )
+
+    def latest_step(self) -> Optional[int]:
+        return self.manager.latest_step()
+
+    def restore(self, state: Any) -> Tuple[Any, int]:
+        """(state, start_step): the latest checkpoint restored onto the
+        live state's shardings, or the input state untouched at step 0."""
+        import jax
+
+        latest = self.manager.latest_step()
+        if latest is None:
+            return state, 0
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(
+                x.shape, x.dtype,
+                sharding=getattr(x, "sharding", None),
+            ) if hasattr(x, "shape") else x,
+            state,
+        )
+        restored = self.manager.restore(
+            latest, args=self._ocp.args.StandardRestore(abstract))
+        log.info("restored checkpoint step %d from %s", latest, self.directory)
+        return restored, int(latest)
+
+    def maybe_save(self, step: int, state: Any) -> bool:
+        """Save if the interval policy says so (orbax decides). Async: the
+        write completes in the background; wait_until_finished() blocks."""
+        return bool(self.manager.save(int(step), args=self._ocp.args.StandardSave(state)))
+
+    def save(self, step: int, state: Any) -> bool:
+        """Unconditional save (end-of-run final state); no-op if that step
+        was already written by the interval policy."""
+        if self.manager.latest_step() == int(step):
+            return False
+        return bool(self.manager.save(
+            int(step), args=self._ocp.args.StandardSave(state), force=True))
+
+    def close(self) -> None:
+        self.manager.wait_until_finished()
+        self.manager.close()
+
+
+def from_env_or_args(checkpoint_dir: str = "", save_every: int = 100,
+                     max_to_keep: int = 3,
+                     env: Optional[dict] = None) -> Optional[Checkpointer]:
+    """Build a Checkpointer from an explicit flag, falling back to the
+    operator-injected TPU_CHECKPOINT_DIR; None when neither is set."""
+    e = env if env is not None else os.environ
+    directory = checkpoint_dir or e.get(ENV_VAR, "")
+    if not directory:
+        return None
+    return Checkpointer(directory, save_every=save_every,
+                        max_to_keep=max_to_keep)

@@ -34,6 +34,11 @@ def parse_args(argv=None):
     p.add_argument("--model-parallel", type=int, default=1)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR "
+                        "as injected by the operator when spec.checkpointDir "
+                        "is set)")
+    p.add_argument("--checkpoint-every", type=int, default=100)
     return p.parse_args(argv)
 
 
@@ -58,20 +63,28 @@ def build(args, mesh=None):
 
 
 def run(info: bootstrap.ProcessInfo, args=None) -> dict:
-    from tpu_operator.payload import train
+    from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
     mesh, _model, state, step, batches = build(args)
     log.info("mesh: %s over %d devices; global batch %d",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.batch)
+    ckpt = checkpoint.from_env_or_args(args.checkpoint_dir,
+                                       save_every=args.checkpoint_every)
+    if ckpt is not None and ckpt.latest_step() is not None:
+        log.info("attempt %d: resuming from %s (latest step: %d)",
+                 info.attempt, ckpt.directory, ckpt.latest_step())
     state, metrics = train.train_loop(
         mesh, step, state, batches, args.steps,
         log_every=args.log_every,
         log_fn=lambda i, m: log.info(
             "step %d loss %.4f acc %.3f", i, m["loss"], m["accuracy"]),
+        checkpointer=ckpt,
     )
-    log.info("final: loss %.4f accuracy %.3f", metrics["loss"], metrics["accuracy"])
+    log.info("final: loss %.4f accuracy %.3f",
+             metrics.get("loss", float("nan")),
+             metrics.get("accuracy", float("nan")))
     return metrics
 
 

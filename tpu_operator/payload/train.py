@@ -174,16 +174,40 @@ def make_regression_train_step(model: Any, tx: optax.GradientTransformation,
 def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                batches, steps: int,
                log_every: int = 0,
-               log_fn: Callable[[int, dict], None] = None) -> Tuple[TrainState, dict]:
-    """Drive N steps; returns (state, last_metrics). Host↔device traffic is
-    one batch in, one scalar dict out per logging interval."""
+               log_fn: Callable[[int, dict], None] = None,
+               checkpointer=None, spec=None) -> Tuple[TrainState, dict]:
+    """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
+    Host↔device traffic is one batch in, one scalar dict out per logging
+    interval. ``spec`` overrides the batch PartitionSpec (default P("data");
+    the LM payload passes P("data", "seq")).
+
+    With a ``checkpointer`` (payload/checkpoint.py), the loop first restores
+    the latest checkpoint — so a whole-group restart (TPUJOB_ATTEMPT > 0)
+    resumes where the previous attempt left off instead of step 0 — then
+    saves on the checkpointer's interval policy plus once at the end.
+    ``steps`` is the *target total*, not an increment: a job restarted at
+    step 400 of 500 runs 100 more, on the *same* batches 400..499 it would
+    have seen uninterrupted: the seed-deterministic stream is fast-forwarded
+    past the ``start`` batches the previous attempt already consumed.
+    """
+    start = 0
+    if checkpointer is not None:
+        state, start = checkpointer.restore(state)
+        for _ in range(start):
+            next(batches)
     metrics = {}
-    for i in range(steps):
+    for i in range(start, steps):
         host_arrays = next(batches)
-        device_arrays = data_mod.put_global_batch(mesh, *host_arrays)
+        device_arrays = data_mod.put_global_batch(mesh, *host_arrays, spec=spec)
         state, metrics = train_step(state, *device_arrays)
+        if checkpointer is not None:
+            checkpointer.maybe_save(i + 1, state)
         if log_every and log_fn and (i + 1) % log_every == 0:
             log_fn(i + 1, jax.device_get(metrics))
+    if checkpointer is not None:
+        if steps > start:
+            checkpointer.save(steps, state)
+        checkpointer.close()
     return state, (jax.device_get(metrics) if metrics else {})
 
 

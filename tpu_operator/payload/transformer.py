@@ -46,6 +46,9 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
+    p.add_argument("--checkpoint-every", type=int, default=100)
     return p.parse_args(argv)
 
 
@@ -179,26 +182,27 @@ def build(args, mesh=None):
 
 
 def run(info: bootstrap.ProcessInfo, args=None) -> dict:
-    import jax
     from jax.sharding import PartitionSpec as P
 
-    from tpu_operator.payload import data as data_mod
+    from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
     mesh, _model, state, step, batches = build(args)
     log.info("mesh: %s over %d devices; batch %d seq %d",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.batch, args.seq_len)
-    spec = P("data", "seq")
-    metrics = {}
-    for i in range(args.steps):
-        (tokens,) = next(batches)
-        (dev_tokens,) = data_mod.put_global_batch(mesh, tokens, spec=spec)
-        state, metrics = step(state, dev_tokens)
-        if args.log_every and (i + 1) % args.log_every == 0:
-            m = jax.device_get(metrics)
-            log.info("step %d loss %.4f", i + 1, m["loss"])
-    metrics = jax.device_get(metrics) if metrics else {}
+    ckpt = checkpoint.from_env_or_args(args.checkpoint_dir,
+                                       save_every=args.checkpoint_every)
+    if ckpt is not None and ckpt.latest_step() is not None:
+        log.info("attempt %d: resuming from %s (latest step: %d)",
+                 info.attempt, ckpt.directory, ckpt.latest_step())
+    state, metrics = train.train_loop(
+        mesh, step, state, batches, args.steps,
+        log_every=args.log_every,
+        log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
+        checkpointer=ckpt,
+        spec=P("data", "seq"),
+    )
     log.info("final: loss %.4f", metrics.get("loss", float("nan")))
     return metrics
 

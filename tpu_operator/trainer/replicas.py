@@ -185,6 +185,8 @@ def build_replica_env(
     }
     if spec.tpu_topology:
         env["TPU_TOPOLOGY"] = spec.tpu_topology
+    if spec.checkpoint_dir:
+        env["TPU_CHECKPOINT_DIR"] = spec.checkpoint_dir
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)
