@@ -6,7 +6,8 @@ install path (the reference only offered ``helm install``,
 README.md:28-47). Supports exactly the template subset the chart uses:
 
 - ``{{ .Values.path.to.key }}`` / ``{{ .Release.Namespace }}`` substitution
-- ``{{- if .Values.x }}`` … ``{{- end }}`` blocks (truthiness)
+- ``{{- if .Values.x }}`` / ``{{- if and .Values.x .Values.y }}`` …
+  ``{{- end }}`` blocks (truthiness)
 - ``{{- .Values.x | toYaml | nindent N }}``
 
 Also imported by tests/test_manifests.py to assert every rendered template
@@ -27,7 +28,7 @@ CHART_DIR = (
     / "deploy" / "chart" / "tpu-job-operator-chart"
 )
 
-_IF_RE = re.compile(r"^\s*\{\{-\s*if\s+(\S+)\s*\}\}\s*$")
+_IF_RE = re.compile(r"^\s*\{\{-\s*if\s+(.+?)\s*\}\}\s*$")
 _END_RE = re.compile(r"^\s*\{\{-\s*end\s*\}\}\s*$")
 _NINDENT_RE = re.compile(
     r"^(\s*)\{\{-\s*(\S+)\s*\|\s*toYaml\s*\|\s*nindent\s+(\d+)\s*\}\}\s*$"
@@ -56,7 +57,10 @@ def render(text: str, values: Dict[str, Any], namespace: str = "default") -> str
     for line in text.splitlines():
         m = _IF_RE.match(line)
         if m:
-            emitting.append(emitting[-1] and bool(_lookup(m.group(1), values, namespace)))
+            cond = m.group(1).split()
+            exprs = cond[1:] if cond[0] == "and" else cond
+            truthy = all(bool(_lookup(e, values, namespace)) for e in exprs)
+            emitting.append(emitting[-1] and truthy)
             continue
         if _END_RE.match(line):
             if len(emitting) == 1:

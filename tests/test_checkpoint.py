@@ -77,6 +77,7 @@ def test_train_loop_resumes_to_target_total(tmp_path):
     ck = checkpoint.Checkpointer(ckdir, save_every=2)
     state, _ = train.train_loop(mesh, step, state, batches, steps=4,
                                 checkpointer=ck)
+    ck.close()
     assert int(jax.device_get(state.step)) == 4
 
     # Restart: fresh state, new checkpointer over the same dir.
@@ -85,6 +86,7 @@ def test_train_loop_resumes_to_target_total(tmp_path):
     assert ck2.latest_step() == 4
     final, _ = train.train_loop(mesh2, step2, fresh, batches2, steps=6,
                                 checkpointer=ck2)
+    ck2.close()
     assert int(jax.device_get(final.step)) == 6
 
     # The final state is also checkpointed (end-of-run save).
@@ -100,6 +102,7 @@ def test_resume_fast_forwards_data_stream(tmp_path):
     args, (mesh, _m, state, step, batches) = tiny_build()
     ck = checkpoint.Checkpointer(ckdir, save_every=1)
     train.train_loop(mesh, step, state, batches, steps=4, checkpointer=ck)
+    ck.close()
 
     consumed = []
 
@@ -113,6 +116,7 @@ def test_resume_fast_forwards_data_stream(tmp_path):
     ck2 = checkpoint.Checkpointer(ckdir, save_every=1)
     train.train_loop(mesh2, step2, fresh, counting_stream(), steps=6,
                      checkpointer=ck2)
+    ck2.close()
     # 4 skipped on fast-forward + 2 trained = batches 0..5, in order.
     assert consumed == [0, 1, 2, 3, 4, 5]
 

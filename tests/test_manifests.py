@@ -86,7 +86,8 @@ def test_crd_manifest_matches_api_constants():
 def test_chart_renders_to_valid_yaml():
     rendered = render_chart.render_chart(namespace="tpu-system", include_tests=True)
     assert {"crd.yaml", "deployment.yaml", "config.yaml", "rbac.yaml",
-            "service-account.yaml", "tests/basic-test.yaml"} <= set(rendered)
+            "service-account.yaml", "dashboard.yaml",
+            "tests/basic-test.yaml"} <= set(rendered)
     kinds = {}
     for rel, text in rendered.items():
         for doc in yaml.safe_load_all(text):
@@ -94,7 +95,13 @@ def test_chart_renders_to_valid_yaml():
                 kinds.setdefault(doc["kind"], []).append(rel)
     assert set(kinds) == {"CustomResourceDefinition", "Deployment", "ConfigMap",
                           "ClusterRole", "ClusterRoleBinding", "ServiceAccount",
-                          "Pod"}
+                          "Pod", "Service"}
+    # The dashboard Service targets the status port the Deployment exposes.
+    (dep,) = list(yaml.safe_load_all(rendered["deployment.yaml"]))
+    container = dep["spec"]["template"]["spec"]["containers"][0]
+    assert any(p["name"] == "status" for p in container.get("ports", []))
+    assert container["livenessProbe"]["httpGet"]["path"] == "/healthz"
+    assert container["readinessProbe"]["httpGet"]["path"] == "/readyz"
 
 
 def test_chart_rbac_covers_operator_verbs():

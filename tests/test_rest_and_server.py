@@ -259,7 +259,7 @@ def test_server_run_end_to_end_over_http():
         opts = build_parser().parse_args([
             "--master", srv.url, "--namespace", "default",
             "--threadiness", "2", "--resync-period", "0",
-            "--gc-interval", "3600",
+            "--gc-interval", "3600", "--status-port", "0",
         ])
         stop = threading.Event()
         th = threading.Thread(target=server.run, args=(opts,),

@@ -237,6 +237,11 @@ class SharedInformerFactory:
         self._started = False
         self._stop_event: Optional[threading.Event] = None
 
+    @property
+    def informers(self) -> Dict[str, "Informer"]:
+        """Live view of the created informers (status server readiness)."""
+        return self._informers
+
     def informer_for(self, resource: str) -> Informer:
         if resource not in self._informers:
             client = getattr(self._clientset, resource)

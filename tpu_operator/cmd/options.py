@@ -54,4 +54,8 @@ def build_parser() -> argparse.ArgumentParser:
                    help="leader-election retry period in seconds")
     p.add_argument("--trace", action="store_true",
                    help="function-level call tracing (the go-tracey equivalent)")
+    p.add_argument("--status-port", type=int, default=0,
+                   help="port for /healthz, /readyz, /metrics, and the job "
+                        "dashboard (0 = disabled; the chart passes 8080; "
+                        "the reference had none of these)")
     return p

@@ -19,6 +19,7 @@ from tpu_operator.client.informer import SharedInformerFactory
 from tpu_operator.controller.chaos import ChaosMonkey
 from tpu_operator.controller.controller import Controller
 from tpu_operator.controller.leaderelection import LeaderElector
+from tpu_operator.controller.statusserver import StatusServer
 from tpu_operator.util import k8sutil
 from tpu_operator.util.util import get_operator_namespace
 
@@ -50,9 +51,19 @@ def run(opts: Any, clientset: Optional[Any] = None,
                                     resync_period=opts.resync_period)
     controller = Controller(clientset, factory, config, namespace)
 
+    # Observability binds before leader election: standbys must answer
+    # kubelet probes too (statusserver.py; the reference had no probes,
+    # metrics, or working dashboard — SURVEY.md §5).
+    status: Optional[StatusServer] = None
+    if getattr(opts, "status_port", 0):
+        status = StatusServer(opts.status_port, metrics=controller.metrics)
+        status.start()
+
     def start_leading(leading_stop: threading.Event) -> None:
         # Auxiliary loops ride the leadership scope, like controller.Run
         # (ref: server.go:93-95).
+        if status is not None:
+            status.set_controller(controller)
         threading.Thread(target=controller.run_gc_loop,
                          args=(opts.gc_interval, leading_stop),
                          daemon=True, name="gc").start()
@@ -63,18 +74,22 @@ def run(opts: Any, clientset: Optional[Any] = None,
                              daemon=True, name="chaos").start()
         controller.run(opts.threadiness, leading_stop)
 
-    if opts.no_leader_elect:
-        start_leading(stop_event)
-        return
+    try:
+        if opts.no_leader_elect:
+            start_leading(stop_event)
+            return
 
-    elector = LeaderElector(
-        clientset, namespace,
-        lease_duration=opts.lease_duration,
-        renew_deadline=opts.renew_deadline,
-        retry_period=opts.retry_period,
-    )
-    elector.run(on_started_leading=start_leading, stop_event=stop_event)
-    if not stop_event.is_set():
-        # Lost the lease (ref: OnStoppedLeading → fatal, server.go:98-102):
-        # exit nonzero so the Deployment restarts a fresh instance.
-        raise RuntimeError("leader election lost; exiting for restart")
+        elector = LeaderElector(
+            clientset, namespace,
+            lease_duration=opts.lease_duration,
+            renew_deadline=opts.renew_deadline,
+            retry_period=opts.retry_period,
+        )
+        elector.run(on_started_leading=start_leading, stop_event=stop_event)
+        if not stop_event.is_set():
+            # Lost the lease (ref: OnStoppedLeading → fatal, server.go:98-102):
+            # exit nonzero so the Deployment restarts a fresh instance.
+            raise RuntimeError("leader election lost; exiting for restart")
+    finally:
+        if status is not None:
+            status.stop()

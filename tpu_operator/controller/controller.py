@@ -56,12 +56,17 @@ class Controller:
         config: Optional[ControllerConfig] = None,
         namespace: str = "",
         queue: Optional[RateLimitingQueue] = None,
+        metrics: Optional[Any] = None,
     ):
         self.clientset = clientset
         self.factory = informer_factory
         self.config = config or ControllerConfig()
         self.namespace = namespace
         self.queue = queue or RateLimitingQueue()
+        # Prometheus-style counters (controller/statusserver.py); a plain
+        # no-op-free Metrics by default so call sites never branch.
+        from tpu_operator.controller.statusserver import Metrics
+        self.metrics = metrics if metrics is not None else Metrics()
         self.recorder = EventRecorder(clientset)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
@@ -131,10 +136,13 @@ class Controller:
             return False
         try:
             forget = self.sync_tpujob(key)
+            self.metrics.inc("reconcile_total")
             if forget:
                 self.queue.forget(key)
         except Exception as e:  # noqa: BLE001 — requeue with backoff
             log.warning("error syncing %s (requeueing): %s", key, e)
+            self.metrics.inc("reconcile_total")
+            self.metrics.inc("reconcile_errors_total")
             self.queue.add_rate_limited(key)
         finally:
             self.queue.done(key)
@@ -198,6 +206,7 @@ class Controller:
                 try:
                     client.delete(ns, md.get("name", ""))
                     deleted += 1
+                    self.metrics.inc("gc_deleted_total")
                 except errors.ApiError as e:
                     if not errors.is_not_found(e):
                         log.warning("gc delete failed: %s", e)

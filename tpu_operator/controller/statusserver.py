@@ -1,0 +1,226 @@
+"""Operator observability: /healthz, /readyz, /metrics, and a job dashboard.
+
+The reference had **no** metrics endpoint, no probes, and its Helm chart's
+dashboard referenced a binary that was not even in the repo (SURVEY.md §5
+"No Prometheus /metrics endpoint"; §2 #18 dashboard.yaml:25-35). This module
+closes all three gaps with one stdlib HTTP server (no new dependencies,
+matching the operator's pure-control-plane footprint):
+
+- ``GET /healthz``  — process liveness (always 200 while the thread serves).
+- ``GET /readyz``   — 200 once the informer caches of the *leading* instance
+  have synced; a non-leading standby also reports 200 (it is a healthy hot
+  spare) with ``standby`` in the body so probes don't flap during elections.
+- ``GET /metrics``  — Prometheus text format: reconcile totals/errors, queue
+  depth, jobs by phase, leadership, GC deletions.
+- ``GET /api/jobs`` — JSON roll-up of every TPUJob (phase, state, replicas)
+  straight from the informer cache: the dashboard the reference's chart
+  promised but never shipped.
+- ``GET /``         — minimal HTML rendering of the same roll-up.
+"""
+
+from __future__ import annotations
+
+import html
+import json
+import logging
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from typing import Any, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+
+class Metrics:
+    """Thread-safe monotonic counters (gauges are sampled at scrape time)."""
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        self._counters: Dict[str, float] = {
+            "reconcile_total": 0,
+            "reconcile_errors_total": 0,
+            "gc_deleted_total": 0,
+            "leader_elections_won_total": 0,
+        }
+
+    def inc(self, name: str, amount: float = 1) -> None:
+        with self._lock:
+            self._counters[name] = self._counters.get(name, 0) + amount
+
+    def snapshot(self) -> Dict[str, float]:
+        with self._lock:
+            return dict(self._counters)
+
+
+class StatusServer:
+    """Serves observability endpoints over the controller's live state.
+
+    ``controller`` may be None before leadership is won — endpoints then
+    report standby state. The server binds immediately at process start so
+    kubelet probes work for standbys too.
+    """
+
+    def __init__(self, port: int, controller: Optional[Any] = None,
+                 metrics: Optional[Metrics] = None, host: str = "") -> None:
+        self.metrics = metrics if metrics is not None else Metrics()
+        self._controller_lock = threading.Lock()
+        self._controller = controller
+        self._leading = threading.Event()
+        outer = self
+
+        class Handler(BaseHTTPRequestHandler):
+            def log_message(self, fmt: str, *args: Any) -> None:
+                log.debug("status: " + fmt, *args)
+
+            def _send(self, code: int, body: str,
+                      content_type: str = "text/plain; charset=utf-8") -> None:
+                data = body.encode()
+                self.send_response(code)
+                self.send_header("Content-Type", content_type)
+                self.send_header("Content-Length", str(len(data)))
+                self.end_headers()
+                self.wfile.write(data)
+
+            def do_GET(self) -> None:  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path == "/healthz":
+                        self._send(200, "ok")
+                    elif path == "/readyz":
+                        code, body = outer.readyz()
+                        self._send(code, body)
+                    elif path == "/metrics":
+                        self._send(200, outer.render_metrics(),
+                                   "text/plain; version=0.0.4; charset=utf-8")
+                    elif path == "/api/jobs":
+                        self._send(200, json.dumps(outer.jobs_rollup()),
+                                   "application/json")
+                    elif path == "/":
+                        self._send(200, outer.render_dashboard(),
+                                   "text/html; charset=utf-8")
+                    else:
+                        self._send(404, "not found")
+                except Exception as e:  # noqa: BLE001 — never kill the probe thread
+                    log.warning("status endpoint %s failed: %s", path, e)
+                    try:
+                        self._send(500, f"error: {e}")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+        self.server = ThreadingHTTPServer((host, port), Handler)
+        self.server.daemon_threads = True
+        self.port = self.server.server_address[1]
+        self._thread: Optional[threading.Thread] = None
+
+    # -- lifecycle -------------------------------------------------------------
+
+    def start(self) -> None:
+        self._thread = threading.Thread(target=self.server.serve_forever,
+                                        daemon=True, name="status-http")
+        self._thread.start()
+        log.info("status server listening on :%d", self.port)
+
+    def stop(self) -> None:
+        self.server.shutdown()
+        self.server.server_close()
+
+    def set_controller(self, controller: Any) -> None:
+        """Called when this instance wins leadership and builds a controller."""
+        with self._controller_lock:
+            self._controller = controller
+        self._leading.set()
+        self.metrics.inc("leader_elections_won_total")
+
+    @property
+    def controller(self) -> Optional[Any]:
+        with self._controller_lock:
+            return self._controller
+
+    # -- endpoint bodies -------------------------------------------------------
+
+    def readyz(self) -> tuple:
+        c = self.controller
+        if not self._leading.is_set() or c is None:
+            return 200, "ok: standby"
+        synced = all(inf.has_synced() for inf in c.factory.informers.values())
+        return (200, "ok: leading, caches synced") if synced else (
+            503, "not ready: caches syncing")
+
+    def jobs_rollup(self) -> list:
+        c = self.controller
+        if c is None:
+            return []
+        out = []
+        for obj in c.job_informer.store.list():
+            md = obj.get("metadata") or {}
+            status = obj.get("status") or {}
+            spec = obj.get("spec") or {}
+            out.append({
+                "namespace": md.get("namespace", ""),
+                "name": md.get("name", ""),
+                "phase": status.get("phase", ""),
+                "state": status.get("state", ""),
+                "attempt": status.get("attempt", 0),
+                "replicas": {
+                    str(rs.get("tpuReplicaType", "WORKER")): rs.get("replicas", 0)
+                    for rs in spec.get("replicaSpecs", [])
+                },
+                "replicaStatuses": status.get("replicaStatuses", []),
+            })
+        return out
+
+    def render_metrics(self) -> str:
+        lines = []
+
+        def emit(name: str, value: float, help_text: str,
+                 mtype: str = "counter", labels: str = "") -> None:
+            full = f"tpu_operator_{name}"
+            lines.append(f"# HELP {full} {help_text}")
+            lines.append(f"# TYPE {full} {mtype}")
+            lines.append(f"{full}{labels} {value}")
+
+        for name, value in sorted(self.metrics.snapshot().items()):
+            emit(name, value, f"Total {name.replace('_', ' ')}.")
+
+        emit("leading", 1 if self._leading.is_set() else 0,
+             "1 if this instance holds the leader lease.", "gauge")
+
+        c = self.controller
+        if c is not None:
+            emit("workqueue_depth", len(c.queue),
+                 "Pending keys in the reconcile workqueue.", "gauge")
+            phases: Dict[str, int] = {}
+            for obj in c.job_informer.store.list():
+                phase = (obj.get("status") or {}).get("phase") or "None"
+                phases[phase] = phases.get(phase, 0) + 1
+            full = "tpu_operator_jobs"
+            lines.append(f"# HELP {full} TPUJobs known to the informer cache, by phase.")
+            lines.append(f"# TYPE {full} gauge")
+            for phase, n in sorted(phases.items()):
+                lines.append(f'{full}{{phase="{phase}"}} {n}')
+        return "\n".join(lines) + "\n"
+
+    def render_dashboard(self) -> str:
+        rows = []
+        for j in self.jobs_rollup():
+            replicas = ", ".join(f"{k}×{v}" for k, v in j["replicas"].items())
+            rows.append(
+                "<tr>" + "".join(
+                    f"<td>{html.escape(str(v))}</td>"
+                    for v in (j["namespace"], j["name"], j["phase"],
+                              j["state"], j["attempt"], replicas)
+                ) + "</tr>"
+            )
+        body = "".join(rows) or '<tr><td colspan="6"><i>no jobs</i></td></tr>'
+        leading = "leading" if self._leading.is_set() else "standby"
+        return (
+            "<!doctype html><html><head><title>tpu-operator</title>"
+            "<style>body{font-family:sans-serif;margin:2em}"
+            "table{border-collapse:collapse}td,th{border:1px solid #ccc;"
+            "padding:.4em .8em;text-align:left}</style></head><body>"
+            f"<h1>tpu-operator <small>({leading})</small></h1>"
+            "<table><tr><th>Namespace</th><th>Name</th><th>Phase</th>"
+            "<th>State</th><th>Attempt</th><th>Replicas</th></tr>"
+            f"{body}</table>"
+            '<p><a href="/metrics">metrics</a> · <a href="/api/jobs">json</a></p>'
+            "</body></html>"
+        )

@@ -75,13 +75,17 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     if ckpt is not None and ckpt.latest_step() is not None:
         log.info("attempt %d: resuming from %s (latest step: %d)",
                  info.attempt, ckpt.directory, ckpt.latest_step())
-    state, metrics = train.train_loop(
-        mesh, step, state, batches, args.steps,
-        log_every=args.log_every,
-        log_fn=lambda i, m: log.info(
-            "step %d loss %.4f acc %.3f", i, m["loss"], m["accuracy"]),
-        checkpointer=ckpt,
-    )
+    try:
+        state, metrics = train.train_loop(
+            mesh, step, state, batches, args.steps,
+            log_every=args.log_every,
+            log_fn=lambda i, m: log.info(
+                "step %d loss %.4f acc %.3f", i, m["loss"], m["accuracy"]),
+            checkpointer=ckpt,
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     log.info("final: loss %.4f accuracy %.3f",
              metrics.get("loss", float("nan")),
              metrics.get("accuracy", float("nan")))

@@ -184,7 +184,9 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     With a ``checkpointer`` (payload/checkpoint.py), the loop first restores
     the latest checkpoint — so a whole-group restart (TPUJOB_ATTEMPT > 0)
     resumes where the previous attempt left off instead of step 0 — then
-    saves on the checkpointer's interval policy plus once at the end.
+    saves on the checkpointer's interval policy plus once at the end. The
+    checkpointer stays owned by the caller, who must ``close()`` it (flushes
+    the async save) when done with it.
     ``steps`` is the *target total*, not an increment: a job restarted at
     step 400 of 500 runs 100 more, on the *same* batches 400..499 it would
     have seen uninterrupted: the seed-deterministic stream is fast-forwarded
@@ -204,10 +206,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             checkpointer.maybe_save(i + 1, state)
         if log_every and log_fn and (i + 1) % log_every == 0:
             log_fn(i + 1, jax.device_get(metrics))
-    if checkpointer is not None:
-        if steps > start:
-            checkpointer.save(steps, state)
-        checkpointer.close()
+    if checkpointer is not None and steps > start:
+        checkpointer.save(steps, state)
     return state, (jax.device_get(metrics) if metrics else {})
 
 

@@ -196,13 +196,17 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     if ckpt is not None and ckpt.latest_step() is not None:
         log.info("attempt %d: resuming from %s (latest step: %d)",
                  info.attempt, ckpt.directory, ckpt.latest_step())
-    state, metrics = train.train_loop(
-        mesh, step, state, batches, args.steps,
-        log_every=args.log_every,
-        log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
-        checkpointer=ckpt,
-        spec=P("data", "seq"),
-    )
+    try:
+        state, metrics = train.train_loop(
+            mesh, step, state, batches, args.steps,
+            log_every=args.log_every,
+            log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
+            checkpointer=ckpt,
+            spec=P("data", "seq"),
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
     log.info("final: loss %.4f", metrics.get("loss", float("nan")))
     return metrics
 
