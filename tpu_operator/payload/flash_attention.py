@@ -1,0 +1,269 @@
+"""Pallas TPU flash-attention block kernel — the hot op of long-context jobs.
+
+The streaming-softmax merge of one visiting K/V block into a resident query
+block is where ring attention (ring_attention.py) spends its FLOPs. The
+plain-XLA path materializes the [B, H, Tq, Tk] score tensor in HBM between
+ops; this kernel keeps everything for one (batch, head, q-block) grid cell
+in VMEM — scores never leave the chip — and tiles the K dimension with an
+in-kernel loop, exactly the flash-attention recurrence (public technique;
+Dao et al. 2022, and the blockwise form of Liu et al.'s ring attention):
+
+    m' = max(m, rowmax(S))           S = (Q K^T) * scale, masked
+    l' = l * e^{m-m'} + rowsum(e^{S-m'})
+    o' = o * e^{m-m'} + e^{S-m'} V
+
+Layouts are MXU-native: [B, H, T, D] with D on lanes; Q@K^T and P@V are
+``dot_general`` contractions hitting the systolic array; masks are computed
+from ``broadcasted_iota`` (2D, as TPU requires). Global sequence offsets
+arrive as scalar-prefetch values so one compiled kernel serves every ring
+step (the offsets are traced, not baked into the grid).
+
+Differentiation: the kernel is forward-only; a ``jax.custom_vjp`` recomputes
+the identical merge in plain jnp for the backward pass (`_merge_ref`) and
+differentiates that — same FLOPs as the pre-kernel backward, so training
+keeps working while the forward gets the fused path. On non-TPU backends the
+kernel runs in interpret mode (tests) or falls back to `_merge_ref`.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional, Tuple
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.experimental import pallas as pl
+from jax.experimental.pallas import tpu as pltpu
+
+NEG_INF = -1e30
+
+Carry = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # o, l, m
+
+
+def _pick_block(t: int, target: int = 512) -> int:
+    """Largest power-of-two divisor of ``t`` up to ``target`` (whole span
+    when ``t`` has no such divisor — tiny test shapes)."""
+    b = target
+    while b >= 128:
+        if t % b == 0:
+            return b
+        b //= 2
+    return t
+
+
+def init_carry(batch: int, heads: int, tq: int, dim: int) -> Carry:
+    """Zero accumulators for a fresh streaming softmax ([B,H,Tq,D] f32 out,
+    [B,H,Tq,1] row-sum / row-max)."""
+    return (
+        jnp.zeros((batch, heads, tq, dim), jnp.float32),
+        jnp.zeros((batch, heads, tq, 1), jnp.float32),
+        jnp.full((batch, heads, tq, 1), NEG_INF, jnp.float32),
+    )
+
+
+def finalize(carry: Carry, dtype) -> jnp.ndarray:
+    """carry → attention output [B,H,Tq,D]; fully-masked rows yield 0.
+
+    A row that never saw an unmasked key keeps m = NEG_INF (its p values
+    were exp(NEG_INF - NEG_INF) = 1, so l alone cannot detect it); the
+    m-based guard is what makes the all-masked case return 0, not mean(V).
+    """
+    o, l, m = carry
+    valid = m > NEG_INF / 2
+    out = jnp.where(valid, o / jnp.maximum(l, 1e-30), 0.0)
+    return out.astype(dtype)
+
+
+# --- reference merge (backward path + non-TPU fallback) -----------------------
+
+def _merge_ref(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+               o: jnp.ndarray, l: jnp.ndarray, m: jnp.ndarray,
+               offsets: jnp.ndarray, causal: bool) -> Carry:
+    """The same recurrence in plain jnp on [B,H,T,D] blocks. Positions are
+    int32 end to end — float32 cannot represent sequence indices past 2^24,
+    which is squarely inside the long-context regime this serves."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = offsets[0] + jnp.arange(q.shape[2], dtype=jnp.int32)
+        k_pos = offsets[1] + jnp.arange(k.shape[2], dtype=jnp.int32)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+    p = jnp.exp(s - m_new)
+    alpha = jnp.exp(m - m_new)
+    l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+    o_new = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    return o_new, l_new, m_new
+
+
+# --- the kernel ---------------------------------------------------------------
+
+def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
+                  o_out, l_out, m_out, *, causal: bool, scale: float):
+    """One (batch, head, q-block, k-tile) grid cell. K tiling lives in the
+    grid — only one [blk_k, D] K/V tile is VMEM-resident at a time, so the
+    kernel compiles at arbitrary per-shard sequence lengths. The (o, l, m)
+    accumulators ride the output blocks, whose index map is constant in the
+    k dimension: Pallas keeps them VMEM-resident across all k-tiles of a
+    q-block (the innermost grid dim), and the carry from the previous ring
+    step seeds them at ik == 0."""
+    blk_q = q_ref.shape[2]
+    blk_k = k_ref.shape[2]
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+
+    @pl.when(ik == 0)
+    def _seed():
+        o_out[...] = o_ref[...]
+        l_out[...] = l_ref[...]
+        m_out[...] = m_ref[...]
+
+    # int32 positions: float32 loses integer resolution past 2^24, well
+    # inside the long-context regime.
+    q_lo = offs_ref[0] + iq * blk_q
+    k_lo = offs_ref[1] + ik * blk_k
+
+    # Causal skip: a k-tile entirely in this q-block's future contributes
+    # nothing — skip its matmuls (≈2× effective throughput for causal).
+    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    def _merge():
+        q = q_ref[0, 0].astype(jnp.float32) * scale      # [blk_q, D]
+        o = o_out[0, 0]                                  # [blk_q, D] f32
+        l = l_out[0, 0]                                  # [blk_q, 1]
+        m = m_out[0, 0]                                  # [blk_q, 1]
+        k_blk = k_ref[0, 0].astype(jnp.float32)          # [blk_k, D]
+        # S = Q K^T on the MXU (contract D, keep f32 accumulation).
+        s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32)
+        if causal:
+            q_pos = q_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+            k_pos = k_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        v_blk = v_ref[0, 0].astype(jnp.float32)
+        o_out[0, 0] = o * alpha + lax.dot_general(
+            p, v_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_out[0, 0] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_out[0, 0] = m_new
+
+
+def _merge_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                  o: jnp.ndarray, l: jnp.ndarray, m: jnp.ndarray,
+                  offsets: jnp.ndarray, causal: bool,
+                  interpret: bool) -> Carry:
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    blk_q = _pick_block(tq)
+    blk_k = _pick_block(tk)
+    scale = d ** -0.5
+
+    def qo_map(ib, ih, iq, ik, offs):
+        return (ib, ih, iq, 0)
+
+    def kv_map(ib, ih, iq, ik, offs):
+        return (ib, ih, ik, 0)
+
+    q_spec = pl.BlockSpec((1, 1, blk_q, d), qo_map)
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_map)
+    acc_spec = pl.BlockSpec((1, 1, blk_q, d), qo_map)
+    vec_spec = pl.BlockSpec((1, 1, blk_q, 1), qo_map)
+
+    kernel = functools.partial(_merge_kernel, causal=causal, scale=scale)
+    return pl.pallas_call(
+        kernel,
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            # k-tiles innermost: the accumulator output blocks revisit the
+            # same index across them and stay VMEM-resident.
+            grid=(b, h, tq // blk_q, tk // blk_k),
+            in_specs=[q_spec, kv_spec, kv_spec, acc_spec, vec_spec, vec_spec],
+            out_specs=[acc_spec, vec_spec, vec_spec],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct(o.shape, o.dtype),
+            jax.ShapeDtypeStruct(l.shape, l.dtype),
+            jax.ShapeDtypeStruct(m.shape, m.dtype),
+        ],
+        interpret=interpret,
+    )(offsets, q, k, v, o, l, m)
+
+
+# --- differentiable wrapper ---------------------------------------------------
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _merge(causal: bool, interpret: bool, q, k, v, o, l, m, offsets) -> Carry:
+    return _merge_pallas(q, k, v, o, l, m, offsets, causal, interpret)
+
+
+def _merge_fwd(causal, interpret, q, k, v, o, l, m, offsets):
+    out = _merge_pallas(q, k, v, o, l, m, offsets, causal, interpret)
+    return out, (q, k, v, o, l, m, offsets)
+
+
+def _merge_bwd(causal, _interpret, residuals, g):
+    import numpy as np
+
+    q, k, v, o, l, m, offsets = residuals
+    _out, vjp = jax.vjp(
+        lambda q_, k_, v_, o_, l_, m_: _merge_ref(q_, k_, v_, o_, l_, m_,
+                                                  offsets, causal),
+        q, k, v, o, l, m,
+    )
+    dq, dk, dv, do, dl, dm = vjp(g)
+    # int32 positions carry no gradient: the float0 cotangent is JAX's
+    # "symbolic zero for integer primals".
+    d_offs = np.zeros(offsets.shape, jax.dtypes.float0)
+    return dq, dk, dv, do, dl, dm, d_offs
+
+
+_merge.defvjp(_merge_fwd, _merge_bwd)
+
+
+def use_pallas_default() -> bool:
+    """Kernel on real TPUs; jnp fallback elsewhere (tests opt in to the
+    interpreter explicitly)."""
+    return jax.default_backend() == "tpu"
+
+
+def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                   carry: Carry, offsets: jnp.ndarray, *, causal: bool = True,
+                   use_pallas: Optional[bool] = None) -> Carry:
+    """Fold K/V block ``k``/``v`` (global position ``offsets[1]``) into the
+    streaming softmax over resident queries ``q`` (position ``offsets[0]``).
+
+    All blocks are [B, H, T, D]; ``offsets`` is a length-2 int32 array so
+    one compiled kernel serves every ring step. Differentiable (custom VJP).
+    ``use_pallas=None`` auto-selects: the kernel on real TPUs, the jnp path
+    elsewhere (``True`` forces the kernel — interpret mode off-TPU, which is
+    orders of magnitude slower than jnp and meant for tests only).
+    """
+    o, l, m = carry
+    offsets = offsets.astype(jnp.int32)
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if not use_pallas:
+        return _merge_ref(q, k, v, o, l, m, offsets, causal)
+    interpret = jax.default_backend() != "tpu"
+    return _merge(causal, interpret, q, k, v, o, l, m, offsets)
+
+
+def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
+                    causal: bool = True,
+                    use_pallas: Optional[bool] = None) -> jnp.ndarray:
+    """Single-device exact attention, [B, T, H, D] in/out — the fused
+    counterpart of ring_attention.reference_attention."""
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, h, tq, d = qt.shape
+    carry = init_carry(b, h, tq, d)
+    offsets = jnp.zeros((2,), jnp.int32)
+    carry = merge_kv_block(qt, kt, vt, carry, offsets, causal=causal,
+                           use_pallas=use_pallas)
+    out = finalize(carry, q.dtype)
+    return jnp.einsum("bhqd->bqhd", out)

@@ -18,8 +18,10 @@ bootstrapping the process group the mesh lives on.
 Design notes (TPU-first):
 - communication: ``ppermute`` neighbor exchange only — no all-gather of K/V,
   so per-device memory stays O(T/N) and the ring rides ICI links.
-- compute: per-step scores are [B, H, Tq_local, Tk_local] — big dense
-  matmuls that tile onto the MXU; bf16 inputs are fine, accumulation is f32.
+- compute: the per-visiting-block merge is the flash-attention recurrence,
+  fused into a single Pallas kernel on TPU (payload/flash_attention.py) so
+  block scores never round-trip through HBM; the jnp fallback below is the
+  same math and serves as the oracle + backward path.
 - control flow: ``lax.scan`` with a static trip count (the axis size), so
   the whole ring unrolls into one XLA while-op, reverse-differentiable.
 - numerics: running max is kept at a finite ``NEG_INF`` so fully-masked
@@ -55,86 +57,78 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
-def _block_scores(q: jnp.ndarray, k: jnp.ndarray, scale: float,
-                  q_offset: jnp.ndarray, kv_offset: jnp.ndarray,
-                  causal: bool) -> jnp.ndarray:
-    """Masked scores [B, H, Tq, Tk] for one (query-block, kv-block) pair.
-    Offsets are the blocks' global sequence positions, so causal masking is
-    correct regardless of which shard's K/V the ring currently holds."""
-    s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
-    if causal:
-        q_pos = q_offset + jnp.arange(q.shape[1])
-        k_pos = kv_offset + jnp.arange(k.shape[1])
-        mask = q_pos[:, None] >= k_pos[None, :]
-        s = jnp.where(mask[None, None], s, NEG_INF)
-    return s
-
-
 def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          axis_name: str, causal: bool) -> jnp.ndarray:
+                          axis_name: str, causal: bool,
+                          use_pallas: bool) -> jnp.ndarray:
     """The per-shard body (runs inside shard_map): q stays resident, k/v
-    rotate; a streaming softmax merges each visiting block."""
+    rotate; a streaming softmax merges each visiting block. The per-block
+    merge is the flash-attention recurrence — the fused Pallas kernel on
+    TPU (payload/flash_attention.py), plain jnp otherwise."""
+    from tpu_operator.payload import flash_attention as fa
+
     axis_size = lax.psum(1, axis_name)
     idx = lax.axis_index(axis_name)
     b, tq, h, d = q.shape
     tk = k.shape[1]
-    scale = d ** -0.5
-    q_offset = idx * tq
+
+    # [B,H,T,D]: D on lanes, the kernel's (and the MXU's) native layout.
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+
+    q_offset = (idx * tq).astype(jnp.int32)
+
+    def offsets(kv_idx):
+        return jnp.stack([q_offset, (kv_idx * tk).astype(jnp.int32)])
 
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
-    def merge(carry, k_blk, v_blk, kv_idx):
-        """Fold one K/V block into the streaming-softmax accumulators."""
-        o, l, m = carry
-        s = _block_scores(q, k_blk, scale, q_offset, kv_idx * tk, causal)
-        m_new = jnp.maximum(m, jnp.max(s, axis=-1))
-        p = jnp.exp(s - m_new[..., None])
-        alpha = jnp.exp(m - m_new)
-        l = l * alpha + jnp.sum(p, axis=-1)
-        o = o * alpha[..., None] + jnp.einsum(
-            "bhqk,bkhd->bhqd", p, v_blk.astype(jnp.float32))
-        return o, l, m_new
-
     # Resident block first, then rotate: exactly axis_size - 1 ppermute
     # hops, none wasted.
-    acc = (
-        jnp.zeros((b, h, tq, d), jnp.float32),
-        jnp.zeros((b, h, tq), jnp.float32),
-        jnp.full((b, h, tq), NEG_INF, jnp.float32),
-    )
-    acc = merge(acc, k, v, idx)
+    carry = fa.init_carry(b, h, tq, d)
+    carry = fa.merge_kv_block(qt, kt, vt, carry, offsets(idx),
+                              causal=causal, use_pallas=use_pallas)
 
-    def step(carry, i):
-        o, l, m, k_cur, v_cur = carry
+    def step(state, i):
+        o, l, m, k_cur, v_cur = state
         k_cur = lax.ppermute(k_cur, axis_name, perm)
         v_cur = lax.ppermute(v_cur, axis_name, perm)
         # After i forward rotations we hold the block that started on
         # shard (idx - i) mod axis_size.
         kv_idx = (idx - i) % axis_size
-        o, l, m = merge((o, l, m), k_cur, v_cur, kv_idx)
+        o, l, m = fa.merge_kv_block(qt, k_cur, v_cur, (o, l, m),
+                                    offsets(kv_idx), causal=causal,
+                                    use_pallas=use_pallas)
         return (o, l, m, k_cur, v_cur), None
 
-    (o, l, _m, _k, _v), _ = lax.scan(
-        step, (*acc, k, v), jnp.arange(1, axis_size))
-    out = jnp.where(l[..., None] > 0, o / jnp.maximum(l, 1e-30)[..., None], 0.0)
-    return jnp.einsum("bhqd->bqhd", out).astype(q.dtype)
+    (o, l, m, _k, _v), _ = lax.scan(
+        step, (*carry, kt, vt), jnp.arange(1, axis_size))
+    out = fa.finalize((o, l, m), q.dtype)
+    return jnp.einsum("bhqd->bqhd", out)
 
 
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, *, seq_axis: str = "seq",
                    batch_axis: Optional[str] = "data",
-                   causal: bool = True) -> jnp.ndarray:
+                   causal: bool = True,
+                   use_pallas: Optional[bool] = None) -> jnp.ndarray:
     """Exact attention over globally [B, T, H, D] arrays whose T dimension is
     sharded on ``mesh`` axis ``seq_axis`` (and B on ``batch_axis``).
 
     Drop-in equal to :func:`reference_attention` (up to accumulation order);
     per-device memory O(T / seq_shards), communication = seq_shards - 1
-    neighbor hops of the local K/V blocks.
+    neighbor hops of the local K/V blocks. ``use_pallas`` selects the fused
+    flash-attention block kernel (default: on real TPUs; tests opt in to the
+    interpreter on CPU).
     """
+    if use_pallas is None:
+        from tpu_operator.payload import flash_attention as fa
+
+        use_pallas = fa.use_pallas_default()
     spec = P(batch_axis, seq_axis, None, None)
     body = functools.partial(_ring_attention_local,
-                             axis_name=seq_axis, causal=causal)
+                             axis_name=seq_axis, causal=causal,
+                             use_pallas=use_pallas)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

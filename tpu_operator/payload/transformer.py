@@ -13,7 +13,9 @@ absent"). Here long-context is first-class payload capability:
   shards over ``seq``. Per-device activation memory is O(T / seq_shards).
 - attention is exact ring attention (payload/ring_attention.py): K/V blocks
   rotate around the ``seq`` axis on neighbor ppermutes (ICI hops), queries
-  stay resident, softmax streams in f32.
+  stay resident, softmax streams in f32. On TPU the per-block merge (and
+  the single-shard path) runs the fused Pallas flash-attention kernel
+  (payload/flash_attention.py).
 - everything else (LN, QKV/MLP matmuls, embeddings) is position-local, so
   it runs on sequence-sharded activations with zero communication; XLA
   inserts the gradient psums over both mesh axes.
@@ -66,6 +68,7 @@ def _build_model(args, mesh):
     import flax.linen as nn
     import jax.numpy as jnp
 
+    from tpu_operator.payload import flash_attention as fa
     from tpu_operator.payload import ring_attention as ring
 
     seq_shards = mesh.shape["seq"]
@@ -73,6 +76,8 @@ def _build_model(args, mesh):
     def attend(q, k, v):
         if seq_shards > 1:
             return ring.ring_attention(q, k, v, mesh, causal=True)
+        if fa.use_pallas_default():
+            return fa.flash_attention(q, k, v, causal=True)
         return ring.reference_attention(q, k, v, causal=True)
 
     class Block(nn.Module):
