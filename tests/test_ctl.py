@@ -1,0 +1,136 @@
+"""tpujobctl CLI tests over the in-process HTTP apiserver."""
+
+import io
+import contextlib
+
+import pytest
+
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.testing.apiserver import ApiServerHarness
+
+
+@pytest.fixture
+def api():
+    with ApiServerHarness() as srv:
+        yield srv, Clientset(RestConfig(host=srv.url, timeout=5.0))
+
+
+def run_ctl(srv, *args):
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--master", srv.url, *args])
+    return rc, out.getvalue()
+
+
+def write_manifest(tmp_path, name="cjob", replicas=2):
+    path = tmp_path / "job.yml"
+    path.write_text(f"""
+apiVersion: tpuoperator.dev/v1alpha1
+kind: TPUJob
+metadata:
+  name: {name}
+spec:
+  checkpointDir: /ckpt/{name}
+  maxRestarts: 2
+  replicaSpecs:
+    - replicas: {replicas}
+      tpuReplicaType: WORKER
+      tpuPort: 8476
+      template:
+        spec:
+          containers:
+            - name: tpu
+              image: x
+""")
+    return str(path)
+
+
+def test_submit_list_get_describe_delete(api, tmp_path):
+    srv, cs = api
+    rc, out = run_ctl(srv, "submit", "-f", write_manifest(tmp_path))
+    assert rc == 0 and "default/cjob created" in out
+    assert cs.tpujobs.get("default", "cjob")["metadata"]["name"] == "cjob"
+
+    rc, out = run_ctl(srv, "list")
+    assert rc == 0
+    assert "NAME" in out and "cjob" in out and "WORKER×2" in out
+
+    rc, out = run_ctl(srv, "get", "cjob", "-o", "json")
+    assert rc == 0
+    import json
+
+    job = json.loads(out)
+    assert job["spec"]["checkpointDir"] == "/ckpt/cjob"
+
+    rc, out = run_ctl(srv, "get", "cjob")  # yaml default
+    assert rc == 0 and "checkpointDir: /ckpt/cjob" in out
+
+    # Status + an event, as the operator would write them.
+    job = cs.tpujobs.get("default", "cjob")
+    job["status"] = {"phase": "Running", "state": "Running", "attempt": 1,
+                     "replicaStatuses": [{"tpuReplicaType": "WORKER",
+                                          "state": "Running",
+                                          "replicasStates": {"Running": 2}}]}
+    cs.tpujobs.update_status("default", job)
+    cs.events.create("default", {
+        "metadata": {"name": "cjob.ev1"},
+        "involvedObject": {"kind": "TPUJob", "name": "cjob"},
+        "type": "Normal", "reason": "SuccessfulCreate",
+        "message": "created pod cjob-worker-x-0", "count": 1,
+    })
+
+    rc, out = run_ctl(srv, "describe", "cjob")
+    assert rc == 0
+    assert "Phase:      Running" in out
+    assert "Attempt:    1 / maxRestarts 2" in out
+    assert "Checkpoint: /ckpt/cjob" in out
+    assert "WORKER: 2" in out
+    assert "SuccessfulCreate" in out
+
+    rc, out = run_ctl(srv, "delete", "cjob")
+    assert rc == 0 and "deleted" in out
+    assert cs.tpujobs.list("default") == []
+
+
+def test_submit_multi_doc_and_skip_foreign_kinds(api, tmp_path):
+    srv, cs = api
+    path = tmp_path / "multi.yml"
+    path.write_text("""
+apiVersion: v1
+kind: ConfigMap
+metadata: {name: not-a-job}
+---
+apiVersion: tpuoperator.dev/v1alpha1
+kind: TPUJob
+metadata: {name: a}
+spec: {replicaSpecs: []}
+---
+apiVersion: tpuoperator.dev/v1alpha1
+kind: TPUJob
+metadata: {name: b, namespace: other}
+spec: {replicaSpecs: []}
+""")
+    rc, out = run_ctl(srv, "submit", "-f", str(path))
+    assert rc == 0
+    assert "default/a created" in out
+    assert "other/b created" in out  # manifest namespace wins
+    assert cs.tpujobs.list("other")[0]["metadata"]["name"] == "b"
+
+
+def test_errors_are_clean(api, tmp_path):
+    srv, _cs = api
+    rc, _ = run_ctl(srv, "get", "missing")
+    assert rc == 1
+    rc, _ = run_ctl(srv, "delete", "missing")
+    assert rc == 1
+    rc, _ = run_ctl(srv, "submit", "-f", str(tmp_path / "nope.yml"))
+    assert rc == 1
+
+
+def test_no_command_prints_help():
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main([])
+    assert rc == 2
+    assert "submit" in out.getvalue()

@@ -53,11 +53,16 @@ def test_streamed_blocks_match_reference():
                                rtol=2e-5, atol=2e-5)
 
 
-def test_grad_matches_reference():
+@pytest.mark.parametrize("use_pallas", [True, False])
+def test_grad_matches_reference(use_pallas):
+    """use_pallas=True exercises the custom VJP (_merge_fwd/_merge_bwd,
+    pallas forward in interpret mode); False the plain jnp autodiff path."""
     q, k, v = qkv(t=128)
 
     def loss_flash(q, k, v):
-        return jnp.sum(fa.flash_attention(q, k, v, causal=True) ** 2)
+        return jnp.sum(
+            fa.flash_attention(q, k, v, causal=True,
+                               use_pallas=use_pallas) ** 2)
 
     def loss_ref(q, k, v):
         return jnp.sum(ring.reference_attention(q, k, v, causal=True) ** 2)
@@ -104,3 +109,16 @@ def test_pick_block():
     assert fa._pick_block(256) == 256
     assert fa._pick_block(384) == 128
     assert fa._pick_block(100) == 100  # tiny test shapes: whole span
+
+
+def test_infeasible_lengths_fall_back_to_jnp():
+    """Odd long lengths (not 128-multiples, too big for one block) must not
+    reach the kernel — they silently use _merge_ref and still match."""
+    assert not fa._kernel_feasible(4000)
+    assert fa._kernel_feasible(4096)
+    assert fa._kernel_feasible(100)
+    q, k, v = qkv(t=516)  # > 512 and not a 128-multiple
+    got = fa.flash_attention(q, k, v, causal=True, use_pallas=True)
+    want = ring.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)

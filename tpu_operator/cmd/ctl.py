@@ -1,0 +1,212 @@
+"""``tpujobctl`` — user-facing CLI for TPUJobs.
+
+The reference offered no tooling beyond raw ``kubectl create -f`` plus
+reading status YAML by eye (README.md:96-121). This is the quality-of-life
+layer on top of the same API surface: submit manifests, list jobs with their
+phase roll-up, describe one job with per-replica states and its recorded
+Events, and delete. Talks straight to the apiserver through the in-repo REST
+client, so it works against any cluster ``kubectl`` does (kubeconfig /
+in-cluster / --master), and against the in-repo test apiserver.
+
+    tpujobctl submit -f examples/tpujob-linear.yml
+    tpujobctl list
+    tpujobctl describe cifar10
+    tpujobctl delete cifar10
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from typing import Any, Dict, List
+
+from tpu_operator import version as version_mod
+from tpu_operator.client import errors
+
+
+def build_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="tpujobctl",
+        description="Manage TPUJobs (submit / list / describe / delete)",
+    )
+    p.add_argument("--master", default="", help="apiserver URL override")
+    p.add_argument("--kubeconfig", default="", help="kubeconfig path")
+    p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--version", action="store_true", help="print version and exit")
+    sub = p.add_subparsers(dest="command")
+
+    sp = sub.add_parser("submit", help="create TPUJob(s) from a manifest")
+    sp.add_argument("-f", "--filename", required=True,
+                    help="YAML manifest (may contain multiple documents)")
+
+    sub.add_parser("list", help="list TPUJobs")
+
+    gp = sub.add_parser("get", help="print one TPUJob")
+    gp.add_argument("name")
+    gp.add_argument("-o", "--output", choices=("yaml", "json"), default="yaml")
+
+    dp = sub.add_parser("describe",
+                        help="job summary: replicas, statuses, events")
+    dp.add_argument("name")
+
+    rp = sub.add_parser("delete", help="delete a TPUJob (children follow via GC)")
+    rp.add_argument("name")
+    return p
+
+
+def _clientset(opts):
+    from tpu_operator.util import k8sutil
+
+    return k8sutil.must_new_kube_client(opts.master, opts.kubeconfig)
+
+
+def _age(obj: Dict[str, Any]) -> str:
+    ts = (obj.get("metadata") or {}).get("creationTimestamp", "")
+    if not ts:
+        return "-"
+    try:
+        created = time.mktime(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+    except ValueError:
+        return "-"
+    seconds = max(0, int(time.time() - time.timezone - created))
+    for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
+        if seconds >= div:
+            return f"{seconds // div}{unit}"
+    return f"{seconds}s"
+
+
+def _print_table(rows: List[List[str]], header: List[str]) -> None:
+    widths = [max(len(str(r[i])) for r in [header] + rows)
+              for i in range(len(header))]
+    for row in [header] + rows:
+        print("  ".join(str(c).ljust(w) for c, w in zip(row, widths)).rstrip())
+
+
+def cmd_submit(cs, opts) -> int:
+    import yaml
+
+    with open(opts.filename, encoding="utf-8") as f:
+        docs = [d for d in yaml.safe_load_all(f) if d]
+    if not docs:
+        print(f"no documents in {opts.filename}", file=sys.stderr)
+        return 1
+    for doc in docs:
+        if doc.get("kind") != "TPUJob":
+            print(f"skipping non-TPUJob document kind={doc.get('kind')!r}",
+                  file=sys.stderr)
+            continue
+        ns = (doc.get("metadata") or {}).get("namespace") or opts.namespace
+        created = cs.tpujobs.create(ns, doc)
+        print(f"tpujob {ns}/{created['metadata']['name']} created")
+    return 0
+
+
+def cmd_list(cs, opts) -> int:
+    jobs = cs.tpujobs.list(opts.namespace)
+    rows = []
+    for j in jobs:
+        status = j.get("status") or {}
+        spec = j.get("spec") or {}
+        replicas = ",".join(
+            f"{rs.get('tpuReplicaType', 'WORKER')}×{rs.get('replicas', 0)}"
+            for rs in spec.get("replicaSpecs", []))
+        rows.append([
+            j["metadata"]["name"],
+            status.get("phase", ""),
+            status.get("state", ""),
+            str(status.get("attempt", 0)),
+            replicas,
+            _age(j),
+        ])
+    _print_table(rows, ["NAME", "PHASE", "STATE", "ATTEMPT", "REPLICAS", "AGE"])
+    return 0
+
+
+def cmd_get(cs, opts) -> int:
+    job = cs.tpujobs.get(opts.namespace, opts.name)
+    if opts.output == "json":
+        print(json.dumps(job, indent=2))
+    else:
+        import yaml
+
+        print(yaml.safe_dump(job, default_flow_style=False, sort_keys=False),
+              end="")
+    return 0
+
+
+def cmd_describe(cs, opts) -> int:
+    job = cs.tpujobs.get(opts.namespace, opts.name)
+    md, spec = job["metadata"], job.get("spec") or {}
+    status = job.get("status") or {}
+    print(f"Name:       {md['name']}")
+    print(f"Namespace:  {md.get('namespace', opts.namespace)}")
+    print(f"Phase:      {status.get('phase', '')}")
+    print(f"State:      {status.get('state', '')}")
+    print(f"Attempt:    {status.get('attempt', 0)} / "
+          f"maxRestarts {spec.get('maxRestarts', '')}")
+    if spec.get("tpuTopology"):
+        print(f"Topology:   {spec['tpuTopology']}")
+    if spec.get("checkpointDir"):
+        print(f"Checkpoint: {spec['checkpointDir']}")
+    print("Replicas:")
+    for rs in spec.get("replicaSpecs", []):
+        print(f"  {rs.get('tpuReplicaType', 'WORKER')}: "
+              f"{rs.get('replicas', 0)} × port {rs.get('tpuPort', '')}")
+    if status.get("replicaStatuses"):
+        print("Replica statuses:")
+        for rstat in status["replicaStatuses"]:
+            print(f"  {rstat.get('tpuReplicaType', '')}: "
+                  f"{rstat.get('state', '')} {rstat.get('replicasStates', {})}")
+    try:
+        events = cs.events.list(opts.namespace)
+    except errors.ApiError:
+        events = []
+    related = [e for e in events
+               if (e.get("involvedObject") or {}).get("name") == opts.name]
+    if related:
+        print("Events:")
+        for e in related[-10:]:
+            print(f"  {e.get('type', '')}\t{e.get('reason', '')}\t"
+                  f"x{e.get('count', 1)}\t{e.get('message', '')}")
+    return 0
+
+
+def cmd_delete(cs, opts) -> int:
+    cs.tpujobs.delete(opts.namespace, opts.name)
+    print(f"tpujob {opts.namespace}/{opts.name} deleted")
+    return 0
+
+
+COMMANDS = {
+    "submit": cmd_submit,
+    "list": cmd_list,
+    "get": cmd_get,
+    "describe": cmd_describe,
+    "delete": cmd_delete,
+}
+
+
+def main(argv=None) -> int:
+    parser = build_parser()
+    opts = parser.parse_args(argv)
+    if opts.version:
+        print(version_mod.info())
+        return 0
+    if not opts.command:
+        parser.print_help()
+        return 2
+    try:
+        cs = _clientset(opts)
+        return COMMANDS[opts.command](cs, opts)
+    except errors.ApiError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+    except FileNotFoundError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 1
+
+
+if __name__ == "__main__":
+    sys.exit(main())

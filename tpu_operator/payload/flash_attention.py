@@ -52,6 +52,14 @@ def _pick_block(t: int, target: int = 512) -> int:
     return t
 
 
+def _kernel_feasible(t: int) -> bool:
+    """Whether a sequence span tiles into VMEM-sized blocks: a 128-multiple
+    (proper tiling) or small enough that the whole span is one block. Odd
+    long lengths (e.g. 4000) would otherwise become a whole-span block whose
+    score tile busts VMEM — those fall back to the jnp path."""
+    return t % 128 == 0 or t <= 512
+
+
 def init_carry(batch: int, heads: int, tq: int, dim: int) -> Carry:
     """Zero accumulators for a fresh streaming softmax ([B,H,Tq,D] f32 out,
     [B,H,Tq,1] row-sum / row-max)."""
@@ -246,6 +254,9 @@ def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     offsets = offsets.astype(jnp.int32)
     if use_pallas is None:
         use_pallas = use_pallas_default()
+    if use_pallas and not (_kernel_feasible(q.shape[2])
+                           and _kernel_feasible(k.shape[2])):
+        use_pallas = False
     if not use_pallas:
         return _merge_ref(q, k, v, o, l, m, offsets, causal)
     interpret = jax.default_backend() != "tpu"
