@@ -17,6 +17,7 @@ in-cluster / --master), and against the in-repo test apiserver.
 from __future__ import annotations
 
 import argparse
+import calendar
 import json
 import sys
 import time
@@ -67,10 +68,10 @@ def _age(obj: Dict[str, Any]) -> str:
     if not ts:
         return "-"
     try:
-        created = time.mktime(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
+        created = calendar.timegm(time.strptime(ts, "%Y-%m-%dT%H:%M:%SZ"))
     except ValueError:
         return "-"
-    seconds = max(0, int(time.time() - time.timezone - created))
+    seconds = max(0, int(time.time() - created))
     for unit, div in (("d", 86400), ("h", 3600), ("m", 60)):
         if seconds >= div:
             return f"{seconds // div}{unit}"
@@ -92,6 +93,7 @@ def cmd_submit(cs, opts) -> int:
     if not docs:
         print(f"no documents in {opts.filename}", file=sys.stderr)
         return 1
+    submitted = 0
     for doc in docs:
         if doc.get("kind") != "TPUJob":
             print(f"skipping non-TPUJob document kind={doc.get('kind')!r}",
@@ -100,6 +102,10 @@ def cmd_submit(cs, opts) -> int:
         ns = (doc.get("metadata") or {}).get("namespace") or opts.namespace
         created = cs.tpujobs.create(ns, doc)
         print(f"tpujob {ns}/{created['metadata']['name']} created")
+        submitted += 1
+    if not submitted:
+        print(f"no TPUJob documents in {opts.filename}", file=sys.stderr)
+        return 1
     return 0
 
 
@@ -197,13 +203,14 @@ def main(argv=None) -> int:
     if not opts.command:
         parser.print_help()
         return 2
+    import yaml
+
     try:
         cs = _clientset(opts)
         return COMMANDS[opts.command](cs, opts)
-    except errors.ApiError as e:
-        print(f"error: {e}", file=sys.stderr)
-        return 1
-    except FileNotFoundError as e:
+    except (errors.ApiError, OSError, yaml.YAMLError) as e:
+        # OSError covers FileNotFoundError plus network-level failures
+        # (connection refused, DNS, TLS) reaching the apiserver.
         print(f"error: {e}", file=sys.stderr)
         return 1
 
