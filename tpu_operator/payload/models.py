@@ -88,6 +88,41 @@ class CifarResNet(nn.Module):
         return x
 
 
+class DecoderBlock(nn.Module):
+    """Pre-LN transformer decoder block, the shared unit of the LM payloads
+    (transformer.py's sequence-parallel stack, pipeline.py's stages).
+
+    ``attend`` is injected by the caller — ring attention on a seq-sharded
+    mesh, the Pallas flash kernel on a single shard, the jnp oracle on CPU —
+    so the block itself stays mesh-agnostic. Compute dtype parameterized
+    (bf16 on the MXU; f32 for parity tests); LayerNorms always f32.
+    """
+
+    dim: int
+    heads: int
+    attend: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
+    dtype: Any = jnp.bfloat16
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
+        b, t, _ = x.shape
+        head_dim = self.dim // self.heads
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
+        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                       name="qkv")(h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+        shape = (b, t, self.heads, head_dim)
+        out = self.attend(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                       name="attn_out")(out.reshape(b, t, self.dim))
+        x = x + out
+        h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        h = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_up")(h)
+        h = nn.gelu(h)
+        h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
+        return x + h
+
+
 class LinearRegressor(nn.Module):
     """The linear-regression payload (ref image mxnet-linear-dist,
     README.md:66-96): y = Wx + b."""

@@ -1,0 +1,355 @@
+"""Pipeline-parallel transformer LM payload (GPipe-style over a mesh axis).
+
+``python -m tpu_operator.payload.pipeline`` — the pipeline-parallelism
+member of the payload zoo. The reference operator hosts parallel training
+strategies without expressing any (SURVEY.md §2 parallelism checklist: its
+only strategy is process-level PS data parallelism, `replicas.go:235-260`);
+here pipeline parallelism is a first-class, TPU-native payload capability
+running on the process group the operator bootstraps.
+
+Design (TPU-first, not a torch-style stage-per-process port):
+
+- **mesh = (data, pipe)**: batch shards over ``data``; the *layer stack*
+  shards over ``pipe``. Stage s holds layers [s·L/S, (s+1)·L/S).
+- **SPMD pipelining inside one jit**: every stage is the *same* program on a
+  different shard of the stacked stage parameters (leading dim S, sharded
+  over ``pipe``). A ``lax.scan`` over M + S - 1 ticks streams M microbatches
+  through; activations hop stage→stage via ``lax.ppermute`` (one ICI hop),
+  exactly the collective-pipelining recipe XLA compiles well — no
+  per-stage Python processes, no point-to-point sends outside the compiler.
+- **Bubble** is the usual (S-1)/(M+S-1); pick microbatches >> stages.
+- **Numerics**: house style (models.py) — bf16 matmuls on the MXU, f32
+  LayerNorm/softmax/loss, f32 master params.
+- Embedding and the LM head are position- and layer-local, so they run
+  data-parallel *outside* the pipelined stack (replicated params); only the
+  block stack pipelines.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+from typing import Optional
+
+from tpu_operator.payload import bootstrap
+
+log = logging.getLogger(__name__)
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--steps", type=int, default=300)
+    p.add_argument("--batch", type=int, default=32, help="global batch size")
+    p.add_argument("--seq-len", type=int, default=256)
+    p.add_argument("--vocab", type=int, default=256)
+    p.add_argument("--dim", type=int, default=256)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--layers", type=int, default=8,
+                   help="total decoder blocks (divisible by --pipeline)")
+    p.add_argument("--pipeline", type=int, default=1,
+                   help="pipeline stages (mesh pipe axis size)")
+    p.add_argument("--microbatches", type=int, default=4,
+                   help="microbatches streamed through the pipeline per step")
+    p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16",
+                   help="stage compute dtype (f32 for parity tests)")
+    p.add_argument("--lr", type=float, default=3e-3)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
+    p.add_argument("--checkpoint-every", type=int, default=100)
+    return p.parse_args(argv)
+
+
+def make_pipe_mesh(num_devices: Optional[int] = None, pipeline: int = 1,
+                   devices: Optional[list] = None):
+    """(data, pipe) mesh: DP outer, pipeline inner — consecutive stages land
+    on neighboring devices so activation hops ride adjacent ICI links."""
+    from tpu_operator.payload import train
+
+    return train.make_mesh(num_devices, model_parallel=pipeline,
+                           devices=devices, axis_names=("data", "pipe"))
+
+
+def _stage_module(args):
+    """One pipeline stage: layers_per_stage pre-LN decoder blocks."""
+    import flax.linen as nn
+    import jax.numpy as jnp
+
+    from tpu_operator.payload import flash_attention as fa
+    from tpu_operator.payload import ring_attention as ring
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+    def attend(q, k, v):
+        if dtype == jnp.bfloat16 and fa.use_pallas_default():
+            return fa.flash_attention(q, k, v, causal=True)
+        return ring.reference_attention(q, k, v, causal=True)
+
+    from tpu_operator.payload import models
+
+    class Stage(nn.Module):
+        dim: int
+        heads: int
+        blocks: int
+
+        @nn.compact
+        def __call__(self, x):
+            for i in range(self.blocks):
+                x = models.DecoderBlock(self.dim, self.heads, attend,
+                                        dtype=dtype, name=f"block{i}")(x)
+            return x
+
+    if args.layers % args.pipeline != 0:
+        raise ValueError(
+            f"--layers {args.layers} not divisible by --pipeline {args.pipeline}")
+    return Stage(dim=args.dim, heads=args.heads,
+                 blocks=args.layers // args.pipeline)
+
+
+def init_stacked_params(stage, rng, num_stages: int, sample):
+    """vmap the stage init over per-stage rngs → every param leaf gains a
+    leading [num_stages] dim (the dim that shards over ``pipe``)."""
+    import jax
+
+    rngs = jax.random.split(rng, num_stages)
+    return jax.vmap(lambda r: stage.init(r, sample)["params"])(rngs)
+
+
+def pipeline_apply(mesh, stage_apply, stacked_params, x, microbatches: int):
+    """Run x [B, T, D] through the stacked stages with GPipe scheduling.
+
+    ``stacked_params``: pytree whose leaves have leading dim S (sharded over
+    mesh axis ``pipe``); ``stage_apply(params, x)`` applies one stage.
+    Differentiable end-to-end: scan reverse-unrolls the schedule, ppermute
+    transposes to the reverse hop, the final psum transposes to a broadcast.
+    """
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    num_stages = mesh.shape["pipe"]
+
+    def leaf_spec(leaf):
+        return P("pipe", *(None,) * (leaf.ndim - 1))
+
+    param_specs = jax.tree_util.tree_map(leaf_spec, stacked_params)
+    x_spec = P("data", None, None)
+
+    def body(params, x_local):
+        # params leaves arrive [1, ...] (this device's stage); drop the dim.
+        params = jax.tree_util.tree_map(lambda p: p[0], params)
+        stage_idx = lax.axis_index("pipe")
+        b_loc, t, d = x_local.shape
+        if b_loc % microbatches != 0:
+            raise ValueError(
+                f"per-datashard batch {b_loc} not divisible by "
+                f"microbatches={microbatches}")
+        mb = b_loc // microbatches
+        x_mb = x_local.reshape(microbatches, mb, t, d)
+        fwd_perm = [(i, i + 1) for i in range(num_stages - 1)]
+
+        def tick(carry, step_i):
+            act, outputs = carry
+            # Stage 0 consumes microbatch step_i (clamped past the end —
+            # those ticks only drain the pipe, results are never collected).
+            inp = lax.dynamic_index_in_dim(
+                x_mb, jnp.minimum(step_i, microbatches - 1), 0, keepdims=False)
+            y = stage_apply(params, jnp.where(stage_idx == 0, inp, act))
+            # The last stage finishes microbatch step_i - (S-1).
+            out_idx = jnp.clip(step_i - (num_stages - 1), 0, microbatches - 1)
+            collect = jnp.logical_and(stage_idx == num_stages - 1,
+                                      step_i >= num_stages - 1)
+            prev = lax.dynamic_index_in_dim(outputs, out_idx, 0, keepdims=False)
+            outputs = lax.dynamic_update_index_in_dim(
+                outputs, jnp.where(collect, y, prev), out_idx, 0)
+            # Hop forward one stage; stage 0's next input comes from x_mb, so
+            # the zeros ppermute feeds unlisted destinations are never read.
+            act = lax.ppermute(y, "pipe", fwd_perm)
+            return (act, outputs), None
+
+        init = (jnp.zeros((mb, t, d), x_local.dtype),
+                jnp.zeros((microbatches, mb, t, d), x_local.dtype))
+        (act, outputs), _ = lax.scan(
+            tick, init, jnp.arange(microbatches + num_stages - 1))
+        # Only the last stage holds real outputs; psum broadcasts them back
+        # to every stage (single non-zero contributor per pipe group).
+        is_last = (stage_idx == num_stages - 1).astype(outputs.dtype)
+        out = lax.psum(outputs * is_last, "pipe")
+        return out.reshape(b_loc, t, d)
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(param_specs, x_spec),
+                       out_specs=x_spec, check_vma=False)
+    return fn(stacked_params, x)
+
+
+def _init_params(args, mesh, rng):
+    """Full param tree: replicated embed/head + pipe-stacked stage params."""
+    import jax
+    import jax.numpy as jnp
+
+    stage = _stage_module(args)
+    num_stages = mesh.shape["pipe"]
+    k_stage, k_tok, k_pos, k_head = jax.random.split(rng, 4)
+    sample = jnp.zeros((1, args.seq_len, args.dim),
+                       jnp.bfloat16 if args.dtype == "bf16" else jnp.float32)
+    return stage, {
+        "tok_embed": jax.random.normal(k_tok, (args.vocab, args.dim),
+                                       jnp.float32) * 0.02,
+        "pos_embed": jax.random.normal(k_pos, (args.seq_len, args.dim),
+                                       jnp.float32) * 0.02,
+        "stages": init_stacked_params(stage, k_stage, num_stages, sample),
+        "ln_f": {"scale": jnp.ones((args.dim,), jnp.float32),
+                 "bias": jnp.zeros((args.dim,), jnp.float32)},
+        "head": jax.random.normal(k_head, (args.dim, args.vocab),
+                                  jnp.float32) * 0.02,
+    }
+
+
+def forward(args, mesh, stage, params, tokens):
+    """Logits [B, T, V]: DP embed → pipelined stack → DP LayerNorm + head."""
+    import jax.numpy as jnp
+
+    dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+    _b, t = tokens.shape
+    x = params["tok_embed"][tokens].astype(dtype)
+    x = x + params["pos_embed"][:t].astype(dtype)[None]
+    x = pipeline_apply(mesh, lambda p, h: stage.apply({"params": p}, h),
+                       params["stages"], x, args.microbatches)
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    xf = (xf - mean) * (var + 1e-6) ** -0.5
+    xf = xf * params["ln_f"]["scale"] + params["ln_f"]["bias"]
+    return xf.astype(dtype) @ params["head"].astype(dtype)
+
+
+def state_shardings(mesh, state):
+    """Shardings for the pipeline state: every leaf under a ``stages`` path
+    (params and the params-shaped adam moments) shards its leading stage dim
+    over ``pipe``; everything else replicates."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_operator.payload import train
+
+    def spec(tree):
+        def leaf_rule(path, leaf):
+            keys = tuple(getattr(p, "key", str(p)) for p in path)
+            if "stages" in keys and getattr(leaf, "ndim", 0) >= 1:
+                return NamedSharding(mesh, P("pipe", *(None,) * (leaf.ndim - 1)))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(leaf_rule, tree)
+
+    return train.TrainState(
+        step=NamedSharding(mesh, P()),
+        params=spec(state.params),
+        batch_stats=spec(state.batch_stats),
+        opt_state=spec(state.opt_state),
+    )
+
+
+def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_operator.payload import train
+
+    shardings = shardings or state_shardings(mesh, state)
+    token_shard = NamedSharding(mesh, P("data", None))
+
+    def step(state, tokens):
+        def loss_fn(params):
+            logits = forward(args, mesh, stage, params, tokens)
+            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+            targets = tokens[:, 1:]
+            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
+            return -jnp.mean(ll)
+
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_state = train.TrainState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=state.batch_stats,
+            opt_state=new_opt,
+        )
+        return new_state, {"loss": loss}
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, token_shard),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )
+
+
+def build(args, mesh=None):
+    """(mesh, stage, state, train_step, batches) for the given config."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_operator.payload import data as data_mod
+    from tpu_operator.payload import train
+
+    mesh = mesh or make_pipe_mesh(pipeline=args.pipeline)
+    data_shards = mesh.shape["data"]
+    if args.batch % (data_shards * args.microbatches) != 0:
+        raise ValueError(
+            f"--batch {args.batch} must divide by data shards × microbatches "
+            f"({data_shards} × {args.microbatches})")
+    stage, params = _init_params(args, mesh, jax.random.key(args.seed))
+    tx = optax.adam(args.lr)
+    state = train.TrainState(
+        step=jnp.zeros((), jnp.int32),
+        params=params,
+        batch_stats={},
+        opt_state=tx.init(params),
+    )
+    shardings = state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+    step = make_pipe_train_step(args, stage, mesh, state, tx, shardings)
+    batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
+                                    vocab=args.vocab)
+    return mesh, stage, state, step, batches
+
+
+def run(info: bootstrap.ProcessInfo, args=None) -> dict:
+    from tpu_operator.payload import checkpoint, train
+
+    args = args or parse_args([])
+    mesh, _stage, state, step, batches = build(args)
+    log.info("mesh: %s over %d devices; %d layers / %d stages, %d microbatches",
+             dict(zip(mesh.axis_names, mesh.devices.shape)),
+             mesh.devices.size, args.layers, args.pipeline, args.microbatches)
+    ckpt = checkpoint.from_env_or_args(args.checkpoint_dir,
+                                       save_every=args.checkpoint_every)
+    if ckpt is not None and ckpt.latest_step() is not None:
+        log.info("attempt %d: resuming from %s (latest step: %d)",
+                 info.attempt, ckpt.directory, ckpt.latest_step())
+    try:
+        state, metrics = train.train_loop(
+            mesh, step, state, batches, args.steps,
+            log_every=args.log_every,
+            log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
+            checkpointer=ckpt,
+        )
+    finally:
+        if ckpt is not None:
+            ckpt.close()
+    log.info("final: loss %.4f", metrics.get("loss", float("nan")))
+    return metrics
+
+
+def main() -> None:
+    args = parse_args()
+    bootstrap.main_wrapper(lambda info: run(info, args))
+
+
+if __name__ == "__main__":
+    main()

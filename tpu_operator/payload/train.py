@@ -88,6 +88,16 @@ def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
     )
 
 
+def place_state(mesh: Mesh, state: TrainState,
+                shardings: Optional[TrainState] = None) -> TrainState:
+    """Commit the state onto its mesh shardings. Builds call this so the
+    live state's shardings are the intended ones from step 0 — checkpoint
+    restore targets the live state's shardings (checkpoint.py), and an
+    uncommitted device-0 state would otherwise restore single-device and
+    clash with the train step's in_shardings."""
+    return jax.device_put(state, shardings or state_shardings(mesh, state))
+
+
 def create_train_state(model: Any, rng: jax.Array, sample_input: jnp.ndarray,
                        tx: optax.GradientTransformation) -> TrainState:
     variables = model.init(rng, sample_input, train=True)

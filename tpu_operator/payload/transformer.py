@@ -80,28 +80,7 @@ def _build_model(args, mesh):
             return fa.flash_attention(q, k, v, causal=True)
         return ring.reference_attention(q, k, v, causal=True)
 
-    class Block(nn.Module):
-        dim: int
-        heads: int
-
-        @nn.compact
-        def __call__(self, x):
-            b, t, _ = x.shape
-            head_dim = self.dim // self.heads
-            h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=jnp.bfloat16,
-                           name="qkv")(h)
-            q, k, v = jnp.split(qkv, 3, axis=-1)
-            shape = (b, t, self.heads, head_dim)
-            out = attend(q.reshape(shape), k.reshape(shape), v.reshape(shape))
-            out = nn.Dense(self.dim, use_bias=False, dtype=jnp.bfloat16,
-                           name="attn_out")(out.reshape(b, t, self.dim))
-            x = x + out
-            h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
-            h = nn.Dense(4 * self.dim, dtype=jnp.bfloat16, name="mlp_up")(h)
-            h = nn.gelu(h)
-            h = nn.Dense(self.dim, dtype=jnp.bfloat16, name="mlp_down")(h)
-            return x + h
+    from tpu_operator.payload import models
 
     class TransformerLM(nn.Module):
         vocab: int
@@ -119,7 +98,8 @@ def _build_model(args, mesh):
                            name="pos_embed")(jnp.arange(t))
             x = x + pos[None]
             for i in range(self.layers):
-                x = Block(self.dim, self.heads, name=f"block{i}")(x)
+                x = models.DecoderBlock(self.dim, self.heads, attend,
+                                        name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
                             name="lm_head")(x)
@@ -180,6 +160,7 @@ def build(args, mesh=None):
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
+    state = train.place_state(mesh, state)
     step = make_lm_train_step(model, tx, mesh, state)
     batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
                                     vocab=args.vocab)
