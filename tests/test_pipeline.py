@@ -85,7 +85,7 @@ def test_pipeline_apply_grad_matches_sequential(mesh, stage_and_params):
                                    atol=1e-5, rtol=1e-4)
 
 
-def test_single_stage_degenerates_to_plain_stack(stage_and_params):
+def test_single_stage_degenerates_to_plain_stack():
     # pipe=1 mesh: the scheduler must collapse to sequential with no hops.
     args = _args(layers=4, pipeline=1)
     mesh1 = pipeline.make_pipe_mesh(2, pipeline=1)

@@ -57,8 +57,9 @@ def build(args, mesh=None):
     tx = optax.sgd(args.lr, momentum=args.momentum)
     sample = jnp.zeros((args.batch, *data_mod.CIFAR_SHAPE), jnp.float32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
-    state = train.place_state(mesh, state)
-    step = train.make_classifier_train_step(model, tx, mesh, state)
+    shardings = train.state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+    step = train.make_classifier_train_step(model, tx, mesh, state, shardings)
     batches = data_mod.synthetic_cifar(args.seed, args.batch)
     return mesh, model, state, step, batches
 

@@ -45,8 +45,9 @@ def run(info: bootstrap.ProcessInfo, args=None) -> float:
     tx = optax.sgd(args.lr)
     sample = jax.numpy.zeros((args.batch, args.dim), jax.numpy.float32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
-    state = train.place_state(mesh, state)
-    step = train.make_regression_train_step(model, tx, mesh, state)
+    shardings = train.state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+    step = train.make_regression_train_step(model, tx, mesh, state, shardings)
     # Every process draws the same global stream; put_global_batch shards it
     # over the data axis (per-process slicing in multi-process jobs).
     batches = data_mod.synthetic_linear(args.seed, args.batch, args.dim)

@@ -18,7 +18,7 @@ MXU-first:
 
 from __future__ import annotations
 
-from typing import Any, Callable, Sequence, Tuple
+from typing import Any, Callable, Optional, Sequence, Tuple
 
 import flax.linen as nn
 import jax.numpy as jnp
@@ -94,14 +94,17 @@ class DecoderBlock(nn.Module):
 
     ``attend`` is injected by the caller — ring attention on a seq-sharded
     mesh, the Pallas flash kernel on a single shard, the jnp oracle on CPU —
-    so the block itself stays mesh-agnostic. Compute dtype parameterized
-    (bf16 on the MXU; f32 for parity tests); LayerNorms always f32.
+    so the block itself stays mesh-agnostic. ``mlp`` optionally replaces the
+    dense FFN with a caller-built module factory (the MoE payload passes its
+    expert-parallel MoEMLP). Compute dtype parameterized (bf16 on the MXU;
+    f32 for parity tests); LayerNorms always f32.
     """
 
     dim: int
     heads: int
     attend: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
     dtype: Any = jnp.bfloat16
+    mlp: Optional[Callable[[str], nn.Module]] = None
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
@@ -117,6 +120,8 @@ class DecoderBlock(nn.Module):
                        name="attn_out")(out.reshape(b, t, self.dim))
         x = x + out
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
+        if self.mlp is not None:
+            return x + self.mlp("moe")(h)
         h = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_up")(h)
         h = nn.gelu(h)
         h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)

@@ -117,9 +117,10 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
 
 
 def make_classifier_train_step(model: Any, tx: optax.GradientTransformation,
-                               mesh: Mesh, state: TrainState) -> Callable:
+                               mesh: Mesh, state: TrainState,
+                               shardings: Optional[TrainState] = None) -> Callable:
     """Compile the classification train step with explicit shardings."""
-    shardings = state_shardings(mesh, state)
+    shardings = shardings or state_shardings(mesh, state)
     batch_shard = data_mod.batch_sharding(mesh)
     label_shard = NamedSharding(mesh, P("data"))
 
@@ -153,8 +154,9 @@ def make_classifier_train_step(model: Any, tx: optax.GradientTransformation,
 
 
 def make_regression_train_step(model: Any, tx: optax.GradientTransformation,
-                               mesh: Mesh, state: TrainState) -> Callable:
-    shardings = state_shardings(mesh, state)
+                               mesh: Mesh, state: TrainState,
+                               shardings: Optional[TrainState] = None) -> Callable:
+    shardings = shardings or state_shardings(mesh, state)
     x_shard = data_mod.batch_sharding(mesh)
 
     def step(state: TrainState, x: jnp.ndarray,

@@ -108,7 +108,7 @@ def _build_model(args, mesh):
                          layers=args.layers, max_seq=args.seq_len)
 
 
-def make_lm_train_step(model, tx, mesh, state):
+def make_lm_train_step(model, tx, mesh, state, shardings=None):
     """Next-token cross-entropy step, jitted with (data, seq) shardings."""
     import jax
     import jax.numpy as jnp
@@ -117,7 +117,7 @@ def make_lm_train_step(model, tx, mesh, state):
 
     from tpu_operator.payload import train
 
-    shardings = train.state_shardings(mesh, state)
+    shardings = shardings or train.state_shardings(mesh, state)
     token_shard = NamedSharding(mesh, P("data", "seq"))
 
     def step(state, tokens):
@@ -160,8 +160,9 @@ def build(args, mesh=None):
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
-    state = train.place_state(mesh, state)
-    step = make_lm_train_step(model, tx, mesh, state)
+    shardings = train.state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+    step = make_lm_train_step(model, tx, mesh, state, shardings)
     batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
                                     vocab=args.vocab)
     return mesh, model, state, step, batches
