@@ -113,9 +113,9 @@ def top2_dispatch(logits, capacity: int):
         return keep[..., None] * jax.nn.one_hot(
             (pos * keep).astype(jnp.int32), capacity, dtype=jnp.float32)
 
-    dispatch = slots(keep1, pos1) + slots(keep2, pos2)
-    combine = (gate1[:, :, None, None] * slots(keep1, pos1)
-               + gate2[:, :, None, None] * slots(keep2, pos2))
+    s1, s2 = slots(keep1, pos1), slots(keep2, pos2)
+    dispatch = s1 + s2
+    combine = gate1[:, :, None, None] * s1 + gate2[:, :, None, None] * s2
     return dispatch, combine, aux
 
 
@@ -185,10 +185,10 @@ def _build_model(args, mesh):
     from tpu_operator.payload import ring_attention as ring
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    if args.experts % args.expert_parallel != 0:
+    if args.experts % mesh.shape["expert"] != 0:
         raise ValueError(
-            f"--experts {args.experts} not divisible by "
-            f"--expert-parallel {args.expert_parallel}")
+            f"--experts {args.experts} not divisible by the mesh expert "
+            f"axis ({mesh.shape['expert']})")
 
     def attend(q, k, v):
         if dtype == jnp.bfloat16 and fa.use_pallas_default():
@@ -236,72 +236,33 @@ def state_shardings(mesh, state):
     """Expert weight stacks (w1/w2 under a ``moe`` path, and their
     params-shaped adam moments) shard their leading E dim over ``expert``;
     everything else replicates."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from tpu_operator.payload import train
 
-    def spec(tree):
-        def leaf_rule(path, leaf):
-            keys = tuple(getattr(p, "key", str(p)) for p in path)
-            if "moe" in keys and keys[-1] in ("w1", "w2") \
-                    and getattr(leaf, "ndim", 0) == 3:
-                return NamedSharding(mesh, P("expert", None, None))
-            return NamedSharding(mesh, P())
-
-        return jax.tree_util.tree_map_with_path(leaf_rule, tree)
-
-    return train.TrainState(
-        step=NamedSharding(mesh, P()),
-        params=spec(state.params),
-        batch_stats=spec(state.batch_stats),
-        opt_state=spec(state.opt_state),
-    )
+    return train.leading_axis_shardings(
+        mesh, state, "expert",
+        lambda keys: "moe" in keys and keys[-1] in ("w1", "w2"))
 
 
 def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
     import jax
     import jax.numpy as jnp
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import train
 
-    shardings = shardings or state_shardings(mesh, state)
-    token_shard = NamedSharding(mesh, P("data", None))
+    def loss_fn(params, tokens):
+        logits, inter = model.apply({"params": params}, tokens,
+                                    mutable=["intermediates"])
+        aux_leaves = jax.tree_util.tree_leaves(inter.get("intermediates", {}))
+        aux = (sum(aux_leaves) / max(1, len(aux_leaves))
+               if aux_leaves else jnp.float32(0.0))
+        lm_loss = train.next_token_nll(logits, tokens)
+        total = lm_loss + args.aux_coef * aux
+        return total, {"loss": lm_loss, "aux_loss": aux, "total_loss": total}
 
-    def step(state, tokens):
-        def loss_fn(params):
-            logits, inter = model.apply({"params": params}, tokens,
-                                        mutable=["intermediates"])
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            targets = tokens[:, 1:]
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            aux_leaves = jax.tree_util.tree_leaves(
-                inter.get("intermediates", {}))
-            aux = (sum(aux_leaves) / max(1, len(aux_leaves))
-                   if aux_leaves else jnp.float32(0.0))
-            lm_loss = -jnp.mean(ll)
-            return lm_loss + args.aux_coef * aux, (lm_loss, aux)
-
-        (loss, (lm_loss, aux)), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_state = train.TrainState(
-            step=state.step + 1,
-            params=optax.apply_updates(state.params, updates),
-            batch_stats=state.batch_stats,
-            opt_state=new_opt,
-        )
-        return new_state, {"loss": lm_loss, "aux_loss": aux,
-                           "total_loss": loss}
-
-    return jax.jit(
-        step,
-        in_shardings=(shardings, token_shard),
-        out_shardings=(shardings, None),
-        donate_argnums=(0,),
-    )
+    return train.make_loss_train_step(
+        loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
+        batch_spec=P("data", None))
 
 
 def build(args, mesh=None):

@@ -229,63 +229,25 @@ def state_shardings(mesh, state):
     """Shardings for the pipeline state: every leaf under a ``stages`` path
     (params and the params-shaped adam moments) shards its leading stage dim
     over ``pipe``; everything else replicates."""
-    import jax
-    from jax.sharding import NamedSharding, PartitionSpec as P
-
     from tpu_operator.payload import train
 
-    def spec(tree):
-        def leaf_rule(path, leaf):
-            keys = tuple(getattr(p, "key", str(p)) for p in path)
-            if "stages" in keys and getattr(leaf, "ndim", 0) >= 1:
-                return NamedSharding(mesh, P("pipe", *(None,) * (leaf.ndim - 1)))
-            return NamedSharding(mesh, P())
-
-        return jax.tree_util.tree_map_with_path(leaf_rule, tree)
-
-    return train.TrainState(
-        step=NamedSharding(mesh, P()),
-        params=spec(state.params),
-        batch_stats=spec(state.batch_stats),
-        opt_state=spec(state.opt_state),
-    )
+    return train.leading_axis_shardings(mesh, state, "pipe",
+                                        lambda keys: "stages" in keys)
 
 
 def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import train
 
-    shardings = shardings or state_shardings(mesh, state)
-    token_shard = NamedSharding(mesh, P("data", None))
+    def loss_fn(params, tokens):
+        loss = train.next_token_nll(
+            forward(args, mesh, stage, params, tokens), tokens)
+        return loss, {"loss": loss}
 
-    def step(state, tokens):
-        def loss_fn(params):
-            logits = forward(args, mesh, stage, params, tokens)
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            targets = tokens[:, 1:]
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return -jnp.mean(ll)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_state = train.TrainState(
-            step=state.step + 1,
-            params=optax.apply_updates(state.params, updates),
-            batch_stats=state.batch_stats,
-            opt_state=new_opt,
-        )
-        return new_state, {"loss": loss}
-
-    return jax.jit(
-        step,
-        in_shardings=(shardings, token_shard),
-        out_shardings=(shardings, None),
-        donate_argnums=(0,),
-    )
+    return train.make_loss_train_step(
+        loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
+        batch_spec=P("data", None))
 
 
 def build(args, mesh=None):

@@ -116,6 +116,69 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+def next_token_nll(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
+    """Mean next-token negative log-likelihood, f32 softmax (house numerics)."""
+    logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
+    targets = tokens[:, 1:]
+    return -jnp.mean(jnp.take_along_axis(logp, targets[..., None], axis=-1))
+
+
+def leading_axis_shardings(mesh: Mesh, state: TrainState, axis: str,
+                           match: Callable[[Tuple[str, ...]], bool]) -> TrainState:
+    """Shardings for payloads with stacked parameter groups: leaves whose
+    path keys satisfy ``match`` shard their leading dim over ``axis`` (the
+    params-shaped adam moments share the paths, so they match identically);
+    everything else replicates. Used by pipeline (stages → pipe) and MoE
+    (expert stacks → expert)."""
+
+    def spec(tree: Any) -> Any:
+        def rule(path, leaf):
+            keys = tuple(getattr(p, "key", str(p)) for p in path)
+            if match(keys) and getattr(leaf, "ndim", 0) >= 1:
+                return NamedSharding(mesh, P(axis, *(None,) * (leaf.ndim - 1)))
+            return NamedSharding(mesh, P())
+
+        return jax.tree_util.tree_map_with_path(rule, tree)
+
+    return TrainState(
+        step=NamedSharding(mesh, P()),
+        params=spec(state.params),
+        batch_stats=spec(state.batch_stats),
+        opt_state=spec(state.opt_state),
+    )
+
+
+def make_loss_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
+                         mesh: Mesh, state: TrainState,
+                         shardings: Optional[TrainState] = None,
+                         batch_spec: P = P("data")) -> Callable:
+    """The shared LM/loss step: ``loss_fn(params, batch) -> (loss, metrics)``
+    differentiated, adam-updated, jitted with donated state. The LM payloads
+    (transformer, pipeline, MoE) build their steps on this with
+    payload-specific loss_fns and batch specs."""
+    shardings = shardings or state_shardings(mesh, state)
+    batch_shard = NamedSharding(mesh, batch_spec)
+
+    def step(state: TrainState, batch: jnp.ndarray) -> Tuple[TrainState, dict]:
+        (loss, metrics), grads = jax.value_and_grad(
+            loss_fn, has_aux=True)(state.params, batch)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        new_state = TrainState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=state.batch_stats,
+            opt_state=new_opt,
+        )
+        return new_state, metrics
+
+    return jax.jit(
+        step,
+        in_shardings=(shardings, batch_shard),
+        out_shardings=(shardings, None),
+        donate_argnums=(0,),
+    )
+
+
 def make_classifier_train_step(model: Any, tx: optax.GradientTransformation,
                                mesh: Mesh, state: TrainState,
                                shardings: Optional[TrainState] = None) -> Callable:

@@ -41,6 +41,10 @@ def parse_args(argv=None):
     p.add_argument("--seq-len", type=int, default=2048, help="global sequence length")
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="sequence-parallel shards (mesh seq axis size)")
+    p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring",
+                   help="sequence-parallel strategy: ring = ppermute K/V "
+                        "rotation, O(T/P) memory; ulysses = head-scatter "
+                        "all-to-all, needs heads %% seq shards == 0")
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -72,9 +76,14 @@ def _build_model(args, mesh):
     from tpu_operator.payload import ring_attention as ring
 
     seq_shards = mesh.shape["seq"]
+    sp_mode = getattr(args, "sp_mode", "ring")
 
     def attend(q, k, v):
         if seq_shards > 1:
+            if sp_mode == "ulysses":
+                from tpu_operator.payload import ulysses
+
+                return ulysses.ulysses_attention(q, k, v, mesh, causal=True)
             return ring.ring_attention(q, k, v, mesh, causal=True)
         if fa.use_pallas_default():
             return fa.flash_attention(q, k, v, causal=True)
@@ -110,40 +119,17 @@ def _build_model(args, mesh):
 
 def make_lm_train_step(model, tx, mesh, state, shardings=None):
     """Next-token cross-entropy step, jitted with (data, seq) shardings."""
-    import jax
-    import jax.numpy as jnp
-    import optax
-    from jax.sharding import NamedSharding, PartitionSpec as P
+    from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import train
 
-    shardings = shardings or train.state_shardings(mesh, state)
-    token_shard = NamedSharding(mesh, P("data", "seq"))
+    def loss_fn(params, tokens):
+        loss = train.next_token_nll(model.apply({"params": params}, tokens),
+                                    tokens)
+        return loss, {"loss": loss}
 
-    def step(state, tokens):
-        def loss_fn(params):
-            logits = model.apply({"params": params}, tokens)
-            logp = jax.nn.log_softmax(logits[:, :-1].astype(jnp.float32))
-            targets = tokens[:, 1:]
-            ll = jnp.take_along_axis(logp, targets[..., None], axis=-1)
-            return -jnp.mean(ll)
-
-        loss, grads = jax.value_and_grad(loss_fn)(state.params)
-        updates, new_opt = tx.update(grads, state.opt_state, state.params)
-        new_state = train.TrainState(
-            step=state.step + 1,
-            params=optax.apply_updates(state.params, updates),
-            batch_stats=state.batch_stats,
-            opt_state=new_opt,
-        )
-        return new_state, {"loss": loss}
-
-    return jax.jit(
-        step,
-        in_shardings=(shardings, token_shard),
-        out_shardings=(shardings, None),
-        donate_argnums=(0,),
-    )
+    return train.make_loss_train_step(loss_fn, tx, mesh, state, shardings,
+                                      batch_spec=P("data", "seq"))
 
 
 def build(args, mesh=None):
