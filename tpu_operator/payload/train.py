@@ -288,17 +288,20 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
 
 def throughput(mesh: Mesh, train_step: Callable, state: TrainState, batches,
                steps: int, warmup: int = 3) -> Tuple[TrainState, float]:
-    """steps/sec over `steps` timed iterations (post-warmup, blocking on the
-    final result so compile + dispatch overlap is excluded)."""
+    """steps/sec over `steps` timed iterations (post-warmup). The fences are
+    ``device_get`` of the last metrics — a value fetch completes only after
+    the whole dependent step chain has executed, which holds on every
+    backend (``block_until_ready`` was observed returning early on the
+    tunneled axon TPU platform and must not be trusted for timing)."""
     for _ in range(warmup):
         host = next(batches)
         dev = data_mod.put_global_batch(mesh, *host)
         state, metrics = train_step(state, *dev)
-    jax.block_until_ready(metrics["loss"])
+    jax.device_get(metrics["loss"])
     start = time.perf_counter()
     for _ in range(steps):
         host = next(batches)
         dev = data_mod.put_global_batch(mesh, *host)
         state, metrics = train_step(state, *dev)
-    jax.block_until_ready(metrics["loss"])
+    jax.device_get(metrics["loss"])
     return state, steps / (time.perf_counter() - start)
