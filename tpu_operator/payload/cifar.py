@@ -42,7 +42,7 @@ def parse_args(argv=None):
     return p.parse_args(argv)
 
 
-def build(args, mesh=None):
+def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
@@ -51,7 +51,8 @@ def build(args, mesh=None):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import models, train
 
-    mesh = mesh or train.make_mesh(model_parallel=args.model_parallel)
+    mesh = mesh or train.make_mesh(model_parallel=args.model_parallel,
+                                   num_slices=num_slices)
     model = models.CifarResNet(blocks_per_stage=args.blocks,
                                widths=tuple(args.widths))
     tx = optax.sgd(args.lr, momentum=args.momentum)
@@ -68,7 +69,8 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
-    mesh, _model, state, step, batches = build(args)
+    mesh, _model, state, step, batches = build(
+        args, num_slices=info.num_slices)
     log.info("mesh: %s over %d devices; global batch %d",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.batch)

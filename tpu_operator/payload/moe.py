@@ -62,13 +62,15 @@ def parse_args(argv=None):
 
 
 def make_moe_mesh(num_devices: Optional[int] = None, expert_parallel: int = 1,
-                  devices: Optional[list] = None):
+                  devices: Optional[list] = None, num_slices: int = 1):
     """(data, expert) mesh: DP outer, expert-parallel inner — the dispatch
-    all-to-all stays within each expert group's adjacent ICI links."""
+    all-to-all stays within each expert group's adjacent ICI links
+    (multi-slice jobs keep every expert group within a slice)."""
     from tpu_operator.payload import train
 
     return train.make_mesh(num_devices, model_parallel=expert_parallel,
-                           devices=devices, axis_names=("data", "expert"))
+                           devices=devices, axis_names=("data", "expert"),
+                           num_slices=num_slices)
 
 
 def top2_dispatch(logits, capacity: int):
@@ -265,7 +267,7 @@ def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
         batch_spec=P("data", None))
 
 
-def build(args, mesh=None):
+def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
@@ -274,7 +276,8 @@ def build(args, mesh=None):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_moe_mesh(expert_parallel=args.expert_parallel)
+    mesh = mesh or make_moe_mesh(expert_parallel=args.expert_parallel,
+                                 num_slices=num_slices)
     model = _build_model(args, mesh)
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
@@ -291,7 +294,8 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
-    mesh, _model, state, step, batches = build(args)
+    mesh, _model, state, step, batches = build(
+        args, num_slices=info.num_slices)
     log.info("mesh: %s over %d devices; %d experts, capacity factor %.2f",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.experts, args.capacity_factor)

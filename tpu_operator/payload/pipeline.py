@@ -62,13 +62,15 @@ def parse_args(argv=None):
 
 
 def make_pipe_mesh(num_devices: Optional[int] = None, pipeline: int = 1,
-                   devices: Optional[list] = None):
+                   devices: Optional[list] = None, num_slices: int = 1):
     """(data, pipe) mesh: DP outer, pipeline inner — consecutive stages land
-    on neighboring devices so activation hops ride adjacent ICI links."""
+    on neighboring devices so activation hops ride adjacent ICI links
+    (multi-slice jobs keep all stages of one pipeline within a slice)."""
     from tpu_operator.payload import train
 
     return train.make_mesh(num_devices, model_parallel=pipeline,
-                           devices=devices, axis_names=("data", "pipe"))
+                           devices=devices, axis_names=("data", "pipe"),
+                           num_slices=num_slices)
 
 
 def _stage_module(args):
@@ -250,7 +252,7 @@ def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
         batch_spec=P("data", None))
 
 
-def build(args, mesh=None):
+def build(args, mesh=None, num_slices: int = 1):
     """(mesh, stage, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
@@ -259,7 +261,8 @@ def build(args, mesh=None):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_pipe_mesh(pipeline=args.pipeline)
+    mesh = mesh or make_pipe_mesh(pipeline=args.pipeline,
+                                  num_slices=num_slices)
     data_shards = mesh.shape["data"]
     if args.batch % (data_shards * args.microbatches) != 0:
         raise ValueError(
@@ -285,7 +288,8 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
-    mesh, _stage, state, step, batches = build(args)
+    mesh, _stage, state, step, batches = build(
+        args, num_slices=info.num_slices)
     log.info("mesh: %s over %d devices; %d layers / %d stages, %d microbatches",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.layers, args.pipeline, args.microbatches)

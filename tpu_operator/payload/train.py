@@ -41,11 +41,24 @@ class TrainState(flax.struct.PyTreeNode):
 
 def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
               devices: Optional[list] = None,
-              axis_names: Tuple[str, str] = ("data", "model")) -> Mesh:
+              axis_names: Tuple[str, str] = ("data", "model"),
+              num_slices: int = 1) -> Mesh:
     """Build a 2-axis mesh over the visible devices (default (data, model);
     the transformer payload reuses this with ("data", "seq")). On a real pod
     slice ``jax.devices()`` spans every process after
-    jax.distributed.initialize; the mesh is global."""
+    jax.distributed.initialize; the mesh is global.
+
+    ``num_slices > 1`` (multi-slice jobs, MEGASCALE_NUM_SLICES from the
+    operator's env contract) makes the mesh DCN-aware: devices are grouped
+    slice-major and the inner axis (model/seq/pipe/expert) is required to
+    fit within one slice, so its collectives — the latency-sensitive ones,
+    issued per matmul/attention/dispatch — ride ICI only, while the outer
+    ``data`` axis spans slices and its once-per-step gradient psum is the
+    only traffic that crosses DCN. This is the standard hybrid ICI×DCN
+    sharding recipe; the slice boundary comes from each device's
+    ``slice_index`` when the runtime exposes one (devices are sorted by it),
+    else from the given device order (processes are already slice-major in
+    the operator's TPU_WORKER_HOSTNAMES ordering)."""
     devices = list(devices if devices is not None else jax.devices())
     if num_devices:
         devices = devices[:num_devices]
@@ -53,7 +66,28 @@ def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
     if n % model_parallel != 0:
         raise ValueError(
             f"{n} devices not divisible by {axis_names[1]}={model_parallel}")
+    if num_slices > 1:
+        if n % num_slices != 0:
+            raise ValueError(
+                f"{n} devices not divisible by num_slices={num_slices}")
+        per_slice = n // num_slices
+        if per_slice % model_parallel != 0:
+            raise ValueError(
+                f"{axis_names[1]}={model_parallel} does not fit within one "
+                f"slice ({per_slice} devices): inner-axis collectives must "
+                f"stay on ICI")
+        if all(hasattr(d, "slice_index") for d in devices):
+            devices = sorted(devices, key=lambda d: (d.slice_index, d.id))
     arr = np.array(devices).reshape(n // model_parallel, model_parallel)
+    if num_slices > 1 and all(hasattr(d, "slice_index") for d in devices):
+        # Guard against num_slices disagreeing with the real topology: a
+        # row silently spanning slices would put per-op collectives on DCN.
+        for row in arr:
+            if len({d.slice_index for d in row}) != 1:
+                raise ValueError(
+                    f"inner axis {axis_names[1]} crosses a slice boundary "
+                    f"(num_slices={num_slices} vs device slice_index "
+                    f"layout); per-op collectives must stay on ICI")
     return Mesh(arr, axis_names)
 
 

@@ -59,13 +59,15 @@ def parse_args(argv=None):
 
 
 def make_lm_mesh(num_devices: Optional[int] = None, seq_parallel: int = 1,
-                 devices: Optional[list] = None):
+                 devices: Optional[list] = None, num_slices: int = 1):
     """(data, seq) mesh: DP outer, sequence-parallel inner (neighboring
-    devices share a ring edge, so K/V rotation stays on adjacent ICI links)."""
+    devices share a ring edge, so K/V rotation stays on adjacent ICI links;
+    multi-slice jobs keep the ring within a slice — train.make_mesh)."""
     from tpu_operator.payload import train
 
     return train.make_mesh(num_devices, model_parallel=seq_parallel,
-                           devices=devices, axis_names=("data", "seq"))
+                           devices=devices, axis_names=("data", "seq"),
+                           num_slices=num_slices)
 
 
 def _build_model(args, mesh):
@@ -132,7 +134,7 @@ def make_lm_train_step(model, tx, mesh, state, shardings=None):
                                       batch_spec=P("data", "seq"))
 
 
-def build(args, mesh=None):
+def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
@@ -141,7 +143,8 @@ def build(args, mesh=None):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_lm_mesh(seq_parallel=args.seq_parallel)
+    mesh = mesh or make_lm_mesh(seq_parallel=args.seq_parallel,
+                                num_slices=num_slices)
     model = _build_model(args, mesh)
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
@@ -160,7 +163,8 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
     from tpu_operator.payload import checkpoint, train
 
     args = args or parse_args([])
-    mesh, _model, state, step, batches = build(args)
+    mesh, _model, state, step, batches = build(
+        args, num_slices=info.num_slices)
     log.info("mesh: %s over %d devices; batch %d seq %d",
              dict(zip(mesh.axis_names, mesh.devices.shape)),
              mesh.devices.size, args.batch, args.seq_len)
