@@ -16,6 +16,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from tpu_operator.payload import bootstrap
 
@@ -39,6 +40,9 @@ def parse_args(argv=None):
                         "as injected by the operator when spec.checkpointDir "
                         "is set)")
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("TPU_PROFILE_DIR", ""),
+                   help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
     return p.parse_args(argv)
 
 
@@ -86,6 +90,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             log_fn=lambda i, m: log.info(
                 "step %d loss %.4f acc %.3f", i, m["loss"], m["accuracy"]),
             checkpointer=ckpt,
+            profile_dir=args.profile_dir,
         )
     finally:
         if ckpt is not None:

@@ -14,6 +14,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 
 from tpu_operator.payload import bootstrap
 
@@ -29,6 +30,9 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1e-3,
                    help="exit nonzero unless final MSE is below this")
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("TPU_PROFILE_DIR", ""),
+                   help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
     return p.parse_args(argv)
 
 
@@ -55,6 +59,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> float:
         mesh, step, state, batches, args.steps,
         log_every=max(1, args.steps // 10),
         log_fn=lambda i, m: log.info("step %d loss %.6f", i, m["loss"]),
+        profile_dir=args.profile_dir,
     )
     loss = float(metrics["loss"])
     log.info("final loss %.6f over %d devices", loss, len(mesh.devices.flat))

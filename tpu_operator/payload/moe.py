@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 import math
 from typing import Any, Callable, Optional
 
@@ -58,6 +59,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("TPU_PROFILE_DIR", ""),
+                   help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
     return p.parse_args(argv)
 
 
@@ -311,6 +315,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             log_fn=lambda i, m: log.info(
                 "step %d loss %.4f aux %.4f", i, m["loss"], m["aux_loss"]),
             checkpointer=ckpt,
+            profile_dir=args.profile_dir,
         )
     finally:
         if ckpt is not None:

@@ -29,6 +29,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 from typing import Optional
 
 from tpu_operator.payload import bootstrap
@@ -52,12 +53,19 @@ def parse_args(argv=None):
                    help="microbatches streamed through the pipeline per step")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16",
                    help="stage compute dtype (f32 for parity tests)")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each block on backward (jax.checkpoint"
+                        "); with many microbatches in flight this bounds "
+                        "per-stage activation memory")
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("TPU_PROFILE_DIR", ""),
+                   help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
     return p.parse_args(argv)
 
 
@@ -90,6 +98,9 @@ def _stage_module(args):
 
     from tpu_operator.payload import models
 
+    Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
+             else models.DecoderBlock)
+
     class Stage(nn.Module):
         dim: int
         heads: int
@@ -98,8 +109,8 @@ def _stage_module(args):
         @nn.compact
         def __call__(self, x):
             for i in range(self.blocks):
-                x = models.DecoderBlock(self.dim, self.heads, attend,
-                                        dtype=dtype, name=f"block{i}")(x)
+                x = Block(self.dim, self.heads, attend,
+                          dtype=dtype, name=f"block{i}")(x)
             return x
 
     if args.layers % args.pipeline != 0:
@@ -304,6 +315,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             log_every=args.log_every,
             log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
             checkpointer=ckpt,
+            profile_dir=args.profile_dir,
         )
     finally:
         if ckpt is not None:

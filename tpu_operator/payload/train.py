@@ -284,7 +284,9 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                batches, steps: int,
                log_every: int = 0,
                log_fn: Callable[[int, dict], None] = None,
-               checkpointer=None, spec=None) -> Tuple[TrainState, dict]:
+               checkpointer=None, spec=None,
+               profile_dir: str = "",
+               profile_range: Tuple[int, int] = (10, 20)) -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
     interval. ``spec`` overrides the batch PartitionSpec (default P("data");
@@ -300,6 +302,12 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     step 400 of 500 runs 100 more, on the *same* batches 400..499 it would
     have seen uninterrupted: the seed-deterministic stream is fast-forwarded
     past the ``start`` batches the previous attempt already consumed.
+
+    ``profile_dir`` (payload ``--profile-dir`` / operator-injectable
+    ``TPU_PROFILE_DIR``) captures a ``jax.profiler`` device trace of steps
+    ``profile_range`` — post-compile steady state — viewable in
+    TensorBoard/XProf. The payload-side half of the reference's tracing
+    subsystem (SURVEY.md §5; control-plane half is util/tracing.py).
     """
     start = 0
     if checkpointer is not None:
@@ -307,14 +315,26 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         for _ in range(start):
             next(batches)
     metrics = {}
+    tracing = profiled = False
     for i in range(start, steps):
+        if (profile_dir and not tracing and not profiled
+                and i >= profile_range[0]):
+            jax.profiler.start_trace(profile_dir)
+            tracing = True
         host_arrays = next(batches)
         device_arrays = data_mod.put_global_batch(mesh, *host_arrays, spec=spec)
         state, metrics = train_step(state, *device_arrays)
+        if tracing and (i + 1) >= profile_range[1]:
+            jax.device_get(metrics)  # drain async work into the trace
+            jax.profiler.stop_trace()
+            tracing, profiled = False, True
         if checkpointer is not None:
             checkpointer.maybe_save(i + 1, state)
         if log_every and log_fn and (i + 1) % log_every == 0:
             log_fn(i + 1, jax.device_get(metrics))
+    if tracing:
+        jax.device_get(metrics)
+        jax.profiler.stop_trace()
     if checkpointer is not None and steps > start:
         checkpointer.save(steps, state)
     return state, (jax.device_get(metrics) if metrics else {})

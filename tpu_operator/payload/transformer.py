@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import logging
+import os
 from typing import Any, Optional
 
 from tpu_operator.payload import bootstrap
@@ -45,6 +46,10 @@ def parse_args(argv=None):
                    help="sequence-parallel strategy: ring = ppermute K/V "
                         "rotation, O(T/P) memory; ulysses = head-scatter "
                         "all-to-all, needs heads %% seq shards == 0")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each block on backward (jax.checkpoint"
+                        "): activation memory O(layers) -> O(1) blocks, for "
+                        "long-context configs that would not fit HBM")
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -55,6 +60,9 @@ def parse_args(argv=None):
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
+    p.add_argument("--profile-dir",
+                   default=os.environ.get("TPU_PROFILE_DIR", ""),
+                   help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
     return p.parse_args(argv)
 
 
@@ -93,6 +101,11 @@ def _build_model(args, mesh):
 
     from tpu_operator.payload import models
 
+    # nn.remat is semantics-preserving: same params/outputs, backward
+    # recomputes the block instead of keeping its activations in HBM.
+    Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
+             else models.DecoderBlock)
+
     class TransformerLM(nn.Module):
         vocab: int
         dim: int
@@ -109,8 +122,8 @@ def _build_model(args, mesh):
                            name="pos_embed")(jnp.arange(t))
             x = x + pos[None]
             for i in range(self.layers):
-                x = models.DecoderBlock(self.dim, self.heads, attend,
-                                        name=f"block{i}")(x)
+                x = Block(self.dim, self.heads, attend,
+                          name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
                             name="lm_head")(x)
@@ -179,6 +192,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             log_every=args.log_every,
             log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
             checkpointer=ckpt,
+            profile_dir=args.profile_dir,
             spec=P("data", "seq"),
         )
     finally:
