@@ -1,0 +1,84 @@
+"""FSDP/ZeRO parameter-sharding tests (8-device CPU mesh).
+
+train.fsdp_shardings must actually shard large params over the data axis
+(memory O(1/N)), be semantics-preserving (same loss as replicated), and
+train end-to-end; sharding is layout, never math.
+"""
+
+from __future__ import annotations
+
+import jax
+import numpy as np
+import pytest
+
+from tpu_operator.payload import transformer
+from tpu_operator.payload import data as data_mod, train
+
+
+def _argv(extra=()):
+    return ["--batch", "8", "--seq-len", "64", "--dim", "64", "--heads", "2",
+            "--layers", "2", *extra]
+
+
+@pytest.fixture(scope="module")
+def mesh():
+    return transformer.make_lm_mesh(8, seq_parallel=1)  # (data=8, seq=1)
+
+
+def test_fsdp_shards_large_params_over_data(mesh):
+    args = transformer.parse_args(_argv(["--fsdp"]))
+    _, _, state, _step, _batches = transformer.build(args, mesh=mesh)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    sharded = [(path, leaf) for path, leaf in flat
+               if leaf.sharding.spec and leaf.sharding.spec[0] == "data"]
+    # vocab=256 embeddings and 3*dim qkv kernels divide 8 and exceed the
+    # size floor — they must be sharded; every sharded leaf is 1/8 per chip.
+    assert sharded, "no param was FSDP-sharded"
+    for _path, leaf in sharded:
+        local = leaf.addressable_shards[0].data.shape
+        assert local[0] == leaf.shape[0] // 8
+    # adam moments mirror the param shardings
+    mu = state.opt_state[0].mu
+    mu_flat = jax.tree_util.tree_flatten_with_path(mu)[0]
+    specs = {jax.tree_util.keystr(p): l.sharding.spec for p, l in mu_flat}
+    for path, leaf in sharded:
+        assert specs[jax.tree_util.keystr(path)] == leaf.sharding.spec
+
+
+def test_fsdp_loss_matches_replicated(mesh):
+    losses = {}
+    for fsdp in (False, True):
+        args = transformer.parse_args(_argv(["--fsdp"] if fsdp else []))
+        _, _, state, step, batches = transformer.build(args, mesh=mesh)
+        (tokens,) = next(batches)
+        from jax.sharding import PartitionSpec as P
+
+        (dev,) = data_mod.put_global_batch(mesh, tokens, spec=P("data", None))
+        state, _ = step(state, dev)
+        _, metrics = step(state, dev)
+        losses[fsdp] = float(metrics["loss"])
+    assert abs(losses[False] - losses[True]) < 5e-3, losses
+
+
+def test_fsdp_loss_descends(mesh):
+    args = transformer.parse_args(_argv(["--fsdp", "--lr", "1e-2"]))
+    _, _, state, step, batches = transformer.build(args, mesh=mesh)
+    from jax.sharding import PartitionSpec as P
+
+    losses = []
+    for _ in range(30):
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh, tokens, spec=P("data", None))
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses[::5]
+
+
+def test_small_or_indivisible_leaves_replicate(mesh):
+    args = transformer.parse_args(_argv(["--fsdp"]))
+    _, _, state, _step, _batches = transformer.build(args, mesh=mesh)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    for _path, leaf in flat:
+        if leaf.size < 1024 or leaf.shape[0] % 8:
+            assert leaf.sharding.spec == (), (_path, leaf.shape)

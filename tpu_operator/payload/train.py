@@ -18,6 +18,7 @@ because its compute lived in user images):
 
 from __future__ import annotations
 
+import logging
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -30,6 +31,8 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from tpu_operator.payload import data as data_mod
 from tpu_operator.payload import models as models_mod
+
+log = logging.getLogger(__name__)
 
 
 class TrainState(flax.struct.PyTreeNode):
@@ -91,35 +94,36 @@ def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
     return Mesh(arr, axis_names)
 
 
-def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
-    """NamedShardings for the state: params follow the TP partition rules,
-    everything else replicates (opt_state mirrors params' specs)."""
+def shardings_from_rule(mesh: Mesh, state: TrainState,
+                        rule: Callable[[Tuple[str, ...], Any], P]) -> TrainState:
+    """TrainState of NamedShardings from one per-leaf rule
+    ``rule(path_keys, leaf) -> PartitionSpec``, applied to params,
+    batch_stats, and opt_state alike (the optimizer state embeds
+    params-shaped moment leaves under the same layer names, so a path rule
+    shards them identically to their params; scalar counters and stats fall
+    through to the rule's replicate case). ``step`` always replicates."""
 
-    def spec_for_params(tree: Any) -> Any:
+    def spec(tree: Any) -> Any:
         return jax.tree_util.tree_map_with_path(
             lambda path, leaf: NamedSharding(
                 mesh,
-                models_mod.param_partition_spec(
-                    tuple(getattr(p, "key", str(p)) for p in path), leaf
-                ),
+                rule(tuple(getattr(p, "key", str(p)) for p in path), leaf),
             ),
             tree,
         )
 
-    replicated = NamedSharding(mesh, P())
-
-    def replicate(tree: Any) -> Any:
-        return jax.tree_util.tree_map(lambda _leaf: replicated, tree)
-
-    # Optimizer state embeds params-shaped leaves (momentum traces) under
-    # paths that contain the same layer names, so the same path rule shards
-    # them identically to their params; scalar counters fall through to P().
     return TrainState(
-        step=replicated,
-        params=spec_for_params(state.params),
-        batch_stats=replicate(state.batch_stats),
-        opt_state=spec_for_params(state.opt_state),
+        step=NamedSharding(mesh, P()),
+        params=spec(state.params),
+        batch_stats=spec(state.batch_stats),
+        opt_state=spec(state.opt_state),
     )
+
+
+def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:
+    """NamedShardings for the state: params follow the TP partition rules,
+    everything else replicates (opt_state mirrors params' specs)."""
+    return shardings_from_rule(mesh, state, models_mod.param_partition_spec)
 
 
 def place_state(mesh: Mesh, state: TrainState,
@@ -165,21 +169,35 @@ def leading_axis_shardings(mesh: Mesh, state: TrainState, axis: str,
     everything else replicates. Used by pipeline (stages → pipe) and MoE
     (expert stacks → expert)."""
 
-    def spec(tree: Any) -> Any:
-        def rule(path, leaf):
-            keys = tuple(getattr(p, "key", str(p)) for p in path)
-            if match(keys) and getattr(leaf, "ndim", 0) >= 1:
-                return NamedSharding(mesh, P(axis, *(None,) * (leaf.ndim - 1)))
-            return NamedSharding(mesh, P())
+    def rule(keys, leaf):
+        if match(keys) and getattr(leaf, "ndim", 0) >= 1:
+            return P(axis, *(None,) * (leaf.ndim - 1))
+        return P()
 
-        return jax.tree_util.tree_map_with_path(rule, tree)
+    return shardings_from_rule(mesh, state, rule)
 
-    return TrainState(
-        step=NamedSharding(mesh, P()),
-        params=spec(state.params),
-        batch_stats=spec(state.batch_stats),
-        opt_state=spec(state.opt_state),
-    )
+
+def fsdp_shardings(mesh: Mesh, state: TrainState, axis: str = "data",
+                   min_size: int = 1024) -> TrainState:
+    """ZeRO/FSDP-style shardings: every large param leaf (and its
+    params-shaped adam moments) shards dim 0 over ``axis`` — normally the
+    data axis, so each DP rank owns 1/N of the params and optimizer state.
+    Under jit, GSPMD all-gathers a layer's weights just-in-time for its
+    matmul and reduce-scatters its gradients — per-device param+opt memory
+    drops to O(1/N) with no hand-written gather/scatter. Leaves whose dim 0
+    does not divide the axis (or smaller than ``min_size`` elements, where
+    collective latency would dominate) replicate."""
+    axis_size = mesh.shape[axis]
+
+    def rule(_keys, leaf):
+        shape = getattr(leaf, "shape", ())
+        size = getattr(leaf, "size", 0)
+        if (len(shape) >= 1 and size >= min_size
+                and shape[0] % axis_size == 0):
+            return P(axis, *(None,) * (len(shape) - 1))
+        return P()
+
+    return shardings_from_rule(mesh, state, rule)
 
 
 def make_loss_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
@@ -305,9 +323,10 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
 
     ``profile_dir`` (payload ``--profile-dir`` / operator-injectable
     ``TPU_PROFILE_DIR``) captures a ``jax.profiler`` device trace of steps
-    ``profile_range`` — post-compile steady state — viewable in
-    TensorBoard/XProf. The payload-side half of the reference's tracing
-    subsystem (SURVEY.md §5; control-plane half is util/tracing.py).
+    ``profile_range`` *relative to this run's first step* — so a resumed
+    attempt still profiles post-compile steady state, not its compile step —
+    viewable in TensorBoard/XProf. The payload-side half of the reference's
+    tracing subsystem (SURVEY.md §5; control-plane half is util/tracing.py).
     """
     start = 0
     if checkpointer is not None:
@@ -316,15 +335,20 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             next(batches)
     metrics = {}
     tracing = profiled = False
+    trace_from, trace_to = start + profile_range[0], start + profile_range[1]
+    if profile_dir and trace_from >= steps:
+        log.warning(
+            "profile window [%d, %d) lies beyond the run's last step %d; "
+            "no trace will be captured", trace_from, trace_to, steps)
     for i in range(start, steps):
         if (profile_dir and not tracing and not profiled
-                and i >= profile_range[0]):
+                and i >= trace_from):
             jax.profiler.start_trace(profile_dir)
             tracing = True
         host_arrays = next(batches)
         device_arrays = data_mod.put_global_batch(mesh, *host_arrays, spec=spec)
         state, metrics = train_step(state, *device_arrays)
-        if tracing and (i + 1) >= profile_range[1]:
+        if tracing and (i + 1) >= trace_to:
             jax.device_get(metrics)  # drain async work into the trace
             jax.profiler.stop_trace()
             tracing, profiled = False, True

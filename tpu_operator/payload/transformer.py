@@ -50,6 +50,10 @@ def parse_args(argv=None):
                    help="rematerialize each block on backward (jax.checkpoint"
                         "): activation memory O(layers) -> O(1) blocks, for "
                         "long-context configs that would not fit HBM")
+    p.add_argument("--fsdp", action="store_true",
+                   help="ZeRO/FSDP param+optimizer sharding over the data "
+                        "axis (train.fsdp_shardings): per-device state "
+                        "memory O(1/N); GSPMD gathers weights just-in-time")
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -162,7 +166,9 @@ def build(args, mesh=None, num_slices: int = 1):
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
-    shardings = train.state_shardings(mesh, state)
+    shardings = (train.fsdp_shardings(mesh, state)
+                 if getattr(args, "fsdp", False)
+                 else train.state_shardings(mesh, state))
     state = train.place_state(mesh, state, shardings)
     step = make_lm_train_step(model, tx, mesh, state, shardings)
     batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
