@@ -151,3 +151,29 @@ def test_spec_checkpoint_dir_roundtrip_and_env_injection():
     env = replicas.build_replica_env("job", "ab12", spec,
                                      types.TPUReplicaType.WORKER, 0)
     assert env["TPU_CHECKPOINT_DIR"] == "/ckpt/run1"
+
+
+def test_spec_profile_dir_roundtrip_and_env_injection():
+    from tpu_operator.trainer import replicas
+
+    spec = types.TPUJobSpec.from_dict({
+        "replicaSpecs": [{
+            "replicas": 2,
+            "tpuReplicaType": "WORKER",
+            "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu"}]}},
+        }],
+        "profileDir": "/traces/run1",
+    })
+    assert spec.profile_dir == "/traces/run1"
+    assert spec.to_dict()["profileDir"] == "/traces/run1"
+
+    env = replicas.build_replica_env("job", "ab12", spec,
+                                     types.TPUReplicaType.WORKER, 0)
+    assert env["TPU_PROFILE_DIR"] == "/traces/run1"
+    # unset -> not injected
+    spec2 = types.TPUJobSpec.from_dict(
+        {"replicaSpecs": spec.to_dict()["replicaSpecs"]})
+    env2 = replicas.build_replica_env("job", "ab12", spec2,
+                                      types.TPUReplicaType.WORKER, 0)
+    assert "TPU_PROFILE_DIR" not in env2

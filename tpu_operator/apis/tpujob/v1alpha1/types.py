@@ -220,6 +220,10 @@ class TPUJobSpec:
     # whole-group restart semantics make operator-advertised resume
     # first-class.
     checkpoint_dir: str = ""
+    # Profiler output directory; when set, injected as TPU_PROFILE_DIR
+    # so payloads capture a jax.profiler steady-state trace
+    # (train.train_loop) without per-job flag plumbing.
+    profile_dir: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -240,6 +244,8 @@ class TPUJobSpec:
             d["numSlices"] = self.num_slices
         if self.checkpoint_dir:
             d["checkpointDir"] = self.checkpoint_dir
+        if self.profile_dir:
+            d["profileDir"] = self.profile_dir
         return d
 
     @classmethod
@@ -254,6 +260,7 @@ class TPUJobSpec:
             tpu_topology=str(d.get("tpuTopology", "")),
             num_slices=int(d.get("numSlices", 1)),
             checkpoint_dir=str(d.get("checkpointDir", "")),
+            profile_dir=str(d.get("profileDir", "")),
         )
 
 

@@ -187,6 +187,8 @@ def build_replica_env(
         env["TPU_TOPOLOGY"] = spec.tpu_topology
     if spec.checkpoint_dir:
         env["TPU_CHECKPOINT_DIR"] = spec.checkpoint_dir
+    if spec.profile_dir:
+        env["TPU_PROFILE_DIR"] = spec.profile_dir
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)
