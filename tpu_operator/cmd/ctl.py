@@ -156,6 +156,8 @@ def cmd_describe(cs, opts) -> int:
         print(f"Topology:   {spec['tpuTopology']}")
     if spec.get("checkpointDir"):
         print(f"Checkpoint: {spec['checkpointDir']}")
+    if spec.get("profileDir"):
+        print(f"Profile:    {spec['profileDir']}")
     print("Replicas:")
     for rs in spec.get("replicaSpecs", []):
         print(f"  {rs.get('tpuReplicaType', 'WORKER')}: "

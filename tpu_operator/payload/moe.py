@@ -53,6 +53,12 @@ def parse_args(argv=None):
     p.add_argument("--aux-coef", type=float, default=1e-2,
                    help="load-balance auxiliary loss coefficient")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="accumulate gradients over K sequential "
+                        "microbatches inside the jit")
+    p.add_argument("--remat", action="store_true",
+                   help="rematerialize each block on backward "
+                        "(jax.checkpoint)")
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
@@ -202,6 +208,8 @@ def _build_model(args, mesh):
         return ring.reference_attention(q, k, v, causal=True)
 
     MoEMLP = _moe_mlp_class(mesh, dtype)
+    Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
+             else models.DecoderBlock)
 
     def moe_mlp(name):
         return MoEMLP(dim=args.dim, experts=args.experts,
@@ -227,9 +235,9 @@ def _build_model(args, mesh):
                 # keep a gradient path for every token even when hot experts
                 # overflow capacity.
                 mlp = moe_mlp if i % 2 == 1 else None
-                x = models.DecoderBlock(self.dim, self.heads, attend,
-                                        dtype=dtype, mlp=mlp,
-                                        name=f"block{i}")(x)
+                x = Block(self.dim, self.heads, attend,
+                          dtype=dtype, mlp=mlp,
+                          name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=dtype,
                             name="lm_head")(x)
@@ -268,7 +276,8 @@ def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
 
     return train.make_loss_train_step(
         loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
-        batch_spec=P("data", None))
+        batch_spec=P("data", None),
+        grad_accum=getattr(args, "grad_accum", 1))
 
 
 def build(args, mesh=None, num_slices: int = 1):

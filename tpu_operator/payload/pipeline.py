@@ -53,6 +53,9 @@ def parse_args(argv=None):
                    help="microbatches streamed through the pipeline per step")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16",
                    help="stage compute dtype (f32 for parity tests)")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="accumulate gradients over K sequential "
+                        "microbatches inside the jit")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize each block on backward (jax.checkpoint"
                         "); with many microbatches in flight this bounds "
@@ -260,7 +263,8 @@ def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
 
     return train.make_loss_train_step(
         loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
-        batch_spec=P("data", None))
+        batch_spec=P("data", None),
+        grad_accum=getattr(args, "grad_accum", 1))
 
 
 def build(args, mesh=None, num_slices: int = 1):

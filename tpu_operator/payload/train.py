@@ -203,17 +203,51 @@ def fsdp_shardings(mesh: Mesh, state: TrainState, axis: str = "data",
 def make_loss_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
                          mesh: Mesh, state: TrainState,
                          shardings: Optional[TrainState] = None,
-                         batch_spec: P = P("data")) -> Callable:
+                         batch_spec: P = P("data"),
+                         grad_accum: int = 1) -> Callable:
     """The shared LM/loss step: ``loss_fn(params, batch) -> (loss, metrics)``
     differentiated, adam-updated, jitted with donated state. The LM payloads
     (transformer, pipeline, MoE) build their steps on this with
-    payload-specific loss_fns and batch specs."""
+    payload-specific loss_fns and batch specs.
+
+    ``grad_accum=K`` splits the batch's leading dim into K sequential
+    microbatches inside the jit (``lax.scan``), averaging their gradients
+    before the single optimizer update — the activation-memory knob for
+    batch sizes whose activations exceed HBM. Numerically equal to the
+    K=1 step up to summation order (every loss_fn here is a mean)."""
+    if grad_accum < 1:
+        raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     shardings = shardings or state_shardings(mesh, state)
     batch_shard = NamedSharding(mesh, batch_spec)
 
+    def grads_and_metrics(params, batch):
+        if grad_accum == 1:
+            (loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, batch)
+            return grads, metrics
+        b = batch.shape[0]
+        if b % grad_accum != 0:
+            raise ValueError(
+                f"batch {b} not divisible by grad_accum={grad_accum}")
+        micro = batch.reshape(grad_accum, b // grad_accum, *batch.shape[1:])
+        # keep each microbatch sharded exactly like a full batch
+        micro = jax.lax.with_sharding_constraint(
+            micro, NamedSharding(mesh, P(None, *batch_spec)))
+
+        def body(g_acc, mb):
+            (_loss, metrics), grads = jax.value_and_grad(
+                loss_fn, has_aux=True)(params, mb)
+            return jax.tree_util.tree_map(jnp.add, g_acc, grads), metrics
+
+        zeros = jax.tree_util.tree_map(jnp.zeros_like, params)
+        g_sum, metrics_stack = jax.lax.scan(body, zeros, micro)
+        grads = jax.tree_util.tree_map(lambda g: g / grad_accum, g_sum)
+        metrics = jax.tree_util.tree_map(
+            lambda m: jnp.mean(m, axis=0), metrics_stack)
+        return grads, metrics
+
     def step(state: TrainState, batch: jnp.ndarray) -> Tuple[TrainState, dict]:
-        (loss, metrics), grads = jax.value_and_grad(
-            loss_fn, has_aux=True)(state.params, batch)
+        grads, metrics = grads_and_metrics(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
         new_state = TrainState(
             step=state.step + 1,

@@ -50,6 +50,10 @@ def parse_args(argv=None):
                    help="rematerialize each block on backward (jax.checkpoint"
                         "): activation memory O(layers) -> O(1) blocks, for "
                         "long-context configs that would not fit HBM")
+    p.add_argument("--grad-accum", type=int, default=1,
+                   help="accumulate gradients over K sequential "
+                        "microbatches inside the jit (activation-memory "
+                        "knob; optimizer sees the full-batch gradient)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO/FSDP param+optimizer sharding over the data "
                         "axis (train.fsdp_shardings): per-device state "
@@ -136,7 +140,8 @@ def _build_model(args, mesh):
                          layers=args.layers, max_seq=args.seq_len)
 
 
-def make_lm_train_step(model, tx, mesh, state, shardings=None):
+def make_lm_train_step(model, tx, mesh, state, shardings=None,
+                       grad_accum: int = 1):
     """Next-token cross-entropy step, jitted with (data, seq) shardings."""
     from jax.sharding import PartitionSpec as P
 
@@ -148,7 +153,8 @@ def make_lm_train_step(model, tx, mesh, state, shardings=None):
         return loss, {"loss": loss}
 
     return train.make_loss_train_step(loss_fn, tx, mesh, state, shardings,
-                                      batch_spec=P("data", "seq"))
+                                      batch_spec=P("data", "seq"),
+                                      grad_accum=grad_accum)
 
 
 def build(args, mesh=None, num_slices: int = 1):
@@ -170,7 +176,8 @@ def build(args, mesh=None, num_slices: int = 1):
                  if getattr(args, "fsdp", False)
                  else train.state_shardings(mesh, state))
     state = train.place_state(mesh, state, shardings)
-    step = make_lm_train_step(model, tx, mesh, state, shardings)
+    step = make_lm_train_step(model, tx, mesh, state, shardings,
+                              grad_accum=getattr(args, "grad_accum", 1))
     batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
                                     vocab=args.vocab)
     return mesh, model, state, step, batches
