@@ -115,3 +115,24 @@ def test_grad_accum_composes_with_fsdp_and_descends(mesh):
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.7, losses[::5]
+
+
+def test_fsdp_composes_with_seq_parallel():
+    # (data=2, seq=4) mesh with FSDP over data: ring attention + ZeRO
+    # params in one jit.
+    mesh_sp = transformer.make_lm_mesh(8, seq_parallel=4)
+    args = transformer.parse_args(
+        ["--batch", "4", "--seq-len", "64", "--dim", "64", "--heads", "4",
+         "--layers", "2", "--seq-parallel", "4", "--fsdp", "--lr", "1e-2"])
+    _, _, state, step, batches = transformer.build(args, mesh=mesh_sp)
+    from jax.sharding import PartitionSpec as P
+
+    losses = []
+    for _ in range(20):
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh_sp, tokens,
+                                           spec=P("data", "seq"))
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0], losses[::4]

@@ -163,3 +163,83 @@ def test_train_step_donation_no_leak(devices):
     new_state, _ = step(state, *arrays)
     with pytest.raises(RuntimeError):
         _ = np.asarray(jax.tree_util.tree_leaves(state.params)[0])
+
+
+def test_npz_classification_deterministic_and_trains(tmp_path):
+    import numpy as np
+
+    from tpu_operator.payload import cifar, data as data_mod
+
+    rng = np.random.default_rng(0)
+    labels = np.arange(64) % 4
+    # learnable: images carry their label in a constant channel offset
+    images = (rng.normal(0.5, 0.05, (64, 32, 32, 3))
+              + labels[:, None, None, None] * 0.2)
+    path = tmp_path / "d.npz"
+    np.savez(path, images=(images * 255).clip(0, 255).astype(np.uint8),
+             labels=labels.astype(np.int64))
+
+    a = data_mod.npz_classification(str(path), seed=3, batch=16)
+    b = data_mod.npz_classification(str(path), seed=3, batch=16)
+    for _ in range(6):  # crosses an epoch boundary at 4 batches/epoch
+        ia, la = next(a)
+        ib, lb = next(b)
+        np.testing.assert_array_equal(la, lb)
+        np.testing.assert_array_equal(ia, ib)
+    assert ia.dtype == np.float32 and ia.max() <= 1.0 and la.dtype == np.int32
+
+    args = cifar.parse_args(["--batch", "16", "--blocks", "1",
+                             "--widths", "8", "8", "8",
+                             "--data", str(path)])
+    mesh, _m, state, step, batches = cifar.build(args)
+    (imgs, lbls) = data_mod.put_global_batch(mesh, *next(batches))
+    state, metrics = step(state, imgs, lbls)
+    assert np.isfinite(float(metrics["loss"]))
+
+
+def test_npz_classification_rejects_tiny_dataset(tmp_path):
+    import numpy as np
+
+    import pytest
+
+    from tpu_operator.payload import data as data_mod
+
+    path = tmp_path / "tiny.npz"
+    np.savez(path, images=np.zeros((4, 32, 32, 3), np.uint8),
+             labels=np.zeros(4, np.int64))
+    with pytest.raises(ValueError, match="examples"):
+        next(data_mod.npz_classification(str(path), seed=0, batch=16))
+
+
+def test_npz_classification_validates_eagerly(tmp_path):
+    import numpy as np
+
+    import pytest
+
+    from tpu_operator.payload import data as data_mod
+
+    # out-of-range labels
+    p1 = tmp_path / "badlabels.npz"
+    np.savez(p1, images=np.zeros((32, 32, 32, 3), np.uint8),
+             labels=np.full(32, 12, np.int64))
+    with pytest.raises(ValueError, match="classes"):
+        data_mod.npz_classification(str(p1), 0, 16, num_classes=10)
+    # image/label length mismatch
+    p2 = tmp_path / "ragged.npz"
+    np.savez(p2, images=np.zeros((32, 32, 32, 3), np.uint8),
+             labels=np.zeros(24, np.int64))
+    with pytest.raises(ValueError, match="labels"):
+        data_mod.npz_classification(str(p2), 0, 16)
+    # wrong image shape
+    p3 = tmp_path / "shape.npz"
+    np.savez(p3, images=np.zeros((32, 28, 28, 1), np.uint8),
+             labels=np.zeros(32, np.int64))
+    with pytest.raises(ValueError, match="expects"):
+        data_mod.npz_classification(str(p3), 0, 16,
+                                    image_shape=data_mod.CIFAR_SHAPE)
+    # pre-normalized floats are NOT rescaled
+    p4 = tmp_path / "floats.npz"
+    np.savez(p4, images=np.full((32, 32, 32, 3), 2.0, np.float32),
+             labels=np.zeros(32, np.int64))
+    imgs, _ = next(data_mod.npz_classification(str(p4), 0, 16))
+    assert float(imgs.max()) == 2.0

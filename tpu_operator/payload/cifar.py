@@ -33,6 +33,10 @@ def parse_args(argv=None):
                    help="blocks per stage (3 → ResNet-20)")
     p.add_argument("--widths", type=int, nargs=3, default=(16, 32, 64))
     p.add_argument("--model-parallel", type=int, default=1)
+    p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
+                   help=".npz dataset (images [N,32,32,3], labels [N]) "
+                        "on a mounted volume; default synthetic "
+                        "(or $TPU_DATA_PATH)")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--checkpoint-dir", default="",
@@ -65,7 +69,13 @@ def build(args, mesh=None, num_slices: int = 1):
     shardings = train.state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
     step = train.make_classifier_train_step(model, tx, mesh, state, shardings)
-    batches = data_mod.synthetic_cifar(args.seed, args.batch)
+    if getattr(args, "data", ""):
+        batches = data_mod.npz_classification(
+            args.data, args.seed, args.batch,
+            num_classes=model.num_classes,
+            image_shape=data_mod.CIFAR_SHAPE)
+    else:
+        batches = data_mod.synthetic_cifar(args.seed, args.batch)
     return mesh, model, state, step, batches
 
 
