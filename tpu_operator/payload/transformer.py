@@ -42,6 +42,10 @@ def parse_args(argv=None):
     p.add_argument("--seq-len", type=int, default=2048, help="global sequence length")
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="sequence-parallel shards (mesh seq axis size)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="Megatron-style TP shards (mesh model axis): qkv/"
+                        "mlp_up column-parallel, attn_out/mlp_down row-"
+                        "parallel; exclusive with --seq-parallel > 1")
     p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring",
                    help="sequence-parallel strategy: ring = ppermute K/V "
                         "rotation, O(T/P) memory; ulysses = head-scatter "
@@ -75,12 +79,23 @@ def parse_args(argv=None):
 
 
 def make_lm_mesh(num_devices: Optional[int] = None, seq_parallel: int = 1,
-                 devices: Optional[list] = None, num_slices: int = 1):
+                 devices: Optional[list] = None, num_slices: int = 1,
+                 tensor_parallel: int = 1):
     """(data, seq) mesh: DP outer, sequence-parallel inner (neighboring
     devices share a ring edge, so K/V rotation stays on adjacent ICI links;
-    multi-slice jobs keep the ring within a slice — train.make_mesh)."""
+    multi-slice jobs keep the ring within a slice — train.make_mesh).
+    With ``tensor_parallel > 1`` the inner axis is ``model`` instead
+    (Megatron TP; exclusive with seq_parallel > 1)."""
     from tpu_operator.payload import train
 
+    if tensor_parallel > 1 and seq_parallel > 1:
+        raise ValueError(
+            "seq_parallel and tensor_parallel are exclusive on the "
+            "2-axis LM mesh; pick one inner axis")
+    if tensor_parallel > 1:
+        return train.make_mesh(num_devices, model_parallel=tensor_parallel,
+                               devices=devices, axis_names=("data", "model"),
+                               num_slices=num_slices)
     return train.make_mesh(num_devices, model_parallel=seq_parallel,
                            devices=devices, axis_names=("data", "seq"),
                            num_slices=num_slices)
@@ -93,7 +108,7 @@ def _build_model(args, mesh):
     from tpu_operator.payload import flash_attention as fa
     from tpu_operator.payload import ring_attention as ring
 
-    seq_shards = mesh.shape["seq"]
+    seq_shards = mesh.shape.get("seq", 1)
     sp_mode = getattr(args, "sp_mode", "ring")
 
     def attend(q, k, v):
@@ -140,6 +155,44 @@ def _build_model(args, mesh):
                          layers=args.layers, max_seq=args.seq_len)
 
 
+def lm_token_spec(mesh):
+    """Token batch PartitionSpec for whichever LM mesh layout is in
+    play: sequence-sharded on (data, seq), batch-only otherwise."""
+    from jax.sharding import PartitionSpec as P
+
+    return P("data", "seq" if "seq" in mesh.shape else None)
+
+
+def lm_tp_shardings(mesh, state):
+    """Megatron-style TP rule over the ``model`` axis: qkv and mlp_up
+    kernels column-parallel P(None, model), attn_out and mlp_down
+    row-parallel P(model, None), whose products GSPMD psums; lm_head
+    column-parallel over vocab. The MLP pair is the classic one-
+    all-reduce Megatron pairing; the *packed* qkv kernel shards
+    contiguous columns, which straddle the q/k/v thirds, so GSPMD
+    inserts a reshard before the head split — correct but one extra
+    collective per block (known follow-up: per-projection Dense layers
+    to make attention head-local). Everything else (LayerNorms, embeddings,
+    adam scalars) replicates; params-shaped adam moments match by path.
+    """
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import train
+
+    col = ("qkv", "mlp_up", "lm_head")
+    row = ("attn_out", "mlp_down")
+
+    def rule(keys, leaf):
+        if keys and keys[-1] == "kernel" and getattr(leaf, "ndim", 0) == 2:
+            if any(k in col for k in keys):
+                return P(None, "model")
+            if any(k in row for k in keys):
+                return P("model", None)
+        return P()
+
+    return train.shardings_from_rule(mesh, state, rule)
+
+
 def make_lm_train_step(model, tx, mesh, state, shardings=None,
                        grad_accum: int = 1):
     """Next-token cross-entropy step, jitted with (data, seq) shardings."""
@@ -153,7 +206,7 @@ def make_lm_train_step(model, tx, mesh, state, shardings=None,
         return loss, {"loss": loss}
 
     return train.make_loss_train_step(loss_fn, tx, mesh, state, shardings,
-                                      batch_spec=P("data", "seq"),
+                                      batch_spec=lm_token_spec(mesh),
                                       grad_accum=grad_accum)
 
 
@@ -166,15 +219,23 @@ def build(args, mesh=None, num_slices: int = 1):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_lm_mesh(seq_parallel=args.seq_parallel,
-                                num_slices=num_slices)
+    mesh = mesh or make_lm_mesh(
+        seq_parallel=args.seq_parallel, num_slices=num_slices,
+        tensor_parallel=getattr(args, "tensor_parallel", 1))
     model = _build_model(args, mesh)
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
-    shardings = (train.fsdp_shardings(mesh, state)
-                 if getattr(args, "fsdp", False)
-                 else train.state_shardings(mesh, state))
+    if "model" in mesh.shape and mesh.shape["model"] > 1:
+        if getattr(args, "fsdp", False):
+            raise ValueError(
+                "--fsdp and --tensor-parallel are exclusive in this "
+                "payload: TP replicates over data, FSDP shards over it")
+        shardings = lm_tp_shardings(mesh, state)
+    elif getattr(args, "fsdp", False):
+        shardings = train.fsdp_shardings(mesh, state)
+    else:
+        shardings = train.state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
     step = make_lm_train_step(model, tx, mesh, state, shardings,
                               grad_accum=getattr(args, "grad_accum", 1))
@@ -206,7 +267,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             log_fn=lambda i, m: log.info("step %d loss %.4f", i, m["loss"]),
             checkpointer=ckpt,
             profile_dir=args.profile_dir,
-            spec=P("data", "seq"),
+            spec=lm_token_spec(mesh),
         )
     finally:
         if ckpt is not None:
