@@ -204,3 +204,48 @@ def test_gc_removes_orphans():
     assert deleted == 1
     names = [p["metadata"]["name"] for p in cs.pods.list("default")]
     assert names == ["kept-pod"]
+
+
+def test_controller_reconciles_100_concurrent_jobs():
+    # The reference's design scale: O(100) concurrent jobs per cluster
+    # (tf_job_design_doc.md:24). Here with 4 reconcile workers (the
+    # reference was only safe at threadiness 1): every job must reach
+    # Creating/Running with its pods and headless service materialized,
+    # and no job may bleed resources into another's label space.
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)
+    controller = Controller(cs, factory)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(4, stop),
+                              daemon=True)
+    runner.start()
+    try:
+        n = 100
+        for i in range(n):
+            cs.tpujobs.create(
+                "default", worker_job_dict(name=f"job-{i:03d}", replicas=2,
+                                           runtime_id=f"r{i:03d}"))
+
+        def all_reconciled():
+            jobs = cs.tpujobs.list("default")
+            phases = [j.get("status", {}).get("phase", "") for j in jobs]
+            return len(jobs) == n and all(
+                p in ("Creating", "Running") for p in phases)
+
+        assert wait_for(all_reconciled, timeout=60.0), [
+            (j["metadata"]["name"], j.get("status", {}).get("phase"))
+            for j in cs.tpujobs.list("default")
+            if j.get("status", {}).get("phase") not in ("Creating", "Running")
+        ][:5]
+        assert wait_for(lambda: len(cs.pods.list("default")) == 2 * n,
+                        timeout=30.0), len(cs.pods.list("default"))
+        # headless + one per replica index = 3 services per job
+        assert wait_for(lambda: len(cs.services.list("default")) == 3 * n,
+                        timeout=30.0), len(cs.services.list("default"))
+        # no cross-job bleed: every pod's job label matches its name prefix
+        for pod in cs.pods.list("default"):
+            labels = pod["metadata"]["labels"]
+            assert pod["metadata"]["name"].startswith(labels["job_name"])
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)
