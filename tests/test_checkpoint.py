@@ -177,3 +177,73 @@ def test_spec_profile_dir_roundtrip_and_env_injection():
     env2 = replicas.build_replica_env("job", "ab12", spec2,
                                       types.TPUReplicaType.WORKER, 0)
     assert "TPU_PROFILE_DIR" not in env2
+
+
+def test_sigterm_drain_checkpoints_current_step(tmp_path):
+    # First SIGTERM → cooperative drain: train_loop saves the *current*
+    # step (not the last interval save) and exits retryable (143).
+    import pytest
+
+    from tpu_operator.payload import bootstrap, checkpoint as ckpt_mod
+    from tpu_operator.payload import data as data_mod, models, train
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    mesh = train.make_mesh(4)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((16, 8), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+    batches = data_mod.synthetic_linear(0, 16, 8)
+
+    ckpt = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=1000)
+    ran = {"steps": 0}
+
+    def counting_batches():
+        for arrays in batches:
+            ran["steps"] += 1
+            if ran["steps"] == 7:
+                bootstrap.request_drain()
+            yield arrays
+
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train.train_loop(mesh, step, state, counting_batches(), 50,
+                             checkpointer=ckpt)
+        assert exc.value.code == bootstrap.EXIT_RETRYABLE
+        ckpt.close()
+        # drain fired entering step index 7 (7 steps completed)
+        assert ckpt.manager.latest_step() == 7
+    finally:
+        bootstrap.reset_drain()
+
+
+def test_drain_without_checkpointer_still_exits_retryable():
+    import pytest
+
+    from tpu_operator.payload import bootstrap
+    from tpu_operator.payload import data as data_mod, models, train
+
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    mesh = train.make_mesh(2)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((8, 8), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+    bootstrap.request_drain()
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train.train_loop(mesh, step, state,
+                             data_mod.synthetic_linear(0, 8, 8), 10)
+        assert exc.value.code == bootstrap.EXIT_RETRYABLE
+    finally:
+        bootstrap.reset_drain()

@@ -23,6 +23,7 @@ import os
 import signal
 import socket
 import sys
+import threading
 import time
 from typing import Callable, Optional
 
@@ -107,14 +108,53 @@ def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
 
 EXIT_RETRYABLE = 143  # 128 + SIGTERM: the retryable band (training.go:172-208)
 
+# SIGTERM inside the step loop requests a cooperative drain: train_loop
+# notices at the next step boundary, saves a checkpoint of the *current*
+# step (single-process jobs), and exits 143 — so a preempted attempt loses
+# zero completed steps instead of rolling back to the last interval save.
+# Outside the step loop (bootstrap, data loading, non-loop payloads) — or on
+# a second SIGTERM — the process exits immediately, as before; kubelet's
+# SIGKILL at the grace deadline is the final backstop.
+_drain = threading.Event()
+_in_step_loop = threading.Event()
+
+
+def request_drain() -> None:
+    _drain.set()
+
+
+def draining() -> bool:
+    return _drain.is_set()
+
+
+def reset_drain() -> None:
+    """Test hook: clear the module-level drain latch."""
+    _drain.clear()
+
+
+def enter_step_loop() -> None:
+    """train_loop marks itself drainable; SIGTERM then defers to the next
+    step boundary instead of killing the process mid-step."""
+    _in_step_loop.set()
+
+
+def exit_step_loop() -> None:
+    _in_step_loop.clear()
+
 
 def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
     """Run a training payload under the exit-code contract. SIGTERM (pod
-    preemption) raises through and exits 143 → retryable → whole-group
-    restart; any other exception exits 1 → permanent failure."""
+    preemption) exits 143 → retryable → whole-group restart; while the step
+    loop runs, the exit defers one step boundary so the current step gets
+    checkpointed (a second SIGTERM exits immediately); any other exception
+    exits 1 → permanent failure."""
 
     def _sigterm(_signum, _frame):
-        raise SystemExit(EXIT_RETRYABLE)
+        if _drain.is_set() or not _in_step_loop.is_set():
+            raise SystemExit(EXIT_RETRYABLE)
+        log.info("SIGTERM: draining — checkpoint at next step boundary "
+                 "(send again to exit immediately)")
+        request_drain()
 
     signal.signal(signal.SIGTERM, _sigterm)
     try:

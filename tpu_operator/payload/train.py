@@ -29,6 +29,7 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_operator.payload import bootstrap as bootstrap_mod
 from tpu_operator.payload import data as data_mod
 from tpu_operator.payload import models as models_mod
 
@@ -374,22 +375,42 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         log.warning(
             "profile window [%d, %d) lies beyond the run's last step %d; "
             "no trace will be captured", trace_from, trace_to, steps)
-    for i in range(start, steps):
-        if (profile_dir and not tracing and not profiled
-                and i >= trace_from):
-            jax.profiler.start_trace(profile_dir)
-            tracing = True
-        host_arrays = next(batches)
-        device_arrays = data_mod.put_global_batch(mesh, *host_arrays, spec=spec)
-        state, metrics = train_step(state, *device_arrays)
-        if tracing and (i + 1) >= trace_to:
-            jax.device_get(metrics)  # drain async work into the trace
-            jax.profiler.stop_trace()
-            tracing, profiled = False, True
-        if checkpointer is not None:
-            checkpointer.maybe_save(i + 1, state)
-        if log_every and log_fn and (i + 1) % log_every == 0:
-            log_fn(i + 1, jax.device_get(metrics))
+    bootstrap_mod.enter_step_loop()  # SIGTERM now defers to a step boundary
+    try:
+        for i in range(start, steps):
+            if bootstrap_mod.draining():
+                # SIGTERM drain: persist the i completed steps and exit
+                # retryable — the restarted attempt resumes exactly here.
+                # The caller's finally close() flushes the async write.
+                # Multi-process jobs skip the save: orbax saves are group
+                # collectives and peers drain at different boundaries (or
+                # not at all), so they fall back to the last interval save,
+                # which whole-group restart handles anyway.
+                if (checkpointer is not None and i > start
+                        and jax.process_count() == 1):
+                    checkpointer.save(i, state)
+                    log.info("drain: checkpointed step %d, exiting retryable", i)
+                else:
+                    log.info("drain: exiting retryable at step %d", i)
+                raise SystemExit(bootstrap_mod.EXIT_RETRYABLE)
+            if (profile_dir and not tracing and not profiled
+                    and i >= trace_from):
+                jax.profiler.start_trace(profile_dir)
+                tracing = True
+            host_arrays = next(batches)
+            device_arrays = data_mod.put_global_batch(mesh, *host_arrays,
+                                                      spec=spec)
+            state, metrics = train_step(state, *device_arrays)
+            if tracing and (i + 1) >= trace_to:
+                jax.device_get(metrics)  # drain async work into the trace
+                jax.profiler.stop_trace()
+                tracing, profiled = False, True
+            if checkpointer is not None:
+                checkpointer.maybe_save(i + 1, state)
+            if log_every and log_fn and (i + 1) % log_every == 0:
+                log_fn(i + 1, jax.device_get(metrics))
+    finally:
+        bootstrap_mod.exit_step_loop()
     if tracing:
         jax.device_get(metrics)
         jax.profiler.stop_trace()
