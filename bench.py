@@ -91,9 +91,10 @@ def main(argv=None) -> int:
               for b in itertools.islice(batches, 8)]
     cycled = itertools.cycle(pregen)
 
-    # Median of three timed windows (one compile, shared warmup): the
-    # tunnel adds a few percent of run-to-run jitter a single window
-    # would pass straight through to the recorded number.
+    # Median of three timed windows (compile cost is paid once, before
+    # the first window; each window still runs its own 5 warmup steps):
+    # the tunnel adds a few percent of run-to-run jitter a single
+    # window would pass straight through to the recorded number.
     rates = []
     for _ in range(1 if args.quick else 3):
         state, steps_per_sec = train.throughput(

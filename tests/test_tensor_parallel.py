@@ -31,9 +31,11 @@ def test_tp_kernels_sharded_col_and_row(mesh):
 
     def spec_for(fragment):
         return next(s for k, s in specs.items()
-                    if fragment in k and "kernel" in k)
+                    if fragment in k and k.endswith("kernel']"))
 
-    assert spec_for("qkv") == (None, "model")
+    assert spec_for("['q']") == (None, "model")
+    assert spec_for("['k']") == (None, "model")
+    assert spec_for("['v']") == (None, "model")
     assert spec_for("mlp_up") == (None, "model")
     assert spec_for("lm_head") == (None, "model")
     assert spec_for("attn_out") == ("model", None)
@@ -46,8 +48,10 @@ def test_tp_kernels_sharded_col_and_row(mesh):
 def test_tp_matches_single_device_loss(mesh):
     losses = {}
     for tp in (1, 4):
+        # --split-qkv on for both, so the param trees (and the seeded
+        # init draws) are identical; only the sharding differs.
         args = transformer.parse_args(
-            _argv(["--tensor-parallel", str(tp)]))
+            _argv(["--tensor-parallel", str(tp), "--split-qkv", "on"]))
         m = mesh if tp == 4 else transformer.make_lm_mesh(1)
         _, _, state, step, batches = transformer.build(args, mesh=m)
         (tokens,) = next(batches)
@@ -87,3 +91,16 @@ def test_tp_rejects_fsdp(mesh):
         _argv(["--tensor-parallel", "4", "--fsdp"]))
     with pytest.raises(ValueError, match="exclusive"):
         transformer.build(args, mesh=mesh)
+
+
+def test_tp_fused_qkv_compat_shards_packed_kernel(mesh):
+    # --split-qkv off under TP: the fused [d, 3d] kernel (checkpoint-compat
+    # layout) still column-shards over the model axis.
+    args = transformer.parse_args(
+        _argv(["--tensor-parallel", "4", "--split-qkv", "off"]))
+    _, _, state, _step, _batches = transformer.build(args, mesh=mesh)
+    flat = jax.tree_util.tree_flatten_with_path(state.params)[0]
+    specs = {jax.tree_util.keystr(p): l.sharding.spec for p, l in flat}
+    qkv = next(s for k, s in specs.items()
+               if "['qkv']" in k and k.endswith("kernel']"))
+    assert qkv == (None, "model")

@@ -105,15 +105,29 @@ class DecoderBlock(nn.Module):
     attend: Callable[[jnp.ndarray, jnp.ndarray, jnp.ndarray], jnp.ndarray]
     dtype: Any = jnp.bfloat16
     mlp: Optional[Callable[[str], nn.Module]] = None
+    # Separate q/k/v projections instead of one fused [dim, 3*dim] kernel.
+    # Fused is the single-big-GEMM default; split is what tensor parallelism
+    # wants — P(None, "model") on each projection keeps whole heads on one
+    # shard, so attention is head-local with no reshard (a fused kernel's
+    # contiguous column shards straddle the q/k/v thirds).
+    split_qkv: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
-                       name="qkv")(h)
-        q, k, v = jnp.split(qkv, 3, axis=-1)
+        if self.split_qkv:
+            q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="q")(h)
+            k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="k")(h)
+            v = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+                         name="v")(h)
+        else:
+            qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
+                           name="qkv")(h)
+            q, k, v = jnp.split(qkv, 3, axis=-1)
         shape = (b, t, self.heads, head_dim)
         out = self.attend(q.reshape(shape), k.reshape(shape), v.reshape(shape))
         out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,

@@ -43,9 +43,16 @@ def parse_args(argv=None):
     p.add_argument("--seq-parallel", type=int, default=1,
                    help="sequence-parallel shards (mesh seq axis size)")
     p.add_argument("--tensor-parallel", type=int, default=1,
-                   help="Megatron-style TP shards (mesh model axis): qkv/"
+                   help="Megatron-style TP shards (mesh model axis): q/k/v/"
                         "mlp_up column-parallel, attn_out/mlp_down row-"
                         "parallel; exclusive with --seq-parallel > 1")
+    p.add_argument("--split-qkv", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="separate q/k/v projections (auto: on under "
+                        "--tensor-parallel, so TP shards whole heads; "
+                        "off fuses one [d,3d] GEMM — also the compat "
+                        "switch for checkpoints saved with a fused "
+                        "kernel, whose param tree differs)")
     p.add_argument("--sp-mode", choices=("ring", "ulysses"), default="ring",
                    help="sequence-parallel strategy: ring = ppermute K/V "
                         "rotation, O(T/P) memory; ulysses = head-scatter "
@@ -124,6 +131,18 @@ def _build_model(args, mesh):
 
     from tpu_operator.payload import models
 
+    tp = mesh.shape.get("model", 1)
+    mode = getattr(args, "split_qkv", "auto")
+    split_qkv = mode == "on" or (mode == "auto" and tp > 1)
+    if tp > 1:
+        if args.heads % tp != 0:
+            raise ValueError(
+                f"--heads {args.heads} must divide by --tensor-parallel "
+                f"{tp} (TP shards whole heads)")
+        if args.dim % tp != 0:
+            raise ValueError(
+                f"--dim {args.dim} must divide by --tensor-parallel {tp}")
+
     # nn.remat is semantics-preserving: same params/outputs, backward
     # recomputes the block instead of keeping its activations in HBM.
     Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
@@ -146,7 +165,7 @@ def _build_model(args, mesh):
             x = x + pos[None]
             for i in range(self.layers):
                 x = Block(self.dim, self.heads, attend,
-                          name=f"block{i}")(x)
+                          split_qkv=split_qkv, name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
                             name="lm_head")(x)
@@ -166,20 +185,20 @@ def lm_token_spec(mesh):
 def lm_tp_shardings(mesh, state):
     """Megatron-style TP rule over the ``model`` axis: qkv and mlp_up
     kernels column-parallel P(None, model), attn_out and mlp_down
-    row-parallel P(model, None), whose products GSPMD psums; lm_head
-    column-parallel over vocab. The MLP pair is the classic one-
-    all-reduce Megatron pairing; the *packed* qkv kernel shards
-    contiguous columns, which straddle the q/k/v thirds, so GSPMD
-    inserts a reshard before the head split — correct but one extra
-    collective per block (known follow-up: per-projection Dense layers
-    to make attention head-local). Everything else (LayerNorms, embeddings,
+    row-parallel P(model, None), whose products GSPMD psums — the
+    classic pairing needing exactly one all-reduce per block per
+    direction; lm_head column-parallel over vocab. TP builds split the
+    qkv projection into per-projection Dense layers (DecoderBlock
+    split_qkv) so each shard holds whole heads and attention is
+    head-local; a *fused* [d, 3d] kernel would shard contiguous columns
+    straddling the q/k/v thirds and pay a reshard per block. Everything else (LayerNorms, embeddings,
     adam scalars) replicates; params-shaped adam moments match by path.
     """
     from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import train
 
-    col = ("qkv", "mlp_up", "lm_head")
+    col = ("q", "k", "v", "qkv", "mlp_up", "lm_head")
     row = ("attn_out", "mlp_down")
 
     def rule(keys, leaf):
