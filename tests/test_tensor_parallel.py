@@ -81,9 +81,11 @@ def test_tp_loss_descends(mesh):
     assert losses[-1] < losses[0] * 0.7, losses[::5]
 
 
-def test_tp_and_sp_are_exclusive():
-    with pytest.raises(ValueError, match="exclusive"):
-        transformer.make_lm_mesh(8, seq_parallel=2, tensor_parallel=4)
+def test_tp_and_sp_compose_into_3axis_mesh():
+    mesh3 = transformer.make_lm_mesh(8, seq_parallel=2, tensor_parallel=4)
+    assert dict(mesh3.shape) == {"data": 1, "seq": 2, "model": 4}
+    with pytest.raises(ValueError, match="divisible"):
+        transformer.make_lm_mesh(8, seq_parallel=3, tensor_parallel=4)
 
 
 def test_tp_rejects_fsdp(mesh):
@@ -104,3 +106,60 @@ def test_tp_fused_qkv_compat_shards_packed_kernel(mesh):
     qkv = next(s for k, s in specs.items()
                if "['qkv']" in k and k.endswith("kernel']"))
     assert qkv == (None, "model")
+
+
+def test_tp_composes_with_seq_parallel_3axis():
+    # (data=2, seq=2, model=2): ring attention over TP-sharded heads in one
+    # jit; loss must match the unsharded single-device run.
+    mesh3 = transformer.make_lm_mesh(8, seq_parallel=2, tensor_parallel=2)
+    assert dict(mesh3.shape) == {"data": 2, "seq": 2, "model": 2}
+    argv = ["--batch", "4", "--seq-len", "64", "--dim", "64", "--heads", "4",
+            "--layers", "2", "--seq-parallel", "2", "--tensor-parallel", "2"]
+    args = transformer.parse_args(argv)
+    _, _, state, step, batches = transformer.build(args, mesh=mesh3)
+
+    args1 = transformer.parse_args(
+        ["--batch", "4", "--seq-len", "64", "--dim", "64", "--heads", "4",
+         "--layers", "2", "--split-qkv", "on"])
+    mesh1 = transformer.make_lm_mesh(1)
+    _, _, s1, step1, _ = transformer.build(args1, mesh=mesh1)
+
+    from jax.sharding import PartitionSpec as P
+
+    (tokens,) = next(batches)
+    (d3,) = data_mod.put_global_batch(mesh3, tokens, spec=P("data", "seq"))
+    (d1,) = data_mod.put_global_batch(mesh1, tokens, spec=P())
+    _, m3 = step(state, d3)
+    _, m1 = step1(s1, d1)
+    assert abs(float(m3["loss"]) - float(m1["loss"])) < 2e-2, (
+        float(m3["loss"]), float(m1["loss"]))
+
+
+def test_3axis_loss_descends():
+    mesh3 = transformer.make_lm_mesh(8, seq_parallel=2, tensor_parallel=2)
+    args = transformer.parse_args(
+        ["--batch", "8", "--seq-len", "64", "--dim", "64", "--heads", "4",
+         "--layers", "2", "--seq-parallel", "2", "--tensor-parallel", "2",
+         "--lr", "1e-2"])
+    _, _, state, step, batches = transformer.build(args, mesh=mesh3)
+
+    from jax.sharding import PartitionSpec as P
+
+    losses = []
+    for _ in range(25):
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh3, tokens, spec=P("data", "seq"))
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+def test_ulysses_rejects_tensor_parallel():
+    mesh3 = transformer.make_lm_mesh(8, seq_parallel=2, tensor_parallel=2)
+    args = transformer.parse_args(
+        ["--batch", "4", "--seq-len", "32", "--dim", "32", "--heads", "4",
+         "--layers", "1", "--seq-parallel", "2", "--tensor-parallel", "2",
+         "--sp-mode", "ulysses"])
+    with pytest.raises(ValueError, match="ulysses"):
+        transformer.build(args, mesh=mesh3)

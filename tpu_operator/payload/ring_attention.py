@@ -110,6 +110,7 @@ def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    mesh: Mesh, *, seq_axis: str = "seq",
                    batch_axis: Optional[str] = "data",
+                   head_axis: Optional[str] = None,
                    causal: bool = True,
                    use_pallas: Optional[bool] = None) -> jnp.ndarray:
     """Exact attention over globally [B, T, H, D] arrays whose T dimension is
@@ -120,12 +121,17 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     neighbor hops of the local K/V blocks. ``use_pallas`` selects the fused
     flash-attention block kernel (default: on real TPUs; tests opt in to the
     interpreter on CPU).
+
+    ``head_axis`` additionally shards the H dimension (composed SP × TP on
+    a 3-axis mesh): the ring math is head-local, so each (seq, model) shard
+    just runs the same recurrence on its slice of heads — no extra
+    communication.
     """
     if use_pallas is None:
         from tpu_operator.payload import flash_attention as fa
 
         use_pallas = fa.use_pallas_default()
-    spec = P(batch_axis, seq_axis, None, None)
+    spec = P(batch_axis, seq_axis, head_axis, None)
     body = functools.partial(_ring_attention_local,
                              axis_name=seq_axis, causal=causal,
                              use_pallas=use_pallas)

@@ -43,6 +43,49 @@ class TrainState(flax.struct.PyTreeNode):
     opt_state: Any
 
 
+def _mesh_device_layout(num_devices, devices, inner, inner_label,
+                        num_slices):
+    """Shared device selection for the mesh builders: slice, validate that
+    the inner (per-op-collective) extent divides the device count — and
+    fits within one slice for multi-slice jobs — and sort slice-major when
+    the runtime exposes ``slice_index``."""
+    devices = list(devices if devices is not None else jax.devices())
+    if num_devices:
+        devices = devices[:num_devices]
+    n = len(devices)
+    if n % inner != 0:
+        raise ValueError(f"{n} devices not divisible by {inner_label}={inner}")
+    if num_slices > 1:
+        if n % num_slices != 0:
+            raise ValueError(
+                f"{n} devices not divisible by num_slices={num_slices}")
+        per_slice = n // num_slices
+        if per_slice % inner != 0:
+            raise ValueError(
+                f"{inner_label}={inner} does not fit within one slice "
+                f"({per_slice} devices): inner-axis collectives must "
+                f"stay on ICI")
+        if all(hasattr(d, "slice_index") for d in devices):
+            devices = sorted(devices, key=lambda d: (d.slice_index, d.id))
+    return devices
+
+
+def _guard_intra_slice(arr, num_slices, inner_label):
+    """Every inner-axes block (arr row, flattened) must sit within one
+    slice: a block silently spanning slices would put per-op collectives on
+    DCN — the exact failure hybrid meshes exist to prevent. Only checkable
+    when devices expose ``slice_index``."""
+    flat_blocks = arr.reshape(arr.shape[0], -1)
+    if num_slices > 1 and all(hasattr(d, "slice_index")
+                              for d in flat_blocks.flat):
+        for block in flat_blocks:
+            if len({d.slice_index for d in block}) != 1:
+                raise ValueError(
+                    f"inner axes ({inner_label}) cross a slice boundary "
+                    f"(num_slices={num_slices} vs device slice_index "
+                    f"layout); per-op collectives must stay on ICI")
+
+
 def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
               devices: Optional[list] = None,
               axis_names: Tuple[str, str] = ("data", "model"),
@@ -63,35 +106,11 @@ def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
     ``slice_index`` when the runtime exposes one (devices are sorted by it),
     else from the given device order (processes are already slice-major in
     the operator's TPU_WORKER_HOSTNAMES ordering)."""
-    devices = list(devices if devices is not None else jax.devices())
-    if num_devices:
-        devices = devices[:num_devices]
+    devices = _mesh_device_layout(num_devices, devices, model_parallel,
+                                  axis_names[1], num_slices)
     n = len(devices)
-    if n % model_parallel != 0:
-        raise ValueError(
-            f"{n} devices not divisible by {axis_names[1]}={model_parallel}")
-    if num_slices > 1:
-        if n % num_slices != 0:
-            raise ValueError(
-                f"{n} devices not divisible by num_slices={num_slices}")
-        per_slice = n // num_slices
-        if per_slice % model_parallel != 0:
-            raise ValueError(
-                f"{axis_names[1]}={model_parallel} does not fit within one "
-                f"slice ({per_slice} devices): inner-axis collectives must "
-                f"stay on ICI")
-        if all(hasattr(d, "slice_index") for d in devices):
-            devices = sorted(devices, key=lambda d: (d.slice_index, d.id))
     arr = np.array(devices).reshape(n // model_parallel, model_parallel)
-    if num_slices > 1 and all(hasattr(d, "slice_index") for d in devices):
-        # Guard against num_slices disagreeing with the real topology: a
-        # row silently spanning slices would put per-op collectives on DCN.
-        for row in arr:
-            if len({d.slice_index for d in row}) != 1:
-                raise ValueError(
-                    f"inner axis {axis_names[1]} crosses a slice boundary "
-                    f"(num_slices={num_slices} vs device slice_index "
-                    f"layout); per-op collectives must stay on ICI")
+    _guard_intra_slice(arr, num_slices, axis_names[1])
     return Mesh(arr, axis_names)
 
 
@@ -119,6 +138,25 @@ def shardings_from_rule(mesh: Mesh, state: TrainState,
         batch_stats=spec(state.batch_stats),
         opt_state=spec(state.opt_state),
     )
+
+
+def make_mesh3(num_devices: Optional[int] = None, seq_parallel: int = 1,
+               model_parallel: int = 1, devices: Optional[list] = None,
+               num_slices: int = 1,
+               axis_names: Tuple[str, str, str] = ("data", "seq", "model")
+               ) -> Mesh:
+    """3-axis (data, seq, model) mesh for composed DP × SP × TP: TP is the
+    innermost axis (its collectives fire per matmul — shortest ICI hops),
+    the sequence ring sits around it, data-parallel outermost (and across
+    DCN for multi-slice jobs, same rule and slice guard as make_mesh)."""
+    inner = seq_parallel * model_parallel
+    label = f"{axis_names[1]}×{axis_names[2]}"
+    devices = _mesh_device_layout(num_devices, devices, inner, label,
+                                  num_slices)
+    n = len(devices)
+    arr = np.array(devices).reshape(n // inner, seq_parallel, model_parallel)
+    _guard_intra_slice(arr, num_slices, label)
+    return Mesh(arr, axis_names)
 
 
 def state_shardings(mesh: Mesh, state: TrainState) -> TrainState:

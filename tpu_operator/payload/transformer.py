@@ -45,7 +45,8 @@ def parse_args(argv=None):
     p.add_argument("--tensor-parallel", type=int, default=1,
                    help="Megatron-style TP shards (mesh model axis): q/k/v/"
                         "mlp_up column-parallel, attn_out/mlp_down row-"
-                        "parallel; exclusive with --seq-parallel > 1")
+                        "parallel; composes with --seq-parallel (3-axis "
+                        "data x seq x model mesh, ring attention only)")
     p.add_argument("--split-qkv", choices=("auto", "on", "off"),
                    default="auto",
                    help="separate q/k/v projections (auto: on under "
@@ -91,14 +92,16 @@ def make_lm_mesh(num_devices: Optional[int] = None, seq_parallel: int = 1,
     """(data, seq) mesh: DP outer, sequence-parallel inner (neighboring
     devices share a ring edge, so K/V rotation stays on adjacent ICI links;
     multi-slice jobs keep the ring within a slice — train.make_mesh).
-    With ``tensor_parallel > 1`` the inner axis is ``model`` instead
-    (Megatron TP; exclusive with seq_parallel > 1)."""
+    With ``tensor_parallel > 1`` the inner axis is ``model`` instead;
+    both > 1 composes DP × SP × TP on a 3-axis mesh (ring attention
+    around TP-sharded heads)."""
     from tpu_operator.payload import train
 
     if tensor_parallel > 1 and seq_parallel > 1:
-        raise ValueError(
-            "seq_parallel and tensor_parallel are exclusive on the "
-            "2-axis LM mesh; pick one inner axis")
+        # composed DP x SP x TP: 3-axis mesh, TP innermost
+        return train.make_mesh3(num_devices, seq_parallel=seq_parallel,
+                                model_parallel=tensor_parallel,
+                                devices=devices, num_slices=num_slices)
     if tensor_parallel > 1:
         return train.make_mesh(num_devices, model_parallel=tensor_parallel,
                                devices=devices, axis_names=("data", "model"),
@@ -124,7 +127,9 @@ def _build_model(args, mesh):
                 from tpu_operator.payload import ulysses
 
                 return ulysses.ulysses_attention(q, k, v, mesh, causal=True)
-            return ring.ring_attention(q, k, v, mesh, causal=True)
+            head_axis = "model" if mesh.shape.get("model", 1) > 1 else None
+            return ring.ring_attention(q, k, v, mesh, causal=True,
+                                       head_axis=head_axis)
         if fa.use_pallas_default():
             return fa.flash_attention(q, k, v, causal=True)
         return ring.reference_attention(q, k, v, causal=True)
@@ -132,6 +137,10 @@ def _build_model(args, mesh):
     from tpu_operator.payload import models
 
     tp = mesh.shape.get("model", 1)
+    if tp > 1 and seq_shards > 1 and sp_mode == "ulysses":
+        raise ValueError(
+            "--sp-mode ulysses does not compose with --tensor-parallel "
+            "(both shard the head dimension); use --sp-mode ring")
     mode = getattr(args, "split_qkv", "auto")
     split_qkv = mode == "on" or (mode == "auto" and tp > 1)
     if tp > 1:
