@@ -18,6 +18,12 @@ Design (TPU-first, not a torch-style stage-per-process port):
   exactly the collective-pipelining recipe XLA compiles well — no
   per-stage Python processes, no point-to-point sends outside the compiler.
 - **Bubble** is the usual (S-1)/(M+S-1); pick microbatches >> stages.
+  (1F1B/interleaved schedules are deliberately not implemented: their win
+  comes from hand-interleaving forward and backward per microbatch, which
+  fights jax.grad's program-level autodiff of this scan — forward-only
+  virtual stages provably leave the bubble fraction unchanged. The JAX-
+  native levers are more microbatches and --remat, which bounds the
+  per-stage activation memory GPipe would otherwise hold for all M.)
 - **Numerics**: house style (models.py) — bf16 matmuls on the MXU, f32
   LayerNorm/softmax/loss, f32 master params.
 - Embedding and the LM head are position- and layer-local, so they run
