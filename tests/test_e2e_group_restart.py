@@ -1,0 +1,98 @@
+"""HTTP-tier e2e: whole-group restart through the real operator binary.
+
+The TPU semantic the reference lacked, exercised over the wire: a worker
+preempted with exit 137 (retryable band) triggers deletion of the whole
+attempt's pods and a fresh gang at attempt 1; a clean exit 0 on the next
+attempt completes the job. The fake-clientset tier covers the same flow
+in-process (test_informer_controller); this tier proves the operator
+*binary* does it against an HTTP apiserver — process boundary included.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import time
+
+import pytest
+
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.testing.apiserver import ApiServerHarness
+
+
+def wait_for(predicate, timeout=60.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+@pytest.fixture
+def operator_env():
+    harness = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=harness.url, timeout=5.0))
+    op = subprocess.Popen(
+        [sys.executable, "-m", "tpu_operator.cmd.main", "--master",
+         harness.url, "--namespace", "default"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    yield cs
+    op.send_signal(signal.SIGINT)
+    try:
+        op.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        op.kill()
+    harness.stop()
+
+
+def _set_pod_state(cs, pod, phase, container_state):
+    pod["status"] = {
+        "phase": phase,
+        "containerStatuses": [{"name": "tpu", "state": container_state}],
+    }
+    cs.pods.update("default", pod)
+
+
+def test_preemption_triggers_group_restart_then_success(operator_env):
+    cs = operator_env
+    cs.tpujobs.create("default", {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "restarts", "namespace": "default"},
+        "spec": {"replicaSpecs": [{
+            "replicas": 2, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu"}]}}}]},
+    })
+
+    def pods_at(attempt, n=2):
+        return [p for p in cs.pods.list("default")
+                if p["metadata"]["labels"].get("attempt") == str(attempt)]
+
+    assert wait_for(lambda: len(pods_at(0)) == 2), cs.pods.list("default")
+    for p in pods_at(0):
+        _set_pod_state(cs, p, "Running", {"running": {}})
+    assert wait_for(lambda: cs.tpujobs.get("default", "restarts")
+                    .get("status", {}).get("phase") == "Running")
+
+    # preempt one worker: retryable band (143/137-style) ⇒ the WHOLE group
+    # restarts, not just the dead pod
+    victim = pods_at(0)[0]
+    _set_pod_state(cs, victim, "Failed",
+                   {"terminated": {"exitCode": 137}})
+
+    assert wait_for(lambda: len(pods_at(1)) == 2, timeout=90.0), [
+        (p["metadata"]["name"], p["metadata"]["labels"].get("attempt"))
+        for p in cs.pods.list("default")]
+    assert cs.tpujobs.get("default", "restarts")["status"].get("attempt") == 1
+    # no attempt-0 stragglers — the old gang is gone
+    assert wait_for(lambda: len(pods_at(0)) == 0, timeout=30.0)
+
+    for p in pods_at(1):
+        _set_pod_state(cs, p, "Succeeded",
+                       {"terminated": {"exitCode": 0}})
+    assert wait_for(
+        lambda: cs.tpujobs.get("default", "restarts")
+        .get("status", {}).get("phase") == "Done", timeout=90.0)
+    assert (cs.tpujobs.get("default", "restarts")["status"].get("state")
+            == "Succeeded")
