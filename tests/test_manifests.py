@@ -114,7 +114,12 @@ def test_chart_rbac_covers_operator_verbs():
             by_group.setdefault(g, set()).update(rule["resources"])
     assert "tpujobs" in by_group[types.CRD_GROUP]
     assert "tpujobs/status" in by_group[types.CRD_GROUP]
-    assert {"pods", "services", "endpoints"} <= by_group[""]
+    assert {"pods", "services"} <= by_group[""]
+    # Least privilege (round-2 decision): no configmaps (controller config is
+    # a mounted file; no per-job PS ConfigMap analog) and no endpoints
+    # (election uses the Lease lock).
+    assert "configmaps" not in by_group[""]
+    assert "endpoints" not in by_group[""]
     assert "leases" in by_group["coordination.k8s.io"]
     binding = next(d for d in docs if d["kind"] == "ClusterRoleBinding")
     assert binding["subjects"][0]["namespace"] == "default"

@@ -99,6 +99,39 @@ def test_rest_watch_stream(api):
     assert not consumer.is_alive()
 
 
+def test_rest_watch_survives_server_death(api):
+    """Killing the apiserver mid-watch must end the stream cleanly — the
+    consumer thread exits without an unhandled exception (the chunked read
+    surfaces IncompleteRead, an HTTPException the iterator must swallow so
+    the reflector above re-lists instead of dying)."""
+    srv, cs = api
+    watch = cs.tpujobs.watch("default")
+    seen, errs = [], []
+
+    def consume():
+        try:
+            for ev in watch:
+                seen.append(ev)
+        except BaseException as exc:  # noqa: BLE001 — the assertion target
+            errs.append(exc)
+
+    consumer = threading.Thread(target=consume, daemon=True)
+    consumer.start()
+    try:
+        assert wait_for(lambda: srv.clientset.tpujobs._watchers)
+        srv.clientset.tpujobs.create("default", worker_job_dict("w1"))
+        assert wait_for(lambda: len(seen) >= 1)
+        # kill(), not stop(): stop() lets handlers write the terminal chunk
+        # (clean EOF — doesn't exercise this path); kill() severs the socket
+        # mid-stream so the client's chunked reader raises IncompleteRead.
+        srv.kill()
+        consumer.join(timeout=5.0)
+        assert not consumer.is_alive(), "watch consumer hung after server death"
+        assert errs == [], f"watch leaked an exception: {errs}"
+    finally:
+        watch.stop()
+
+
 # --- kubeconfig resolution ---------------------------------------------------
 
 def test_kubeconfig_parsing(tmp_path):

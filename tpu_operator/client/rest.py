@@ -24,6 +24,7 @@ Wire behavior:
 
 from __future__ import annotations
 
+import http.client
 import json
 import socket
 import ssl
@@ -110,8 +111,12 @@ class _StreamWatch:
                     except json.JSONDecodeError:
                         continue
                     yield event.get("type", ""), event.get("object", {})
-        except (OSError, ssl.SSLError, socket.timeout):
-            return  # stream torn down (stop() or server side); caller re-lists
+        except (OSError, ssl.SSLError, socket.timeout, http.client.HTTPException):
+            # Stream torn down — stop() shut the socket, or the server closed
+            # the chunked response mid-read (surfaces as IncompleteRead, an
+            # HTTPException, NOT an OSError). Either way this is a clean
+            # stream end: the reflector above re-lists and re-watches.
+            return
         finally:
             # Consumer-side close: safe here (same thread as the reader).
             try:

@@ -146,7 +146,21 @@ def put_global_batch(mesh: Mesh, *arrays: np.ndarray, spec: P = None):
     out = []
     multiprocess = jax.process_count() > 1
     for arr in arrays:
-        if multiprocess:
+        if isinstance(arr, jax.Array) and arr.sharding == sharding:
+            # Already placed exactly as requested (e.g. bench.py pre-stages
+            # batches in HBM and cycles them back through the train loop):
+            # pass through — re-placing is wasted transfer, and
+            # make_array_from_process_local_data would reject it.
+            out.append(arr)
+        elif isinstance(arr, jax.Array) and not arr.is_fully_addressable:
+            # A global array we cannot re-place from here (this process only
+            # holds its shards) whose layout is NOT the requested one:
+            # passing it through would silently train on the wrong
+            # partitioning, so fail loudly instead.
+            raise ValueError(
+                f"put_global_batch: global array sharded {arr.sharding} "
+                f"cannot be re-placed to requested {sharding}")
+        elif multiprocess:
             out.append(jax.make_array_from_process_local_data(
                 sharding, arr, global_shape=arr.shape))
         else:

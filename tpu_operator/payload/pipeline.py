@@ -285,10 +285,14 @@ def build(args, mesh=None, num_slices: int = 1):
     mesh = mesh or make_pipe_mesh(pipeline=args.pipeline,
                                   num_slices=num_slices)
     data_shards = mesh.shape["data"]
-    if args.batch % (data_shards * args.microbatches) != 0:
+    grad_accum = getattr(args, "grad_accum", 1)
+    if args.batch % (data_shards * args.microbatches * grad_accum) != 0:
+        # grad_accum divides the batch before the loss_fn sees it, so it
+        # belongs in the divisibility check: failing here beats a trace-time
+        # shape error inside pipeline_apply.
         raise ValueError(
             f"--batch {args.batch} must divide by data shards × microbatches "
-            f"({data_shards} × {args.microbatches})")
+            f"× grad_accum ({data_shards} × {args.microbatches} × {grad_accum})")
     stage, params = _init_params(args, mesh, jax.random.key(args.seed))
     tx = optax.adam(args.lr)
     state = train.TrainState(

@@ -449,9 +449,15 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 log_fn(i + 1, jax.device_get(metrics))
     finally:
         bootstrap_mod.exit_step_loop()
-    if tracing:
-        jax.device_get(metrics)
-        jax.profiler.stop_trace()
+        if tracing:
+            # Close the trace on EVERY exit path — normal completion with the
+            # window open, SIGTERM drain (SystemExit above), or a step error —
+            # so the captured window is flushed instead of lost/corrupt.
+            try:
+                jax.device_get(metrics)  # drain async work into the trace
+            except Exception:  # noqa: BLE001 — device poisoned; still close
+                pass
+            jax.profiler.stop_trace()
     if checkpointer is not None and steps > start:
         checkpointer.save(steps, state)
     return state, (jax.device_get(metrics) if metrics else {})

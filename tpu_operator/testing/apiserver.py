@@ -18,6 +18,7 @@ from __future__ import annotations
 
 import json
 import logging
+import socket
 import threading
 import urllib.parse
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -180,12 +181,28 @@ class _Handler(BaseHTTPRequestHandler):
             self._send_error(e)
 
 
+class _TrackingHTTPServer(ThreadingHTTPServer):
+    """ThreadingHTTPServer that remembers accepted sockets so the harness can
+    sever them abruptly (kill()) — a clean shutdown() ends chunked watch
+    streams with the terminal 0-chunk, which never exercises the client's
+    torn-stream (IncompleteRead) path."""
+
+    def __init__(self, *args: Any, **kwargs: Any):
+        super().__init__(*args, **kwargs)
+        self.client_socks: list = []
+
+    def get_request(self):
+        sock, addr = super().get_request()
+        self.client_socks.append(sock)
+        return sock, addr
+
+
 class ApiServerHarness:
     """Lifecycle wrapper: ``with ApiServerHarness() as srv: srv.url ...``"""
 
     def __init__(self, host: str = "127.0.0.1", port: int = 0):
         self.clientset = FakeClientset()
-        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd = _TrackingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         # Never join handler threads on close: a handler can be parked inside
         # a quiet watch stream; close_watches() unblocks them, but shutdown
@@ -209,6 +226,27 @@ class ApiServerHarness:
 
     def stop(self) -> None:
         self.clientset.close_watches()  # end live streams → handlers exit
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        if self._thread:
+            self._thread.join(timeout=5.0)
+
+    def kill(self) -> None:
+        """Simulate hard apiserver death: sever every accepted connection
+        WITHOUT the clean chunked-stream terminator, so open watches see a
+        mid-protocol EOF (http.client.IncompleteRead on the consumer side).
+        This is the failure mode a real apiserver restart/LB reset produces;
+        stop() can't reproduce it because close_watches() lets handlers
+        finish their streams cleanly."""
+        for sock in self._httpd.client_socks:
+            try:
+                # shutdown(), not close(): the handler's rfile/wfile makefile
+                # objects hold io-refs, so close() would only drop a refcount
+                # without sending FIN; shutdown() tears the TCP stream down
+                # immediately regardless.
+                sock.shutdown(socket.SHUT_RDWR)
+            except OSError:
+                pass
         self._httpd.shutdown()
         self._httpd.server_close()
         if self._thread:
