@@ -118,6 +118,11 @@ def check_pyproject_lockstep() -> list[str]:
             failures.append(
                 f"pin drift: pyproject payload extra has {name}=={ver} but "
                 f"payload image requirements.txt has {img.get(name, 'nothing')}")
+    for name, ver in img.items():
+        if name not in extra:
+            failures.append(
+                f"pin drift: payload image requirements.txt has {name}=={ver} "
+                f"but the pyproject payload extra omits it")
     return failures
 
 

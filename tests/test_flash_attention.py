@@ -85,6 +85,52 @@ def test_ring_attention_with_pallas_kernel_matches_reference():
                                rtol=2e-5, atol=2e-5)
 
 
+@pytest.mark.parametrize("use_pallas", [True, False])
+def test_ring_backward_matches_reference(use_pallas):
+    """The backward ring (custom VJP rotating dK/dV accumulators) against
+    dense-attention autodiff — with the flash-backward kernels in interpret
+    mode (True) and the jnp tile math (False)."""
+    from tpu_operator.payload.transformer import make_lm_mesh
+
+    mesh = make_lm_mesh(4, seq_parallel=2)
+    q, k, v = qkv(b=2, t=256, h=2, d=64)
+
+    def loss_ring(q, k, v):
+        out = ring.ring_attention(q, k, v, mesh, causal=True,
+                                  use_pallas=use_pallas)
+        return jnp.sum(out.astype(jnp.float32) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(
+            ring.reference_attention(q, k, v, causal=True) ** 2)
+
+    got = jax.grad(loss_ring, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_fully_masked_rows_have_zero_gradient():
+    """A query block entirely before every key (causal): out = 0 and all
+    gradients must be exactly 0 (the L = 0 guard in _logsumexp_rows keeps
+    the backward P = exp(NEG_INF - 0) = 0, not NaN)."""
+    q, k, v = qkv(t=128)
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, h, t, d = qt.shape
+    L = jnp.zeros((b, h, t, 1), jnp.float32)
+    D = jnp.zeros((b, h, t, 1), jnp.float32)
+    g = jnp.ones_like(qt)
+    for use_pallas in (False, True):
+        dq, dk, dv = fa.attention_block_grads(
+            qt, kt, vt, g, L, D, jnp.array([0, 10_000], jnp.int32),
+            causal=True, use_pallas=use_pallas)
+        for name, grad in (("dq", dq), ("dk", dk), ("dv", dv)):
+            assert np.all(np.asarray(grad) == 0.0), (use_pallas, name)
+
+
 def test_fully_masked_rows_are_zero():
     """Queries positioned entirely before every key (causal) must produce
     exactly 0, not mean(V) — the m-based finalize guard."""

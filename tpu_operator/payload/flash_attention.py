@@ -18,11 +18,27 @@ from ``broadcasted_iota`` (2D, as TPU requires). Global sequence offsets
 arrive as scalar-prefetch values so one compiled kernel serves every ring
 step (the offsets are traced, not baked into the grid).
 
-Differentiation: the kernel is forward-only; a ``jax.custom_vjp`` recomputes
-the identical merge in plain jnp for the backward pass (`_merge_ref`) and
-differentiates that — same FLOPs as the pre-kernel backward, so training
-keeps working while the forward gets the fused path. On non-TPU backends the
-kernel runs in interpret mode (tests) or falls back to `_merge_ref`.
+Differentiation — fully fused, both directions:
+
+- :func:`flash_attention` (the single-shard path every payload calls) is a
+  whole-attention ``jax.custom_vjp``: the forward saves only (q, k, v, out,
+  L) where ``L = m + log l`` is the per-row logsumexp, and the backward runs
+  two Pallas kernels (`_bwd_dq_kernel`, `_bwd_dkv_kernel`) implementing the
+  standard flash-attention backward recurrence (Dao et al. 2022): recompute
+  the score tile in VMEM from Q/K and L, form ``dS = P * (dP - D)`` with
+  ``D = rowsum(dO * O)``, and accumulate dQ / dK / dV — the [T, T] score
+  and probability tensors never exist in HBM in either direction. (The
+  pre-round-2 backward differentiated the jnp merge, which materialized
+  the f32 [B, H, T, T] scores — 4.3 GB at B=16 H=16 T=2048, an HBM OOM
+  and the dominant bandwidth cost of training steps.)
+- :func:`merge_kv_block` (the ring building block) keeps its per-merge
+  custom VJP for standalone use; ring_attention.py now differentiates at
+  the ring level instead (a backward ring over the same two kernels via
+  :func:`attention_block_grads`), so the carry-threaded merge backward is
+  off the training hot path.
+
+On non-TPU backends the kernels run in interpret mode (tests) or fall back
+to the plain-jnp reference math.
 """
 
 from __future__ import annotations
@@ -238,6 +254,237 @@ def use_pallas_default() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# --- fused backward ------------------------------------------------------------
+#
+# The flash backward needs, per (q-block, k-block) tile pair, only the VMEM
+# recomputation of that tile's scores:  S = scale QK^T,  P = exp(S - L),
+# dV += P^T dO,  dP = dO V^T,  dS = P (dP - D),  dQ += scale dS K,
+# dK += scale dS^T Q,  with L the forward's row logsumexp and
+# D = rowsum(dO * O) precomputed per row. Two kernels split the work by
+# which accumulator can stay VMEM-resident: dQ tiles accumulate over k
+# (k innermost in the grid), dK/dV tiles over q (q innermost).
+
+
+def _logsumexp_rows(l: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
+    """Per-row logsumexp from the streaming carry, [B,H,T,1] f32. Rows that
+    never saw an unmasked key (m still NEG_INF) get L = 0: their backward
+    tiles then compute P = exp(NEG_INF - 0) = 0 instead of NaN."""
+    return jnp.where(m > NEG_INF / 2,
+                     m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
+
+
+def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
+                   causal: bool, scale: float):
+    """The shared per-tile backward recurrence: recompute this tile's
+    probabilities from Q/K and the forward's logsumexp, then
+    dS = P (dP - D). Both backward kernels build their accumulations from
+    this one definition so the recurrence cannot desynchronize between
+    dQ and dK/dV."""
+    q = q_ref[0, 0].astype(jnp.float32)
+    k_blk = k_ref[0, 0].astype(jnp.float32)
+    v_blk = v_ref[0, 0].astype(jnp.float32)
+    g = g_ref[0, 0].astype(jnp.float32)
+    blk_q, blk_k = q.shape[0], k_blk.shape[0]
+    s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
+                        preferred_element_type=jnp.float32) * scale
+    if causal:
+        q_pos = q_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+    p = jnp.exp(s - L_ref[0, 0])                          # [blk_q, blk_k]
+    dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
+                         preferred_element_type=jnp.float32)
+    ds = p * (dp - D_ref[0, 0])
+    return q, k_blk, g, p, ds
+
+
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
+                   dq_out, *, causal: bool, scale: float):
+    """dQ for one (batch, head, q-block) — k-tiles innermost so the dq
+    output block revisits its index and accumulates in VMEM."""
+    blk_q = q_ref.shape[2]
+    blk_k = k_ref.shape[2]
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    q_lo = offs_ref[0] + iq * blk_q
+    k_lo = offs_ref[1] + ik * blk_k
+
+    @pl.when(ik == 0)
+    def _zero():
+        dq_out[...] = jnp.zeros_like(dq_out)
+
+    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    def _acc():
+        _q, k_blk, _g, _p, ds = _bwd_tile_p_ds(
+            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
+            causal, scale)
+        dq_out[0, 0] += scale * lax.dot_general(
+            ds, k_blk, (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
+                    dk_out, dv_out, *, causal: bool, scale: float):
+    """dK/dV for one (batch, head, k-block) — q-tiles innermost so both
+    output blocks accumulate in VMEM."""
+    blk_q = q_ref.shape[2]
+    blk_k = k_ref.shape[2]
+    ik = pl.program_id(2)
+    iq = pl.program_id(3)
+    q_lo = offs_ref[0] + iq * blk_q
+    k_lo = offs_ref[1] + ik * blk_k
+
+    @pl.when(iq == 0)
+    def _zero():
+        dk_out[...] = jnp.zeros_like(dk_out)
+        dv_out[...] = jnp.zeros_like(dv_out)
+
+    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    def _acc():
+        q, _k, g, p, ds = _bwd_tile_p_ds(
+            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
+            causal, scale)
+        # dV += P^T dO
+        dv_out[0, 0] += lax.dot_general(
+            p, g, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        # dK += dS^T Q
+        dk_out[0, 0] += scale * lax.dot_general(
+            ds, q, (((0,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+
+
+def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
+    b, h, tq, d = q.shape
+    tk = k.shape[2]
+    blk_q = _pick_block(tq)
+    blk_k = _pick_block(tk)
+    scale = d ** -0.5
+
+    def q_map(ib, ih, iq, ik, offs):
+        return (ib, ih, iq, 0)
+
+    def k_map(ib, ih, iq, ik, offs):
+        return (ib, ih, ik, 0)
+
+    q_spec = pl.BlockSpec((1, 1, blk_q, d), q_map)
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), k_map)
+    row_spec = pl.BlockSpec((1, 1, blk_q, 1), q_map)
+
+    dq = pl.pallas_call(
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, tq // blk_q, tk // blk_k),
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            out_specs=[q_spec],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(q.shape, jnp.float32)],
+        interpret=interpret,
+    )(offsets, q, k, v, g, L, D)[0]
+
+    # dkv grid transposes the block roles: k-blocks outer, q-tiles inner.
+    def qT_map(ib, ih, ik, iq, offs):
+        return (ib, ih, iq, 0)
+
+    def kT_map(ib, ih, ik, iq, offs):
+        return (ib, ih, ik, 0)
+
+    qT_spec = pl.BlockSpec((1, 1, blk_q, d), qT_map)
+    kvT_spec = pl.BlockSpec((1, 1, blk_k, d), kT_map)
+    rowT_spec = pl.BlockSpec((1, 1, blk_q, 1), qT_map)
+
+    dk, dv = pl.pallas_call(
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, h, tk // blk_k, tq // blk_q),
+            in_specs=[qT_spec, kvT_spec, kvT_spec, qT_spec, rowT_spec,
+                      rowT_spec],
+            out_specs=[kvT_spec, kvT_spec],
+        ),
+        out_shape=[jax.ShapeDtypeStruct(k.shape, jnp.float32),
+                   jax.ShapeDtypeStruct(v.shape, jnp.float32)],
+        interpret=interpret,
+    )(offsets, q, k, v, g, L, D)
+    return dq, dk, dv
+
+
+def _bwd_ref(q, k, v, g, L, D, offsets, causal: bool):
+    """The same tile math in plain jnp (CPU fallback / infeasible shapes);
+    materializes this block pair's scores, which is fine at test sizes."""
+    scale = q.shape[-1] ** -0.5
+    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
+                   k.astype(jnp.float32)) * scale
+    if causal:
+        q_pos = offsets[0] + jnp.arange(q.shape[2], dtype=jnp.int32)
+        k_pos = offsets[1] + jnp.arange(k.shape[2], dtype=jnp.int32)
+        s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    p = jnp.exp(s - L)
+    g32 = g.astype(jnp.float32)
+    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
+    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
+    ds = p * (dp - D)
+    dq = scale * jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
+    dk = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
+    return dq, dk, dv
+
+
+def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
+                          use_pallas: Optional[bool] = None):
+    """(dq, dk, dv) f32 contributions of one K/V block to the gradients,
+    given the *global* row logsumexp ``L`` and ``D = rowsum(dO * O)`` —
+    the building block of both the single-shard fused backward and the
+    backward ring (ring_attention.py). All blocks [B, H, T, D]."""
+    offsets = offsets.astype(jnp.int32)
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas and not (_kernel_feasible(q.shape[2])
+                           and _kernel_feasible(k.shape[2])):
+        use_pallas = False
+    if not use_pallas:
+        return _bwd_ref(q, k, v, g, L, D, offsets, causal)
+    interpret = jax.default_backend() != "tpu"
+    return _bwd_pallas(q, k, v, g, L, D, offsets, causal, interpret)
+
+
+def _attn_impl(causal, use_pallas, q, k, v):
+    b, h, t, d = q.shape
+    carry = init_carry(b, h, t, d)
+    offsets = jnp.zeros((2,), jnp.int32)
+    o, l, m = [None] * 3
+    if use_pallas:
+        interpret = jax.default_backend() != "tpu"
+        o, l, m = _merge_pallas(q, k, v, *carry, offsets, causal, interpret)
+    else:
+        o, l, m = _merge_ref(q, k, v, *carry, offsets, causal)
+    return finalize((o, l, m), q.dtype), _logsumexp_rows(l, m)
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1))
+def _attn(causal: bool, use_pallas: bool, q, k, v):
+    out, _L = _attn_impl(causal, use_pallas, q, k, v)
+    return out
+
+
+def _attn_fwd(causal, use_pallas, q, k, v):
+    out, L = _attn_impl(causal, use_pallas, q, k, v)
+    return out, (q, k, v, out, L)
+
+
+def _attn_bwd(causal, use_pallas, residuals, g):
+    q, k, v, out, L = residuals
+    D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True)
+    dq, dk, dv = attention_block_grads(
+        q, k, v, g, L, D, jnp.zeros((2,), jnp.int32),
+        causal=causal, use_pallas=use_pallas)
+    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+
+
+_attn.defvjp(_attn_fwd, _attn_bwd)
+
+
 def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    carry: Carry, offsets: jnp.ndarray, *, causal: bool = True,
                    use_pallas: Optional[bool] = None) -> Carry:
@@ -267,14 +514,17 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True,
                     use_pallas: Optional[bool] = None) -> jnp.ndarray:
     """Single-device exact attention, [B, T, H, D] in/out — the fused
-    counterpart of ring_attention.reference_attention."""
+    counterpart of ring_attention.reference_attention. Forward and backward
+    both run as Pallas kernels (module docstring): O(T) memory in either
+    direction, so this is the path that makes 8k-32k contexts trainable on
+    one chip."""
     qt = jnp.einsum("bqhd->bhqd", q)
     kt = jnp.einsum("bkhd->bhkd", k)
     vt = jnp.einsum("bkhd->bhkd", v)
-    b, h, tq, d = qt.shape
-    carry = init_carry(b, h, tq, d)
-    offsets = jnp.zeros((2,), jnp.int32)
-    carry = merge_kv_block(qt, kt, vt, carry, offsets, causal=causal,
-                           use_pallas=use_pallas)
-    out = finalize(carry, q.dtype)
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas and not (_kernel_feasible(qt.shape[2])
+                           and _kernel_feasible(kt.shape[2])):
+        use_pallas = False
+    out = _attn(causal, use_pallas, qt, kt, vt)
     return jnp.einsum("bhqd->bqhd", out)

@@ -57,30 +57,29 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
-def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
-                          axis_name: str, causal: bool,
-                          use_pallas: bool) -> jnp.ndarray:
-    """The per-shard body (runs inside shard_map): q stays resident, k/v
-    rotate; a streaming softmax merges each visiting block. The per-block
-    merge is the flash-attention recurrence — the fused Pallas kernel on
-    TPU (payload/flash_attention.py), plain jnp otherwise."""
-    from tpu_operator.payload import flash_attention as fa
-
-    axis_size = lax.psum(1, axis_name)
+def _ring_offsets_fn(axis_name, tq, tk):
+    """(idx, kv_idx) → global [q_offset, k_offset] int32 pair for a shard's
+    resident queries against the block that started life on shard kv_idx."""
     idx = lax.axis_index(axis_name)
-    b, tq, h, d = q.shape
-    tk = k.shape[1]
-
-    # [B,H,T,D]: D on lanes, the kernel's (and the MXU's) native layout.
-    qt = jnp.einsum("bqhd->bhqd", q)
-    kt = jnp.einsum("bkhd->bhkd", k)
-    vt = jnp.einsum("bkhd->bhkd", v)
-
     q_offset = (idx * tq).astype(jnp.int32)
 
     def offsets(kv_idx):
         return jnp.stack([q_offset, (kv_idx * tk).astype(jnp.int32)])
 
+    return idx, offsets
+
+
+def _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas):
+    """Forward ring: q resident, K/V rotate on neighbor ppermutes, each
+    visit folded by the fused streaming-softmax merge. Returns the raw
+    carry so callers can also extract the row logsumexp for the backward
+    ring."""
+    from tpu_operator.payload import flash_attention as fa
+
+    axis_size = lax.psum(1, axis_name)
+    b, h, tq, d = qt.shape
+    tk = kt.shape[2]
+    idx, offsets = _ring_offsets_fn(axis_name, tq, tk)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     # Resident block first, then rotate: exactly axis_size - 1 ppermute
@@ -103,7 +102,90 @@ def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
 
     (o, l, m, _k, _v), _ = lax.scan(
         step, (*carry, kt, vt), jnp.arange(1, axis_size))
-    out = fa.finalize((o, l, m), q.dtype)
+    return o, l, m
+
+
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+def _ring_local_attn(axis_name: str, causal: bool, use_pallas: bool,
+                     qt, kt, vt):
+    """Per-shard ring attention in [B,H,T,D] layout (runs inside shard_map),
+    differentiated by a *backward ring* (defvjp below) instead of autodiff
+    through the forward scan: the forward saves only (q, k, v, out, L) —
+    O(T/N) per shard — and the backward rotates K/V (plus their gradient
+    accumulators) around the ring again, computing each block pair's
+    contribution with the fused flash-backward kernels
+    (flash_attention.attention_block_grads). Neither direction materializes
+    a score tensor in HBM, and backward communication stays neighbor-only
+    ppermutes like the forward."""
+    from tpu_operator.payload import flash_attention as fa
+
+    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas)
+    return fa.finalize((o, l, m), qt.dtype)
+
+
+def _ring_local_fwd(axis_name, causal, use_pallas, qt, kt, vt):
+    from tpu_operator.payload import flash_attention as fa
+
+    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas)
+    out = fa.finalize((o, l, m), qt.dtype)
+    return out, (qt, kt, vt, out, fa._logsumexp_rows(l, m))
+
+
+def _ring_local_bwd(axis_name, causal, use_pallas, residuals, g):
+    from tpu_operator.payload import flash_attention as fa
+
+    qt, kt, vt, out, L = residuals
+    axis_size = lax.psum(1, axis_name)
+    tq, tk = qt.shape[2], kt.shape[2]
+    idx, offsets = _ring_offsets_fn(axis_name, tq, tk)
+    perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                axis=-1, keepdims=True)
+
+    def block_grads(k_cur, v_cur, kv_idx):
+        return fa.attention_block_grads(qt, k_cur, v_cur, g, L, D,
+                                        offsets(kv_idx), causal=causal,
+                                        use_pallas=use_pallas)
+
+    # Home block first (mirrors the forward), then rotate K/V together
+    # with their f32 gradient accumulators so each block's dK/dV ride
+    # along with it around the ring.
+    dq, dk, dv = block_grads(kt, vt, idx)
+
+    def step(state, i):
+        dq, k_cur, v_cur, dk_cur, dv_cur = state
+        k_cur = lax.ppermute(k_cur, axis_name, perm)
+        v_cur = lax.ppermute(v_cur, axis_name, perm)
+        dk_cur = lax.ppermute(dk_cur, axis_name, perm)
+        dv_cur = lax.ppermute(dv_cur, axis_name, perm)
+        kv_idx = (idx - i) % axis_size
+        dq_b, dk_b, dv_b = block_grads(k_cur, v_cur, kv_idx)
+        return (dq + dq_b, k_cur, v_cur, dk_cur + dk_b, dv_cur + dv_b), None
+
+    (dq, _k, _v, dk, dv), _ = lax.scan(
+        step, (dq, kt, vt, dk, dv), jnp.arange(1, axis_size))
+
+    # After axis_size - 1 rotations a block (and its accumulated gradient)
+    # sits one hop short of its home shard: one final ppermute closes the
+    # ring.
+    dk = lax.ppermute(dk, axis_name, perm)
+    dv = lax.ppermute(dv, axis_name, perm)
+    return dq.astype(qt.dtype), dk.astype(kt.dtype), dv.astype(vt.dtype)
+
+
+_ring_local_attn.defvjp(_ring_local_fwd, _ring_local_bwd)
+
+
+def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                          axis_name: str, causal: bool,
+                          use_pallas: bool) -> jnp.ndarray:
+    """The per-shard body (runs inside shard_map): transpose to the kernel's
+    [B,H,T,D] layout, run the ring (custom-VJP'd — see _ring_local_attn),
+    transpose back."""
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    out = _ring_local_attn(axis_name, causal, use_pallas, qt, kt, vt)
     return jnp.einsum("bhqd->bqhd", out)
 
 
