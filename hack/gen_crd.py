@@ -1,0 +1,130 @@
+#!/usr/bin/env python3
+"""Render the TPUJob CRD manifests from the structural schema source of
+truth (tpu_operator/apis/tpujob/v1alpha1/schema.py) into
+
+    examples/crd.yml
+    deploy/chart/tpu-job-operator-chart/templates/crd.yaml  (Helm-wrapped)
+
+Run with ``--check`` (hack/verify.sh does) to fail on drift instead of
+writing — the schema-in-code and the YAML on disk can then never diverge,
+the same guarantee the reference got from hack/verify-codegen.sh for its
+generated clients.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+
+import yaml
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod  # noqa: E402
+
+HEADER = """\
+# TPUJob CustomResourceDefinition.
+#
+# Reference parity: examples/crd.yml:1-11 (the reference registers an
+# apiextensions/v1beta1 CRD for mxjobs.fioravanzo.org). This is the modern
+# apiextensions/v1 equivalent for tpujobs.tpuoperator.dev with a structural
+# openAPIV3Schema GENERATED from tpu_operator/apis/tpujob/v1alpha1/schema.py
+# by hack/gen_crd.py — do not edit the schema here. The PodTemplateSpec
+# subtree stays permissive (x-kubernetes-preserve-unknown-fields), keeping
+# the reference's "don't hide Kubernetes" passthrough; everything else is
+# typed, enum-bounded, and unknown-field-free.
+"""
+
+CHART_HEADER = """\
+# Reference parity: build/chart/mx-job-operator-chart/templates/crd.yaml
+# Schema GENERATED from tpu_operator/apis/tpujob/v1alpha1/schema.py by
+# hack/gen_crd.py — do not edit the schema here (hack/verify.sh checks
+# drift).
+"""
+
+
+def crd_dict() -> dict:
+    return {
+        "apiVersion": "apiextensions.k8s.io/v1",
+        "kind": "CustomResourceDefinition",
+        "metadata": {"name": "tpujobs.tpuoperator.dev"},
+        "spec": {
+            "group": "tpuoperator.dev",
+            "scope": "Namespaced",
+            "names": {
+                "kind": "TPUJob",
+                "singular": "tpujob",
+                "plural": "tpujobs",
+                "shortNames": ["tj"],
+            },
+            "versions": [{
+                "name": "v1alpha1",
+                "served": True,
+                "storage": True,
+                "schema": {
+                    "openAPIV3Schema":
+                        schema_mod.tpujob_openapi_v3_schema(),
+                },
+                "subresources": {"status": {}},
+                "additionalPrinterColumns": [
+                    {"name": "Phase", "type": "string",
+                     "jsonPath": ".status.phase"},
+                    {"name": "State", "type": "string",
+                     "jsonPath": ".status.state"},
+                    {"name": "Attempt", "type": "integer",
+                     "jsonPath": ".status.attempt"},
+                    {"name": "Age", "type": "date",
+                     "jsonPath": ".metadata.creationTimestamp"},
+                ],
+            }],
+        },
+    }
+
+
+def render_example() -> str:
+    return HEADER + yaml.safe_dump(crd_dict(), sort_keys=False,
+                                   default_flow_style=False)
+
+
+def render_chart() -> str:
+    body = yaml.safe_dump(crd_dict(), sort_keys=False,
+                          default_flow_style=False)
+    return (CHART_HEADER + "{{- if .Values.crd.install }}\n" + body
+            + "{{- end }}\n")
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--check", action="store_true",
+                   help="fail on drift instead of writing")
+    args = p.parse_args()
+
+    targets = {
+        os.path.join(REPO, "examples/crd.yml"): render_example(),
+        os.path.join(REPO, "deploy/chart/tpu-job-operator-chart/templates/"
+                           "crd.yaml"): render_chart(),
+    }
+    drifted = []
+    for path, want in targets.items():
+        have = open(path).read() if os.path.exists(path) else ""
+        if have != want:
+            if args.check:
+                drifted.append(path)
+            else:
+                with open(path, "w") as f:
+                    f.write(want)
+                print(f"gen_crd: wrote {os.path.relpath(path, REPO)}")
+    if drifted:
+        print("gen_crd: DRIFT — regenerate with `python hack/gen_crd.py`:")
+        for path in drifted:
+            print(f"  {os.path.relpath(path, REPO)}")
+        return 1
+    if args.check:
+        print("gen_crd: OK")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

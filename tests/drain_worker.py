@@ -1,0 +1,82 @@
+"""Subprocess worker for the coordinated multi-process drain test
+(tests/test_multiprocess_drain.py).
+
+Runs one process of a 2-process CPU jax.distributed group training the tiny
+transformer LM through the full bootstrap + train_loop path, with interval
+saves effectively disabled — the only checkpoint that can appear is the
+coordinated drain save, so the parent test can assert exactly which step
+every process agreed on.
+
+Usage: drain_worker.py <coordinator_port> <process_id> <num_processes>
+       <checkpoint_dir> <sentinel_dir>
+"""
+
+import faulthandler
+import os
+import signal
+import sys
+
+
+def main() -> None:
+    faulthandler.register(signal.SIGUSR1)  # debug: dump stacks when hung
+    port, pid, nprocs, ckpt_dir, sentinel_dir = sys.argv[1:6]
+    # Must be set before the first jax import: one local CPU device per
+    # process, and the bootstrap env contract this worker consumes.
+    os.environ.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_PROCESS_ID": pid,
+        "JAX_NUM_PROCESSES": nprocs,
+        "TPU_WORKER_ID": pid,
+    })
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    # A sitecustomize hook may have registered a real-accelerator PJRT
+    # plugin (and imported jax) at interpreter boot — before this main()
+    # ran. Backend *clients* are lazy, so overriding the platform config
+    # here still wins (same trick as tests/conftest.py).
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO,
+                        format="%(asctime)s %(levelname)s %(message)s")
+
+    from tpu_operator.payload import (bootstrap, checkpoint, train,
+                                      transformer)
+
+    def run(info: bootstrap.ProcessInfo) -> None:
+        args = transformer.parse_args([
+            "--batch", "4", "--seq-len", "32", "--dim", "16", "--heads",
+            "2", "--layers", "1", "--vocab", "64",
+        ])
+        mesh, _model, state, step, batches = transformer.build(args)
+        ckpt = checkpoint.Checkpointer(ckpt_dir, save_every=10 ** 9)
+        sentinel = os.path.join(sentinel_dir, f"stepping_{info.process_id}")
+
+        def log_fn(i, _metrics):
+            # First log interval: tell the parent we are in steady-state
+            # stepping (safe to deliver SIGTERM).
+            if not os.path.exists(sentinel):
+                with open(sentinel, "w") as f:
+                    f.write(str(i))
+
+        try:
+            # steps is effectively unbounded: this run only ends by drain.
+            train.train_loop(mesh, step, state, batches, steps=200_000,
+                             log_every=5, log_fn=log_fn,
+                             checkpointer=ckpt,
+                             spec=transformer.lm_token_spec(mesh))
+        finally:
+            ckpt.close()
+
+    sys.exit(bootstrap.run_payload(run))
+
+
+if __name__ == "__main__":
+    main()

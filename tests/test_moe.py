@@ -128,3 +128,82 @@ def test_build_validates_expert_divisibility():
     with pytest.raises(ValueError):
         moe.build(_args(experts=3, expert_parallel=4),
                   mesh=moe.make_moe_mesh(8, expert_parallel=4))
+
+
+@pytest.fixture(scope="module")
+def mesh_ep_tp():
+    # (data=2, expert=2, model=2): the composed EP × TP mesh.
+    return moe.make_moe_mesh(8, expert_parallel=2, tensor_parallel=2)
+
+
+def test_ep_tp_mesh_axes(mesh_ep_tp):
+    assert dict(zip(mesh_ep_tp.axis_names,
+                    mesh_ep_tp.devices.shape)) == {
+        "data": 2, "expert": 2, "model": 2}
+
+
+def test_ep_tp_loss_matches_unsharded(mesh_ep_tp):
+    # Same spec + seed on (data=2, expert=2, model=2) vs a single-device
+    # mesh: sharding is layout, not semantics.
+    args = _args(expert_parallel=2, tensor_parallel=2)
+    mesh1 = moe.make_moe_mesh(1, expert_parallel=1)
+    # split_qkv=on pins the same param tree (and init draws) on both
+    # sides; the TP build splits automatically, the unsharded one would
+    # default to the fused kernel.
+    _, _, s1, step1, batches = moe.build(
+        _args(expert_parallel=1, split_qkv="on"), mesh=mesh1)
+    _, _, s8, step8, _ = moe.build(args, mesh=mesh_ep_tp)
+
+    from tpu_operator.payload import data as data_mod
+
+    (tokens,) = next(batches)
+    (d1,) = data_mod.put_global_batch(mesh1, tokens)
+    (d8,) = data_mod.put_global_batch(mesh_ep_tp, tokens)
+    _, m1 = step1(s1, d1)
+    _, m8 = step8(s8, d8)
+    assert abs(float(m1["loss"]) - float(m8["loss"])) < 1e-4
+    assert abs(float(m1["aux_loss"]) - float(m8["aux_loss"])) < 1e-4
+
+
+def test_ep_tp_state_shardings(mesh_ep_tp):
+    # Expert FFNs shard (expert, ·, model)/(expert, model, ·); dense
+    # q/k/v column-parallel; routers replicate.
+    args = _args(expert_parallel=2, tensor_parallel=2)
+    _m, _model, state, _step, _b = moe.build(args, mesh=mesh_ep_tp)
+    shardings = moe.state_shardings(mesh_ep_tp, state)
+    flat = jax.tree_util.tree_flatten_with_path(shardings.params)[0]
+
+    def specs_for(key):
+        return [s.spec for path, s in flat
+                if any(getattr(p, "key", None) == key for p in path)]
+
+    assert all(s == ("expert", None, "model") for s in specs_for("w1"))
+    assert all(s == ("expert", "model", None) for s in specs_for("w2"))
+    assert all(s == (None, "model")
+               for s in specs_for("q")), specs_for("q")
+    assert all(s == ("model", None) for s in specs_for("attn_out"))
+    assert all(s == () for s in specs_for("router"))
+
+
+def test_ep_tp_loss_descends(mesh_ep_tp):
+    args = _args(batch=16, expert_parallel=2, tensor_parallel=2,
+                 log_every=0)
+    _mesh, _model, state, step, batches = moe.build(args, mesh=mesh_ep_tp)
+
+    from tpu_operator.payload import data as data_mod
+
+    losses = []
+    for _ in range(30):
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh_ep_tp, tokens)
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.7, losses[::5]
+
+
+def test_ep_tp_validates_head_divisibility():
+    with pytest.raises(ValueError, match="heads"):
+        moe.build(_args(heads=3, expert_parallel=2, tensor_parallel=2),
+                  mesh=moe.make_moe_mesh(8, expert_parallel=2,
+                                         tensor_parallel=2))

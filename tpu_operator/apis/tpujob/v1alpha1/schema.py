@@ -1,0 +1,218 @@
+"""Structural openAPIV3Schema for the TPUJob CRD, generated from types.py.
+
+The reference's CRD carried no schema at all (examples/crd.yml:1-11 — v1beta1
+CRDs predate structural schemas), and round 1 shipped
+``x-kubernetes-preserve-unknown-fields: true``, which let a typo'd field
+(``maxRestart:``) through to be silently defaulted by the operator. This
+module is the single source of truth for the structural schema:
+
+- ``deploy/chart/.../crd.yaml`` and ``examples/crd.yml`` embed it via
+  ``hack/gen_crd.py`` (``hack/verify.sh`` fails on drift);
+- the in-process test apiserver (tpu_operator/testing/apiserver.py)
+  validates every TPUJob create/update against it in *strict* mode —
+  unknown fields are rejected with 422, which is kubectl's
+  ``--validate=strict`` behavior and exactly what catches the typo case
+  (a real apiserver would prune instead, which still prevents the silent
+  defaulting but hides the mistake);
+- ``validate_strict`` below is that validator: types, enums, integer
+  bounds, and unknown-field rejection, with the PodTemplateSpec subtree
+  (``spec.replicaSpecs[].template``) deliberately permissive — we keep the
+  reference's "don't hide Kubernetes" passthrough (tf_job_design_doc.md:73),
+  and its schema belongs to the pod API, not this CRD.
+
+Enums and bounds mirror types.py/validation.py: replica types
+(TPUReplicaType.ALL), restart policies (RestartPolicy.ALL), phases/states
+for the status subresource, port 1-65535, non-negative counters.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, List, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1 import types
+
+
+def _str(**kw) -> Dict[str, Any]:
+    return {"type": "string", **kw}
+
+
+def _int(minimum=None, maximum=None) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"type": "integer"}
+    if minimum is not None:
+        out["minimum"] = minimum
+    if maximum is not None:
+        out["maximum"] = maximum
+    return out
+
+
+def _obj(properties: Dict[str, Any], required: List[str] = ()) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"type": "object", "properties": properties}
+    if required:
+        out["required"] = list(required)
+    return out
+
+
+def _arr(items: Dict[str, Any]) -> Dict[str, Any]:
+    return {"type": "array", "items": items}
+
+
+PRESERVE = {"type": "object", "x-kubernetes-preserve-unknown-fields": True}
+
+
+def replica_spec_schema() -> Dict[str, Any]:
+    return _obj({
+        "replicas": _int(minimum=1),
+        # PodTemplateSpec passthrough: schema'd by the pod API, not us.
+        "template": dict(PRESERVE),
+        # nullable: an explicit ``tpuPort: null`` is meaningful to
+        # validation (it must flag it, not default it).
+        "tpuPort": {"type": "integer", "minimum": 1, "maximum": 65535,
+                    "nullable": True},
+        "tpuReplicaType": _str(enum=list(types.TPUReplicaType.ALL)),
+    })
+
+
+def spec_schema() -> Dict[str, Any]:
+    return _obj({
+        "replicaSpecs": _arr(replica_spec_schema()),
+        "terminationPolicy": _obj({
+            "chief": _obj({
+                "replicaName": _str(enum=list(types.TPUReplicaType.ALL)),
+                "replicaIndex": _int(minimum=0),
+            }),
+        }),
+        "runtimeId": _str(),
+        "schedulerName": _str(),
+        "restartPolicy": _str(enum=list(types.RestartPolicy.ALL)),
+        "maxRestarts": _int(minimum=0),
+        "tpuTopology": _str(pattern=r"^\d+x\d+(x\d+)?$"),
+        "numSlices": _int(minimum=1),
+        "checkpointDir": _str(),
+        "profileDir": _str(),
+    }, required=["replicaSpecs"])
+
+
+def status_schema() -> Dict[str, Any]:
+    phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
+              types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
+              types.TPUJobPhase.FAILED, types.TPUJobPhase.DONE]
+    states = [types.State.UNKNOWN, types.State.RUNNING,
+              types.State.SUCCEEDED, types.State.FAILED]
+    replica_states = [types.ReplicaState.UNKNOWN, types.ReplicaState.STARTING,
+                      types.ReplicaState.RUNNING, types.ReplicaState.SUCCEEDED,
+                      types.ReplicaState.FAILED]
+    return _obj({
+        "phase": _str(enum=phases),
+        "reason": _str(),
+        "state": _str(enum=states),
+        "attempt": _int(minimum=0),
+        "replicaStatuses": _arr(_obj({
+            "tpuReplicaType": _str(enum=list(types.TPUReplicaType.ALL)),
+            "state": _str(enum=replica_states),
+            "replicasStates": {
+                "type": "object",
+                "additionalProperties": _int(minimum=0),
+            },
+        })),
+    })
+
+
+def tpujob_openapi_v3_schema() -> Dict[str, Any]:
+    """The CRD's versions[].schema.openAPIV3Schema value."""
+    return _obj({
+        "apiVersion": _str(),
+        "kind": _str(),
+        # ObjectMeta belongs to the apiserver; structural schemas leave it
+        # implicit (K8s rejects attempts to schema metadata beyond name/
+        # generateName).
+        "metadata": {"type": "object"},
+        "spec": spec_schema(),
+        "status": status_schema(),
+    }, required=["spec"])
+
+
+# --- strict validation (the test apiserver's admission path) -----------------
+
+class SchemaError(ValueError):
+    """One strict-validation failure, with a JSON-path-ish location."""
+
+
+def _fail(path: str, msg: str):
+    raise SchemaError(f"{path or '.'}: {msg}")
+
+
+def validate_strict(value: Any, schema: Dict[str, Any] = None,
+                    path: str = "") -> None:
+    """Validate ``value`` against ``schema`` (default: the full TPUJob
+    schema), *rejecting* unknown fields — kubectl --validate=strict
+    semantics, stricter than apiserver pruning, so tests catch typos."""
+    if schema is None:
+        schema = tpujob_openapi_v3_schema()
+
+    if value is None:
+        if schema.get("nullable"):
+            return
+        _fail(path, "null not allowed")
+
+    t = schema.get("type")
+    if t == "object":
+        if schema.get("x-kubernetes-preserve-unknown-fields"):
+            if not isinstance(value, dict):
+                _fail(path, f"expected object, got {type(value).__name__}")
+            return
+        if not isinstance(value, dict):
+            _fail(path, f"expected object, got {type(value).__name__}")
+        props = schema.get("properties")
+        addl = schema.get("additionalProperties")
+        if props is not None:
+            for key in value:
+                if key not in props:
+                    _fail(f"{path}.{key}", "unknown field")
+            for key in schema.get("required", ()):
+                if key not in value:
+                    _fail(f"{path}.{key}", "required field missing")
+            for key, sub in props.items():
+                if key in value:
+                    validate_strict(value[key], sub, f"{path}.{key}")
+        elif isinstance(addl, dict):
+            for key, v in value.items():
+                validate_strict(v, addl, f"{path}.{key}")
+        return
+    if t == "array":
+        if not isinstance(value, list):
+            _fail(path, f"expected array, got {type(value).__name__}")
+        for i, v in enumerate(value):
+            validate_strict(v, schema["items"], f"{path}[{i}]")
+        return
+    if t == "string":
+        if not isinstance(value, str):
+            _fail(path, f"expected string, got {type(value).__name__}")
+        enum = schema.get("enum")
+        if enum is not None and value not in enum:
+            _fail(path, f"{value!r} not one of {enum}")
+        pattern = schema.get("pattern")
+        if pattern is not None:
+            import re
+
+            if not re.match(pattern, value):
+                _fail(path, f"{value!r} does not match {pattern!r}")
+        return
+    if t == "integer":
+        if isinstance(value, bool) or not isinstance(value, int):
+            _fail(path, f"expected integer, got {type(value).__name__}")
+        lo, hi = schema.get("minimum"), schema.get("maximum")
+        if lo is not None and value < lo:
+            _fail(path, f"{value} < minimum {lo}")
+        if hi is not None and value > hi:
+            _fail(path, f"{value} > maximum {hi}")
+        return
+    _fail(path, f"unhandled schema type {t!r}")
+
+
+def validate_tpujob_strict(body: Dict[str, Any]) -> Tuple[bool, str]:
+    """(ok, message) for a TPUJob create/update body."""
+    try:
+        validate_strict(body)
+        return True, ""
+    except SchemaError as e:
+        return False, str(e)

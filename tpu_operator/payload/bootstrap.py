@@ -159,6 +159,14 @@ def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
     signal.signal(signal.SIGTERM, _sigterm)
     try:
         info = initialize()
+        # jax.distributed.initialize installs its own C++ SIGTERM handler
+        # (the preemption notifier, preemption_notifier.cc) which *replaces*
+        # the drain handler above. Left in place, SIGTERM would never set
+        # the drain latch; instead orbax's out-of-band preemption save path
+        # triggers and its finalize barrier deadlocks against the still-
+        # looping peers. Re-install ours so the operator's drain contract —
+        # agree on a boundary step, group-save, exit 143 — owns preemption.
+        signal.signal(signal.SIGTERM, _sigterm)
         fn(info)
         return 0
     except SystemExit as e:

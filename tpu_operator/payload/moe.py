@@ -49,7 +49,21 @@ def parse_args(argv=None):
                    help="experts per MoE layer (mesh expert axis must divide it)")
     p.add_argument("--expert-parallel", type=int, default=1,
                    help="expert-parallel shards (mesh expert axis size)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="Megatron-style TP shards (mesh model axis) "
+                        "*inside* each expert shard: expert FFNs w1/w2 "
+                        "column/row-parallel over their hidden dim, "
+                        "attention q/k/v/mlp dense layers sharded as in the "
+                        "dense transformer — composes with "
+                        "--expert-parallel on a 3-axis (data, expert, "
+                        "model) mesh")
     p.add_argument("--capacity-factor", type=float, default=1.25)
+    p.add_argument("--split-qkv", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="separate q/k/v projections (auto: on under "
+                        "--tensor-parallel so TP shards whole heads; the "
+                        "explicit values pin the param-tree layout, e.g. "
+                        "for parity tests or checkpoint compatibility)")
     p.add_argument("--aux-coef", type=float, default=1e-2,
                    help="load-balance auxiliary loss coefficient")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16")
@@ -72,12 +86,23 @@ def parse_args(argv=None):
 
 
 def make_moe_mesh(num_devices: Optional[int] = None, expert_parallel: int = 1,
-                  devices: Optional[list] = None, num_slices: int = 1):
+                  devices: Optional[list] = None, num_slices: int = 1,
+                  tensor_parallel: int = 1):
     """(data, expert) mesh: DP outer, expert-parallel inner — the dispatch
     all-to-all stays within each expert group's adjacent ICI links
-    (multi-slice jobs keep every expert group within a slice)."""
+    (multi-slice jobs keep every expert group within a slice).
+
+    ``tensor_parallel > 1`` composes EP × TP on a 3-axis (data, expert,
+    model) mesh reusing train.make_mesh3's layout and intra-slice guard: TP
+    innermost (its psums fire per expert matmul — shortest ICI hops), the
+    expert all-to-all around it, DP outermost / across DCN."""
     from tpu_operator.payload import train
 
+    if tensor_parallel > 1:
+        return train.make_mesh3(num_devices, seq_parallel=expert_parallel,
+                                model_parallel=tensor_parallel,
+                                devices=devices, num_slices=num_slices,
+                                axis_names=("data", "expert", "model"))
     return train.make_mesh(num_devices, model_parallel=expert_parallel,
                            devices=devices, axis_names=("data", "expert"),
                            num_slices=num_slices)
@@ -177,6 +202,14 @@ def _moe_mlp_class(mesh, dtype):
                     expert_in, NamedSharding(mesh, P("expert", "data")))
                 h = jnp.einsum("egcd,edf->egcf", expert_in,
                                w1.astype(dtype))
+                if "model" in mesh.shape and mesh.shape["model"] > 1:
+                    # EP × TP: inside each expert shard the hidden dim is
+                    # column-parallel over ``model`` (w1 P(E,·,model)); pin
+                    # it so gelu runs sharded and only w2's row-parallel
+                    # product gets the one psum per layer.
+                    h = jax.lax.with_sharding_constraint(
+                        h, NamedSharding(mesh,
+                                         P("expert", "data", None, "model")))
                 h = nn.gelu(h)
                 expert_out = jnp.einsum("egcf,efd->egcd", h, w2.astype(dtype))
                 expert_out = jax.lax.with_sharding_constraint(
@@ -201,6 +234,16 @@ def _build_model(args, mesh):
         raise ValueError(
             f"--experts {args.experts} not divisible by the mesh expert "
             f"axis ({mesh.shape['expert']})")
+    tp = mesh.shape.get("model", 1)
+    if tp > 1:
+        if args.heads % tp != 0:
+            raise ValueError(
+                f"--heads {args.heads} must divide by --tensor-parallel "
+                f"{tp} (TP shards whole heads)")
+        if (4 * args.dim) % tp != 0:
+            raise ValueError(
+                f"FFN hidden {4 * args.dim} must divide by "
+                f"--tensor-parallel {tp}")
 
     def attend(q, k, v):
         if dtype == jnp.bfloat16 and fa.use_pallas_default():
@@ -210,6 +253,11 @@ def _build_model(args, mesh):
     MoEMLP = _moe_mlp_class(mesh, dtype)
     Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
              else models.DecoderBlock)
+    # Under TP, split q/k/v so each model shard owns whole heads
+    # (transformer.py's rule — a fused [d,3d] kernel's contiguous column
+    # shards would straddle the q/k/v thirds).
+    mode = getattr(args, "split_qkv", "auto")
+    split_qkv = mode == "on" or (mode == "auto" and tp > 1)
 
     def moe_mlp(name):
         return MoEMLP(dim=args.dim, experts=args.experts,
@@ -236,7 +284,7 @@ def _build_model(args, mesh):
                 # overflow capacity.
                 mlp = moe_mlp if i % 2 == 1 else None
                 x = Block(self.dim, self.heads, attend,
-                          dtype=dtype, mlp=mlp,
+                          dtype=dtype, mlp=mlp, split_qkv=split_qkv,
                           name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=dtype,
@@ -249,12 +297,39 @@ def _build_model(args, mesh):
 def state_shardings(mesh, state):
     """Expert weight stacks (w1/w2 under a ``moe`` path, and their
     params-shaped adam moments) shard their leading E dim over ``expert``;
-    everything else replicates."""
+    everything else replicates.
+
+    On an EP × TP mesh (``model`` axis present) the expert FFNs
+    additionally shard their hidden dim over ``model`` — w1 [E, D, 4D]
+    column-parallel, w2 [E, 4D, D] row-parallel, the Megatron pairing whose
+    products GSPMD psums once per layer — and the dense attention/MLP
+    kernels follow transformer.py's TP rule (split q/k/v column-parallel,
+    attn_out/mlp_down row-parallel, lm_head over vocab). Routers stay
+    replicated: routing is per-token f32 math every shard needs."""
+    from jax.sharding import PartitionSpec as P
+
     from tpu_operator.payload import train
 
-    return train.leading_axis_shardings(
-        mesh, state, "expert",
-        lambda keys: "moe" in keys and keys[-1] in ("w1", "w2"))
+    tp = "model" in mesh.shape and mesh.shape["model"] > 1
+    col = ("q", "k", "v", "qkv", "mlp_up", "lm_head")
+    row = ("attn_out", "mlp_down")
+
+    def rule(keys, leaf):
+        if "moe" in keys and keys[-1] in ("w1", "w2") \
+                and getattr(leaf, "ndim", 0) >= 1:
+            if tp and getattr(leaf, "ndim", 0) == 3:
+                return (P("expert", None, "model") if keys[-1] == "w1"
+                        else P("expert", "model", None))
+            return P("expert", *(None,) * (leaf.ndim - 1))
+        if tp and keys and keys[-1] == "kernel" \
+                and getattr(leaf, "ndim", 0) == 2 and "router" not in keys:
+            if any(k in col for k in keys):
+                return P(None, "model")
+            if any(k in row for k in keys):
+                return P("model", None)
+        return P()
+
+    return train.shardings_from_rule(mesh, state, rule)
 
 
 def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
@@ -289,8 +364,9 @@ def build(args, mesh=None, num_slices: int = 1):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_moe_mesh(expert_parallel=args.expert_parallel,
-                                 num_slices=num_slices)
+    mesh = mesh or make_moe_mesh(
+        expert_parallel=args.expert_parallel, num_slices=num_slices,
+        tensor_parallel=getattr(args, "tensor_parallel", 1))
     model = _build_model(args, mesh)
     tx = optax.adam(args.lr)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)

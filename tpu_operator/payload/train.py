@@ -420,19 +420,34 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         log.warning(
             "profile window [%d, %d) lies beyond the run's last step %d; "
             "no trace will be captured", trace_from, trace_to, steps)
+
+    if jax.process_count() > 1:
+        # Coordinated drain: SIGTERM lands on *one* pod (preemption), but an
+        # orbax save is a group collective, so every process must agree on
+        # the boundary step. Each step, every process contributes its local
+        # drain latch to a tiny allgather; all processes evaluate the same
+        # gathered array at the same loop index, so they reach consensus at
+        # the same i and group-save one consistent checkpoint. Cost: one
+        # scalar collective per step — noise next to a training step.
+        from jax.experimental import multihost_utils
+
+        def drain_agreed() -> bool:
+            flag = np.int32(1 if bootstrap_mod.draining() else 0)
+            return bool(multihost_utils.process_allgather(flag).max())
+    else:
+        drain_agreed = bootstrap_mod.draining
+
     bootstrap_mod.enter_step_loop()  # SIGTERM now defers to a step boundary
     try:
         for i in range(start, steps):
-            if bootstrap_mod.draining():
-                # SIGTERM drain: persist the i completed steps and exit
-                # retryable — the restarted attempt resumes exactly here.
-                # The caller's finally close() flushes the async write.
-                # Multi-process jobs skip the save: orbax saves are group
-                # collectives and peers drain at different boundaries (or
-                # not at all), so they fall back to the last interval save,
-                # which whole-group restart handles anyway.
-                if (checkpointer is not None and i > start
-                        and jax.process_count() == 1):
+            if drain_agreed():
+                # Drain: persist the i completed steps and exit retryable —
+                # the restarted attempt resumes exactly here. The caller's
+                # finally close() flushes the async write. In multi-process
+                # jobs every peer (signaled or not) reaches this branch at
+                # the same i (consensus above), saves collectively, and
+                # exits retryable so the operator restarts the whole group.
+                if checkpointer is not None and i > start:
                     checkpointer.save(i, state)
                     log.info("drain: checkpointed step %d, exiting retryable", i)
                 else:

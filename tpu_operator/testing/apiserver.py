@@ -140,13 +140,30 @@ class _Handler(BaseHTTPRequestHandler):
             except OSError:
                 pass
 
+    def _admit(self, client: Any, body: dict) -> None:
+        """Schema admission for TPUJobs: validate against the structural
+        openAPIV3Schema in *strict* mode (unknown fields rejected — kubectl
+        --validate=strict semantics), raising 422 like a real apiserver's
+        Invalid status. Other resources pass through: their schemas belong
+        to upstream K8s, and the fakes stay permissive."""
+        if getattr(client, "kind", "") != "TPUJob":
+            return
+        from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+
+        ok, message = schema_mod.validate_tpujob_strict(body)
+        if not ok:
+            raise errors.ApiError(422, "Invalid",
+                                  f"TPUJob validation failed: {message}")
+
     def do_POST(self) -> None:  # noqa: N802
         routed = self._route()
         if routed is None:
             return
         client, namespace, _name, _st, _params = routed
         try:
-            self._send_json(201, client.create(namespace, self._read_body() or {}))
+            body = self._read_body() or {}
+            self._admit(client, body)
+            self._send_json(201, client.create(namespace, body))
         except errors.ApiError as e:
             self._send_error(e)
 
@@ -157,6 +174,11 @@ class _Handler(BaseHTTPRequestHandler):
         client, namespace, name, is_status, _params = routed
         body = self._read_body() or {}
         try:
+            # Both branches admit: a real apiserver validates status-
+            # subresource writes against the CRD's structural schema too
+            # (the status enums exist to catch operator-side drift like a
+            # miscased phase).
+            self._admit(client, body)
             if is_status:
                 self._send_json(200, client.update_status(namespace, body))
             else:
