@@ -101,6 +101,26 @@ def finalize(carry: Carry, dtype) -> jnp.ndarray:
 
 # --- reference merge (backward path + non-TPU fallback) -----------------------
 
+def _stride_of(offsets: jnp.ndarray) -> jnp.ndarray:
+    """Position stride from an offsets array: [q_off, k_off] means
+    contiguous (stride 1); [q_off, k_off, stride] supports striped
+    sequence layouts (ring_attention stripe mode, Brandon et al. 2023),
+    where slot i holds global position off + stride*i."""
+    if offsets.shape[0] >= 3:
+        return offsets[2]
+    return jnp.int32(1)
+
+
+def _normalize_offsets(offsets: jnp.ndarray) -> jnp.ndarray:
+    """int32 [q_off, k_off, stride] — pads the contiguous two-element form
+    with stride 1 so the kernels (which scalar-prefetch index [2]) see one
+    layout."""
+    offsets = offsets.astype(jnp.int32)
+    if offsets.shape[0] == 2:
+        offsets = jnp.concatenate([offsets, jnp.ones((1,), jnp.int32)])
+    return offsets
+
+
 def _merge_ref(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                o: jnp.ndarray, l: jnp.ndarray, m: jnp.ndarray,
                offsets: jnp.ndarray, causal: bool) -> Carry:
@@ -111,8 +131,9 @@ def _merge_ref(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
-        q_pos = offsets[0] + jnp.arange(q.shape[2], dtype=jnp.int32)
-        k_pos = offsets[1] + jnp.arange(k.shape[2], dtype=jnp.int32)
+        stride = _stride_of(offsets)
+        q_pos = offsets[0] + stride * jnp.arange(q.shape[2], dtype=jnp.int32)
+        k_pos = offsets[1] + stride * jnp.arange(k.shape[2], dtype=jnp.int32)
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
@@ -145,13 +166,16 @@ def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
         m_out[...] = m_ref[...]
 
     # int32 positions: float32 loses integer resolution past 2^24, well
-    # inside the long-context regime.
-    q_lo = offs_ref[0] + iq * blk_q
-    k_lo = offs_ref[1] + ik * blk_k
+    # inside the long-context regime. Slot i holds global position
+    # off + stride*i (stride 1 = contiguous; > 1 = striped layout).
+    stride = offs_ref[2]
+    q_lo = offs_ref[0] + stride * (iq * blk_q)
+    k_lo = offs_ref[1] + stride * (ik * blk_k)
 
     # Causal skip: a k-tile entirely in this q-block's future contributes
     # nothing — skip its matmuls (≈2× effective throughput for causal).
-    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    @pl.when(jnp.logical_or(not causal,
+                            q_lo + stride * (blk_q - 1) >= k_lo))
     def _merge():
         q = q_ref[0, 0].astype(jnp.float32) * scale      # [blk_q, D]
         o = o_out[0, 0]                                  # [blk_q, D] f32
@@ -162,8 +186,10 @@ def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-            k_pos = k_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+            q_pos = q_lo + stride * lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 0)
+            k_pos = k_lo + stride * lax.broadcasted_iota(
+                jnp.int32, (blk_q, blk_k), 1)
             s = jnp.where(q_pos >= k_pos, s, NEG_INF)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
@@ -274,7 +300,7 @@ def _logsumexp_rows(l: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
 
 
 def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
-                   causal: bool, scale: float):
+                   stride, causal: bool, scale: float):
     """The shared per-tile backward recurrence: recompute this tile's
     probabilities from Q/K and the forward's logsumexp, then
     dS = P (dP - D). Both backward kernels build their accumulations from
@@ -288,8 +314,10 @@ def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
     s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = q_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 0)
-        k_pos = k_lo + lax.broadcasted_iota(jnp.int32, (blk_q, blk_k), 1)
+        q_pos = q_lo + stride * lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 0)
+        k_pos = k_lo + stride * lax.broadcasted_iota(
+            jnp.int32, (blk_q, blk_k), 1)
         s = jnp.where(q_pos >= k_pos, s, NEG_INF)
     p = jnp.exp(s - L_ref[0, 0])                          # [blk_q, blk_k]
     dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
@@ -306,17 +334,19 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
     blk_k = k_ref.shape[2]
     iq = pl.program_id(2)
     ik = pl.program_id(3)
-    q_lo = offs_ref[0] + iq * blk_q
-    k_lo = offs_ref[1] + ik * blk_k
+    stride = offs_ref[2]
+    q_lo = offs_ref[0] + stride * (iq * blk_q)
+    k_lo = offs_ref[1] + stride * (ik * blk_k)
 
     @pl.when(ik == 0)
     def _zero():
         dq_out[...] = jnp.zeros_like(dq_out)
 
-    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    @pl.when(jnp.logical_or(not causal,
+                            q_lo + stride * (blk_q - 1) >= k_lo))
     def _acc():
         _q, k_blk, _g, _p, ds = _bwd_tile_p_ds(
-            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
+            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
             causal, scale)
         dq_out[0, 0] += scale * lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
@@ -331,18 +361,20 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
     blk_k = k_ref.shape[2]
     ik = pl.program_id(2)
     iq = pl.program_id(3)
-    q_lo = offs_ref[0] + iq * blk_q
-    k_lo = offs_ref[1] + ik * blk_k
+    stride = offs_ref[2]
+    q_lo = offs_ref[0] + stride * (iq * blk_q)
+    k_lo = offs_ref[1] + stride * (ik * blk_k)
 
     @pl.when(iq == 0)
     def _zero():
         dk_out[...] = jnp.zeros_like(dk_out)
         dv_out[...] = jnp.zeros_like(dv_out)
 
-    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    @pl.when(jnp.logical_or(not causal,
+                            q_lo + stride * (blk_q - 1) >= k_lo))
     def _acc():
         q, _k, g, p, ds = _bwd_tile_p_ds(
-            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
+            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
             causal, scale)
         # dV += P^T dO
         dv_out[0, 0] += lax.dot_general(
@@ -417,8 +449,9 @@ def _bwd_ref(q, k, v, g, L, D, offsets, causal: bool):
     s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale
     if causal:
-        q_pos = offsets[0] + jnp.arange(q.shape[2], dtype=jnp.int32)
-        k_pos = offsets[1] + jnp.arange(k.shape[2], dtype=jnp.int32)
+        stride = _stride_of(offsets)
+        q_pos = offsets[0] + stride * jnp.arange(q.shape[2], dtype=jnp.int32)
+        k_pos = offsets[1] + stride * jnp.arange(k.shape[2], dtype=jnp.int32)
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
     p = jnp.exp(s - L)
     g32 = g.astype(jnp.float32)
@@ -436,7 +469,7 @@ def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
     given the *global* row logsumexp ``L`` and ``D = rowsum(dO * O)`` —
     the building block of both the single-shard fused backward and the
     backward ring (ring_attention.py). All blocks [B, H, T, D]."""
-    offsets = offsets.astype(jnp.int32)
+    offsets = _normalize_offsets(offsets)
     if use_pallas is None:
         use_pallas = use_pallas_default()
     if use_pallas and not (_kernel_feasible(q.shape[2])
@@ -451,7 +484,7 @@ def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
 def _attn_impl(causal, use_pallas, q, k, v):
     b, h, t, d = q.shape
     carry = init_carry(b, h, t, d)
-    offsets = jnp.zeros((2,), jnp.int32)
+    offsets = _normalize_offsets(jnp.zeros((2,), jnp.int32))
     o, l, m = [None] * 3
     if use_pallas:
         interpret = jax.default_backend() != "tpu"
@@ -491,14 +524,15 @@ def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Fold K/V block ``k``/``v`` (global position ``offsets[1]``) into the
     streaming softmax over resident queries ``q`` (position ``offsets[0]``).
 
-    All blocks are [B, H, T, D]; ``offsets`` is a length-2 int32 array so
-    one compiled kernel serves every ring step. Differentiable (custom VJP).
+    All blocks are [B, H, T, D]; ``offsets`` is [q_off, k_off] (contiguous)
+    or [q_off, k_off, stride] (striped layout) int32, so one compiled
+    kernel serves every ring step. Differentiable (custom VJP).
     ``use_pallas=None`` auto-selects: the kernel on real TPUs, the jnp path
     elsewhere (``True`` forces the kernel — interpret mode off-TPU, which is
     orders of magnitude slower than jnp and meant for tests only).
     """
     o, l, m = carry
-    offsets = offsets.astype(jnp.int32)
+    offsets = _normalize_offsets(offsets)
     if use_pallas is None:
         use_pallas = use_pallas_default()
     if use_pallas and not (_kernel_feasible(q.shape[2])

@@ -57,19 +57,47 @@ def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return out.astype(q.dtype)
 
 
-def _ring_offsets_fn(axis_name, tq, tk):
-    """(idx, kv_idx) → global [q_offset, k_offset] int32 pair for a shard's
-    resident queries against the block that started life on shard kv_idx."""
-    idx = lax.axis_index(axis_name)
-    q_offset = (idx * tq).astype(jnp.int32)
+def stripe_permutation(t: int, shards: int):
+    """(perm, inv) for the striped sequence layout (Striped Attention,
+    Brandon et al. 2023): ``x[:, perm]`` lays tokens out so shard r's
+    contiguous slice holds global positions r, r+N, r+2N, … Under causal
+    masking every rank then owns an equal mix of early (cheap) and late
+    (expensive) query positions, so ring wall-clock is set by the mean
+    shard instead of the last one (contiguous layout: the final shard does
+    ~2x the mean work and the first almost none)."""
+    import numpy as np
 
-    def offsets(kv_idx):
-        return jnp.stack([q_offset, (kv_idx * tk).astype(jnp.int32)])
+    if t % shards:
+        raise ValueError(f"seq {t} not divisible by {shards} shards")
+    perm = np.arange(t).reshape(t // shards, shards).T.reshape(-1)
+    inv = np.argsort(perm)
+    return perm, inv
+
+
+def _ring_offsets_fn(axis_name, tq, tk, stripe: bool):
+    """(idx, kv_idx) → [q_offset, k_offset, stride] int32 for a shard's
+    resident queries against the block that started life on shard kv_idx.
+    Contiguous layout: shard r's slot c is global position r*C + c
+    (stride 1). Striped: slot c is position r + N*c (stride N) — the
+    kernels mask on off + stride*slot either way."""
+    idx = lax.axis_index(axis_name)
+    n = lax.psum(1, axis_name)
+    if stripe:
+        def offsets(kv_idx):
+            return jnp.stack([idx.astype(jnp.int32),
+                              jnp.asarray(kv_idx, jnp.int32),
+                              jnp.int32(n)])
+    else:
+        q_offset = (idx * tq).astype(jnp.int32)
+
+        def offsets(kv_idx):
+            return jnp.stack([q_offset, (kv_idx * tk).astype(jnp.int32),
+                              jnp.int32(1)])
 
     return idx, offsets
 
 
-def _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas):
+def _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas, stripe):
     """Forward ring: q resident, K/V rotate on neighbor ppermutes, each
     visit folded by the fused streaming-softmax merge. Returns the raw
     carry so callers can also extract the row logsumexp for the backward
@@ -79,7 +107,7 @@ def _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas):
     axis_size = lax.psum(1, axis_name)
     b, h, tq, d = qt.shape
     tk = kt.shape[2]
-    idx, offsets = _ring_offsets_fn(axis_name, tq, tk)
+    idx, offsets = _ring_offsets_fn(axis_name, tq, tk, stripe)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
 
     # Resident block first, then rotate: exactly axis_size - 1 ppermute
@@ -105,9 +133,9 @@ def _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas):
     return o, l, m
 
 
-@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2))
+@functools.partial(jax.custom_vjp, nondiff_argnums=(0, 1, 2, 3))
 def _ring_local_attn(axis_name: str, causal: bool, use_pallas: bool,
-                     qt, kt, vt):
+                     stripe: bool, qt, kt, vt):
     """Per-shard ring attention in [B,H,T,D] layout (runs inside shard_map),
     differentiated by a *backward ring* (defvjp below) instead of autodiff
     through the forward scan: the forward saves only (q, k, v, out, L) —
@@ -119,25 +147,27 @@ def _ring_local_attn(axis_name: str, causal: bool, use_pallas: bool,
     ppermutes like the forward."""
     from tpu_operator.payload import flash_attention as fa
 
-    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas)
+    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas,
+                             stripe)
     return fa.finalize((o, l, m), qt.dtype)
 
 
-def _ring_local_fwd(axis_name, causal, use_pallas, qt, kt, vt):
+def _ring_local_fwd(axis_name, causal, use_pallas, stripe, qt, kt, vt):
     from tpu_operator.payload import flash_attention as fa
 
-    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas)
+    o, l, m = _ring_fwd_scan(qt, kt, vt, axis_name, causal, use_pallas,
+                             stripe)
     out = fa.finalize((o, l, m), qt.dtype)
     return out, (qt, kt, vt, out, fa._logsumexp_rows(l, m))
 
 
-def _ring_local_bwd(axis_name, causal, use_pallas, residuals, g):
+def _ring_local_bwd(axis_name, causal, use_pallas, stripe, residuals, g):
     from tpu_operator.payload import flash_attention as fa
 
     qt, kt, vt, out, L = residuals
     axis_size = lax.psum(1, axis_name)
     tq, tk = qt.shape[2], kt.shape[2]
-    idx, offsets = _ring_offsets_fn(axis_name, tq, tk)
+    idx, offsets = _ring_offsets_fn(axis_name, tq, tk, stripe)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
     D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                 axis=-1, keepdims=True)
@@ -178,14 +208,15 @@ _ring_local_attn.defvjp(_ring_local_fwd, _ring_local_bwd)
 
 def _ring_attention_local(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                           axis_name: str, causal: bool,
-                          use_pallas: bool) -> jnp.ndarray:
+                          use_pallas: bool, stripe: bool) -> jnp.ndarray:
     """The per-shard body (runs inside shard_map): transpose to the kernel's
     [B,H,T,D] layout, run the ring (custom-VJP'd — see _ring_local_attn),
     transpose back."""
     qt = jnp.einsum("bqhd->bhqd", q)
     kt = jnp.einsum("bkhd->bhkd", k)
     vt = jnp.einsum("bkhd->bhkd", v)
-    out = _ring_local_attn(axis_name, causal, use_pallas, qt, kt, vt)
+    out = _ring_local_attn(axis_name, causal, use_pallas, stripe,
+                           qt, kt, vt)
     return jnp.einsum("bhqd->bqhd", out)
 
 
@@ -194,7 +225,8 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                    batch_axis: Optional[str] = "data",
                    head_axis: Optional[str] = None,
                    causal: bool = True,
-                   use_pallas: Optional[bool] = None) -> jnp.ndarray:
+                   use_pallas: Optional[bool] = None,
+                   stripe: bool = False) -> jnp.ndarray:
     """Exact attention over globally [B, T, H, D] arrays whose T dimension is
     sharded on ``mesh`` axis ``seq_axis`` (and B on ``batch_axis``).
 
@@ -208,7 +240,15 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     a 3-axis mesh): the ring math is head-local, so each (seq, model) shard
     just runs the same recurrence on its slice of heads — no extra
     communication.
-    """
+
+    ``stripe=True`` declares the T dimension is in the striped layout
+    (:func:`stripe_permutation` — shard r's slice holds global positions
+    r, r+N, …), which balances causal work across ring ranks: with
+    contiguous shards the last rank does ~2x the mean work and sets the
+    ring's wall-clock; striped, every rank's unmasked-tile count is equal
+    to within one tile row. The caller owns laying out q/k/v (and
+    interpreting the output) in that permutation — transformer.py's
+    ``--sp-layout striped`` does this end to end."""
     if use_pallas is None:
         from tpu_operator.payload import flash_attention as fa
 
@@ -216,7 +256,7 @@ def ring_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     spec = P(batch_axis, seq_axis, head_axis, None)
     body = functools.partial(_ring_attention_local,
                              axis_name=seq_axis, causal=causal,
-                             use_pallas=use_pallas)
+                             use_pallas=use_pallas, stripe=stripe)
     fn = jax.shard_map(body, mesh=mesh, in_specs=(spec, spec, spec),
                        out_specs=spec, check_vma=False)
     return fn(q, k, v)

@@ -207,6 +207,24 @@ def next_token_nll(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     return jnp.mean(lse - tgt)
 
 
+def next_token_nll_masked(logits: jnp.ndarray, targets: jnp.ndarray,
+                          mask: jnp.ndarray) -> jnp.ndarray:
+    """Next-token NLL with explicit per-slot targets and validity mask —
+    the permuted-layout form of :func:`next_token_nll` (striped sequence
+    layout: slot order ≠ position order, so the "shift by one" pairing is
+    precomputed by the caller). Equal to the natural-order loss: both
+    average ``lse - logit[target]`` over the same (position, next-token)
+    pairs, just enumerated in a different order."""
+    lse = jax.scipy.special.logsumexp(logits.astype(jnp.float32), axis=-1)
+    tgt = jnp.take_along_axis(logits, targets[..., None],
+                              axis=-1)[..., 0].astype(jnp.float32)
+    # Broadcast the mask against the [B, T] per-slot grid and normalize by
+    # the count of valid cells — correct for both a shared [T] mask (the
+    # striped layout) and a per-example [B, T] one (padding-aware batches).
+    mask = jnp.broadcast_to(mask.astype(jnp.float32), lse.shape)
+    return jnp.sum((lse - tgt) * mask) / jnp.maximum(jnp.sum(mask), 1.0)
+
+
 def leading_axis_shardings(mesh: Mesh, state: TrainState, axis: str,
                            match: Callable[[Tuple[str, ...]], bool]) -> TrainState:
     """Shardings for payloads with stacked parameter groups: leaves whose

@@ -58,6 +58,18 @@ def parse_args(argv=None):
                    help="sequence-parallel strategy: ring = ppermute K/V "
                         "rotation, O(T/P) memory; ulysses = head-scatter "
                         "all-to-all, needs heads %% seq shards == 0")
+    p.add_argument("--sp-layout", choices=("contiguous", "striped"),
+                   default="contiguous",
+                   help="how the sequence dim shards under --sp-mode ring: "
+                        "contiguous = shard r holds positions [rC, (r+1)C) "
+                        "— under causal masking the last rank does ~2x the "
+                        "mean attention work and sets ring wall-clock; "
+                        "striped = shard r holds positions r, r+N, r+2N, … "
+                        "(Striped Attention, Brandon et al. 2023) — every "
+                        "rank's causal work is equal to within one tile. "
+                        "The permutation is applied inside the jit (token "
+                        "gather + position ids + shifted-target loss); "
+                        "model params and semantics are identical")
     p.add_argument("--remat", action="store_true",
                    help="rematerialize each block on backward (jax.checkpoint"
                         "): activation memory O(layers) -> O(1) blocks, for "
@@ -133,6 +145,13 @@ def _build_model(args, mesh):
 
     seq_shards = mesh.shape.get("seq", 1)
     sp_mode = getattr(args, "sp_mode", "ring")
+    striped = getattr(args, "sp_layout", "contiguous") == "striped"
+    if striped and sp_mode != "ring":
+        raise ValueError("--sp-layout striped requires --sp-mode ring")
+    if striped and seq_shards <= 1:
+        raise ValueError(
+            "--sp-layout striped requires --seq-parallel > 1 (the layout "
+            "exists to balance the ring)")
 
     def attend(q, k, v):
         if seq_shards > 1:
@@ -142,7 +161,7 @@ def _build_model(args, mesh):
                 return ulysses.ulysses_attention(q, k, v, mesh, causal=True)
             head_axis = "model" if mesh.shape.get("model", 1) > 1 else None
             return ring.ring_attention(q, k, v, mesh, causal=True,
-                                       head_axis=head_axis)
+                                       head_axis=head_axis, stripe=striped)
         if fa.use_pallas_default():
             return fa.flash_attention(q, k, v, causal=True)
         return ring.reference_attention(q, k, v, causal=True)
@@ -188,12 +207,17 @@ def _build_model(args, mesh):
         max_seq: int
 
         @nn.compact
-        def __call__(self, tokens, train: bool = True):
+        def __call__(self, tokens, train: bool = True, positions=None):
+            # ``positions``: per-slot global position ids (striped layout
+            # feeds permuted tokens, so slot index != position); default
+            # natural order.
             _b, t = tokens.shape
+            if positions is None:
+                positions = jnp.arange(t)
             x = nn.Embed(self.vocab, self.dim, dtype=jnp.bfloat16,
                          name="tok_embed")(tokens)
             pos = nn.Embed(self.max_seq, self.dim, dtype=jnp.bfloat16,
-                           name="pos_embed")(jnp.arange(t))
+                           name="pos_embed")(positions)
             x = x + pos[None]
             for i in range(self.layers):
                 x = Block(self.dim, self.heads, attend,
@@ -245,16 +269,50 @@ def lm_tp_shardings(mesh, state):
 
 
 def make_lm_train_step(model, tx, mesh, state, shardings=None,
-                       grad_accum: int = 1):
-    """Next-token cross-entropy step, jitted with (data, seq) shardings."""
-    from jax.sharding import PartitionSpec as P
+                       grad_accum: int = 1, sp_layout: str = "contiguous"):
+    """Next-token cross-entropy step, jitted with (data, seq) shardings.
 
+    ``sp_layout="striped"``: the step still takes *natural-order* token
+    batches; inside the jit the tokens are gathered into the striped
+    layout (a [B, T] int32 all-to-all across the seq axis — bytes-wise
+    noise), the model runs with explicit position ids, and the loss pairs
+    each slot with its true next token. Semantically identical to the
+    contiguous step; only the ring's work balance changes."""
+    import jax
+    import jax.numpy as jnp
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    from tpu_operator.payload import ring_attention as ring_mod
     from tpu_operator.payload import train
 
-    def loss_fn(params, tokens):
-        loss = train.next_token_nll(model.apply({"params": params}, tokens),
-                                    tokens)
-        return loss, {"loss": loss}
+    if sp_layout == "striped":
+        seq_shards = mesh.shape.get("seq", 1)
+        perm_np, _inv = ring_mod.stripe_permutation(model.max_seq,
+                                                    seq_shards)
+        perm = jnp.asarray(perm_np, jnp.int32)
+        spec = lm_token_spec(mesh)
+
+        def loss_fn(params, tokens):
+            t = tokens.shape[1]
+            if t != perm.shape[0]:
+                # jnp.take would silently *clip* out-of-range indices on a
+                # shorter batch, training on corrupted pairs.
+                raise ValueError(
+                    f"striped layout was built for seq_len "
+                    f"{perm.shape[0]}, got batch with T={t}")
+            tok_s = jnp.take(tokens, perm, axis=1)
+            tok_s = jax.lax.with_sharding_constraint(
+                tok_s, NamedSharding(mesh, spec))
+            logits = model.apply({"params": params}, tok_s, positions=perm)
+            targets = jnp.take(tokens, (perm + 1) % t, axis=1)
+            mask = perm < t - 1
+            loss = train.next_token_nll_masked(logits, targets, mask)
+            return loss, {"loss": loss}
+    else:
+        def loss_fn(params, tokens):
+            loss = train.next_token_nll(
+                model.apply({"params": params}, tokens), tokens)
+            return loss, {"loss": loss}
 
     return train.make_loss_train_step(loss_fn, tx, mesh, state, shardings,
                                       batch_spec=lm_token_spec(mesh),
@@ -291,7 +349,9 @@ def build(args, mesh=None, num_slices: int = 1):
         shardings = train.state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
     step = make_lm_train_step(model, tx, mesh, state, shardings,
-                              grad_accum=getattr(args, "grad_accum", 1))
+                              grad_accum=getattr(args, "grad_accum", 1),
+                              sp_layout=getattr(args, "sp_layout",
+                                                "contiguous"))
     batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
                                     vocab=args.vocab)
     return mesh, model, state, step, batches
