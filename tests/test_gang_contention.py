@@ -1,0 +1,148 @@
+"""Gang contention: two TPUJobs racing for one slice's capacity
+(BASELINE config 5, examples/tpujob-gang-pair.yml; VERDICT round-1 item 9).
+
+The apiserver's pod-create path is wrapped with a capacity-limited fake
+kubelet: at most 4 *active* (non-terminal) pods exist at once — one slice.
+Two 4-worker jobs are created simultaneously against the real operator
+binary (threadiness 2, so their reconciles genuinely interleave). Required
+behavior of sync_pods_gang's all-or-none create-with-rollback:
+
+- exactly one job acquires the full slice; the other holds ZERO pods while
+  it waits (no stranded partial gang — the deadlock the reference's
+  create-if-absent loop would produce);
+- when the winner's pods reach a terminal phase, the loser's rate-limited
+  requeue acquires the slice and completes too — no livelock.
+"""
+
+from __future__ import annotations
+
+import signal
+import subprocess
+import sys
+import threading
+import time
+
+import pytest
+
+from tpu_operator.client import errors
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.testing.apiserver import ApiServerHarness
+
+CAPACITY = 4
+
+
+def wait_for(predicate, timeout=90.0, interval=0.25):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if predicate():
+            return True
+        time.sleep(interval)
+    return predicate()
+
+
+def _limit_pod_capacity(fake, capacity: int):
+    """Wrap the fake's pod create with a capacity-counting kubelet stand-in:
+    active (non-terminal) pods are bounded, extra creates get 403 — what a
+    quota'd/device-exhausted slice answers."""
+    real_create = fake.pods.create
+    lock = threading.Lock()
+
+    def limited_create(namespace, obj):
+        with lock:
+            active = [
+                p for p in fake.pods.list(namespace, "")
+                if p.get("status", {}).get("phase")
+                not in ("Succeeded", "Failed")
+            ]
+            if len(active) >= capacity:
+                raise errors.ApiError(
+                    403, "Forbidden",
+                    f"insufficient TPU capacity: {len(active)}/{capacity} "
+                    f"chips in use")
+            return real_create(namespace, obj)
+
+    fake.pods.create = limited_create
+
+
+def _job(name: str):
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1",
+        "kind": "TPUJob",
+        "metadata": {"name": name},
+        "spec": {
+            "replicaSpecs": [{
+                "replicas": CAPACITY,
+                "tpuReplicaType": "WORKER",
+                "template": {"spec": {"containers": [
+                    {"name": "tpu", "image": "payload:test"}]}},
+            }],
+        },
+    }
+
+
+def _pods_of(cs, job_name):
+    return [p for p in cs.pods.list("default", f"job_name={job_name}")]
+
+
+def _succeed_pods(cs, pods):
+    for pod in pods:
+        pod["status"] = {
+            "phase": "Succeeded",
+            "containerStatuses": [{"name": "tpu", "state": {
+                "terminated": {"exitCode": 0}}}],
+        }
+        cs.pods.update("default", pod)
+
+
+@pytest.fixture
+def contended_env():
+    harness = ApiServerHarness().start()
+    _limit_pod_capacity(harness.clientset, CAPACITY)
+    cs = Clientset(RestConfig(host=harness.url, timeout=5.0))
+    op = subprocess.Popen(
+        [sys.executable, "-m", "tpu_operator.cmd.main", "--master",
+         harness.url, "--namespace", "default", "--threadiness", "2"],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    yield cs
+    op.send_signal(signal.SIGINT)
+    try:
+        op.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        op.kill()
+    harness.stop()
+
+
+def test_two_jobs_one_slice_no_partial_no_livelock(contended_env):
+    cs = contended_env
+    cs.tpujobs.create("default", _job("gang-a"))
+    cs.tpujobs.create("default", _job("gang-b"))
+
+    # One job must acquire the FULL slice.
+    def one_winner():
+        a, b = len(_pods_of(cs, "gang-a")), len(_pods_of(cs, "gang-b"))
+        return sorted((a, b)) == [0, CAPACITY]
+
+    assert wait_for(one_winner), (
+        f"no clean winner: gang-a={len(_pods_of(cs, 'gang-a'))} "
+        f"gang-b={len(_pods_of(cs, 'gang-b'))}")
+
+    winner = "gang-a" if len(_pods_of(cs, "gang-a")) == CAPACITY else "gang-b"
+    loser = "gang-b" if winner == "gang-a" else "gang-a"
+
+    # While the winner holds the slice, the loser must keep holding ZERO
+    # pods (all-or-none rollback) across repeated reconcile attempts.
+    for _ in range(8):
+        assert len(_pods_of(cs, loser)) == 0, "loser stranded a partial gang"
+        time.sleep(0.25)
+
+    # Winner completes → slice frees → loser's requeue acquires it.
+    _succeed_pods(cs, _pods_of(cs, winner))
+    assert wait_for(lambda: (cs.tpujobs.get("default", winner)
+                             .get("status", {}).get("phase") == "Done"))
+    assert wait_for(
+        lambda: len(_pods_of(cs, loser)) == CAPACITY,
+        timeout=120.0), "loser never acquired the freed slice (livelock?)"
+
+    _succeed_pods(cs, _pods_of(cs, loser))
+    assert wait_for(lambda: (cs.tpujobs.get("default", loser)
+                             .get("status", {}).get("phase") == "Done"))

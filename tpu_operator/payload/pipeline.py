@@ -18,12 +18,25 @@ Design (TPU-first, not a torch-style stage-per-process port):
   exactly the collective-pipelining recipe XLA compiles well — no
   per-stage Python processes, no point-to-point sends outside the compiler.
 - **Bubble** is the usual (S-1)/(M+S-1); pick microbatches >> stages.
-  (1F1B/interleaved schedules are deliberately not implemented: their win
-  comes from hand-interleaving forward and backward per microbatch, which
-  fights jax.grad's program-level autodiff of this scan — forward-only
-  virtual stages provably leave the bubble fraction unchanged. The JAX-
-  native levers are more microbatches and --remat, which bounds the
-  per-stage activation memory GPipe would otherwise hold for all M.)
+- **Two schedules.** ``--schedule gpipe`` differentiates the forward scan
+  with ``jax.grad`` — simple, but reverse-mode holds every tick's carry, so
+  per-stage activation memory is O(M). ``--schedule 1f1b`` is the
+  one-forward-one-backward schedule (PipeDream-flush / Megatron, public
+  technique), hand-scheduled precisely because it *cannot* be expressed
+  through jax.grad of a scan (round-1's open question): backward work for
+  early microbatches must interleave with forward work for later ones.
+  The implementation (``pipeline_1f1b_loss_and_grads``) runs a tick clock
+  inside shard_map — stage s executes F(m) at tick 2m+s and B(m) at tick
+  2m+2S-1-s; the two families have opposite tick parity, so each tick every
+  stage runs exactly one of them under ``lax.cond`` (XLA Conditional:
+  only the taken branch executes). Activations hop forward and cotangents
+  hop backward on neighbor ppermutes every tick. Backward *recomputes* the
+  stage forward from a stashed copy of its input via ``jax.vjp`` (stage-
+  granular remat), so a stage holds at most S - s stashed inputs —
+  activation memory O(S), independent of M — and gradients accumulate in
+  the scan carry, never through autodiff of the schedule itself. The
+  bubble fraction (S-1)/(M+S-1) is unchanged vs gpipe (both flush); the
+  win is memory: M can grow to shrink the bubble without growing HBM.
 - **Numerics**: house style (models.py) — bf16 matmuls on the MXU, f32
   LayerNorm/softmax/loss, f32 master params.
 - Embedding and the LM head are position- and layer-local, so they run
@@ -57,6 +70,13 @@ def parse_args(argv=None):
                    help="pipeline stages (mesh pipe axis size)")
     p.add_argument("--microbatches", type=int, default=4,
                    help="microbatches streamed through the pipeline per step")
+    p.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
+                   help="gpipe = scan-forward + jax.grad (activation memory "
+                        "O(microbatches) per stage); 1f1b = hand-scheduled "
+                        "one-forward-one-backward with manual vjp and "
+                        "recompute-from-stash (activation memory O(stages), "
+                        "independent of microbatches — the schedule for "
+                        "M >> S runs that would not fit HBM under gpipe)")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16",
                    help="stage compute dtype (f32 for parity tests)")
     p.add_argument("--grad-accum", type=int, default=1,
@@ -229,22 +249,221 @@ def _init_params(args, mesh, rng):
     }
 
 
+def _embed(embed_params, tokens, dtype):
+    """tokens [B, T] → activations [B, T, D] (stage-0-local in 1f1b)."""
+    x = embed_params["tok_embed"][tokens].astype(dtype)
+    return x + embed_params["pos_embed"][:tokens.shape[1]].astype(dtype)[None]
+
+
+def _head_logits(head_params, x, dtype):
+    """Final LayerNorm + LM head (last-stage-local in 1f1b)."""
+    import jax.numpy as jnp
+
+    xf = x.astype(jnp.float32)
+    mean = xf.mean(-1, keepdims=True)
+    var = xf.var(-1, keepdims=True)
+    xf = (xf - mean) * (var + 1e-6) ** -0.5
+    xf = xf * head_params["ln_f"]["scale"] + head_params["ln_f"]["bias"]
+    return xf.astype(dtype) @ head_params["head"].astype(dtype)
+
+
 def forward(args, mesh, stage, params, tokens):
     """Logits [B, T, V]: DP embed → pipelined stack → DP LayerNorm + head."""
     import jax.numpy as jnp
 
     dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
-    _b, t = tokens.shape
-    x = params["tok_embed"][tokens].astype(dtype)
-    x = x + params["pos_embed"][:t].astype(dtype)[None]
+    x = _embed(params, tokens, dtype)
     x = pipeline_apply(mesh, lambda p, h: stage.apply({"params": p}, h),
                        params["stages"], x, args.microbatches)
-    xf = x.astype(jnp.float32)
-    mean = xf.mean(-1, keepdims=True)
-    var = xf.var(-1, keepdims=True)
-    xf = (xf - mean) * (var + 1e-6) ** -0.5
-    xf = xf * params["ln_f"]["scale"] + params["ln_f"]["bias"]
-    return xf.astype(dtype) @ params["head"].astype(dtype)
+    return _head_logits(params, x, dtype)
+
+
+def onef1b_schedule(num_stages: int, microbatches: int):
+    """The 1F1B tick table, for tests and introspection: per tick, per
+    stage, ("F", m) / ("B", m) / None. Stage s: F(m) at tick 2m+s, B(m) at
+    tick 2m + 2S-1-s — opposite parities, so no tick needs both."""
+    s_, m_ = num_stages, microbatches
+    total = 2 * (m_ + s_ - 1)
+    table = []
+    for t in range(total):
+        row = []
+        for s in range(s_):
+            if (t - s) % 2 == 0 and 0 <= (t - s) // 2 < m_:
+                row.append(("F", (t - s) // 2))
+            elif (t - (2 * s_ - 1 - s)) % 2 == 0 \
+                    and 0 <= (t - (2 * s_ - 1 - s)) // 2 < m_:
+                row.append(("B", (t - (2 * s_ - 1 - s)) // 2))
+            else:
+                row.append(None)
+        table.append(row)
+    return table
+
+
+def pipeline_1f1b_loss_and_grads(mesh, stage_apply, params, tokens,
+                                 microbatches: int, dtype):
+    """(loss, grads) for the full pipelined LM under the 1F1B schedule —
+    manual differentiation, no jax.grad anywhere near the tick scan.
+
+    Module docstring has the schedule; per tick each stage either
+
+    - **F**: take the activation that hopped in (stage 0: embed its own
+      microbatch), run the stage forward, stash the *input* (the remat
+      residual), send the output up-ring; or
+    - **B**: re-run the stage forward from the stashed input under
+      ``jax.vjp``, seed the cotangent (last stage: d(loss_m)/dy from the
+      head+loss vjp, scaled 1/M; others: the cotangent that hopped down),
+      accumulate parameter gradients into the carry, send dx down-ring.
+
+    Embed/head/ln_f params are replicated but only touched by the boundary
+    stages, so their gradient contributions psum over ``pipe``; everything
+    pmeans over ``data``. Stage-stack gradients come back sharded over
+    ``pipe`` exactly like the parameters."""
+    import jax
+    import jax.numpy as jnp
+    from jax import lax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import train
+
+    num_stages = mesh.shape["pipe"]
+
+    def leaf_spec(leaf):
+        return P("pipe", *(None,) * (leaf.ndim - 1))
+
+    stage_specs = jax.tree_util.tree_map(leaf_spec, params["stages"])
+    param_specs = {**{k: P() for k in params if k != "stages"},
+                   "stages": stage_specs}
+    tok_spec = P("data", None)
+    grad_specs = (P(), param_specs)
+
+    def body(params, tok_local):
+        stage_params = jax.tree_util.tree_map(lambda p: p[0],
+                                              params["stages"])
+        embed_params = {"tok_embed": params["tok_embed"],
+                        "pos_embed": params["pos_embed"]}
+        head_params = {"ln_f": params["ln_f"], "head": params["head"]}
+        s_idx = lax.axis_index("pipe")
+        b_loc, t_len = tok_local.shape
+        if b_loc % microbatches != 0:
+            raise ValueError(
+                f"per-datashard batch {b_loc} not divisible by "
+                f"microbatches={microbatches}")
+        mb = b_loc // microbatches
+        tok_mb = tok_local.reshape(microbatches, mb, t_len)
+        d = params["tok_embed"].shape[1]
+        act_shape = (mb, t_len, d)
+        up = [(i, i + 1) for i in range(num_stages - 1)]
+        down = [(i + 1, i) for i in range(num_stages - 1)]
+
+        def head_loss(hp, y, tgt_tokens):
+            return train.next_token_nll(_head_logits(hp, y, dtype),
+                                        tgt_tokens)
+
+        zero_g = dict(
+            stage=jax.tree_util.tree_map(jnp.zeros_like, stage_params),
+            embed=jax.tree_util.tree_map(jnp.zeros_like, embed_params),
+            head=jax.tree_util.tree_map(jnp.zeros_like, head_params),
+        )
+
+        def tick(carry, t):
+            fwd_in, bwd_in, stash, g, loss_acc = carry
+            is_f = jnp.logical_and((t - s_idx) % 2 == 0, t >= s_idx)
+            m_f_raw = (t - s_idx) // 2
+            f_valid = jnp.logical_and(is_f, m_f_raw < microbatches)
+            m_f = jnp.clip(m_f_raw, 0, microbatches - 1)
+            b_off = 2 * num_stages - 1 - s_idx
+            m_b_raw = (t - b_off) // 2
+            b_valid = jnp.logical_and(t >= b_off, m_b_raw < microbatches)
+            m_b = jnp.clip(m_b_raw, 0, microbatches - 1)
+
+            def f_branch(_):
+                x_own = _embed(embed_params,
+                               lax.dynamic_index_in_dim(tok_mb, m_f, 0,
+                                                        keepdims=False),
+                               dtype)
+                x_in = jnp.where(s_idx == 0, x_own, fwd_in)
+                y = stage_apply(stage_params, x_in)
+                stash_upd = lax.dynamic_update_index_in_dim(
+                    stash, x_in, m_f % num_stages, 0)
+                new_stash = jnp.where(f_valid, stash_upd, stash)
+                return (y, jnp.zeros(act_shape, dtype), new_stash,
+                        zero_g, jnp.float32(0.0))
+
+            def b_branch(_):
+                x_saved = lax.dynamic_index_in_dim(stash, m_b % num_stages,
+                                                   0, keepdims=False)
+                y_b, stage_vjp = jax.vjp(stage_apply, stage_params, x_saved)
+                tgt = lax.dynamic_index_in_dim(tok_mb, m_b, 0,
+                                               keepdims=False)
+
+                def last(_):
+                    loss_m, head_vjp = jax.vjp(
+                        lambda hp, y: head_loss(hp, y, tgt), head_params,
+                        y_b)
+                    g_head, dy = head_vjp(jnp.float32(1.0 / microbatches))
+                    return loss_m, g_head, dy.astype(dtype)
+
+                def other(_):
+                    return (jnp.float32(0.0),
+                            jax.tree_util.tree_map(jnp.zeros_like,
+                                                   head_params),
+                            bwd_in)
+
+                loss_m, g_head_d, dy = lax.cond(s_idx == num_stages - 1,
+                                                last, other, None)
+                g_stage_d, dx = stage_vjp(dy)
+
+                def s0(_):
+                    _x, embed_vjp = jax.vjp(
+                        lambda ep: _embed(ep, tgt, dtype), embed_params)
+                    (g_embed_d,) = embed_vjp(dx)
+                    return g_embed_d
+
+                g_embed_d = lax.cond(
+                    s_idx == 0, s0,
+                    lambda _: jax.tree_util.tree_map(jnp.zeros_like,
+                                                     embed_params),
+                    None)
+                mask = b_valid.astype(jnp.float32)
+                g_d = dict(stage=g_stage_d, embed=g_embed_d, head=g_head_d)
+                g_d = jax.tree_util.tree_map(lambda x: x * mask, g_d)
+                return (jnp.zeros(act_shape, dtype), dx, stash, g_d,
+                        loss_m * mask / microbatches)
+
+            y_send, dx_send, stash, g_d, loss_d = lax.cond(
+                is_f, f_branch, b_branch, None)
+            g = jax.tree_util.tree_map(jnp.add, g, g_d)
+            fwd_in = lax.ppermute(y_send, "pipe", up)
+            bwd_in = lax.ppermute(dx_send, "pipe", down)
+            return (fwd_in, bwd_in, stash, g, loss_acc + loss_d), None
+
+        init = (jnp.zeros(act_shape, dtype), jnp.zeros(act_shape, dtype),
+                jnp.zeros((num_stages, *act_shape), dtype), zero_g,
+                jnp.float32(0.0))
+        total_ticks = 2 * (microbatches + num_stages - 1)
+        (_f, _b, _stash, g, loss), _ = lax.scan(
+            tick, init, jnp.arange(total_ticks))
+
+        # Reduce: loss lives on the last stage only; replicated-param grads
+        # live on their boundary stages only.
+        loss = lax.pmean(lax.psum(loss, "pipe"), "data")
+        g_stage = jax.tree_util.tree_map(
+            lambda x: lax.pmean(x, "data")[None], g["stage"])
+        g_rep = jax.tree_util.tree_map(
+            lambda x: lax.pmean(lax.psum(x, "pipe"), "data"),
+            {"embed": g["embed"], "head": g["head"]})
+        grads = {
+            "tok_embed": g_rep["embed"]["tok_embed"],
+            "pos_embed": g_rep["embed"]["pos_embed"],
+            "stages": g_stage,
+            "ln_f": g_rep["head"]["ln_f"],
+            "head": g_rep["head"]["head"],
+        }
+        return loss, grads
+
+    fn = jax.shard_map(body, mesh=mesh, in_specs=(param_specs, tok_spec),
+                       out_specs=grad_specs, check_vma=False)
+    return fn(params, tokens)
 
 
 def state_shardings(mesh, state):
@@ -258,9 +477,29 @@ def state_shardings(mesh, state):
 
 
 def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
+    import jax.numpy as jnp
     from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import train
+
+    shardings = shardings or state_shardings(mesh, state)
+
+    if getattr(args, "schedule", "gpipe") == "1f1b":
+        if getattr(args, "grad_accum", 1) != 1:
+            raise ValueError(
+                "--schedule 1f1b already streams microbatches; use "
+                "--microbatches instead of --grad-accum")
+        dtype = jnp.bfloat16 if args.dtype == "bf16" else jnp.float32
+
+        def grads_and_metrics(params, tokens):
+            loss, grads = pipeline_1f1b_loss_and_grads(
+                mesh, lambda p, h: stage.apply({"params": p}, h),
+                params, tokens, args.microbatches, dtype)
+            return grads, {"loss": loss}
+
+        return train.make_grads_train_step(
+            grads_and_metrics, tx, mesh, state, shardings,
+            batch_spec=P("data", None))
 
     def loss_fn(params, tokens):
         loss = train.next_token_nll(
@@ -268,7 +507,7 @@ def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
         return loss, {"loss": loss}
 
     return train.make_loss_train_step(
-        loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
+        loss_fn, tx, mesh, state, shardings,
         batch_spec=P("data", None),
         grad_accum=getattr(args, "grad_accum", 1))
 

@@ -310,6 +310,22 @@ def make_loss_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
             lambda m: jnp.mean(m, axis=0), metrics_stack)
         return grads, metrics
 
+    return make_grads_train_step(grads_and_metrics, tx, mesh, state,
+                                 shardings, batch_spec=batch_spec)
+
+
+def make_grads_train_step(grads_and_metrics: Callable,
+                          tx: optax.GradientTransformation, mesh: Mesh,
+                          state: TrainState,
+                          shardings: Optional[TrainState] = None,
+                          batch_spec: P = P("data")) -> Callable:
+    """The shared adam-update/donated-jit tail of every step builder:
+    ``grads_and_metrics(params, batch) -> (grads, metrics)`` however the
+    caller computes them — jax.value_and_grad (make_loss_train_step) or
+    hand-accumulated manual vjp (the pipeline 1F1B schedule)."""
+    shardings = shardings or state_shardings(mesh, state)
+    batch_shard = NamedSharding(mesh, batch_spec)
+
     def step(state: TrainState, batch: jnp.ndarray) -> Tuple[TrainState, dict]:
         grads, metrics = grads_and_metrics(state.params, batch)
         updates, new_opt = tx.update(grads, state.opt_state, state.params)
