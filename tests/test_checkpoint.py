@@ -117,8 +117,13 @@ def test_resume_fast_forwards_data_stream(tmp_path):
     train.train_loop(mesh2, step2, fresh, counting_stream(), steps=6,
                      checkpointer=ck2)
     ck2.close()
-    # 4 skipped on fast-forward + 2 trained = batches 0..5, in order.
-    assert consumed == [0, 1, 2, 3, 4, 5]
+    # 4 skipped on fast-forward + 2 trained, in order; the input pipeline
+    # may read a bounded look-ahead past the last trained batch (prefetch
+    # depth 2) — extra *consumption* is fine, extra *training* is not,
+    # and ck2's saved step (6, asserted via resume elsewhere) pins that.
+    assert consumed[:6] == [0, 1, 2, 3, 4, 5]
+    assert len(consumed) <= 6 + 2
+    assert consumed == sorted(consumed)
 
 
 def test_interval_policy_skips_off_interval_steps(tmp_path):
@@ -201,19 +206,18 @@ def test_sigterm_drain_checkpoints_current_step(tmp_path):
     batches = data_mod.synthetic_linear(0, 16, 8)
 
     ckpt = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=1000)
-    ran = {"steps": 0}
 
-    def counting_batches():
-        for arrays in batches:
-            ran["steps"] += 1
-            if ran["steps"] == 7:
-                bootstrap.request_drain()
-            yield arrays
+    def drain_after_step_7(i, _metrics):
+        # Step-indexed trigger (the signal handler's moral equivalent),
+        # independent of input-pipeline prefetch look-ahead.
+        if i == 7:
+            bootstrap.request_drain()
 
     try:
         with pytest.raises(SystemExit) as exc:
-            train.train_loop(mesh, step, state, counting_batches(), 50,
-                             checkpointer=ckpt)
+            train.train_loop(mesh, step, state, batches, 50,
+                             checkpointer=ckpt, log_every=1,
+                             log_fn=drain_after_step_7)
         assert exc.value.code == bootstrap.EXIT_RETRYABLE
         ckpt.close()
         # drain fired entering step index 7 (7 steps completed)

@@ -96,3 +96,43 @@ def test_preemption_triggers_group_restart_then_success(operator_env):
         .get("status", {}).get("phase") == "Done", timeout=90.0)
     assert (cs.tpujobs.get("default", "restarts")["status"].get("state")
             == "Succeeded")
+
+
+def test_suspend_resume_through_operator_binary(operator_env):
+    """User PATCHes spec.suspend over the wire; the operator tears down the
+    gang (slice freed), parks the job Suspended, and re-gangs the SAME
+    attempt on resume — then the job runs to completion."""
+    cs = operator_env
+    cs.tpujobs.create("default", {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "parkable", "namespace": "default"},
+        "spec": {"replicaSpecs": [{
+            "replicas": 2, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu"}]}}}]},
+    })
+
+    assert wait_for(lambda: len(cs.pods.list("default")) == 2)
+    for p in cs.pods.list("default"):
+        _set_pod_state(cs, p, "Running", {"running": {}})
+    assert wait_for(lambda: cs.tpujobs.get("default", "parkable")
+                    .get("status", {}).get("phase") == "Running")
+
+    job = cs.tpujobs.get("default", "parkable")
+    job["spec"]["suspend"] = True
+    cs.tpujobs.update("default", job)
+    assert wait_for(lambda: cs.tpujobs.get("default", "parkable")
+                    .get("status", {}).get("phase") == "Suspended")
+    assert wait_for(lambda: cs.pods.list("default") == [])
+
+    job = cs.tpujobs.get("default", "parkable")
+    job["spec"]["suspend"] = False
+    cs.tpujobs.update("default", job)
+    assert wait_for(lambda: len(cs.pods.list("default")) == 2, timeout=90.0)
+    pods = cs.pods.list("default")
+    assert all(p["metadata"]["labels"]["attempt"] == "0" for p in pods)
+    assert cs.tpujobs.get("default", "parkable")["status"].get("attempt") == 0
+
+    for p in pods:
+        _set_pod_state(cs, p, "Succeeded", {"terminated": {"exitCode": 0}})
+    assert wait_for(lambda: cs.tpujobs.get("default", "parkable")
+                    .get("status", {}).get("phase") == "Done", timeout=90.0)

@@ -243,3 +243,54 @@ def test_npz_classification_validates_eagerly(tmp_path):
              labels=np.zeros(32, np.int64))
     imgs, _ = next(data_mod.npz_classification(str(p4), 0, 16))
     assert float(imgs.max()) == 2.0
+
+
+def test_device_prefetch_preserves_order_and_bounds_lookahead():
+    import itertools
+
+    import jax
+
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh = train.make_mesh(4)
+    produced = []
+
+    def stream(n):
+        for i in range(n):
+            produced.append(i)
+            yield (np.full((4, 2), i, np.float32),)
+
+    # Order: device batches come back exactly in stream order.
+    out = [int(np.asarray(b[0])[0, 0])
+           for b in data_mod.device_prefetch(mesh, stream(7), depth=2)]
+    assert out == list(range(7))
+
+    # Look-ahead bound: after consuming k batches, at most k + depth have
+    # been pulled from the host stream.
+    produced.clear()
+    it = data_mod.device_prefetch(mesh, stream(10), depth=3)
+    for k in range(1, 5):
+        b = next(it)
+        assert isinstance(b[0], jax.Array)
+        assert len(produced) <= k + 3, (k, produced)
+
+    # Streams shorter than depth still drain completely.
+    assert len(list(data_mod.device_prefetch(mesh, stream(2), depth=5))) == 2
+    assert list(data_mod.device_prefetch(mesh, stream(0), depth=2)) == []
+
+
+def test_device_prefetch_depth_zero_is_strict_lockstep():
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh = train.make_mesh(4)
+    produced = []
+
+    def stream(n):
+        for i in range(n):
+            produced.append(i)
+            yield (np.full((4, 2), i, np.float32),)
+
+    it = data_mod.device_prefetch(mesh, stream(5), depth=0)
+    for k in range(1, 4):
+        next(it)
+        assert len(produced) == k  # no look-ahead at all

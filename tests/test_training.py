@@ -387,3 +387,113 @@ def test_reconcile_cleanup_phase_deletes_then_done():
     tj.reconcile()
     assert cs.pods.list("default") == []
     assert tj.job.status.phase == t.TPUJobPhase.DONE
+
+
+# --- suspend / resume (TPU-native; batch/v1 Job semantics) -------------------
+
+def test_suspend_tears_down_generation_and_parks():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert len(cs.pods.list("default")) == 2
+
+    tj.job.spec.suspend = True
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.SUSPENDED
+    assert tj.job.status.reason == "suspended by spec"
+    assert cs.pods.list("default") == []  # slice freed
+    assert any(e["reason"] == "JobSuspended" for e in cs.events.list("default"))
+    # idempotent while parked: no pods reappear, no repeat events
+    n_events = len(cs.events.list("default"))
+    tj.reconcile()
+    assert cs.pods.list("default") == []
+    assert len(cs.events.list("default")) == n_events
+    # attempt (the retry budget counter) is untouched
+    assert tj.job.status.attempt == 0
+
+
+def test_resume_regangs_same_attempt_to_completion():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    tj.job.spec.suspend = True
+    tj.reconcile()
+    assert cs.pods.list("default") == []
+
+    tj.job.spec.suspend = False
+    tj.reconcile()
+    pods = cs.pods.list("default")
+    assert len(pods) == 2
+    # same attempt: no retry budget spent, payload resumes from checkpoint
+    assert all(p["metadata"]["labels"]["attempt"] == "0" for p in pods)
+    assert any(e["reason"] == "JobResumed" for e in cs.events.list("default"))
+    assert tj.job.status.phase in (t.TPUJobPhase.CREATING,
+                                   t.TPUJobPhase.RUNNING)
+
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    for p in cs.pods.list("default"):
+        set_container_state(cs, p, "Succeeded",
+                            state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+
+
+def test_job_created_suspended_never_creates_pods():
+    job = worker_job()
+    job.spec.suspend = True
+    cs, tj = new_training_job(job)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.SUSPENDED
+    assert cs.pods.list("default") == []
+
+
+def test_suspend_does_not_touch_terminal_jobs():
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    for p in cs.pods.list("default"):
+        set_container_state(cs, p, "Succeeded",
+                            state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+    tj.job.spec.suspend = True
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+
+
+def test_suspend_roundtrips_through_wire_format():
+    job = worker_job()
+    job.spec.suspend = True
+    wire = job.to_dict()
+    assert wire["spec"]["suspend"] is True
+    assert t.TPUJob.from_dict(wire).spec.suspend is True
+    # default: absent from the wire, parsed false
+    job2 = worker_job()
+    assert "suspend" not in job2.to_dict()["spec"]
+    assert t.TPUJob.from_dict(job2.to_dict()).spec.suspend is False
+
+
+def test_suspend_retains_terminated_pods_and_their_verdict():
+    """Chief already exited 0 but the controller had not rolled it up when
+    the user suspended: terminated pods are retained (logs + verdict), and
+    resume rolls straight to Done instead of re-running the finished job."""
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    for p in cs.pods.list("default"):
+        set_container_state(cs, p, "Succeeded",
+                            state={"terminated": {"exitCode": 0}})
+    tj.job.spec.suspend = True
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.SUSPENDED
+    assert len(cs.pods.list("default")) == 2  # terminated pods kept
+
+    tj.job.spec.suspend = False
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+    assert len(cs.pods.list("default")) == 2  # nothing re-ran

@@ -89,13 +89,15 @@ def spec_schema() -> Dict[str, Any]:
         "numSlices": _int(minimum=1),
         "checkpointDir": _str(),
         "profileDir": _str(),
+        "suspend": {"type": "boolean"},
     }, required=["replicaSpecs"])
 
 
 def status_schema() -> Dict[str, Any]:
     phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
               types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
-              types.TPUJobPhase.FAILED, types.TPUJobPhase.DONE]
+              types.TPUJobPhase.FAILED, types.TPUJobPhase.DONE,
+              types.TPUJobPhase.SUSPENDED]
     states = [types.State.UNKNOWN, types.State.RUNNING,
               types.State.SUCCEEDED, types.State.FAILED]
     replica_states = [types.ReplicaState.UNKNOWN, types.ReplicaState.STARTING,
@@ -196,6 +198,10 @@ def validate_strict(value: Any, schema: Dict[str, Any] = None,
 
             if not re.match(pattern, value):
                 _fail(path, f"{value!r} does not match {pattern!r}")
+        return
+    if t == "boolean":
+        if not isinstance(value, bool):
+            _fail(path, f"expected boolean, got {type(value).__name__}")
         return
     if t == "integer":
         if isinstance(value, bool) or not isinstance(value, int):

@@ -92,6 +92,11 @@ class TPUJobPhase:
     CLEANUP = "CleanUp"
     FAILED = "Failed"
     DONE = "Done"
+    # TPU-native: spec.suspend parked the job — its generation's pods are
+    # deleted (the slice is freed for other jobs), the object and its
+    # services remain, and clearing the flag resumes the same attempt
+    # (payloads continue from their checkpoint).
+    SUSPENDED = "Suspended"
 
 
 class State:
@@ -224,6 +229,11 @@ class TPUJobSpec:
     # so payloads capture a jax.profiler steady-state trace
     # (train.train_loop) without per-job flag plumbing.
     profile_dir: str = ""
+    # Suspend (batch/v1 Job semantics, Kueue-style slice management): true
+    # parks the job — pods of the current attempt are deleted so the TPU
+    # slice frees for other work; false resumes the same attempt (retry
+    # budget untouched; checkpointed payloads continue where they stopped).
+    suspend: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -246,6 +256,8 @@ class TPUJobSpec:
             d["checkpointDir"] = self.checkpoint_dir
         if self.profile_dir:
             d["profileDir"] = self.profile_dir
+        if self.suspend:
+            d["suspend"] = True
         return d
 
     @classmethod
@@ -261,6 +273,7 @@ class TPUJobSpec:
             num_slices=int(d.get("numSlices", 1)),
             checkpoint_dir=str(d.get("checkpointDir", "")),
             profile_dir=str(d.get("profileDir", "")),
+            suspend=bool(d.get("suspend", False)),
         )
 
 

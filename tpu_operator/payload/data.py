@@ -124,6 +124,41 @@ def synthetic_lm(seed: int, batch: int, seq_len: int,
         yield (seq.astype(np.int32),)
 
 
+def device_prefetch(mesh: Mesh, batches, spec: P = None,
+                    depth: int = 2) -> Iterator[tuple]:
+    """Wrap a host-batch iterator into a device-batch iterator that keeps
+    ``depth`` transfers in flight ahead of consumption.
+
+    ``jax.device_put`` (and the multi-process placement path) is
+    asynchronous — it returns immediately with the copy enqueued — so
+    issuing the next batches' transfers *before* the current step is
+    dispatched overlaps host→device bytes behind device compute, the same
+    double-buffering a tf.data/grain input pipeline does on a real TPU VM.
+    ``depth=0`` degenerates to the unbuffered per-step put (and is what
+    bench.py's pre-staged cycles effectively are: put_global_batch passes
+    already-placed arrays through untouched)."""
+    from collections import deque
+
+    it = iter(batches)
+    if depth <= 0:
+        for arrs in it:
+            yield put_global_batch(mesh, *arrs, spec=spec)
+        return
+    buf: deque = deque()
+    try:
+        for _ in range(depth):
+            buf.append(put_global_batch(mesh, *next(it), spec=spec))
+    except StopIteration:
+        pass
+    for arrs in it:
+        nxt = put_global_batch(mesh, *arrs, spec=spec)
+        if buf:
+            yield buf.popleft()
+        buf.append(nxt)
+    while buf:
+        yield buf.popleft()
+
+
 def batch_sharding(mesh: Mesh, spec: P = None) -> NamedSharding:
     """Batches shard over the ``data`` axis by default; pass ``spec`` for
     additional dims (e.g. P("data", "seq") for sequence-sharded tokens)."""

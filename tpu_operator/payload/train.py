@@ -282,7 +282,6 @@ def make_loss_train_step(loss_fn: Callable, tx: optax.GradientTransformation,
     if grad_accum < 1:
         raise ValueError(f"grad_accum must be >= 1, got {grad_accum}")
     shardings = shardings or state_shardings(mesh, state)
-    batch_shard = NamedSharding(mesh, batch_spec)
 
     def grads_and_metrics(params, batch):
         if grad_accum == 1:
@@ -418,10 +417,14 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                log_fn: Callable[[int, dict], None] = None,
                checkpointer=None, spec=None,
                profile_dir: str = "",
-               profile_range: Tuple[int, int] = (10, 20)) -> Tuple[TrainState, dict]:
+               profile_range: Tuple[int, int] = (10, 20),
+               prefetch: int = 2) -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
-    interval. ``spec`` overrides the batch PartitionSpec (default P("data");
+    interval — and the batch transfers run ``prefetch`` deep ahead of the
+    step (data.device_prefetch), so host batch generation and H2D bytes
+    overlap behind device compute instead of sitting on the critical path.
+    ``spec`` overrides the batch PartitionSpec (default P("data");
     the LM payload passes P("data", "seq")).
 
     With a ``checkpointer`` (payload/checkpoint.py), the loop first restores
@@ -447,6 +450,10 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         state, start = checkpointer.restore(state)
         for _ in range(start):
             next(batches)
+    # Prefetch wraps the stream only after the resume fast-forward above,
+    # so a restarted attempt still sees exactly the batches it would have.
+    dev_batches = data_mod.device_prefetch(mesh, batches, spec=spec,
+                                           depth=max(0, prefetch))
     metrics = {}
     tracing = profiled = False
     trace_from, trace_to = start + profile_range[0], start + profile_range[1]
@@ -491,10 +498,7 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                     and i >= trace_from):
                 jax.profiler.start_trace(profile_dir)
                 tracing = True
-            host_arrays = next(batches)
-            device_arrays = data_mod.put_global_batch(mesh, *host_arrays,
-                                                      spec=spec)
-            state, metrics = train_step(state, *device_arrays)
+            state, metrics = train_step(state, *next(dev_batches))
             if tracing and (i + 1) >= trace_to:
                 jax.device_get(metrics)  # drain async work into the trace
                 jax.profiler.stop_trace()

@@ -300,6 +300,40 @@ class TrainingJob:
         self.setup_replicas()
         attempt = self.job.status.attempt
 
+        # Suspend/resume (spec.suspend, batch/v1 Job semantics): suspension
+        # tears down the whole generation — a partial JAX group computes
+        # nothing, so freeing part of the slice would waste the rest — and
+        # parks the job in Suspended; clearing the flag re-gangs the SAME
+        # attempt (no retry budget spent; checkpointed payloads resume).
+        if self.job.spec.suspend:
+            if phase != TPUJobPhase.SUSPENDED:
+                # Delete only LIVE pods (like _fail): terminated pods keep
+                # their logs and their verdict — a chief that already
+                # exited 0 must still roll up to Done on resume, not
+                # re-run.
+                self._delete_live_pods()
+                self.job.status.phase = TPUJobPhase.SUSPENDED
+                self.job.status.state = State.UNKNOWN
+                self.job.status.reason = "suspended by spec"
+                # Pre-suspend replica roll-ups describe pods that no longer
+                # run; a parked job showing "Running" replicas would lie.
+                self.job.status.replica_statuses = []
+                if self.recorder:
+                    self.recorder.event(
+                        self, "Normal", "JobSuspended",
+                        f"deleted attempt {attempt}'s live pods; slice freed")
+            self.update_crd_status()
+            return
+        if phase == TPUJobPhase.SUSPENDED:
+            self.job.status.phase = TPUJobPhase.CREATING
+            self.job.status.state = State.RUNNING
+            self.job.status.reason = ""
+            if self.recorder:
+                self.recorder.event(
+                    self, "Normal", "JobResumed",
+                    f"re-ganging attempt {attempt}")
+            # fall through: the normal sync below recreates the gang.
+
         # Services first: the coordinator's DNS name must resolve before any
         # worker calls jax.distributed.initialize (SURVEY.md hard part (c)).
         self._sync_headless_service()
