@@ -111,25 +111,48 @@ class DecoderBlock(nn.Module):
     # shard, so attention is head-local with no reshard (a fused kernel's
     # contiguous column shards straddle the q/k/v thirds).
     split_qkv: bool = False
+    # Grouped-query attention (Ainslie et al. 2023, public technique):
+    # K/V project to kv_heads < heads and each K/V head serves
+    # heads/kv_heads query heads. Cuts K/V projection params, their
+    # gradients, and (at inference) the KV cache by the group factor;
+    # K/V are broadcast across the group before the attention kernel, so
+    # every attend implementation (flash, ring, ulysses, oracle) works
+    # unchanged. 0 = MHA (kv_heads == heads); 1 = MQA.
+    kv_heads: int = 0
 
     @nn.compact
     def __call__(self, x: jnp.ndarray) -> jnp.ndarray:
         b, t, _ = x.shape
         head_dim = self.dim // self.heads
+        kv_heads = self.kv_heads or self.heads
+        if kv_heads < 0 or self.heads % kv_heads != 0:
+            # Note 4 % -1 == 0 in Python: the sign check cannot be folded
+            # into the divisibility one.
+            raise ValueError(
+                f"heads {self.heads} must divide by kv_heads {kv_heads} > 0")
+        kv_dim = kv_heads * head_dim
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_attn")(x)
-        if self.split_qkv:
+        if self.split_qkv or kv_heads != self.heads:
+            # GQA always splits: a fused [d, q+2kv] kernel's thirds are no
+            # longer equal, and TP sharding needs per-projection columns.
             q = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                          name="q")(h)
-            k = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+            k = nn.Dense(kv_dim, use_bias=False, dtype=self.dtype,
                          name="k")(h)
-            v = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
+            v = nn.Dense(kv_dim, use_bias=False, dtype=self.dtype,
                          name="v")(h)
         else:
             qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
                            name="qkv")(h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
-        shape = (b, t, self.heads, head_dim)
-        out = self.attend(q.reshape(shape), k.reshape(shape), v.reshape(shape))
+        q = q.reshape(b, t, self.heads, head_dim)
+        k = k.reshape(b, t, kv_heads, head_dim)
+        v = v.reshape(b, t, kv_heads, head_dim)
+        if kv_heads != self.heads:
+            group = self.heads // kv_heads
+            k = jnp.repeat(k, group, axis=2)
+            v = jnp.repeat(v, group, axis=2)
+        out = self.attend(q, k, v)
         out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                        name="attn_out")(out.reshape(b, t, self.dim))
         x = x + out

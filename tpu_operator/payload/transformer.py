@@ -98,6 +98,12 @@ def parse_args(argv=None):
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention: K/V heads (< --heads; "
+                        "each serves heads/kv-heads query heads). 0 = MHA, "
+                        "1 = MQA. Cuts K/V projection params + grads by "
+                        "the group factor; under --tensor-parallel, "
+                        "kv-heads must divide by the TP degree")
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
@@ -175,11 +181,21 @@ def _build_model(args, mesh):
             "(both shard the head dimension); use --sp-mode ring")
     mode = getattr(args, "split_qkv", "auto")
     split_qkv = mode == "on" or (mode == "auto" and tp > 1)
+    kv_heads = getattr(args, "kv_heads", 0)
+    if kv_heads < 0:
+        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
+    if kv_heads and args.heads % kv_heads != 0:
+        raise ValueError(
+            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
     if tp > 1:
         if args.heads % tp != 0:
             raise ValueError(
                 f"--heads {args.heads} must divide by --tensor-parallel "
                 f"{tp} (TP shards whole heads)")
+        if kv_heads and kv_heads % tp != 0:
+            raise ValueError(
+                f"--kv-heads {kv_heads} must divide by --tensor-parallel "
+                f"{tp} (TP shards whole K/V heads)")
         if args.dim % tp != 0:
             raise ValueError(
                 f"--dim {args.dim} must divide by --tensor-parallel {tp}")
@@ -221,7 +237,8 @@ def _build_model(args, mesh):
             x = x + pos[None]
             for i in range(self.layers):
                 x = Block(self.dim, self.heads, attend,
-                          split_qkv=split_qkv, name=f"block{i}")(x)
+                          split_qkv=split_qkv, kv_heads=kv_heads,
+                          name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
                             name="lm_head")(x)
