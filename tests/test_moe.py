@@ -207,3 +207,33 @@ def test_ep_tp_validates_head_divisibility():
         moe.build(_args(heads=3, expert_parallel=2, tensor_parallel=2),
                   mesh=moe.make_moe_mesh(8, expert_parallel=2,
                                          tensor_parallel=2))
+
+
+def test_moe_gqa_with_ep_tp_descends(mesh_ep_tp):
+    from tpu_operator.payload import data as data_mod
+
+    args = _args(batch=16, expert_parallel=2, tensor_parallel=2,
+                 heads=4, kv_heads=2)
+    _m, _model, state, step, batches = moe.build(args, mesh=mesh_ep_tp)
+    assert state.params["block0"]["k"]["kernel"].shape == (32, 16)
+    losses = []
+    for _ in range(25):
+        (tok,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh_ep_tp, tok)
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+def test_moe_gqa_validates_divisibility(mesh_ep_tp):
+    with pytest.raises(ValueError, match="kv-heads"):
+        moe.build(_args(heads=4, kv_heads=3),
+                  mesh=moe.make_moe_mesh(2, expert_parallel=1))
+    with pytest.raises(ValueError, match="kv-heads"):
+        moe.build(_args(heads=4, kv_heads=-2),
+                  mesh=moe.make_moe_mesh(2, expert_parallel=1))
+    with pytest.raises(ValueError, match="kv-heads"):
+        # MQA (1 K/V head) cannot shard over a TP degree of 2
+        moe.build(_args(heads=4, kv_heads=1, expert_parallel=2,
+                        tensor_parallel=2), mesh=mesh_ep_tp)

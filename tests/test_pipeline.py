@@ -247,3 +247,22 @@ def test_build_validates_divisibility():
                        mesh=pipeline.make_pipe_mesh(8, pipeline=4))
     with pytest.raises(ValueError):
         pipeline._stage_module(_args(layers=5, pipeline=4))
+
+
+def test_pipeline_gqa_descends(mesh):
+    from tpu_operator.payload import data as data_mod
+
+    args = _args(batch=16, microbatches=4, heads=4, kv_heads=2,
+                 schedule="1f1b")
+    _mesh, _stage, state, step, batches = pipeline.build(args, mesh=mesh)
+    blk = state.params["stages"]
+    # stacked stage params: [S, in, out]; K/V project to kv_heads*head_dim
+    assert blk["block0"]["k"]["kernel"].shape == (4, 32, 16)
+    losses = []
+    for _ in range(25):
+        (tok,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh, tok)
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::5]

@@ -497,3 +497,35 @@ def test_suspend_retains_terminated_pods_and_their_verdict():
     tj.reconcile()
     assert tj.job.status.phase == t.TPUJobPhase.DONE
     assert len(cs.pods.list("default")) == 2  # nothing re-ran
+
+
+def test_suspend_survives_operator_restart():
+    """Operator dies while a job is parked: the NEW operator's TrainingJob
+    (rebuilt from the persisted CRD, reference-style UID-keyed resume) must
+    keep the job parked, and a later resume still works."""
+    cs, tj = new_training_job()
+    tj.reconcile()
+    all_running(cs)
+    # the user suspends via the apiserver (as the e2e tier does); the
+    # in-memory copy follows the same edit, as refresh() would
+    wire = cs.tpujobs.get("default", "train")
+    wire["spec"]["suspend"] = True
+    cs.tpujobs.update("default", wire)
+    tj.job.spec.suspend = True
+    tj.reconcile()
+    assert cs.pods.list("default") == []
+
+    # "restart": a fresh TrainingJob from the apiserver's copy of the job
+    wire = cs.tpujobs.get("default", "train")
+    revived = TrainingJob(cs, EventRecorder(cs),
+                          t.TPUJob.from_dict(wire))
+    assert revived.job.spec.suspend is True
+    assert revived.job.status.phase == t.TPUJobPhase.SUSPENDED
+    revived.reconcile()
+    assert cs.pods.list("default") == []  # still parked
+
+    revived.job.spec.suspend = False
+    revived.reconcile()
+    assert len(cs.pods.list("default")) == 2
+    assert all(p["metadata"]["labels"]["attempt"] == "0"
+               for p in cs.pods.list("default"))

@@ -44,6 +44,10 @@ def parse_args(argv=None):
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=128)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention K/V heads (0 = MHA, "
+                        "1 = MQA); must divide --heads and, under "
+                        "--tensor-parallel, the TP degree")
     p.add_argument("--layers", type=int, default=4)
     p.add_argument("--experts", type=int, default=4,
                    help="experts per MoE layer (mesh expert axis must divide it)")
@@ -234,6 +238,12 @@ def _build_model(args, mesh):
         raise ValueError(
             f"--experts {args.experts} not divisible by the mesh expert "
             f"axis ({mesh.shape['expert']})")
+    kv_heads = getattr(args, "kv_heads", 0)
+    if kv_heads < 0:
+        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
+    if kv_heads and args.heads % kv_heads != 0:
+        raise ValueError(
+            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
     tp = mesh.shape.get("model", 1)
     if tp > 1:
         if args.heads % tp != 0:
@@ -244,6 +254,10 @@ def _build_model(args, mesh):
             raise ValueError(
                 f"FFN hidden {4 * args.dim} must divide by "
                 f"--tensor-parallel {tp}")
+        if kv_heads and kv_heads % tp != 0:
+            raise ValueError(
+                f"--kv-heads {kv_heads} must divide by --tensor-parallel "
+                f"{tp} (TP shards whole K/V heads)")
 
     def attend(q, k, v):
         if dtype == jnp.bfloat16 and fa.use_pallas_default():
@@ -285,6 +299,7 @@ def _build_model(args, mesh):
                 mlp = moe_mlp if i % 2 == 1 else None
                 x = Block(self.dim, self.heads, attend,
                           dtype=dtype, mlp=mlp, split_qkv=split_qkv,
+                          kv_heads=kv_heads,
                           name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
             return nn.Dense(self.vocab, use_bias=False, dtype=dtype,

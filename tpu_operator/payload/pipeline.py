@@ -64,6 +64,9 @@ def parse_args(argv=None):
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=0,
+                   help="grouped-query attention K/V heads (0 = MHA, "
+                        "1 = MQA); must divide --heads")
     p.add_argument("--layers", type=int, default=8,
                    help="total decoder blocks (divisible by --pipeline)")
     p.add_argument("--pipeline", type=int, default=1,
@@ -130,6 +133,13 @@ def _stage_module(args):
     Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
              else models.DecoderBlock)
 
+    kv_heads = getattr(args, "kv_heads", 0)
+    if kv_heads < 0:
+        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
+    if kv_heads and args.heads % kv_heads != 0:
+        raise ValueError(
+            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
+
     class Stage(nn.Module):
         dim: int
         heads: int
@@ -139,7 +149,8 @@ def _stage_module(args):
         def __call__(self, x):
             for i in range(self.blocks):
                 x = Block(self.dim, self.heads, attend,
-                          dtype=dtype, name=f"block{i}")(x)
+                          dtype=dtype, kv_heads=kv_heads,
+                          name=f"block{i}")(x)
             return x
 
     if args.layers % args.pipeline != 0:
