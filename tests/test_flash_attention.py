@@ -149,6 +149,161 @@ def test_fully_masked_rows_are_zero():
         assert np.all(np.asarray(out) == 0.0), f"use_pallas={use_pallas}"
 
 
+def qkv_gqa(b=1, t=256, h=4, kv=2, d=64, seed=0, dtype=jnp.float32):
+    rng = np.random.default_rng(seed)
+    mk = lambda hh: jnp.asarray(rng.normal(size=(b, t, hh, d)), dtype)
+    return mk(h), mk(kv), mk(kv)
+
+
+@pytest.mark.parametrize("kv", [1, 2])
+@pytest.mark.parametrize("causal", [True, False])
+def test_gqa_flash_matches_repeat_oracle(kv, causal):
+    """Grouped-KV kernel vs the jnp.repeat-based oracle (kv=1 is MQA).
+    The oracle broadcasts K/V to full heads; the kernel must never need
+    to."""
+    q, k, v = qkv_gqa(h=4, kv=kv)
+    got = fa.flash_attention(q, k, v, causal=causal, use_pallas=True)
+    want = ring.reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("use_pallas", [True, False])
+def test_gqa_grad_matches_repeat_oracle(use_pallas):
+    """GQA gradients through the fused backward: dk/dv come back at KV
+    size and must equal the oracle's gradient (which sums the repeated
+    heads' contributions via the repeat's transpose)."""
+    q, k, v = qkv_gqa(t=128, h=4, kv=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(q, k, v, causal=True,
+                               use_pallas=use_pallas) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ring.reference_attention(q, k, v, causal=True) ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    assert got[1].shape == k.shape and got[2].shape == v.shape
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
+
+
+def test_gqa_streamed_blocks_match_reference():
+    """Out-of-order merge_kv_block calls with kv-sized K/V blocks — the
+    GQA ring step pattern (carry at query heads, visiting blocks at KV
+    heads)."""
+    q, k, v = qkv_gqa(t=256, h=4, kv=2)
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, h, t, d = qt.shape
+    half = t // 2
+
+    carry = fa.init_carry(b, h, t, d)
+    carry = fa.merge_kv_block(qt, kt[:, :, half:], vt[:, :, half:], carry,
+                              jnp.array([0.0, half]), causal=True,
+                              use_pallas=True)
+    carry = fa.merge_kv_block(qt, kt[:, :, :half], vt[:, :, :half], carry,
+                              jnp.array([0.0, 0.0]), causal=True,
+                              use_pallas=True)
+    got = jnp.einsum("bhqd->bqhd", fa.finalize(carry, q.dtype))
+    want = ring.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_gqa_striped_offsets_kernel_matches_ref_math():
+    """Grouped causal mask under a strided (striped-layout) offsets triple:
+    kernel (interpret) vs the grouped jnp reference recurrence."""
+    q, k, v = qkv_gqa(t=128, h=4, kv=2)
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, h, t, d = qt.shape
+    offsets = jnp.array([1, 0, 2], jnp.int32)  # q at 1+2i, k at 2i
+    carry = fa.init_carry(b, h, t, d)
+    got = fa.merge_kv_block(qt, kt, vt, carry, offsets, causal=True,
+                            use_pallas=True)
+    want = fa._merge_ref(qt, kt, vt, *carry, offsets, True)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-5, atol=2e-5)
+
+
+@pytest.mark.parametrize("stripe", [False, True])
+def test_gqa_ring_attention_matches_reference(stripe):
+    """Ring attention with kv-sized K/V rotating the ring (the GQA ICI
+    win), forward and backward, contiguous and striped layouts."""
+    from tpu_operator.payload.transformer import make_lm_mesh
+
+    mesh = make_lm_mesh(4, seq_parallel=2)
+    q, k, v = qkv_gqa(b=2, t=256, h=4, kv=2)
+    if stripe:
+        perm, inv = ring.stripe_permutation(256, 2)
+        qs, ks, vs = q[:, perm], k[:, perm], v[:, perm]
+    else:
+        qs, ks, vs = q, k, v
+
+    def loss_ring(q_, k_, v_):
+        out = ring.ring_attention(q_, k_, v_, mesh, causal=True,
+                                  use_pallas=True, stripe=stripe)
+        if stripe:
+            out = out[:, inv]
+        return jnp.sum(out.astype(jnp.float32) ** 2), out
+
+    def loss_ref(q_, k_, v_):
+        out = ring.reference_attention(q_, k_, v_, causal=True)
+        return jnp.sum(out.astype(jnp.float32) ** 2), out
+
+    (_, got_out), got = jax.value_and_grad(
+        loss_ring, argnums=(0, 1, 2), has_aux=True)(qs, ks, vs)
+    (_, want_out), want = jax.value_and_grad(
+        loss_ref, argnums=(0, 1, 2), has_aux=True)(q, k, v)
+    np.testing.assert_allclose(np.asarray(got_out), np.asarray(want_out),
+                               rtol=2e-5, atol=2e-5)
+    if stripe:
+        got = tuple(g[:, inv] for g in got)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=2e-4, atol=2e-4)
+
+
+def test_gqa_rejects_non_divisible_heads():
+    q, k, v = qkv_gqa(t=128, h=4, kv=3)
+    with pytest.raises(ValueError, match="multiple of K/V heads"):
+        fa.flash_attention(q, k, v, causal=True, use_pallas=False)
+
+
+def test_gqa_block_heuristics():
+    """GQA groups shrink blk_q to keep the flattened score panel inside
+    VMEM; MHA keeps the round-2 blocks exactly."""
+    assert fa._fwd_blocks(8192, 8192, 1) == (512, 512)
+    assert fa._fwd_blocks(8192, 8192, 4) == (256, 512)
+    assert fa._fwd_blocks(8192, 8192, 8) == (128, 512)
+    assert fa._fwd_blocks(8192, 8192, 16) == (64, 512)
+    assert fa._bwd_blocks(8192, 8192, 1) == (512, 512)
+    assert fa._bwd_blocks(8192, 8192, 4) == (128, 512)
+    assert fa._bwd_blocks(8192, 8192, 16) == (64, 256)
+    # non-power-of-two groups (12 heads / 4 kv = group 3): the target is
+    # rounded down to a power of two so blk_q still lands on a divisor
+    # instead of degenerating to the whole span
+    blk_q, blk_k = fa._fwd_blocks(8192, 8192, 3)
+    assert blk_q <= 512 and 8192 % blk_q == 0 and blk_q * 3 <= 1024
+    blk_q, _ = fa._bwd_blocks(8192, 8192, 3)
+    assert blk_q <= 256 and 8192 % blk_q == 0
+
+
+def test_gqa_non_power_of_two_group_matches_oracle():
+    q, k, v = qkv_gqa(t=256, h=6, kv=2)  # group = 3
+    got = fa.flash_attention(q, k, v, causal=True, use_pallas=True)
+    want = ring.reference_attention(q, k, v, causal=True)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
 def test_pick_block():
     assert fa._pick_block(1024) == 512
     assert fa._pick_block(512) == 512

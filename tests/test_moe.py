@@ -34,7 +34,7 @@ def mesh():
 
 def test_top2_dispatch_invariants():
     logits = jax.random.normal(jax.random.key(0), (2, 16, 4))
-    dispatch, combine, aux = moe.top2_dispatch(logits, capacity=16)
+    dispatch, combine, aux, drop = moe.top2_dispatch(logits, capacity=16)
     # ample capacity: every token lands in exactly its two experts…
     np.testing.assert_allclose(np.asarray(dispatch.sum(axis=(2, 3))), 2.0)
     # …each slot holds at most one token…
@@ -44,14 +44,38 @@ def test_top2_dispatch_invariants():
                                atol=1e-6)
     # aux loss is ≥ 1 at exact balance (Switch scaling), finite here.
     assert np.isfinite(float(aux)) and float(aux) >= 1.0
+    # nothing dropped at ample capacity
+    assert abs(float(drop)) < 1e-6
 
 
 def test_top2_capacity_drops_tokens_not_correctness():
     logits = jnp.zeros((1, 16, 2))  # all tokens tie → argmax routes all to e0
-    dispatch, combine, _aux = moe.top2_dispatch(logits, capacity=4)
+    dispatch, combine, _aux, drop = moe.top2_dispatch(logits, capacity=4)
     # expert 0 first choices fill 4 slots; the rest of its traffic drops
     assert float(dispatch[0, :, 0].sum()) <= 4.0 + 1e-6
     assert np.isfinite(np.asarray(combine)).all()
+    # 32 routed assignments (2 × 16 tokens), 8 capacity slots ⇒ 75% dropped
+    np.testing.assert_allclose(float(drop), 0.75, atol=1e-6)
+
+
+def test_drop_frac_reported_in_training_metrics(mesh):
+    """The dropped-token fraction must surface per step: ~0 at an ample
+    capacity factor, decidedly nonzero when capacity is starved."""
+    from tpu_operator.payload import data as data_mod
+
+    ample = _args(capacity_factor=4.0)
+    starved = _args(capacity_factor=0.25)
+    _, _, st_a, step_a, batches = moe.build(ample, mesh=mesh)
+    _, _, st_s, step_s, _ = moe.build(starved, mesh=mesh)
+    (tok,) = next(batches)
+    from jax.sharding import PartitionSpec as P
+
+    (dev,) = data_mod.put_global_batch(mesh, tok, spec=P("data", None))
+    _, m_a = step_a(st_a, dev)
+    _, m_s = step_s(st_s, dev)
+    assert float(m_a["drop_frac"]) < 0.05, m_a
+    assert float(m_s["drop_frac"]) > 0.2, m_s
+    assert 0.0 <= float(m_s["drop_frac"]) <= 1.0
 
 
 def test_identical_experts_degenerate_to_dense_ffn(mesh):

@@ -294,3 +294,80 @@ def test_device_prefetch_depth_zero_is_strict_lockstep():
     for k in range(1, 4):
         next(it)
         assert len(produced) == k  # no look-ahead at all
+
+
+def test_token_file_lm_deterministic_and_resumable(tmp_path):
+    """The memory-mapped token stream is an exact function of (file, seed):
+    two iterators agree across epoch boundaries, and skipping N batches
+    (train_loop's resume fast-forward) lands exactly where an uninterrupted
+    run would be."""
+    rng = np.random.default_rng(0)
+    tokens = rng.integers(0, 97, size=2048).astype(np.uint16)
+    path = tmp_path / "tokens.npy"
+    np.save(path, tokens)
+
+    mk = lambda: data_mod.token_file_lm(str(path), seed=5, batch=8,
+                                        seq_len=32, vocab=97)
+    a, b = mk(), mk()
+    drawn = []
+    for _ in range(12):  # 64 windows / 8 = 8 batches per epoch: crosses one
+        (ta,) = next(a)
+        (tb,) = next(b)
+        np.testing.assert_array_equal(ta, tb)
+        assert ta.shape == (8, 32) and ta.dtype == np.int32
+        drawn.append(ta)
+    # windows within one epoch never repeat
+    first_epoch = np.concatenate([d.reshape(-1, 32) for d in drawn[:8]])
+    assert len(np.unique(first_epoch[:, 0], axis=0)) >= 8
+
+    resumed = mk()
+    for _ in range(5):
+        next(resumed)  # the fast-forward train_loop does on resume
+    fresh = mk()
+    for _ in range(5):
+        next(fresh)
+    np.testing.assert_array_equal(next(fresh)[0], next(resumed)[0])
+
+
+def test_token_file_lm_validates_eagerly(tmp_path):
+    p1 = tmp_path / "big.npy"
+    np.save(p1, np.full(512, 300, np.int32))
+    with pytest.raises(ValueError, match="vocab"):
+        data_mod.token_file_lm(str(p1), 0, 4, 32, vocab=256)
+    p2 = tmp_path / "short.npy"
+    np.save(p2, np.zeros(64, np.int32))
+    with pytest.raises(ValueError, match="windows"):
+        data_mod.token_file_lm(str(p2), 0, 8, 32)
+    p3 = tmp_path / "shape.npy"
+    np.save(p3, np.zeros((8, 8), np.int32))
+    with pytest.raises(ValueError, match="1-D"):
+        data_mod.token_file_lm(str(p3), 0, 2, 4)
+
+
+def test_transformer_trains_on_token_file(tmp_path):
+    """--data end to end: the LM fits a strongly-structured real token file
+    through the mmap path (loss must drop hard, proving the stream feeds
+    actual file contents, not noise)."""
+    from tpu_operator.payload import transformer
+
+    # a file full of the same affine recurrence the synthetic stream uses
+    a, b, vocab = 5, 17, 64
+    seq = np.empty(4096, np.int64)
+    seq[0] = 1
+    for t in range(1, len(seq)):
+        seq[t] = (a * seq[t - 1] + b) % vocab
+    path = tmp_path / "corpus.npy"
+    np.save(path, seq.astype(np.uint16))
+
+    args = transformer.parse_args([
+        "--batch", "8", "--seq-len", "32", "--dim", "64", "--heads", "2",
+        "--layers", "2", "--vocab", str(vocab), "--lr", "1e-2",
+        "--data", str(path)])
+    mesh, _m, state, step, batches = transformer.build(args)
+    losses = []
+    for _ in range(30):
+        (tok,) = data_mod.put_global_batch(mesh, *next(batches), spec=None)
+        state, metrics = step(state, tok)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.5, losses[::6]

@@ -256,3 +256,28 @@ def test_gqa_validates_divisibility():
             ["--heads", "4", "--kv-heads", "1", "--tensor-parallel", "2",
              "--dim", "32"]),
             mesh=transformer.make_lm_mesh(4, tensor_parallel=2))
+
+
+def test_split_qkv_off_under_tp_warns(caplog):
+    """--split-qkv off with a model axis > 1 shards a fused [d,3d]
+    kernel's columns across the q/k/v thirds — supported (checkpoint
+    layout compat, test_tp_fused_qkv_compat_shards_packed_kernel) but
+    heads stop being shard-local, so both LM payloads must say so."""
+    import logging
+
+    from tpu_operator.payload import moe, transformer
+
+    with caplog.at_level(logging.WARNING):
+        transformer.build(transformer.parse_args(
+            ["--batch", "8", "--heads", "4", "--dim", "32", "--seq-len",
+             "32", "--tensor-parallel", "2", "--split-qkv", "off"]),
+            mesh=transformer.make_lm_mesh(4, tensor_parallel=2))
+    assert any("split-qkv off" in r.message for r in caplog.records)
+    caplog.clear()
+    with caplog.at_level(logging.WARNING):
+        moe.build(moe.parse_args(
+            ["--batch", "8", "--heads", "4", "--dim", "32", "--seq-len",
+             "32", "--experts", "4", "--tensor-parallel", "2",
+             "--split-qkv", "off"]),
+            mesh=moe.make_moe_mesh(8, expert_parallel=2, tensor_parallel=2))
+    assert any("split-qkv off" in r.message for r in caplog.records)

@@ -124,6 +124,75 @@ def synthetic_lm(seed: int, batch: int, seq_len: int,
         yield (seq.astype(np.int32),)
 
 
+def token_file_lm(path: str, seed: int, batch: int, seq_len: int,
+                  vocab: int = 0) -> Iterator[Tuple[np.ndarray]]:
+    """Stream [batch, seq_len] i32 token batches from a mounted ``.npy``
+    token file — the real-data counterpart of synthetic_lm, mirroring the
+    CIFAR ``.npz`` discipline (npz_classification): mounted volume, eager
+    validation, seed-deterministic order.
+
+    The file is a 1-D integer token array, **memory-mapped** — a
+    multi-GB corpus costs no resident RAM; each batch gathers only the
+    windows it touches. Tokens chunk into non-overlapping ``seq_len``
+    windows (remainder dropped); every epoch draws a fresh seeded
+    permutation of windows, so the stream is an exact function of
+    (path contents, seed) — which is what makes two properties hold:
+
+    - every process of a multi-controller job draws the identical global
+      batch and contributes its addressable slices (put_global_batch's
+      contract, same as the synthetic generators);
+    - checkpoint resume replays exactly: train_loop fast-forwards the
+      stream past the ``start`` batches the previous attempt consumed, and
+      determinism guarantees batches ``start..`` match what an
+      uninterrupted run would have seen.
+
+    ``vocab`` validates eagerly (min/max over the mapped array — a
+    sequential scan, no materialization): out-of-range tokens would
+    otherwise train silently wrong through the loss's clamped gather.
+    """
+    tokens = np.load(path, mmap_mode="r")
+    if tokens.ndim != 1 or not np.issubdtype(tokens.dtype, np.integer):
+        raise ValueError(
+            f"token file {path}: expected a 1-D integer array, got "
+            f"{tokens.dtype}{list(tokens.shape)}")
+    n_windows = len(tokens) // seq_len
+    if n_windows < batch:
+        raise ValueError(
+            f"token file {path}: {len(tokens)} tokens = {n_windows} "
+            f"windows of {seq_len} < batch {batch}")
+    if vocab:
+        lo, hi = int(tokens.min()), int(tokens.max())
+        if lo < 0 or hi >= vocab:
+            raise ValueError(
+                f"token file {path} spans [{lo}, {hi}], model vocab is "
+                f"{vocab}")
+
+    def stream():
+        rng = np.random.default_rng(seed)
+        while True:
+            perm = rng.permutation(n_windows)
+            for i in range(0, n_windows - batch + 1, batch):
+                idx = perm[i:i + batch]
+                out = np.empty((batch, seq_len), np.int32)
+                for row, w in enumerate(idx):
+                    out[row] = tokens[w * seq_len:(w + 1) * seq_len]
+                yield (out,)
+
+    return stream()
+
+
+def lm_batches(args) -> Iterator[Tuple[np.ndarray]]:
+    """The shared LM data entry: ``--data /path/tokens.npy`` selects the
+    memory-mapped real-token stream, else the synthetic recurrence — one
+    switch for transformer/pipeline/moe so the payloads cannot drift."""
+    data_path = getattr(args, "data", "")
+    if data_path:
+        return token_file_lm(data_path, args.seed, args.batch, args.seq_len,
+                             vocab=args.vocab)
+    return synthetic_lm(args.seed, args.batch, args.seq_len,
+                        vocab=args.vocab)
+
+
 def device_prefetch(mesh: Mesh, batches, spec: P = None,
                     depth: int = 2) -> Iterator[tuple]:
     """Wrap a host-batch iterator into a device-batch iterator that keeps

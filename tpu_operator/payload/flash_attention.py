@@ -18,6 +18,19 @@ from ``broadcasted_iota`` (2D, as TPU requires). Global sequence offsets
 arrive as scalar-prefetch values so one compiled kernel serves every ring
 step (the offsets are traced, not baked into the grid).
 
+Grouped-query attention is kernel-native (Ainslie et al. 2023, public
+technique): K/V may carry ``kv_heads < heads`` with
+``group = heads // kv_heads`` query heads per K/V head. The grid's head
+dimension runs over *KV* heads and the Q/O/L/M BlockSpecs carry a
+``group``-deep head block that the kernel flattens to a
+``[group*blk_q, D]`` panel — one bigger MXU matmul per tile, K/V blocks
+fetched once per group instead of once per query head, and dK/dV
+accumulated directly at KV size. No ``jnp.repeat`` anywhere: the repeated
+K/V tensor (and its gradient) that a broadcast-based GQA materializes in
+HBM — the 4x K/V bandwidth and memory cost at kv4/16 — never exists.
+Q heads map to K/V heads contiguously (query head h reads KV head
+h // group), matching the `jnp.repeat(k, group, axis=2)` oracle.
+
 Differentiation — fully fused, both directions:
 
 - :func:`flash_attention` (the single-shard path every payload calls) is a
@@ -57,15 +70,58 @@ NEG_INF = -1e30
 Carry = Tuple[jnp.ndarray, jnp.ndarray, jnp.ndarray]  # o, l, m
 
 
-def _pick_block(t: int, target: int = 512) -> int:
+def _pick_block(t: int, target: int = 512, floor: int = 128) -> int:
     """Largest power-of-two divisor of ``t`` up to ``target`` (whole span
-    when ``t`` has no such divisor — tiny test shapes)."""
-    b = target
-    while b >= 128:
+    when ``t`` has no such divisor — tiny test shapes). ``floor`` drops to
+    64 for large GQA groups, whose flattened panels multiply every q-row
+    by the group factor. ``target`` is rounded down to a power of two
+    first: the budget formulas divide by the group factor, and a
+    non-power-of-two group (e.g. 12 heads / 4 KV heads = group 3) would
+    otherwise make the halving loop skip every actual divisor of ``t``
+    and fall through to the whole span — the exact VMEM blowup this
+    helper exists to cap."""
+    b = 1 << (max(1, target).bit_length() - 1)
+    while b >= floor:
         if t % b == 0:
             return b
         b //= 2
     return t
+
+
+def _fwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
+    """(blk_q, blk_k) for the forward merge. The kernel's VMEM high-water
+    is the flattened f32 score panel [group*blk_q, blk_k] plus its exp —
+    with double-buffered q/o blocks on top, a 2048-row panel measured
+    1.75M over the 16M scoped-vmem limit on v5e. Cap the panel area at
+    1024x512 and shrink k-tiles before dropping blk_q below 128.
+    group == 1 keeps the round-2 blocks (512, 512) exactly."""
+    floor = 64 if group > 8 else 128
+    blk_q = _pick_block(tq, target=max(floor, min(512, 1024 // group)),
+                        floor=floor)
+    flat = group * blk_q
+    blk_k = _pick_block(tk, target=max(128, min(512, (1024 * 512) // flat)))
+    return blk_q, blk_k
+
+
+def _bwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
+    """(blk_q, blk_k) for the backward kernels, which hold three
+    [group*blk_q, blk_k] f32 panels (P, dP, dS) at once — budget half the
+    forward's panel area. group == 1 keeps (512, 512)."""
+    floor = 64 if group > 8 else 128
+    blk_q = _pick_block(tq, target=max(floor, min(512, 512 // group)),
+                        floor=floor)
+    flat = group * blk_q
+    blk_k = _pick_block(tk, target=max(128, min(512, (512 * 512) // flat)))
+    return blk_q, blk_k
+
+
+def _group_of(q: jnp.ndarray, k: jnp.ndarray) -> int:
+    """Query heads per K/V head, from [B, H, T, D] blocks. 1 = MHA."""
+    hq, hkv = q.shape[1], k.shape[1]
+    if hkv <= 0 or hq % hkv != 0:
+        raise ValueError(
+            f"query heads {hq} must be a multiple of K/V heads {hkv}")
+    return hq // hkv
 
 
 def _kernel_feasible(t: int) -> bool:
@@ -78,7 +134,8 @@ def _kernel_feasible(t: int) -> bool:
 
 def init_carry(batch: int, heads: int, tq: int, dim: int) -> Carry:
     """Zero accumulators for a fresh streaming softmax ([B,H,Tq,D] f32 out,
-    [B,H,Tq,1] row-sum / row-max)."""
+    [B,H,Tq,1] row-sum / row-max). ``heads`` is *query* heads — the carry
+    is per query row regardless of K/V grouping."""
     return (
         jnp.zeros((batch, heads, tq, dim), jnp.float32),
         jnp.zeros((batch, heads, tq, 1), jnp.float32),
@@ -124,38 +181,63 @@ def _normalize_offsets(offsets: jnp.ndarray) -> jnp.ndarray:
 def _merge_ref(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                o: jnp.ndarray, l: jnp.ndarray, m: jnp.ndarray,
                offsets: jnp.ndarray, causal: bool) -> Carry:
-    """The same recurrence in plain jnp on [B,H,T,D] blocks. Positions are
-    int32 end to end — float32 cannot represent sequence indices past 2^24,
-    which is squarely inside the long-context regime this serves."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    """The same recurrence in plain jnp on [B,H,T,D] blocks (K/V may be at
+    kv_heads). Positions are int32 end to end — float32 cannot represent
+    sequence indices past 2^24, which is squarely inside the long-context
+    regime this serves."""
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    group = _group_of(q, k)
+    scale = d ** -0.5
+    qg = q.reshape(b, hkv, group, tq, d).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k.astype(jnp.float32)) * scale
     if causal:
         stride = _stride_of(offsets)
-        q_pos = offsets[0] + stride * jnp.arange(q.shape[2], dtype=jnp.int32)
-        k_pos = offsets[1] + stride * jnp.arange(k.shape[2], dtype=jnp.int32)
+        q_pos = offsets[0] + stride * jnp.arange(tq, dtype=jnp.int32)
+        k_pos = offsets[1] + stride * jnp.arange(tk, dtype=jnp.int32)
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
+    s = s.reshape(b, hq, tq, tk)
     m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
     p = jnp.exp(s - m_new)
     alpha = jnp.exp(m - m_new)
     l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-    o_new = o * alpha + jnp.einsum("bhqk,bhkd->bhqd", p, v.astype(jnp.float32))
+    pv = jnp.einsum("bhgqk,bhkd->bhgqd",
+                    p.reshape(b, hkv, group, tq, tk),
+                    v.astype(jnp.float32)).reshape(b, hq, tq, d)
+    o_new = o * alpha + pv
     return o_new, l_new, m_new
 
 
 # --- the kernel ---------------------------------------------------------------
 
+def _causal_mask(s, q_lo, k_lo, stride, blk_q: int, group: int):
+    """Mask a flattened [group*blk_q, blk_k] score panel: row r is query
+    slot r % blk_q (every group repeats the same q-block), column c is key
+    slot c; global positions are off + stride*slot."""
+    rows, blk_k = s.shape
+    row = lax.broadcasted_iota(jnp.int32, (rows, blk_k), 0)
+    q_slot = row if group == 1 else lax.rem(row, blk_q)
+    q_pos = q_lo + stride * q_slot
+    k_pos = k_lo + stride * lax.broadcasted_iota(jnp.int32, (rows, blk_k), 1)
+    return jnp.where(q_pos >= k_pos, s, NEG_INF)
+
+
 def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
-                  o_out, l_out, m_out, *, causal: bool, scale: float):
-    """One (batch, head, q-block, k-tile) grid cell. K tiling lives in the
-    grid — only one [blk_k, D] K/V tile is VMEM-resident at a time, so the
-    kernel compiles at arbitrary per-shard sequence lengths. The (o, l, m)
-    accumulators ride the output blocks, whose index map is constant in the
-    k dimension: Pallas keeps them VMEM-resident across all k-tiles of a
-    q-block (the innermost grid dim), and the carry from the previous ring
-    step seeds them at ik == 0."""
+                  o_out, l_out, m_out, *, causal: bool, scale: float,
+                  group: int):
+    """One (batch, kv-head, q-block, k-tile) grid cell. K tiling lives in
+    the grid — only one [blk_k, D] K/V tile is VMEM-resident at a time, so
+    the kernel compiles at arbitrary per-shard sequence lengths. The
+    (o, l, m) accumulators ride the output blocks, whose index map is
+    constant in the k dimension: Pallas keeps them VMEM-resident across all
+    k-tiles of a q-block (the innermost grid dim), and the carry from the
+    previous ring step seeds them at ik == 0. The q/accumulator blocks are
+    ``group`` heads deep (all query heads of this KV head), flattened to one
+    [group*blk_q, D] panel so the whole group shares a single K/V fetch and
+    a single MXU contraction."""
     blk_q = q_ref.shape[2]
     blk_k = k_ref.shape[2]
+    rows = group * blk_q
     iq = pl.program_id(2)
     ik = pl.program_id(3)
 
@@ -177,39 +259,37 @@ def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
     @pl.when(jnp.logical_or(not causal,
                             q_lo + stride * (blk_q - 1) >= k_lo))
     def _merge():
-        q = q_ref[0, 0].astype(jnp.float32) * scale      # [blk_q, D]
-        o = o_out[0, 0]                                  # [blk_q, D] f32
-        l = l_out[0, 0]                                  # [blk_q, 1]
-        m = m_out[0, 0]                                  # [blk_q, 1]
+        q = q_ref[0].astype(jnp.float32).reshape(rows, -1) * scale
+        o = o_out[0].reshape(rows, -1)                   # [rows, D] f32
+        l = l_out[0].reshape(rows, 1)
+        m = m_out[0].reshape(rows, 1)
         k_blk = k_ref[0, 0].astype(jnp.float32)          # [blk_k, D]
         # S = Q K^T on the MXU (contract D, keep f32 accumulation).
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                             preferred_element_type=jnp.float32)
         if causal:
-            q_pos = q_lo + stride * lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 0)
-            k_pos = k_lo + stride * lax.broadcasted_iota(
-                jnp.int32, (blk_q, blk_k), 1)
-            s = jnp.where(q_pos >= k_pos, s, NEG_INF)
+            s = _causal_mask(s, q_lo, k_lo, stride, blk_q, group)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
         v_blk = v_ref[0, 0].astype(jnp.float32)
-        o_out[0, 0] = o * alpha + lax.dot_general(
+        o_new = o * alpha + lax.dot_general(
             p, v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        l_out[0, 0] = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
-        m_out[0, 0] = m_new
+        l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        o_out[0] = o_new.reshape(group, blk_q, -1)
+        l_out[0] = l_new.reshape(group, blk_q, 1)
+        m_out[0] = m_new.reshape(group, blk_q, 1)
 
 
 def _merge_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                   o: jnp.ndarray, l: jnp.ndarray, m: jnp.ndarray,
                   offsets: jnp.ndarray, causal: bool,
                   interpret: bool) -> Carry:
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
-    blk_q = _pick_block(tq)
-    blk_k = _pick_block(tk)
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    group = _group_of(q, k)
+    blk_q, blk_k = _fwd_blocks(tq, tk, group)
     scale = d ** -0.5
 
     def qo_map(ib, ih, iq, ik, offs):
@@ -218,19 +298,20 @@ def _merge_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     def kv_map(ib, ih, iq, ik, offs):
         return (ib, ih, ik, 0)
 
-    q_spec = pl.BlockSpec((1, 1, blk_q, d), qo_map)
+    q_spec = pl.BlockSpec((1, group, blk_q, d), qo_map)
     kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_map)
-    acc_spec = pl.BlockSpec((1, 1, blk_q, d), qo_map)
-    vec_spec = pl.BlockSpec((1, 1, blk_q, 1), qo_map)
+    acc_spec = pl.BlockSpec((1, group, blk_q, d), qo_map)
+    vec_spec = pl.BlockSpec((1, group, blk_q, 1), qo_map)
 
-    kernel = functools.partial(_merge_kernel, causal=causal, scale=scale)
+    kernel = functools.partial(_merge_kernel, causal=causal, scale=scale,
+                               group=group)
     return pl.pallas_call(
         kernel,
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             # k-tiles innermost: the accumulator output blocks revisit the
             # same index across them and stay VMEM-resident.
-            grid=(b, h, tq // blk_q, tk // blk_k),
+            grid=(b, hkv, tq // blk_q, tk // blk_k),
             in_specs=[q_spec, kv_spec, kv_spec, acc_spec, vec_spec, vec_spec],
             out_specs=[acc_spec, vec_spec, vec_spec],
         ),
@@ -288,7 +369,10 @@ def use_pallas_default() -> bool:
 # dK += scale dS^T Q,  with L the forward's row logsumexp and
 # D = rowsum(dO * O) precomputed per row. Two kernels split the work by
 # which accumulator can stay VMEM-resident: dQ tiles accumulate over k
-# (k innermost in the grid), dK/dV tiles over q (q innermost).
+# (k innermost in the grid), dK/dV tiles over q (q innermost). Under GQA
+# the q-side blocks are group-deep and flattened exactly as in the forward;
+# dK/dV accumulate the whole group's contribution in one P^T/dS^T matmul,
+# landing at KV size with no post-hoc reduction.
 
 
 def _logsumexp_rows(l: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
@@ -300,35 +384,33 @@ def _logsumexp_rows(l: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
 
 
 def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
-                   stride, causal: bool, scale: float):
+                   stride, causal: bool, scale: float, group: int):
     """The shared per-tile backward recurrence: recompute this tile's
     probabilities from Q/K and the forward's logsumexp, then
     dS = P (dP - D). Both backward kernels build their accumulations from
     this one definition so the recurrence cannot desynchronize between
-    dQ and dK/dV."""
-    q = q_ref[0, 0].astype(jnp.float32)
+    dQ and dK/dV. q/g/L/D arrive group-deep and leave flattened to
+    [group*blk_q, ·] panels."""
+    blk_q = q_ref.shape[2]
+    rows = group * blk_q
+    q = q_ref[0].astype(jnp.float32).reshape(rows, -1)
     k_blk = k_ref[0, 0].astype(jnp.float32)
     v_blk = v_ref[0, 0].astype(jnp.float32)
-    g = g_ref[0, 0].astype(jnp.float32)
-    blk_q, blk_k = q.shape[0], k_blk.shape[0]
+    g = g_ref[0].astype(jnp.float32).reshape(rows, -1)
     s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
     if causal:
-        q_pos = q_lo + stride * lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 0)
-        k_pos = k_lo + stride * lax.broadcasted_iota(
-            jnp.int32, (blk_q, blk_k), 1)
-        s = jnp.where(q_pos >= k_pos, s, NEG_INF)
-    p = jnp.exp(s - L_ref[0, 0])                          # [blk_q, blk_k]
+        s = _causal_mask(s, q_lo, k_lo, stride, blk_q, group)
+    p = jnp.exp(s - L_ref[0].reshape(rows, 1))            # [rows, blk_k]
     dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
-    ds = p * (dp - D_ref[0, 0])
+    ds = p * (dp - D_ref[0].reshape(rows, 1))
     return q, k_blk, g, p, ds
 
 
 def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
-                   dq_out, *, causal: bool, scale: float):
-    """dQ for one (batch, head, q-block) — k-tiles innermost so the dq
+                   dq_out, *, causal: bool, scale: float, group: int):
+    """dQ for one (batch, kv-head, q-block) — k-tiles innermost so the dq
     output block revisits its index and accumulates in VMEM."""
     blk_q = q_ref.shape[2]
     blk_k = k_ref.shape[2]
@@ -347,16 +429,20 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
     def _acc():
         _q, k_blk, _g, _p, ds = _bwd_tile_p_ds(
             q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
-            causal, scale)
-        dq_out[0, 0] += scale * lax.dot_general(
+            causal, scale, group)
+        dq = scale * lax.dot_general(
             ds, k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
+        dq_out[0] += dq.reshape(group, blk_q, -1)
 
 
 def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
-                    dk_out, dv_out, *, causal: bool, scale: float):
-    """dK/dV for one (batch, head, k-block) — q-tiles innermost so both
-    output blocks accumulate in VMEM."""
+                    dk_out, dv_out, *, causal: bool, scale: float,
+                    group: int):
+    """dK/dV for one (batch, kv-head, k-block) — q-tiles innermost so both
+    output blocks accumulate in VMEM. The flattened [group*blk_q, blk_k]
+    P/dS panels contract over their row dim, so each matmul already sums
+    the whole query-head group into the KV-sized output."""
     blk_q = q_ref.shape[2]
     blk_k = k_ref.shape[2]
     ik = pl.program_id(2)
@@ -375,8 +461,8 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
     def _acc():
         q, _k, g, p, ds = _bwd_tile_p_ds(
             q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
-            causal, scale)
-        # dV += P^T dO
+            causal, scale, group)
+        # dV += P^T dO (rows contract: sums over q-slots and the group)
         dv_out[0, 0] += lax.dot_general(
             p, g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
@@ -387,10 +473,10 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
 
 
 def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
-    b, h, tq, d = q.shape
-    tk = k.shape[2]
-    blk_q = _pick_block(tq)
-    blk_k = _pick_block(tk)
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    group = _group_of(q, k)
+    blk_q, blk_k = _bwd_blocks(tq, tk, group)
     scale = d ** -0.5
 
     def q_map(ib, ih, iq, ik, offs):
@@ -399,15 +485,16 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     def k_map(ib, ih, iq, ik, offs):
         return (ib, ih, ik, 0)
 
-    q_spec = pl.BlockSpec((1, 1, blk_q, d), q_map)
+    q_spec = pl.BlockSpec((1, group, blk_q, d), q_map)
     kv_spec = pl.BlockSpec((1, 1, blk_k, d), k_map)
-    row_spec = pl.BlockSpec((1, 1, blk_q, 1), q_map)
+    row_spec = pl.BlockSpec((1, group, blk_q, 1), q_map)
 
     dq = pl.pallas_call(
-        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale),
+        functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
+                          group=group),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, h, tq // blk_q, tk // blk_k),
+            grid=(b, hkv, tq // blk_q, tk // blk_k),
             in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
             out_specs=[q_spec],
         ),
@@ -422,15 +509,16 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     def kT_map(ib, ih, ik, iq, offs):
         return (ib, ih, ik, 0)
 
-    qT_spec = pl.BlockSpec((1, 1, blk_q, d), qT_map)
+    qT_spec = pl.BlockSpec((1, group, blk_q, d), qT_map)
     kvT_spec = pl.BlockSpec((1, 1, blk_k, d), kT_map)
-    rowT_spec = pl.BlockSpec((1, 1, blk_q, 1), qT_map)
+    rowT_spec = pl.BlockSpec((1, group, blk_q, 1), qT_map)
 
     dk, dv = pl.pallas_call(
-        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale),
+        functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
+                          group=group),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
-            grid=(b, h, tk // blk_k, tq // blk_q),
+            grid=(b, hkv, tk // blk_k, tq // blk_q),
             in_specs=[qT_spec, kvT_spec, kvT_spec, qT_spec, rowT_spec,
                       rowT_spec],
             out_specs=[kvT_spec, kvT_spec],
@@ -445,22 +533,25 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
 def _bwd_ref(q, k, v, g, L, D, offsets, causal: bool):
     """The same tile math in plain jnp (CPU fallback / infeasible shapes);
     materializes this block pair's scores, which is fine at test sizes."""
-    scale = q.shape[-1] ** -0.5
-    s = jnp.einsum("bhqd,bhkd->bhqk", q.astype(jnp.float32),
-                   k.astype(jnp.float32)) * scale
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    group = _group_of(q, k)
+    scale = d ** -0.5
+    qg = q.reshape(b, hkv, group, tq, d).astype(jnp.float32)
+    gg = g.reshape(b, hkv, group, tq, d).astype(jnp.float32)
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, k.astype(jnp.float32)) * scale
     if causal:
         stride = _stride_of(offsets)
-        q_pos = offsets[0] + stride * jnp.arange(q.shape[2], dtype=jnp.int32)
-        k_pos = offsets[1] + stride * jnp.arange(k.shape[2], dtype=jnp.int32)
+        q_pos = offsets[0] + stride * jnp.arange(tq, dtype=jnp.int32)
+        k_pos = offsets[1] + stride * jnp.arange(tk, dtype=jnp.int32)
         s = jnp.where(q_pos[:, None] >= k_pos[None, :], s, NEG_INF)
-    p = jnp.exp(s - L)
-    g32 = g.astype(jnp.float32)
-    dv = jnp.einsum("bhqk,bhqd->bhkd", p, g32)
-    dp = jnp.einsum("bhqd,bhkd->bhqk", g32, v.astype(jnp.float32))
-    ds = p * (dp - D)
-    dq = scale * jnp.einsum("bhqk,bhkd->bhqd", ds, k.astype(jnp.float32))
-    dk = scale * jnp.einsum("bhqk,bhqd->bhkd", ds, q.astype(jnp.float32))
-    return dq, dk, dv
+    p = jnp.exp(s - L.reshape(b, hkv, group, tq, 1))
+    dv = jnp.einsum("bhgqk,bhgqd->bhkd", p, gg)
+    dp = jnp.einsum("bhgqd,bhkd->bhgqk", gg, v.astype(jnp.float32))
+    ds = p * (dp - D.reshape(b, hkv, group, tq, 1))
+    dq = scale * jnp.einsum("bhgqk,bhkd->bhgqd", ds, k.astype(jnp.float32))
+    dk = scale * jnp.einsum("bhgqk,bhgqd->bhkd", ds, qg)
+    return dq.reshape(b, hq, tq, d), dk, dv
 
 
 def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
@@ -468,7 +559,8 @@ def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
     """(dq, dk, dv) f32 contributions of one K/V block to the gradients,
     given the *global* row logsumexp ``L`` and ``D = rowsum(dO * O)`` —
     the building block of both the single-shard fused backward and the
-    backward ring (ring_attention.py). All blocks [B, H, T, D]."""
+    backward ring (ring_attention.py). Blocks are [B, H, T, D]; K/V may
+    carry fewer (grouped) heads, and dk/dv come back at that KV size."""
     offsets = _normalize_offsets(offsets)
     if use_pallas is None:
         use_pallas = use_pallas_default()
@@ -524,9 +616,10 @@ def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     """Fold K/V block ``k``/``v`` (global position ``offsets[1]``) into the
     streaming softmax over resident queries ``q`` (position ``offsets[0]``).
 
-    All blocks are [B, H, T, D]; ``offsets`` is [q_off, k_off] (contiguous)
-    or [q_off, k_off, stride] (striped layout) int32, so one compiled
-    kernel serves every ring step. Differentiable (custom VJP).
+    All blocks are [B, H, T, D]; K/V may carry grouped (fewer) heads — the
+    carry stays at query-head size. ``offsets`` is [q_off, k_off]
+    (contiguous) or [q_off, k_off, stride] (striped layout) int32, so one
+    compiled kernel serves every ring step. Differentiable (custom VJP).
     ``use_pallas=None`` auto-selects: the kernel on real TPUs, the jnp path
     elsewhere (``True`` forces the kernel — interpret mode off-TPU, which is
     orders of magnitude slower than jnp and meant for tests only).
@@ -548,10 +641,13 @@ def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True,
                     use_pallas: Optional[bool] = None) -> jnp.ndarray:
     """Single-device exact attention, [B, T, H, D] in/out — the fused
-    counterpart of ring_attention.reference_attention. Forward and backward
-    both run as Pallas kernels (module docstring): O(T) memory in either
-    direction, so this is the path that makes 8k-32k contexts trainable on
-    one chip."""
+    counterpart of ring_attention.reference_attention. K/V may carry
+    ``kv_heads`` < H (grouped-query attention, module docstring): the
+    kernels index K/V heads by group, so the repeated-K/V tensor of a
+    broadcast-based GQA never exists in HBM and dK/dV come back at KV
+    size. Forward and backward both run as Pallas kernels: O(T) memory in
+    either direction, so this is the path that makes 8k-32k contexts
+    trainable on one chip."""
     qt = jnp.einsum("bqhd->bhqd", q)
     kt = jnp.einsum("bkhd->bhkd", k)
     vt = jnp.einsum("bkhd->bhkd", v)

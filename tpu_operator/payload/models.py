@@ -114,10 +114,12 @@ class DecoderBlock(nn.Module):
     # Grouped-query attention (Ainslie et al. 2023, public technique):
     # K/V project to kv_heads < heads and each K/V head serves
     # heads/kv_heads query heads. Cuts K/V projection params, their
-    # gradients, and (at inference) the KV cache by the group factor;
-    # K/V are broadcast across the group before the attention kernel, so
-    # every attend implementation (flash, ring, ulysses, oracle) works
-    # unchanged. 0 = MHA (kv_heads == heads); 1 = MQA.
+    # gradients, activations, and (at inference) the KV cache by the
+    # group factor. K/V go to ``attend`` at kv_heads size — the flash
+    # kernels index K/V heads by group (flash_attention.py module
+    # docstring), ring rotates kv-sized blocks (group-factor less ICI
+    # traffic), ulysses all-to-alls kv-sized K/V, and the jnp oracle
+    # broadcasts internally. 0 = MHA (kv_heads == heads); 1 = MQA.
     kv_heads: int = 0
 
     @nn.compact
@@ -146,12 +148,11 @@ class DecoderBlock(nn.Module):
                            name="qkv")(h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
         q = q.reshape(b, t, self.heads, head_dim)
+        # K/V stay at kv_heads: every attend implementation is GQA-native
+        # (no jnp.repeat — a broadcast here would materialize full-head
+        # K/V activations + gradients, forfeiting GQA's bandwidth win).
         k = k.reshape(b, t, kv_heads, head_dim)
         v = v.reshape(b, t, kv_heads, head_dim)
-        if kv_heads != self.heads:
-            group = self.heads // kv_heads
-            k = jnp.repeat(k, group, axis=2)
-            v = jnp.repeat(v, group, axis=2)
         out = self.attend(q, k, v)
         out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                        name="attn_out")(out.reshape(b, t, self.dim))
@@ -174,6 +175,47 @@ class LinearRegressor(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         return nn.Dense(self.features, dtype=jnp.float32, name="linear")(x)
+
+
+def resolve_split_qkv(mode: str, tp: int, log) -> bool:
+    """The shared --split-qkv resolution for every LM payload: 'auto'
+    splits under TP (each model shard owns whole heads); an explicit 'off'
+    under TP is allowed (fused-kernel checkpoint layouts) but warned — the
+    fused [d, 3d] kernel's contiguous column shards straddle the q/k/v
+    thirds, so heads stop being shard-local."""
+    if mode == "off" and tp > 1:
+        log.warning(
+            "--split-qkv off with --tensor-parallel %d: the fused qkv "
+            "kernel's column shards straddle the q/k/v thirds (heads not "
+            "shard-local); use auto/on unless checkpoint layout "
+            "compatibility requires the fused kernel", tp)
+    return mode == "on" or (mode == "auto" and tp > 1)
+
+
+def validate_heads_dims(heads: int, kv_heads: int, dim: int, tp: int) -> None:
+    """The shared --kv-heads / --tensor-parallel divisibility contract:
+    heads (and K/V heads, if grouped) divide by TP so shards own whole
+    heads; dim divides by TP for the column/row kernel shards. Raises
+    ValueError with the flag names the operator actually typed."""
+    if kv_heads < 0:
+        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
+    if kv_heads and heads % kv_heads != 0:
+        # Note 4 % -1 == 0 in Python: the sign check above cannot be
+        # folded into this divisibility one.
+        raise ValueError(
+            f"--heads {heads} must divide by --kv-heads {kv_heads}")
+    if tp > 1:
+        if heads % tp != 0:
+            raise ValueError(
+                f"--heads {heads} must divide by --tensor-parallel {tp} "
+                f"(TP shards whole heads)")
+        if kv_heads and kv_heads % tp != 0:
+            raise ValueError(
+                f"--kv-heads {kv_heads} must divide by --tensor-parallel "
+                f"{tp} (TP shards whole K/V heads)")
+        if dim % tp != 0:
+            raise ValueError(
+                f"--dim {dim} must divide by --tensor-parallel {tp}")
 
 
 def param_partition_spec(path: Tuple[str, ...], leaf: Any) -> P:

@@ -80,6 +80,10 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
+                   help="mounted .npy token file (1-D int array): "
+                        "memory-mapped real-data stream (data.token_file_lm)"
+                        "; empty = synthetic recurrence")
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
@@ -114,12 +118,19 @@ def make_moe_mesh(num_devices: Optional[int] = None, expert_parallel: int = 1,
 
 def top2_dispatch(logits, capacity: int):
     """Top-2 routing → (dispatch [G,n,E,C] bool-ish, combine [G,n,E,C] f32,
-    aux f32 scalar). Pure function of f32 router logits; all shapes static.
+    aux f32 scalar, drop_frac f32 scalar). Pure function of f32 router
+    logits; all shapes static.
 
     Position bookkeeping is cumsum algebra (no sort/scatter): token t's slot
     in expert e is the count of earlier tokens routed to e; slots ≥ C drop.
     Second choices fill after all first choices (Switch convention), so a
     hot expert drops 2nd-choice traffic before any 1st-choice traffic.
+
+    ``drop_frac`` is the fraction of routed assignments (2 per token) that
+    fell past their expert's capacity — the metric that tells an operator
+    whether the configured --capacity-factor is actually holding (a
+    persistent nonzero drop rate silently degrades quality long before the
+    loss shows it). Exported into training metrics by the MoE loss.
     """
     import jax
     import jax.numpy as jnp
@@ -143,6 +154,10 @@ def top2_dispatch(logits, capacity: int):
     pos2 = (jnp.cumsum(mask2, axis=1) * mask2 - mask2) + count1  # 2nd fills after 1st
     keep1 = mask1 * (pos1 < capacity)
     keep2 = mask2 * (pos2 < capacity)
+    # Routed assignments that fell past capacity (comparisons carry no
+    # gradient — this is a pure observability scalar).
+    n_tokens = logits.shape[0] * logits.shape[1]
+    drop_frac = 1.0 - (jnp.sum(keep1) + jnp.sum(keep2)) / (2.0 * n_tokens)
 
     gate1 = jnp.sum(probs * keep1, axis=-1)                      # [G,n]
     gate2 = jnp.sum(probs * keep2, axis=-1)
@@ -157,7 +172,7 @@ def top2_dispatch(logits, capacity: int):
     s1, s2 = slots(keep1, pos1), slots(keep2, pos2)
     dispatch = s1 + s2
     combine = gate1[:, :, None, None] * s1 + gate2[:, :, None, None] * s2
-    return dispatch, combine, aux
+    return dispatch, combine, aux, drop_frac
 
 
 def _moe_mlp_class(mesh, dtype):
@@ -194,8 +209,10 @@ def _moe_mlp_class(mesh, dtype):
                 w1 = self.param("w1", init, (e, d, hidden), jnp.float32)
                 w2 = self.param("w2", init, (e, hidden, d), jnp.float32)
 
-                dispatch, combine, aux = top2_dispatch(router(x), capacity)
+                dispatch, combine, aux, drop = top2_dispatch(router(x),
+                                                             capacity)
                 self.sow("intermediates", "aux_loss", aux)
+                self.sow("intermediates", "drop_frac", drop)
 
                 # [G,n,E,C] × [G,n,D] → [E,G,C,D]; the constraint flips the
                 # sharded dim from G (data) to E (expert): GSPMD emits the
@@ -239,25 +256,8 @@ def _build_model(args, mesh):
             f"--experts {args.experts} not divisible by the mesh expert "
             f"axis ({mesh.shape['expert']})")
     kv_heads = getattr(args, "kv_heads", 0)
-    if kv_heads < 0:
-        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
-    if kv_heads and args.heads % kv_heads != 0:
-        raise ValueError(
-            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
     tp = mesh.shape.get("model", 1)
-    if tp > 1:
-        if args.heads % tp != 0:
-            raise ValueError(
-                f"--heads {args.heads} must divide by --tensor-parallel "
-                f"{tp} (TP shards whole heads)")
-        if (4 * args.dim) % tp != 0:
-            raise ValueError(
-                f"FFN hidden {4 * args.dim} must divide by "
-                f"--tensor-parallel {tp}")
-        if kv_heads and kv_heads % tp != 0:
-            raise ValueError(
-                f"--kv-heads {kv_heads} must divide by --tensor-parallel "
-                f"{tp} (TP shards whole K/V heads)")
+    models.validate_heads_dims(args.heads, kv_heads, args.dim, tp)
 
     def attend(q, k, v):
         if dtype == jnp.bfloat16 and fa.use_pallas_default():
@@ -270,8 +270,8 @@ def _build_model(args, mesh):
     # Under TP, split q/k/v so each model shard owns whole heads
     # (transformer.py's rule — a fused [d,3d] kernel's contiguous column
     # shards would straddle the q/k/v thirds).
-    mode = getattr(args, "split_qkv", "auto")
-    split_qkv = mode == "on" or (mode == "auto" and tp > 1)
+    split_qkv = models.resolve_split_qkv(getattr(args, "split_qkv", "auto"),
+                                         tp, log)
 
     def moe_mlp(name):
         return MoEMLP(dim=args.dim, experts=args.experts,
@@ -354,15 +354,22 @@ def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
 
     from tpu_operator.payload import train
 
+    def _mean_sown(inter, name):
+        leaves = [leaf for path, leaf in
+                  jax.tree_util.tree_flatten_with_path(
+                      inter.get("intermediates", {}))[0]
+                  if any(getattr(p, "key", str(p)) == name for p in path)]
+        return (sum(leaves) / len(leaves)) if leaves else jnp.float32(0.0)
+
     def loss_fn(params, tokens):
         logits, inter = model.apply({"params": params}, tokens,
                                     mutable=["intermediates"])
-        aux_leaves = jax.tree_util.tree_leaves(inter.get("intermediates", {}))
-        aux = (sum(aux_leaves) / max(1, len(aux_leaves))
-               if aux_leaves else jnp.float32(0.0))
+        aux = _mean_sown(inter, "aux_loss")
+        drop = jax.lax.stop_gradient(_mean_sown(inter, "drop_frac"))
         lm_loss = train.next_token_nll(logits, tokens)
         total = lm_loss + args.aux_coef * aux
-        return total, {"loss": lm_loss, "aux_loss": aux, "total_loss": total}
+        return total, {"loss": lm_loss, "aux_loss": aux,
+                       "drop_frac": drop, "total_loss": total}
 
     return train.make_loss_train_step(
         loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
@@ -389,8 +396,7 @@ def build(args, mesh=None, num_slices: int = 1):
     shardings = state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
     step = make_moe_train_step(args, model, mesh, state, tx, shardings)
-    batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
-                                    vocab=args.vocab)
+    batches = data_mod.lm_batches(args)
     return mesh, model, state, step, batches
 
 
@@ -413,7 +419,8 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
             mesh, step, state, batches, args.steps,
             log_every=args.log_every,
             log_fn=lambda i, m: log.info(
-                "step %d loss %.4f aux %.4f", i, m["loss"], m["aux_loss"]),
+                "step %d loss %.4f aux %.4f drop %.3f", i, m["loss"],
+                m["aux_loss"], m["drop_frac"]),
             checkpointer=ckpt,
             profile_dir=args.profile_dir,
         )

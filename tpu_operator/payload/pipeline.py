@@ -11,6 +11,14 @@ Design (TPU-first, not a torch-style stage-per-process port):
 
 - **mesh = (data, pipe)**: batch shards over ``data``; the *layer stack*
   shards over ``pipe``. Stage s holds layers [s·L/S, (s+1)·L/S).
+  ``--tensor-parallel`` widens this to **(data, pipe, model)**: the tick
+  schedule and hops stay hand-written (shard_map manual over data+pipe),
+  while the ``model`` axis is left in *auto* mode — stage kernels are
+  Megatron-sharded (stage_param_spec) and GSPMD inserts the per-matmul TP
+  collectives inside each tick. ``--zero1`` shards adam moments over
+  ``data`` (params/grads stay replicated): the optimizer update runs on
+  1/N shards — the PP-compatible slice of FSDP's memory win, without
+  gather traffic inside the tick loop.
 - **SPMD pipelining inside one jit**: every stage is the *same* program on a
   different shard of the stacked stage parameters (leading dim S, sharded
   over ``pipe``). A ``lax.scan`` over M + S - 1 ticks streams M microbatches
@@ -71,6 +79,24 @@ def parse_args(argv=None):
                    help="total decoder blocks (divisible by --pipeline)")
     p.add_argument("--pipeline", type=int, default=1,
                    help="pipeline stages (mesh pipe axis size)")
+    p.add_argument("--tensor-parallel", type=int, default=1,
+                   help="Megatron TP degree inside every stage: 3-axis "
+                        "(data, pipe, model) mesh, stage q/k/v/mlp_up "
+                        "kernels column-sharded and attn_out/mlp_down "
+                        "row-sharded over ``model`` (the dense "
+                        "transformer's split-qkv rule), activations still "
+                        "hopping the pipe axis")
+    p.add_argument("--split-qkv", choices=("auto", "on", "off"),
+                   default="auto",
+                   help="separate q/k/v stage projections (auto: on under "
+                        "--tensor-parallel, so shards own whole heads)")
+    p.add_argument("--zero1", action="store_true",
+                   help="ZeRO-1: shard adam moments over the data axis "
+                        "(params/grads stay replicated across DP; the "
+                        "optimizer update runs on 1/N shards and GSPMD "
+                        "gathers updated params) — the PP-compatible "
+                        "optimizer-memory knob, ≈state/3 per rank at "
+                        "adam's 2 moments")
     p.add_argument("--microbatches", type=int, default=4,
                    help="microbatches streamed through the pipeline per step")
     p.add_argument("--schedule", choices=("gpipe", "1f1b"), default="gpipe",
@@ -92,6 +118,10 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
+                   help="mounted .npy token file (1-D int array): "
+                        "memory-mapped real-data stream (data.token_file_lm)"
+                        "; empty = synthetic recurrence")
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
@@ -102,19 +132,34 @@ def parse_args(argv=None):
 
 
 def make_pipe_mesh(num_devices: Optional[int] = None, pipeline: int = 1,
-                   devices: Optional[list] = None, num_slices: int = 1):
+                   devices: Optional[list] = None, num_slices: int = 1,
+                   tensor_parallel: int = 1):
     """(data, pipe) mesh: DP outer, pipeline inner — consecutive stages land
     on neighboring devices so activation hops ride adjacent ICI links
-    (multi-slice jobs keep all stages of one pipeline within a slice)."""
+    (multi-slice jobs keep all stages of one pipeline within a slice).
+
+    ``tensor_parallel > 1`` composes PP × TP on a 3-axis
+    (data, pipe, model) mesh (train.make_mesh3's layout and intra-slice
+    guard): TP innermost — its psums fire per stage matmul, so they get
+    the shortest ICI hops — the once-per-tick pipe hop around it, DP
+    outermost / across DCN."""
     from tpu_operator.payload import train
 
+    if tensor_parallel > 1:
+        return train.make_mesh3(num_devices, seq_parallel=pipeline,
+                                model_parallel=tensor_parallel,
+                                devices=devices, num_slices=num_slices,
+                                axis_names=("data", "pipe", "model"))
     return train.make_mesh(num_devices, model_parallel=pipeline,
                            devices=devices, axis_names=("data", "pipe"),
                            num_slices=num_slices)
 
 
-def _stage_module(args):
-    """One pipeline stage: layers_per_stage pre-LN decoder blocks."""
+def _stage_module(args, tp: int = 1):
+    """One pipeline stage: layers_per_stage pre-LN decoder blocks.
+    ``tp > 1`` turns on split-qkv (each model shard owns whole heads) and
+    validates the TP divisibility contract; the sharding itself is purely
+    a parameter-placement concern (stage_param_spec)."""
     import flax.linen as nn
     import jax.numpy as jnp
 
@@ -134,11 +179,9 @@ def _stage_module(args):
              else models.DecoderBlock)
 
     kv_heads = getattr(args, "kv_heads", 0)
-    if kv_heads < 0:
-        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
-    if kv_heads and args.heads % kv_heads != 0:
-        raise ValueError(
-            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
+    models.validate_heads_dims(args.heads, kv_heads, args.dim, tp)
+    split_qkv = models.resolve_split_qkv(getattr(args, "split_qkv", "auto"),
+                                         tp, log)
 
     class Stage(nn.Module):
         dim: int
@@ -150,6 +193,7 @@ def _stage_module(args):
             for i in range(self.blocks):
                 x = Block(self.dim, self.heads, attend,
                           dtype=dtype, kv_heads=kv_heads,
+                          split_qkv=split_qkv,
                           name=f"block{i}")(x)
             return x
 
@@ -158,6 +202,32 @@ def _stage_module(args):
             f"--layers {args.layers} not divisible by --pipeline {args.pipeline}")
     return Stage(dim=args.dim, heads=args.heads,
                  blocks=args.layers // args.pipeline)
+
+
+def stage_param_spec(keys, leaf, tp: int):
+    """PartitionSpec for one *stacked* stage leaf ([S, ...], leading dim on
+    ``pipe``). With ``tp > 1`` the intra-stage dims follow the dense
+    transformer's Megatron rule (transformer.lm_tp_shardings): q/k/v and
+    mlp_up kernels column-shard their output dim over ``model`` (whole
+    heads / FFN columns per shard), attn_out and mlp_down row-shard their
+    input dim (GSPMD inserts the psum after the matmul); the mlp_up bias
+    follows its columns. LayerNorms and everything else replicate within
+    the stage."""
+    from jax.sharding import PartitionSpec as P
+
+    nd = getattr(leaf, "ndim", 0)
+    if nd < 1:
+        return P()
+    if tp > 1 and len(keys) >= 2:
+        name, kind = keys[-2], keys[-1]
+        if kind == "kernel" and nd == 3:
+            if name in ("q", "k", "v", "qkv", "mlp_up"):
+                return P("pipe", None, "model")
+            if name in ("attn_out", "mlp_down"):
+                return P("pipe", "model", None)
+        if kind == "bias" and nd == 2 and name == "mlp_up":
+            return P("pipe", "model")
+    return P("pipe", *(None,) * (nd - 1))
 
 
 def init_stacked_params(stage, rng, num_stages: int, sample):
@@ -232,8 +302,13 @@ def pipeline_apply(mesh, stage_apply, stacked_params, x, microbatches: int):
         out = lax.psum(outputs * is_last, "pipe")
         return out.reshape(b_loc, t, d)
 
+    # Manual over (data, pipe) only: a 3-axis PP × TP mesh leaves ``model``
+    # in GSPMD's hands inside the body — stage matmuls see their kernels
+    # model-sharded (stage_param_spec) and the compiler inserts the TP
+    # psums, while the tick schedule and ppermute hops stay hand-written.
     fn = jax.shard_map(body, mesh=mesh, in_specs=(param_specs, x_spec),
-                       out_specs=x_spec, check_vma=False)
+                       out_specs=x_spec, axis_names={"data", "pipe"},
+                       check_vma=False)
     return fn(stacked_params, x)
 
 
@@ -242,7 +317,7 @@ def _init_params(args, mesh, rng):
     import jax
     import jax.numpy as jnp
 
-    stage = _stage_module(args)
+    stage = _stage_module(args, tp=mesh.shape.get("model", 1))
     num_stages = mesh.shape["pipe"]
     k_stage, k_tok, k_pos, k_head = jax.random.split(rng, 4)
     sample = jnp.zeros((1, args.seq_len, args.dim),
@@ -472,19 +547,63 @@ def pipeline_1f1b_loss_and_grads(mesh, stage_apply, params, tokens,
         }
         return loss, grads
 
+    # Manual over (data, pipe); ``model`` (PP × TP meshes) stays auto so
+    # GSPMD shards the stage matmuls — see pipeline_apply.
     fn = jax.shard_map(body, mesh=mesh, in_specs=(param_specs, tok_spec),
-                       out_specs=grad_specs, check_vma=False)
+                       out_specs=grad_specs, axis_names={"data", "pipe"},
+                       check_vma=False)
     return fn(params, tokens)
 
 
-def state_shardings(mesh, state):
+def state_shardings(mesh, state, zero1: bool = False):
     """Shardings for the pipeline state: every leaf under a ``stages`` path
-    (params and the params-shaped adam moments) shards its leading stage dim
-    over ``pipe``; everything else replicates."""
+    (params and the params-shaped adam moments) shards its leading stage
+    dim over ``pipe`` — plus, on a PP × TP mesh, its intra-stage dims over
+    ``model`` (stage_param_spec); everything else replicates.
+
+    ``zero1`` additionally shards *optimizer-state* leaves (only) over the
+    ``data`` axis on their first still-unsharded divisible dim — params and
+    gradients stay replicated across DP (the 1F1B body's pmean contract is
+    untouched); the adam update then runs on 1/N of each moment and GSPMD
+    gathers the updated params, which is exactly ZeRO-1."""
+    import jax
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
     from tpu_operator.payload import train
 
-    return train.leading_axis_shardings(mesh, state, "pipe",
-                                        lambda keys: "stages" in keys)
+    tp = mesh.shape.get("model", 1)
+    data = mesh.shape["data"]
+
+    def param_rule(keys, leaf):
+        if "stages" in keys and getattr(leaf, "ndim", 0) >= 1:
+            return stage_param_spec(keys, leaf, tp)
+        return P()
+
+    def opt_rule(keys, leaf):
+        spec = param_rule(keys, leaf)
+        shape = getattr(leaf, "shape", ())
+        if not zero1 or getattr(leaf, "size", 0) < 1024:
+            return spec
+        parts = list(spec) + [None] * (len(shape) - len(spec))
+        for i, dim in enumerate(shape):
+            if parts[i] is None and dim % data == 0:
+                parts[i] = "data"
+                return P(*parts)
+        return spec
+
+    def build(tree, rule):
+        return jax.tree_util.tree_map_with_path(
+            lambda path, leaf: NamedSharding(
+                mesh,
+                rule(tuple(getattr(p, "key", str(p)) for p in path), leaf)),
+            tree)
+
+    return train.TrainState(
+        step=NamedSharding(mesh, P()),
+        params=build(state.params, param_rule),
+        batch_stats=build(state.batch_stats, param_rule),
+        opt_state=build(state.opt_state, opt_rule),
+    )
 
 
 def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
@@ -493,7 +612,8 @@ def make_pipe_train_step(args, stage, mesh, state, tx, shardings=None):
 
     from tpu_operator.payload import train
 
-    shardings = shardings or state_shardings(mesh, state)
+    shardings = shardings or state_shardings(
+        mesh, state, zero1=getattr(args, "zero1", False))
 
     if getattr(args, "schedule", "gpipe") == "1f1b":
         if getattr(args, "grad_accum", 1) != 1:
@@ -532,8 +652,9 @@ def build(args, mesh=None, num_slices: int = 1):
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
 
-    mesh = mesh or make_pipe_mesh(pipeline=args.pipeline,
-                                  num_slices=num_slices)
+    mesh = mesh or make_pipe_mesh(
+        pipeline=args.pipeline, num_slices=num_slices,
+        tensor_parallel=getattr(args, "tensor_parallel", 1))
     data_shards = mesh.shape["data"]
     grad_accum = getattr(args, "grad_accum", 1)
     if args.batch % (data_shards * args.microbatches * grad_accum) != 0:
@@ -551,11 +672,11 @@ def build(args, mesh=None, num_slices: int = 1):
         batch_stats={},
         opt_state=tx.init(params),
     )
-    shardings = state_shardings(mesh, state)
+    shardings = state_shardings(mesh, state,
+                                zero1=getattr(args, "zero1", False))
     state = train.place_state(mesh, state, shardings)
     step = make_pipe_train_step(args, stage, mesh, state, tx, shardings)
-    batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
-                                    vocab=args.vocab)
+    batches = data_mod.lm_batches(args)
     return mesh, stage, state, step, batches
 
 

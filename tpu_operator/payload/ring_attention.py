@@ -44,7 +44,17 @@ NEG_INF = -1e30  # finite stand-in for -inf: keeps exp() NaN-free when a
 
 def reference_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
                         causal: bool = True) -> jnp.ndarray:
-    """Vanilla full attention, [B, T, H, D] layout — the parity oracle."""
+    """Vanilla full attention, [B, T, H, D] layout — the parity oracle.
+    K/V with fewer (grouped) heads are broadcast to full head count here:
+    the oracle *is* the repeat-based GQA definition the kernels must
+    match, so materializing the repeat is the point, not a cost."""
+    if k.shape[2] != q.shape[2]:
+        hq, hkv = q.shape[2], k.shape[2]
+        if hkv <= 0 or hq % hkv != 0:
+            raise ValueError(
+                f"query heads {hq} must be a multiple of K/V heads {hkv}")
+        k = jnp.repeat(k, hq // hkv, axis=2)
+        v = jnp.repeat(v, hq // hkv, axis=2)
     scale = q.shape[-1] ** -0.5
     s = jnp.einsum("bqhd,bkhd->bhqk", q.astype(jnp.float32),
                    k.astype(jnp.float32)) * scale

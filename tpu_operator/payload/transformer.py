@@ -108,6 +108,10 @@ def parse_args(argv=None):
     p.add_argument("--lr", type=float, default=3e-3)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
+    p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
+                   help="mounted .npy token file (1-D int array): "
+                        "memory-mapped real-data stream (data.token_file_lm)"
+                        "; empty = synthetic recurrence")
     p.add_argument("--checkpoint-dir", default="",
                    help="checkpoint/resume dir (default: $TPU_CHECKPOINT_DIR)")
     p.add_argument("--checkpoint-every", type=int, default=100)
@@ -179,26 +183,10 @@ def _build_model(args, mesh):
         raise ValueError(
             "--sp-mode ulysses does not compose with --tensor-parallel "
             "(both shard the head dimension); use --sp-mode ring")
-    mode = getattr(args, "split_qkv", "auto")
-    split_qkv = mode == "on" or (mode == "auto" and tp > 1)
+    split_qkv = models.resolve_split_qkv(getattr(args, "split_qkv", "auto"),
+                                         tp, log)
     kv_heads = getattr(args, "kv_heads", 0)
-    if kv_heads < 0:
-        raise ValueError(f"--kv-heads must be >= 0, got {kv_heads}")
-    if kv_heads and args.heads % kv_heads != 0:
-        raise ValueError(
-            f"--heads {args.heads} must divide by --kv-heads {kv_heads}")
-    if tp > 1:
-        if args.heads % tp != 0:
-            raise ValueError(
-                f"--heads {args.heads} must divide by --tensor-parallel "
-                f"{tp} (TP shards whole heads)")
-        if kv_heads and kv_heads % tp != 0:
-            raise ValueError(
-                f"--kv-heads {kv_heads} must divide by --tensor-parallel "
-                f"{tp} (TP shards whole K/V heads)")
-        if args.dim % tp != 0:
-            raise ValueError(
-                f"--dim {args.dim} must divide by --tensor-parallel {tp}")
+    models.validate_heads_dims(args.heads, kv_heads, args.dim, tp)
 
     # nn.remat is semantics-preserving: same params/outputs, backward
     # recomputes the block instead of keeping its activations in HBM.
@@ -369,8 +357,7 @@ def build(args, mesh=None, num_slices: int = 1):
                               grad_accum=getattr(args, "grad_accum", 1),
                               sp_layout=getattr(args, "sp_layout",
                                                 "contiguous"))
-    batches = data_mod.synthetic_lm(args.seed, args.batch, args.seq_len,
-                                    vocab=args.vocab)
+    batches = data_mod.lm_batches(args)
     return mesh, model, state, step, batches
 
 

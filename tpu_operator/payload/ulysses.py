@@ -64,10 +64,18 @@ def ulysses_attention(q, k, v, mesh: Mesh, *, seq_axis: str = "seq",
         use_pallas = fa.use_pallas_default()
     shards = mesh.shape[seq_axis]
     heads = q.shape[2]
+    kv_heads = k.shape[2]
     if heads % shards != 0:
         raise ValueError(
             f"ulysses needs heads ({heads}) divisible by the {seq_axis!r} "
             f"axis size ({shards}); use --sp-mode ring otherwise")
+    if kv_heads % shards != 0:
+        # GQA: K/V scatter at their own (smaller) head count, so the kv
+        # group must also split evenly across the seq shards.
+        raise ValueError(
+            f"ulysses needs kv_heads ({kv_heads}) divisible by the "
+            f"{seq_axis!r} axis size ({shards}); use --sp-mode ring or a "
+            f"larger --kv-heads")
     spec = P(batch_axis, seq_axis, None, None)
     body = functools.partial(_ulysses_local, axis_name=seq_axis,
                              causal=causal, use_pallas=use_pallas)
