@@ -48,6 +48,18 @@ def conflict(kind: str, name: str, message: str = "") -> ApiError:
     return ApiError(409, "Conflict", message or f'operation on {kind} "{name}" conflicted')
 
 
+def expired(kind: str, message: str = "") -> ApiError:
+    """410 Gone — the requested watch resourceVersion predates the
+    server's retained event window (etcd compaction / watch cache
+    horizon); the only recovery is a fresh list."""
+    return ApiError(410, "Expired",
+                    message or f"too old resource version for {kind}")
+
+
+def is_expired(err: BaseException) -> bool:
+    return isinstance(err, ApiError) and err.code == 410
+
+
 def is_not_found(err: BaseException) -> bool:
     """ref: k8sutil.go:80-82 IsKubernetesResourceNotFoundError."""
     return isinstance(err, ApiError) and err.code == 404 and err.reason != "Conflict"

@@ -73,6 +73,15 @@ class FakeResourceClient:
         return (namespace, name)
 
     def _notify(self, event_type: str, obj: dict, namespace: str) -> None:
+        # Deletion bumps the resourceVersion on the *event* object (real
+        # apiserver semantics: the watch DELETED event carries a fresh RV),
+        # so the event log stays ordered by the global version counter.
+        if event_type == "DELETED":
+            obj = copy.deepcopy(obj)
+            obj.setdefault("metadata", {})["resourceVersion"] = str(
+                self._cs.next_version())
+        rv = int((obj.get("metadata") or {}).get("resourceVersion") or 0)
+        self._cs.log_event(rv, self.kind, namespace, event_type, obj)
         lbls = (obj.get("metadata") or {}).get("labels") or {}
         for q, ns, selector in list(self._watchers):
             if ns not in ("", namespace):
@@ -120,6 +129,15 @@ class FakeResourceClient:
                     continue
                 out.append(copy.deepcopy(obj))
             return out
+
+    def list_with_version(self, namespace: str = "",
+                          label_selector: str = "") -> Tuple[List[dict], str]:
+        """(items, list resourceVersion) — the list-envelope RV a real
+        apiserver returns in ``metadata.resourceVersion``, which anchors a
+        gap-free watch (reflector list-then-watch)."""
+        with self._cs.lock:
+            return (self.list(namespace, label_selector),
+                    str(self._cs.current_version()))
 
     def update(self, namespace: str, obj: dict) -> dict:
         with self._cs.lock:
@@ -190,9 +208,37 @@ class FakeResourceClient:
 
     def watch(self, namespace: str = "", label_selector: str = "",
               resource_version: str = "") -> Watch:
+        """Watch from "now" (no ``resource_version``) or from just after a
+        given RV — replaying retained events with newer RVs first, exactly
+        the apiserver contract. An RV older than the bounded event log's
+        horizon raises **410 Gone** (errors.expired): the caller cannot be
+        given a gap-free stream and must re-list. ``"0"`` means "any
+        version" (K8s special case: never 410s, no replay guarantee)."""
         q: "queue.Queue[Optional[Tuple[str, dict]]]" = queue.Queue()
         entry = (q, namespace, label_selector or None)
         with self._cs.lock:
+            if resource_version and resource_version != "0":
+                try:
+                    since = int(resource_version)
+                except ValueError:
+                    # Real apiservers answer 400, not a dropped connection.
+                    raise errors.ApiError(
+                        400, "BadRequest",
+                        f"invalid resourceVersion {resource_version!r}")
+                if since < self._cs.evicted_through():
+                    raise errors.expired(
+                        self.kind,
+                        f"resourceVersion {resource_version} is too old "
+                        f"(oldest retained: {self._cs.evicted_through()})")
+                for rv, kind, ns, ev, obj in self._cs.retained_events():
+                    if kind != self.kind or rv <= since:
+                        continue
+                    if namespace and ns != namespace:
+                        continue
+                    lbls = (obj.get("metadata") or {}).get("labels") or {}
+                    if label_selector and not matches(label_selector, lbls):
+                        continue
+                    q.put((ev, copy.deepcopy(obj)))
             self._watchers.append(entry)
 
         def _unregister() -> None:
@@ -208,9 +254,20 @@ class FakeClientset:
     and the TPUJob CRD (ref: fake.NewSimpleClientset +
     fake/clientset_generated.go)."""
 
+    # Watch-event history window (replay for RV-anchored watches). Real
+    # apiservers bound theirs by etcd compaction + a watch cache; anything
+    # older answers 410 Gone. Small enough that tests can actually age an
+    # RV out and exercise the informer's 410 re-list path.
+    EVENT_LOG_SIZE = 256
+
     def __init__(self) -> None:
+        import collections
+
         self.lock = threading.RLock()
         self._version = 0
+        self._events: "collections.deque" = collections.deque(
+            maxlen=self.EVENT_LOG_SIZE)
+        self._evicted_through = 0  # highest RV ever dropped from _events
         self.actions: List[Tuple[str, str, str, str]] = []
         self.pods = FakeResourceClient("Pod", self)
         self.services = FakeResourceClient("Service", self)
@@ -223,6 +280,25 @@ class FakeClientset:
     def next_version(self) -> int:
         self._version += 1
         return self._version
+
+    def current_version(self) -> int:
+        return self._version
+
+    def log_event(self, rv: int, kind: str, namespace: str, event_type: str,
+                  obj: dict) -> None:
+        if len(self._events) == self._events.maxlen:
+            self._evicted_through = max(self._evicted_through,
+                                        self._events[0][0])
+        self._events.append((rv, kind, namespace, event_type,
+                             copy.deepcopy(obj)))
+
+    def retained_events(self):
+        return list(self._events)
+
+    def evicted_through(self) -> int:
+        """Highest resourceVersion evicted from the bounded event log: a
+        watch anchored at or below this cannot be gap-free → 410."""
+        return self._evicted_through
 
     def close_watches(self) -> None:
         """Terminate every open watch stream (unblocks consumers waiting on

@@ -135,20 +135,50 @@ class Informer:
                 stop_event.wait(1.0)
 
     def _list_and_watch(self, stop_event: threading.Event) -> None:
-        # Watch opens BEFORE the list so no event can fall in a gap between
-        # the two: events racing the list are simply applied on top of the
-        # snapshot (idempotent for a level-triggered consumer). A client that
-        # supports resourceVersion (the real apiserver) additionally anchors
-        # the watch at the list's RV; the resync re-list below heals any
-        # divergence either way.
-        watch = self._client.watch(self._namespace)
+        # Against a client that returns a list resourceVersion
+        # (list_with_version — the real apiserver and the harness), this is
+        # the client-go Reflector discipline: list, then watch anchored at
+        # the list's RV, so the stream resumes exactly where the snapshot
+        # ended — gap-free by construction. A 410 Gone on the anchored open
+        # (RV already compacted out of the server's watch window) falls back
+        # to a from-now watch for THIS cycle only; the snapshot was just
+        # taken, so the at-most-moments-wide gap is healed by the resync
+        # re-list like any other race.
+        #
+        # Clients without list RVs (bare fakes) keep the round-2 order —
+        # watch opens BEFORE the list so no event falls in a gap between
+        # the two; racing events are applied on top of the snapshot
+        # (idempotent for a level-triggered consumer).
+        from tpu_operator.client import errors
+
+        objs, rv = None, ""
+        lister = getattr(self._client, "list_with_version", None)
+        if lister is not None:
+            objs, rv = lister(self._namespace)
+        if rv:
+            try:
+                watch = self._client.watch(self._namespace,
+                                           resource_version=rv)
+            except errors.ApiError as e:
+                if not errors.is_expired(e):
+                    raise
+                log.info("anchored watch at RV %s got 410 Gone; watching "
+                         "from now (resync heals the window)", rv)
+                watch = self._client.watch(self._namespace)
+        else:
+            # No list RV (server omitted it, or bare fake): discard any
+            # pre-watch snapshot and keep the watch-BEFORE-list order — a
+            # post-watch list closes the gap a from-now watch would leave.
+            objs = None
+            watch = self._client.watch(self._namespace)
         with self._lock:
             self._watch = watch
         if stop_event.is_set():  # raced shutdown between create and register
             watch.stop()
             return
 
-        objs = self._client.list(self._namespace)
+        if objs is None:
+            objs = self._client.list(self._namespace)
         self.store.replace(objs)
         for obj in objs:
             self._dispatch_add(obj)
@@ -168,8 +198,21 @@ class Informer:
             elif event_type == "DELETED":
                 self.store.delete(obj)
                 self._dispatch_delete(obj)
+            elif event_type == "BOOKMARK":
+                # Progress marker only (carries just a resourceVersion);
+                # nothing to dispatch — next cycle re-anchors off a fresh
+                # list RV anyway.
+                continue
             elif event_type == "ERROR":
-                return  # re-list
+                code = (obj or {}).get("code")
+                if code == 410:
+                    # The server compacted past our position mid-stream:
+                    # the mandated recovery is a fresh list (immediately —
+                    # this is an expected protocol event, not a fault).
+                    log.info("watch stream expired (410 Gone in-stream); "
+                             "re-listing")
+                    return
+                return  # unknown server error → re-list
 
     def _stop_current_watch_on(self, stop_event: threading.Event) -> None:
         stop_event.wait()

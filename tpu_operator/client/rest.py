@@ -224,6 +224,22 @@ class RestResourceClient:
         result = self._rest.request("GET", path, params=params)
         return (result or {}).get("items", [])
 
+    def list_with_version(self, namespace: str = "",
+                          label_selector: str = "") -> Tuple[List[dict], str]:
+        """(items, list resourceVersion) from the list envelope's
+        ``metadata.resourceVersion`` — what a reflector anchors its watch
+        at for a gap-free list-then-watch (client-go Reflector semantics)."""
+        params: Dict[str, str] = {}
+        if label_selector:
+            params["labelSelector"] = label_selector
+        if namespace:
+            path = self._path(namespace)
+        else:
+            path = f"{self._prefix}/{self.resource}"
+        result = self._rest.request("GET", path, params=params) or {}
+        return (result.get("items", []),
+                (result.get("metadata") or {}).get("resourceVersion", ""))
+
     def update(self, namespace: str, obj: dict) -> dict:
         name = (obj.get("metadata") or {}).get("name", "")
         return self._rest.request("PUT", self._path(namespace, name), body=obj)

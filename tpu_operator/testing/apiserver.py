@@ -114,14 +114,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif params.get("watch") == "true":
                 self._serve_watch(client, namespace, params)
             else:
-                items = client.list(namespace, params.get("labelSelector", ""))
+                items, rv = client.list_with_version(
+                    namespace, params.get("labelSelector", ""))
                 self._send_json(200, {"kind": f"{client.kind}List",
-                                      "apiVersion": "v1", "items": items})
+                                      "apiVersion": "v1", "items": items,
+                                      "metadata": {"resourceVersion": rv}})
         except errors.ApiError as e:
             self._send_error(e)
 
     def _serve_watch(self, client: Any, namespace: str, params: Dict[str, str]) -> None:
-        watch = client.watch(namespace, params.get("labelSelector", ""))
+        # Raises 410 Gone (into do_GET's ApiError handler — headers not yet
+        # sent) when the anchor RV predates the event-log horizon, exactly
+        # the real watch-cache contract the informer's re-list path handles.
+        watch = client.watch(namespace, params.get("labelSelector", ""),
+                             resource_version=params.get("resourceVersion", ""))
         self.send_response(200)
         self.send_header("Content-Type", "application/json")
         self.send_header("Transfer-Encoding", "chunked")
