@@ -1,0 +1,165 @@
+#!/usr/bin/env python3
+"""Profile-derived step-time breakdown for a payload config.
+
+Answers the question the bench suite's MFU numbers raise but cannot
+answer: WHERE does the non-MXU time go? Captures a ``jax.profiler`` device
+trace of a few steady-state steps, parses the XPlane protobuf directly
+(tensorboard_plugin_profile ships the schema; no TensorBoard UI needed),
+and aggregates per-op self time by the TPU runtime's ``hlo_category`` stat
+(schema: tensorflow/tsl's xplane_pb2, shipped in the baked image) —
+convolution/dot fusions (MXU), the Pallas attention custom-calls,
+elementwise/reduce fusions (optimizer + remat recompute), infeed/outfeed,
+and idle gaps (host stall) from busy-vs-wall time.
+
+Default config = the flagship GQA bench row, so the output slots straight
+into docs/benchmarks.md's attribution table:
+
+    python hack/profile_breakdown.py            # flagship GQA, 6 steps
+    python hack/profile_breakdown.py --quick    # tiny CPU smoke
+"""
+
+from __future__ import annotations
+
+import argparse
+import collections
+import glob
+import itertools
+import json
+import os
+import sys
+import tempfile
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+FLAGSHIP = ["--dim", "2048", "--layers", "8", "--heads", "16",
+            "--kv-heads", "4", "--batch", "32", "--seq-len", "2048",
+            "--vocab", "32768", "--remat", "--remat-policy", "dots",
+            "--grad-accum", "4", "--adam-mu-dtype", "bf16"]
+QUICK = ["--dim", "64", "--layers", "2", "--heads", "2", "--batch", "4",
+         "--seq-len", "128", "--vocab", "256"]
+
+
+def capture(argv, steps: int, outdir: str) -> float:
+    """Run warmup + ``steps`` traced steps; returns measured sec/step."""
+    import jax
+
+    from tpu_operator.payload import data as data_mod, transformer
+
+    targs = transformer.parse_args(argv)
+    mesh, _m, state, step, batches = transformer.build(targs)
+    spec = transformer.lm_token_spec(mesh)
+    pregen = [data_mod.put_global_batch(mesh, *b, spec=spec)
+              for b in itertools.islice(batches, 4)]
+    cycled = itertools.cycle(pregen)
+    for _ in range(3):
+        state, metrics = step(state, *next(cycled))
+    jax.device_get(metrics["loss"])
+
+    jax.profiler.start_trace(outdir)
+    t0 = time.perf_counter()
+    for _ in range(steps):
+        state, metrics = step(state, *next(cycled))
+    jax.device_get(metrics["loss"])
+    dt = (time.perf_counter() - t0) / steps
+    jax.profiler.stop_trace()
+    return dt
+
+
+def classify(name: str, category: str) -> str:
+    """hlo_category (plus name heuristics for custom calls) → report bucket."""
+    cat = (category or "").lower()
+    low = name.lower()
+    if "custom" in cat or "custom-call" in low or "pallas" in low:
+        return "attention kernels (pallas custom-calls)"
+    if "convolution" in cat or cat.startswith("dot") or "matmul" in cat:
+        return "matmul (MXU)"
+    if "all-reduce" in cat or "all-gather" in cat or "collective" in cat \
+            or "permute" in cat:
+        return "collectives"
+    if "infeed" in cat or "outfeed" in cat or "copy" in cat \
+            or "host" in cat:
+        return "data movement"
+    return "elementwise / reduce / other fusions"
+
+
+def parse_xplanes(outdir: str):
+    """{bucket: total_self_us}, device_busy_us, plane_wall_us from every
+    TPU device plane under outdir."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = glob.glob(os.path.join(outdir, "**", "*.xplane.pb"),
+                      recursive=True)
+    if not paths:
+        raise SystemExit(f"no xplane.pb under {outdir}")
+    buckets: dict = collections.defaultdict(float)
+    busy = 0.0
+    wall_lo, wall_hi = None, 0.0
+    for path in paths:
+        xs = xplane_pb2.XSpace()
+        with open(path, "rb") as f:
+            xs.ParseFromString(f.read())
+        for plane in xs.planes:
+            if "TPU" not in plane.name or "XLA Ops" not in [
+                    l.name for l in plane.lines]:
+                if "TPU" not in plane.name:
+                    continue
+            ev_meta = plane.event_metadata
+            st_meta = plane.stat_metadata
+            for line in plane.lines:
+                if line.name != "XLA Ops":
+                    continue
+                for ev in line.events:
+                    dur = ev.duration_ps / 1e6  # ps → us
+                    meta = ev_meta.get(ev.metadata_id)
+                    name = meta.name if meta else ""
+                    cat = ""
+                    for st in ev.stats:
+                        key = st_meta.get(st.metadata_id)
+                        if key is not None and key.name == "hlo_category":
+                            cat = (st.str_value
+                                   or st_meta.get(st.ref_value).name
+                                   if st.ref_value else st.str_value)
+                    buckets[classify(name, cat or "")] += dur
+                    busy += dur
+                    t_start = ev.offset_ps / 1e6
+                    wall_lo = t_start if wall_lo is None else min(
+                        wall_lo, t_start)
+                    wall_hi = max(wall_hi, t_start + dur)
+    wall = (wall_hi - (wall_lo or 0.0))
+    return dict(buckets), busy, wall
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser()
+    ap.add_argument("--quick", action="store_true")
+    ap.add_argument("--steps", type=int, default=6)
+    ap.add_argument("--outdir", default="")
+    args, extra = ap.parse_known_args(argv)
+    if args.quick:
+        os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    cfg = (QUICK if args.quick else FLAGSHIP) + extra
+    outdir = args.outdir or tempfile.mkdtemp(prefix="tpu_profile_")
+    dt = capture(cfg, args.steps, outdir)
+    buckets, busy, wall = parse_xplanes(outdir)
+    per_step = {k: v / args.steps / 1e3 for k, v in buckets.items()}  # ms
+    report = {
+        "config": " ".join(cfg),
+        "measured_step_ms": round(dt * 1e3, 1),
+        "device_busy_ms_per_step": round(busy / args.steps / 1e3, 1),
+        "device_idle_ms_per_step": round(
+            max(0.0, wall - busy) / args.steps / 1e3, 1),
+        "breakdown_ms_per_step": {
+            k: round(v, 1) for k, v in sorted(
+                per_step.items(), key=lambda kv: -kv[1])},
+        "breakdown_pct_of_busy": {
+            k: round(100 * v * args.steps * 1e3 / busy, 1)
+            for k, v in sorted(per_step.items(), key=lambda kv: -kv[1])},
+        "trace_dir": outdir,
+    }
+    print(json.dumps(report, indent=1))
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

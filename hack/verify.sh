@@ -10,6 +10,7 @@ set -euo pipefail
 cd "$(dirname "$0")/.."
 
 python hack/check_payload_image.py
+python hack/gen_lock.py --check
 python hack/gen_crd.py --check
 python -m pytest tests/ -x -q
 python hack/e2e_smoke.py --timeout 120

@@ -1,14 +1,33 @@
 """Version metadata for the operator binary.
 
 Reference parity: version/version.go:22-40 (Version/GitSHA + runtime info,
-``--version`` prints and exits).
+``--version`` prints and exits). The reference's GitSHA was injected by
+-ldflags at build; here the image build writes ``tpu_operator/_build_info.py``
+(Dockerfile ``ARG GIT_SHA`` → one-line module), with an env override for
+ad-hoc runs. Unstamped dev checkouts report "dev" — the same behavior as
+the reference's "Not provided." fallback, but the shipped images are
+stamped.
 """
 
+import os
 import platform
 import sys
 
 VERSION = "0.1.0"
-GIT_SHA = "dev"
+
+
+def _resolve_git_sha() -> str:
+    env = os.environ.get("TPU_OPERATOR_GIT_SHA", "")
+    if env:
+        return env
+    try:
+        from tpu_operator._build_info import GIT_SHA as baked
+        return baked
+    except ImportError:
+        return "dev"
+
+
+GIT_SHA = _resolve_git_sha()
 
 
 def info() -> str:
