@@ -331,8 +331,11 @@ def bench_moe(quick: bool, windows: int = 3) -> dict:
                 "--vocab", "256", "--dtype", "f32"]
         steps, windows = 3, 1
     else:
+        # batch 8: the [G,n,E,C] dispatch/combine one-hots and [E,G,C,D]
+        # expert buffers scale with G — batch 16 at this config OOMs the
+        # 16G chip in HLO temps (measured), 8 fits with headroom.
         argv = ["--dim", "1024", "--layers", "8", "--heads", "16",
-                "--experts", "8", "--batch", "16", "--seq-len", "2048",
+                "--experts", "8", "--batch", "8", "--seq-len", "2048",
                 "--vocab", "32768", "--capacity-factor", "1.25"]
         steps = 10
     margs = moe.parse_args(argv)

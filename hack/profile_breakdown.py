@@ -66,20 +66,39 @@ def capture(argv, steps: int, outdir: str) -> float:
     return dt
 
 
+OVERLAPPED = "dma / async (overlapped, not counted as busy)"
+
+
 def classify(name: str, category: str) -> str:
-    """hlo_category (plus name heuristics for custom calls) → report bucket."""
+    """hlo_category → report bucket. The category ALONE decides whenever
+    present: HLO instruction names are full printed instructions whose
+    operand references leak other ops' names (a conv fusion consuming a
+    custom-call's output contains 'custom-call' in its text — a name
+    heuristic misbucketed 377 ms/step of flagship matmuls as attention).
+    TPU categories observed: 'convolution fusion' (dots lower to these),
+    'loop fusion'/'non-fusion elementwise'/'reduce', 'custom-call'/'custom
+    fusion' (pallas), 'async-start/done' + 'copy-start/done' (DMA spans
+    that run CONCURRENTLY with compute — counting them as busy
+    double-counts the step, so they bucket separately and are excluded
+    from busy time)."""
     cat = (category or "").lower()
-    low = name.lower()
-    if "custom" in cat or "custom-call" in low or "pallas" in low:
+    if "async" in cat or cat.startswith("copy-"):
+        return OVERLAPPED
+    if "custom" in cat:
         return "attention kernels (pallas custom-calls)"
-    if "convolution" in cat or cat.startswith("dot") or "matmul" in cat:
+    if "convolution" in cat or cat.startswith("dot") or "matmul" in cat \
+            or "output fusion" in cat:
         return "matmul (MXU)"
     if "all-reduce" in cat or "all-gather" in cat or "collective" in cat \
             or "permute" in cat:
         return "collectives"
-    if "infeed" in cat or "outfeed" in cat or "copy" in cat \
+    if "infeed" in cat or "outfeed" in cat or "data formatting" in cat \
             or "host" in cat:
         return "data movement"
+    if not cat:  # no category metadata: fall back to name sniffing
+        low = name.split("=", 1)[0].lower()
+        if "custom-call" in low or "pallas" in low:
+            return "attention kernels (pallas custom-calls)"
     return "elementwise / reduce / other fusions"
 
 
@@ -100,32 +119,63 @@ def parse_xplanes(outdir: str):
         with open(path, "rb") as f:
             xs.ParseFromString(f.read())
         for plane in xs.planes:
-            if "TPU" not in plane.name or "XLA Ops" not in [
-                    l.name for l in plane.lines]:
-                if "TPU" not in plane.name:
-                    continue
+            if "TPU" not in plane.name:
+                continue
             ev_meta = plane.event_metadata
             st_meta = plane.stat_metadata
+
+            # hlo_category lives in the event *metadata* stats (per unique
+            # HLO op), not the per-occurrence event stats.
+            def meta_category(mid: int) -> str:
+                meta = ev_meta.get(mid)
+                if meta is None:
+                    return ""
+                for st in meta.stats:
+                    key = st_meta.get(st.metadata_id)
+                    if key is not None and key.name == "hlo_category":
+                        if st.str_value:
+                            return st.str_value
+                        ref = st_meta.get(st.ref_value)
+                        return ref.name if ref is not None else ""
+                return ""
+
+            cat_cache: dict = {}
             for line in plane.lines:
                 if line.name != "XLA Ops":
                     continue
-                for ev in line.events:
-                    dur = ev.duration_ps / 1e6  # ps → us
-                    meta = ev_meta.get(ev.metadata_id)
-                    name = meta.name if meta else ""
-                    cat = ""
-                    for st in ev.stats:
-                        key = st_meta.get(st.metadata_id)
-                        if key is not None and key.name == "hlo_category":
-                            cat = (st.str_value
-                                   or st_meta.get(st.ref_value).name
-                                   if st.ref_value else st.str_value)
-                    buckets[classify(name, cat or "")] += dur
+                # Control-flow HLOs (the grad-accum `while`, conditionals)
+                # are recorded as events SPANNING their body ops, so a
+                # naive sum double-counts every looped op. Containment
+                # sweep → per-event *self* time: each child's duration is
+                # subtracted from its innermost enclosing parent.
+                evs = sorted(
+                    ((e.offset_ps, e.offset_ps + e.duration_ps,
+                      e.metadata_id) for e in line.events),
+                    key=lambda e: (e[0], -(e[1] - e[0])))
+                selfs = []
+                stack = []  # indices into selfs of currently-open events
+                for s, t, mid in evs:
+                    while stack and s >= selfs[stack[-1]][1]:
+                        stack.pop()
+                    selfs.append([s, t, mid, (t - s)])
+                    if stack:
+                        selfs[stack[-1]][3] -= (t - s)
+                    stack.append(len(selfs) - 1)
+                for s, t, mid, self_ps in selfs:
+                    dur = max(0, self_ps) / 1e6  # ps → us
+                    if mid not in cat_cache:
+                        meta = ev_meta.get(mid)
+                        cat_cache[mid] = classify(
+                            meta.name if meta else "",
+                            meta_category(mid))
+                    bucket = cat_cache[mid]
+                    buckets[bucket] += dur
+                    if bucket == OVERLAPPED:
+                        continue  # concurrent DMA: not device busy time
                     busy += dur
-                    t_start = ev.offset_ps / 1e6
-                    wall_lo = t_start if wall_lo is None else min(
-                        wall_lo, t_start)
-                    wall_hi = max(wall_hi, t_start + dur)
+                    wall_lo = (s / 1e6 if wall_lo is None
+                               else min(wall_lo, s / 1e6))
+                    wall_hi = max(wall_hi, t / 1e6)
     wall = (wall_hi - (wall_lo or 0.0))
     return dict(buckets), busy, wall
 
@@ -135,20 +185,28 @@ def main(argv=None) -> int:
     ap.add_argument("--quick", action="store_true")
     ap.add_argument("--steps", type=int, default=6)
     ap.add_argument("--outdir", default="")
+    ap.add_argument("--parse-only", action="store_true",
+                    help="re-analyze an existing --outdir trace without "
+                         "re-capturing (iterate on bucketing for free)")
     args, extra = ap.parse_known_args(argv)
     if args.quick:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
     cfg = (QUICK if args.quick else FLAGSHIP) + extra
     outdir = args.outdir or tempfile.mkdtemp(prefix="tpu_profile_")
-    dt = capture(cfg, args.steps, outdir)
+    dt = None
+    if not args.parse_only:
+        dt = capture(cfg, args.steps, outdir)
     buckets, busy, wall = parse_xplanes(outdir)
+    overlapped = buckets.pop(OVERLAPPED, 0.0)
     per_step = {k: v / args.steps / 1e3 for k, v in buckets.items()}  # ms
     report = {
         "config": " ".join(cfg),
-        "measured_step_ms": round(dt * 1e3, 1),
+        "measured_step_ms": round(dt * 1e3, 1) if dt is not None else None,
         "device_busy_ms_per_step": round(busy / args.steps / 1e3, 1),
         "device_idle_ms_per_step": round(
             max(0.0, wall - busy) / args.steps / 1e3, 1),
+        "overlapped_dma_ms_per_step": round(
+            overlapped / args.steps / 1e3, 1),
         "breakdown_ms_per_step": {
             k: round(v, 1) for k, v in sorted(
                 per_step.items(), key=lambda kv: -kv[1])},
