@@ -21,6 +21,29 @@ def _lm_argv(extra=()):
             "--layers", "2", "--seq-parallel", "4", *extra]
 
 
+def test_remat_dots_attn_policy_loss_identical():
+    """--remat-policy dots_attn (saves the flash kernel's named residuals)
+    must be semantics-preserving vs no remat — same two-step loss to bf16
+    wiggle — including GQA, whose kv-sized K/V ride the named residuals."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import data as data_mod
+
+    mesh = transformer.make_lm_mesh(8, seq_parallel=4)
+    losses = {}
+    for label, extra in (("none", []),
+                         ("dots_attn", ["--remat", "--remat-policy",
+                                        "dots_attn"])):
+        args = transformer.parse_args(_lm_argv(extra + ["--kv-heads", "2"]))
+        _, _, state, step, batches = transformer.build(args, mesh=mesh)
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh, tokens, spec=P("data", "seq"))
+        state, _ = step(state, dev)
+        _, metrics = step(state, dev)
+        losses[label] = float(metrics["loss"])
+    assert abs(losses["none"] - losses["dots_attn"]) < 5e-3, losses
+
+
 def test_remat_transformer_loss_identical():
     mesh = transformer.make_lm_mesh(8, seq_parallel=4)
     losses = {}

@@ -594,6 +594,18 @@ def _attn(causal: bool, use_pallas: bool, q, k, v):
 
 def _attn_fwd(causal, use_pallas, q, k, v):
     out, L = _attn_impl(causal, use_pallas, q, k, v)
+    # Named for remat policies: under jax.checkpoint, "dots"-style policies
+    # do not save custom-call outputs, so the whole forward kernel re-runs
+    # inside the backward — measured at ~1/3 of the flagship's attention
+    # time (docs/benchmarks.md attribution). Naming the two backward
+    # residuals lets a save_only_these_names policy (transformer
+    # --remat-policy dots_attn) keep them resident: O(B·T·H·D) bf16 + the
+    # [B,H,T,1] logsumexp per layer, in exchange for skipping the
+    # recompute pass.
+    from jax.ad_checkpoint import checkpoint_name
+
+    out = checkpoint_name(out, "flash_attn_out")
+    L = checkpoint_name(L, "flash_attn_lse")
     return out, (q, k, v, out, L)
 
 
