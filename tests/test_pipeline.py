@@ -384,3 +384,107 @@ def test_pipeline_gqa_descends(mesh):
         losses.append(float(metrics["loss"]))
     assert np.isfinite(losses).all()
     assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+def test_interleaved_schedule_invariants():
+    """The simulated schedule must satisfy every dependency (the generator
+    asserts them internally), fire each unit exactly once (also internal),
+    degenerate to plain 1F1B's makespan at V=1, and shrink the relative
+    bubble ~V x at fixed S, M."""
+    t_v1 = pipeline.onef1b_interleaved_schedule(4, 1, 8)["act"].shape[0]
+    assert t_v1 == 2 * (8 + 4 - 1)  # plain 1F1B flush makespan
+    t_v2 = pipeline.onef1b_interleaved_schedule(4, 2, 8)["act"].shape[0]
+    rb1 = (t_v1 - 2 * 8) / (2 * 8)
+    rb2 = (t_v2 - 2 * 2 * 8) / (2 * 2 * 8)
+    assert rb2 < rb1 / 1.5, (rb1, rb2)
+    # generator-internal audits across a grid (raises on violation)
+    for s, v, m in [(2, 2, 4), (2, 3, 6), (4, 4, 8), (8, 2, 8)]:
+        tbl = pipeline.onef1b_interleaved_schedule(s, v, m)
+        assert ((tbl["act"] == 1).sum() == (tbl["act"] == 2).sum()
+                == v * m * s)
+    with pytest.raises(ValueError, match="divisible"):
+        pipeline.onef1b_interleaved_schedule(4, 2, 6)
+
+
+def test_interleaved_matches_plain_1f1b(mesh):
+    """S=2 devices x V=2 chunks must equal the plain S=4 pipeline: the
+    chunk stacks initialize identically (same rng, V*S chunks), only their
+    device placement differs — loss trajectory tight, params loose (adam's
+    first steps amplify f32 summation-order differences)."""
+    from tpu_operator.payload import data as data_mod
+
+    a_int = _args(batch=16, microbatches=4, layers=4, pipeline=2,
+                  schedule="1f1b-interleaved", virtual_stages=2)
+    a_pln = _args(batch=16, microbatches=4, layers=4, pipeline=4,
+                  schedule="1f1b")
+    mesh2 = pipeline.make_pipe_mesh(4, pipeline=2)
+    _, _, st_i, step_i, batches = pipeline.build(a_int, mesh=mesh2)
+    _, _, st_p, step_p, _ = pipeline.build(a_pln, mesh=mesh)
+    # identical underlying chunk params, different layout
+    vs = jax.tree_util.tree_leaves(st_i.params["stages"])[0]
+    ps = jax.tree_util.tree_leaves(st_p.params["stages"])[0]
+    np.testing.assert_array_equal(
+        np.asarray(vs).reshape(ps.shape), np.asarray(ps))
+    for _ in range(2):
+        (tok,) = next(batches)
+        (dev_i,) = data_mod.put_global_batch(mesh2, tok)
+        (dev_p,) = data_mod.put_global_batch(mesh, tok)
+        st_i, m_i = step_i(st_i, dev_i)
+        st_p, m_p = step_p(st_p, dev_p)
+        assert abs(float(m_i["loss"]) - float(m_p["loss"])) < 2e-5, \
+            (float(m_i["loss"]), float(m_p["loss"]))
+    for a, b in zip(jax.tree_util.tree_leaves(st_i.params),
+                    jax.tree_util.tree_leaves(st_p.params)):
+        np.testing.assert_allclose(
+            np.asarray(a).reshape(np.asarray(b).shape), np.asarray(b),
+            atol=5e-3, rtol=5e-3)
+
+
+def test_interleaved_1f1b_loss_descends():
+    from tpu_operator.payload import data as data_mod
+
+    args = _args(batch=16, microbatches=4, layers=4, pipeline=2,
+                 schedule="1f1b-interleaved", virtual_stages=2)
+    mesh2 = pipeline.make_pipe_mesh(4, pipeline=2)
+    _m, _s, state, step, batches = pipeline.build(args, mesh=mesh2)
+    losses = []
+    for _ in range(25):
+        (tok,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh2, tok)
+        state, metrics = step(state, dev)
+        losses.append(float(metrics["loss"]))
+    assert np.isfinite(losses).all()
+    assert losses[-1] < losses[0] * 0.8, losses[::5]
+
+
+def test_interleaved_validates_divisibility():
+    mesh2 = pipeline.make_pipe_mesh(4, pipeline=2)
+    with pytest.raises(ValueError, match="divisible"):
+        # microbatches (1) not divisible by pipeline (2)
+        pipeline.build(_args(batch=16, microbatches=1, layers=4,
+                             pipeline=2, schedule="1f1b-interleaved",
+                             virtual_stages=2), mesh=mesh2)
+    with pytest.raises(ValueError, match="layers"):
+        pipeline.build(_args(batch=16, microbatches=4, layers=6,
+                             pipeline=2, schedule="1f1b-interleaved",
+                             virtual_stages=2), mesh=mesh2)
+
+
+def test_interleaved_v1_default_works():
+    """--schedule 1f1b-interleaved with the flag's default
+    --virtual-stages 1 must run (the [V, S] layout applies at V=1 too) and
+    match plain 1f1b's loss on the same config."""
+    from tpu_operator.payload import data as data_mod
+
+    mesh2 = pipeline.make_pipe_mesh(4, pipeline=2)
+    a_int = _args(batch=16, microbatches=4, layers=4, pipeline=2,
+                  schedule="1f1b-interleaved")
+    a_pln = _args(batch=16, microbatches=4, layers=4, pipeline=2,
+                  schedule="1f1b")
+    _, _, st_i, step_i, batches = pipeline.build(a_int, mesh=mesh2)
+    _, _, st_p, step_p, _ = pipeline.build(a_pln, mesh=mesh2)
+    (tok,) = next(batches)
+    (dev,) = data_mod.put_global_batch(mesh2, tok)
+    _, m_i = step_i(st_i, dev)
+    _, m_p = step_p(st_p, dev)
+    assert abs(float(m_i["loss"]) - float(m_p["loss"])) < 2e-5
