@@ -1,0 +1,99 @@
+"""Microbench the flash-attention kernels at the flagship attention shape.
+
+Times forward-only and forward+backward at the exact per-microbatch shape
+the flagship LM runs (B8 T2048 H16 KV4 D128 by default), reporting ms and
+effective TFLOPS (causal halves the realized MACs; fwd = 2 tile matmuls,
+bwd = 6). This is the tool behind docs/benchmarks.md's attention-bucket
+numbers: run it before and after kernel changes.
+
+Timing methodology — long windows only. The axon tunnel pays a large
+dispatch-latency ramp after every fence (measured ~115 ms across the
+first ~15 steps of a window: the host streams dispatches one RTT at a
+time until the async queue covers the round trip). Short windows are
+therefore dominated by dispatch latency and *invert* kernel rankings —
+a 10-step window measured this kernel at 9.6 ms/step where the 200-step
+steady state is 2.8 ms. Real training never pays this (the train loop
+dispatches continuously), so steady state is the honest number. Default:
+150-step windows, median of 3.
+
+Usage: python hack/attn_microbench.py [--t 2048] [--b 8] [--heads 16]
+       [--kv 4] [--d 128] [--steps 150] [--windows 3] [--no-causal]
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--t", type=int, default=2048)
+    p.add_argument("--b", type=int, default=8)
+    p.add_argument("--heads", type=int, default=16)
+    p.add_argument("--kv", type=int, default=4)
+    p.add_argument("--d", type=int, default=128)
+    p.add_argument("--steps", type=int, default=150)
+    p.add_argument("--windows", type=int, default=3)
+    p.add_argument("--no-causal", action="store_true")
+    args = p.parse_args(argv)
+
+    import jax
+    import jax.numpy as jnp
+
+    from tpu_operator.payload import flash_attention as fa
+
+    causal = not args.no_causal
+    key = jax.random.key(0)
+    mk = lambda hh: jax.random.normal(
+        key, (args.b, args.t, hh, args.d), jnp.bfloat16)
+    q, k, v = mk(args.heads), mk(args.kv), mk(args.kv)
+
+    fwd = jax.jit(lambda q, k, v: fa.flash_attention(q, k, v, causal=causal))
+    grad = jax.jit(jax.grad(
+        lambda q, k, v: jnp.sum(
+            fa.flash_attention(q, k, v, causal=causal).astype(jnp.float32)
+            ** 2),
+        argnums=(0, 1, 2)))
+
+    frac = 0.5 if causal else 1.0
+    mm = 2 * args.b * args.heads * args.t * args.t * args.d * frac
+    fwd_flops = 2 * mm
+    bwd_flops = 6 * mm
+
+    def timed(fn, tag, flops):
+        val = None
+        for _ in range(10):
+            val = fn(q, k, v)
+        jax.device_get(jax.tree_util.tree_leaves(val)[0].ravel()[0])
+        times = []
+        for _ in range(args.windows):
+            t0 = time.perf_counter()
+            for _ in range(args.steps):
+                val = fn(q, k, v)
+            jax.device_get(jax.tree_util.tree_leaves(val)[0].ravel()[0])
+            times.append((time.perf_counter() - t0) / args.steps)
+        times.sort()
+        med = times[len(times) // 2]
+        spread = 100 * (times[-1] - times[0]) / med if len(times) > 1 else 0.0
+        print(f"{tag:24s} {med * 1e3:8.2f} ms   "
+              f"{flops / med / 1e12:7.1f} TFLOPS eff   "
+              f"spread {spread:.1f}%")
+        return med
+
+    print(f"shape B{args.b} T{args.t} H{args.heads} KV{args.kv} D{args.d} "
+          f"causal={causal} backend={jax.default_backend()} "
+          f"steps/window={args.steps}")
+    f = timed(fwd, "forward", fwd_flops)
+    fb = timed(grad, "forward+backward", fwd_flops + bwd_flops)
+    print(f"{'backward (derived)':24s} {(fb - f) * 1e3:8.2f} ms   "
+          f"{bwd_flops / (fb - f) / 1e12:7.1f} TFLOPS eff")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -191,6 +191,44 @@ def test_gqa_grad_matches_repeat_oracle(use_pallas):
                                    rtol=1e-4, atol=1e-4)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+def test_fused_forward_multi_tile_recurrence(monkeypatch, causal):
+    """Force nq = nk = 4 so the fused forward's cross-tile machinery —
+    scratch reset at ik == 0, alpha rescale of the accumulator across
+    k-tiles, the clamped causal K/V index map, emit at ik == nk-1 —
+    actually executes. At the default block heuristics every t <= 512
+    test shape is a single tile, which reduces the kernel to its
+    degenerate case and would let a cross-tile rescale bug ship green."""
+    monkeypatch.setattr(fa, "_fwd_blocks", lambda tq, tk, g: (64, 64))
+    q, k, v = qkv()
+    got = fa.flash_attention(q, k, v, causal=causal, use_pallas=True)
+    want = ring.reference_attention(q, k, v, causal=causal)
+    np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                               rtol=2e-5, atol=2e-5)
+
+
+def test_fused_forward_multi_tile_gqa_grad(monkeypatch):
+    """Multi-tile (nq = nk = 4) GQA forward + fused backward vs the
+    oracle — covers the group-flattened panels under cross-tile
+    accumulation in both directions."""
+    monkeypatch.setattr(fa, "_fwd_blocks", lambda tq, tk, g: (64, 64))
+    monkeypatch.setattr(fa, "_bwd_blocks", lambda tq, tk, g: (64, 64))
+    q, k, v = qkv_gqa(t=256, h=4, kv=2)
+
+    def loss_flash(q, k, v):
+        return jnp.sum(
+            fa.flash_attention(q, k, v, causal=True, use_pallas=True) ** 2)
+
+    def loss_ref(q, k, v):
+        return jnp.sum(ring.reference_attention(q, k, v, causal=True) ** 2)
+
+    got = jax.grad(loss_flash, argnums=(0, 1, 2))(q, k, v)
+    want = jax.grad(loss_ref, argnums=(0, 1, 2))(q, k, v)
+    for g, w in zip(got, want):
+        np.testing.assert_allclose(np.asarray(g), np.asarray(w),
+                                   rtol=1e-4, atol=1e-4)
+
+
 def test_gqa_streamed_blocks_match_reference():
     """Out-of-order merge_kv_block calls with kv-sized K/V blocks — the
     GQA ring step pattern (carry at query heads, visiting blocks at KV
@@ -279,14 +317,15 @@ def test_gqa_rejects_non_divisible_heads():
 
 def test_gqa_block_heuristics():
     """GQA groups shrink blk_q to keep the flattened score panel inside
-    VMEM; MHA keeps the round-2 blocks exactly."""
-    assert fa._fwd_blocks(8192, 8192, 1) == (512, 512)
-    assert fa._fwd_blocks(8192, 8192, 4) == (256, 512)
-    assert fa._fwd_blocks(8192, 8192, 8) == (128, 512)
-    assert fa._fwd_blocks(8192, 8192, 16) == (64, 512)
-    assert fa._bwd_blocks(8192, 8192, 1) == (512, 512)
-    assert fa._bwd_blocks(8192, 8192, 4) == (128, 512)
-    assert fa._bwd_blocks(8192, 8192, 16) == (64, 256)
+    VMEM; the blk_k budgets are the round-4 steady-state sweep optima
+    (flash_attention._fwd_blocks docstring)."""
+    assert fa._fwd_blocks(8192, 8192, 1) == (512, 1024)
+    assert fa._fwd_blocks(8192, 8192, 4) == (256, 1024)
+    assert fa._fwd_blocks(8192, 8192, 8) == (128, 1024)
+    assert fa._fwd_blocks(8192, 8192, 16) == (64, 1024)
+    assert fa._bwd_blocks(8192, 8192, 1) == (512, 1024)
+    assert fa._bwd_blocks(8192, 8192, 4) == (128, 1024)
+    assert fa._bwd_blocks(8192, 8192, 16) == (64, 512)
     # non-power-of-two groups (12 heads / 4 kv = group 3): the target is
     # rounded down to a power of two so blk_q still lands on a divisor
     # instead of degenerating to the whole span

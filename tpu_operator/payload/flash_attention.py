@@ -88,31 +88,53 @@ def _pick_block(t: int, target: int = 512, floor: int = 128) -> int:
     return t
 
 
-def _fwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
-    """(blk_q, blk_k) for the forward merge. The kernel's VMEM high-water
-    is the flattened f32 score panel [group*blk_q, blk_k] plus its exp —
-    with double-buffered q/o blocks on top, a 2048-row panel measured
-    1.75M over the 16M scoped-vmem limit on v5e. Cap the panel area at
-    1024x512 and shrink k-tiles before dropping blk_q below 128.
-    group == 1 keeps the round-2 blocks (512, 512) exactly."""
+def _panel_blocks(tq: int, tk: int, group: int, q_budget: int,
+                  area: int, k_cap: int) -> Tuple[int, int]:
+    """Shared (blk_q, blk_k) selection for all three kernel families:
+    blk_q targets ``q_budget // group`` flattened rows, then blk_k fills
+    the f32 score-panel area budget ``area`` up to ``k_cap``. The three
+    callers differ only in budgets — one definition so a resweep cannot
+    desynchronize them."""
     floor = 64 if group > 8 else 128
-    blk_q = _pick_block(tq, target=max(floor, min(512, 1024 // group)),
+    blk_q = _pick_block(tq, target=max(floor, min(512, q_budget // group)),
                         floor=floor)
     flat = group * blk_q
-    blk_k = _pick_block(tk, target=max(128, min(512, (1024 * 512) // flat)))
+    blk_k = _pick_block(tk, target=max(128, min(k_cap, area // flat)))
     return blk_q, blk_k
+
+
+def _fwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
+    """Fused forward kernel blocks: flattened-panel area capped at
+    1024x1024 f32 (4 MB). Swept at steady state on v5e at the flagship
+    attention shape (B8 T2048 D128, causal; long timing windows — short
+    windows are dispatch-latency-bound on the tunnel and invert the
+    ranking): MHA (512,1024) 4.02 ms beats (512,512) 4.14 and (256,512)
+    5.26; GQA kv4 (256,1024) 2.77 ms beats (256,512) 3.17 and (512,512)
+    3.19. (512,1024) at group 4 (8 MB panel) fails to compile — the area
+    cap is the compile-feasibility boundary, not taste."""
+    return _panel_blocks(tq, tk, group, q_budget=1024,
+                         area=1024 * 1024, k_cap=1024)
+
+
+def _merge_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
+    """Ring *merge* kernel blocks. On top of the score panel this kernel
+    streams six f32 o/l/m carry blocks (in and out) and so sits much
+    closer to the 16 MB VMEM scope than the fused forward — a 2048-row
+    panel measured 1.75 MB over the cap on v5e at the round-3 budget.
+    Keeps the round-3 1024x512 panel area; the fused forward's doubled
+    budget was swept without these carry streams and does not transfer."""
+    return _panel_blocks(tq, tk, group, q_budget=1024,
+                         area=1024 * 512, k_cap=512)
 
 
 def _bwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
-    """(blk_q, blk_k) for the backward kernels, which hold three
-    [group*blk_q, blk_k] f32 panels (P, dP, dS) at once — budget half the
-    forward's panel area. group == 1 keeps (512, 512)."""
-    floor = 64 if group > 8 else 128
-    blk_q = _pick_block(tq, target=max(floor, min(512, 512 // group)),
-                        floor=floor)
-    flat = group * blk_q
-    blk_k = _pick_block(tk, target=max(128, min(512, (512 * 512) // flat)))
-    return blk_q, blk_k
+    """Backward kernel blocks: three [group*blk_q, blk_k] f32 panels
+    (P, dP, dS) live at once — half the forward's q rows. Swept at steady
+    state (same method as :func:`_fwd_blocks`): MHA (512,1024) 10.90 ms
+    fwd+bwd beats (512,512) 11.82; GQA kv4 (128,1024) 9.33 ms beats the
+    pre-round-4 (128,512) 9.88."""
+    return _panel_blocks(tq, tk, group, q_budget=512,
+                         area=512 * 1024, k_cap=1024)
 
 
 def _group_of(q: jnp.ndarray, k: jnp.ndarray) -> int:
@@ -259,22 +281,24 @@ def _merge_kernel(offs_ref, q_ref, k_ref, v_ref, o_ref, l_ref, m_ref,
     @pl.when(jnp.logical_or(not causal,
                             q_lo + stride * (blk_q - 1) >= k_lo))
     def _merge():
-        q = q_ref[0].astype(jnp.float32).reshape(rows, -1) * scale
+        # Matmuls on the inputs' native dtype (bf16 → full-rate MXU) with
+        # f32 accumulation; f32 inputs keep full-precision matmuls.
+        q = q_ref[0].reshape(rows, -1)
         o = o_out[0].reshape(rows, -1)                   # [rows, D] f32
         l = l_out[0].reshape(rows, 1)
         m = m_out[0].reshape(rows, 1)
-        k_blk = k_ref[0, 0].astype(jnp.float32)          # [blk_k, D]
+        k_blk = k_ref[0, 0]                              # [blk_k, D]
         # S = Q K^T on the MXU (contract D, keep f32 accumulation).
         s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
-                            preferred_element_type=jnp.float32)
+                            preferred_element_type=jnp.float32) * scale
         if causal:
             s = _causal_mask(s, q_lo, k_lo, stride, blk_q, group)
         m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
         p = jnp.exp(s - m_new)
         alpha = jnp.exp(m - m_new)
-        v_blk = v_ref[0, 0].astype(jnp.float32)
+        v_blk = v_ref[0, 0]
         o_new = o * alpha + lax.dot_general(
-            p, v_blk, (((1,), (0,)), ((), ())),
+            p.astype(v_blk.dtype), v_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         l_new = l * alpha + jnp.sum(p, axis=-1, keepdims=True)
         o_out[0] = o_new.reshape(group, blk_q, -1)
@@ -289,7 +313,7 @@ def _merge_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     b, hq, tq, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     group = _group_of(q, k)
-    blk_q, blk_k = _fwd_blocks(tq, tk, group)
+    blk_q, blk_k = _merge_blocks(tq, tk, group)
     scale = d ** -0.5
 
     def qo_map(ib, ih, iq, ik, offs):
@@ -361,6 +385,131 @@ def use_pallas_default() -> bool:
     return jax.default_backend() == "tpu"
 
 
+# --- fused single-shard forward -----------------------------------------------
+#
+# The ring merge above streams its (o, l, m) carry through HBM because ring
+# steps are separate kernel launches — that is the price of the ring API.
+# The single-shard forward (what every non-ring payload calls, including the
+# flagship) has no such constraint, and paying it anyway measured 14 TFLOPS
+# effective at the flagship attention shape: six extra f32 block streams
+# (o/l/m in and out), a separate finalize pass over f32 [B,H,T,D], and a
+# VMEM high-water within ~2 MB of the 16 MB scope cap that defeated DMA
+# double-buffering. This kernel is the standard fused form instead: the
+# accumulators live in VMEM *scratch* across the k-grid, nothing but q/k/v
+# is read, and the only writes are the final bf16 output block and the f32
+# logsumexp residual at the last k-tile. Matmuls take the inputs' native
+# dtype (bf16 rides the MXU at full rate; f32 accumulation via
+# preferred_element_type) — f32 inputs keep full-precision matmuls so the
+# interpret-mode tests stay bit-comparable to the jnp oracle. Measured at
+# the flagship attention shape (B8 T2048 H16 KV4 D128, causal, bf16,
+# steady state): 4.89 ms (carry-stream path, already with native-dtype
+# matmuls) → 2.77 ms fused; in the flagship train step, 45.1k → 48.2k
+# tokens/sec together with the backward's native-dtype matmuls.
+
+
+def _fwd_kernel(q_ref, k_ref, v_ref, o_ref, L_ref, acc_scr, l_scr, m_scr, *,
+                causal: bool, scale: float, group: int, nk: int):
+    """One (batch, kv-head, q-block, k-tile) cell of the fused forward.
+    Streaming-softmax state rides VMEM scratch (persistent across the
+    innermost k dimension), is reset at ik == 0, and collapses to the
+    normalized output + logsumexp at ik == nk - 1."""
+    blk_q = q_ref.shape[2]
+    rows = group * blk_q
+    blk_k = k_ref.shape[2]
+    iq = pl.program_id(2)
+    ik = pl.program_id(3)
+    q_lo = iq * blk_q
+    k_lo = ik * blk_k
+
+    @pl.when(ik == 0)
+    def _reset():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+
+    # Causal skip: tiles entirely in the future contribute nothing. Their
+    # K/V DMA is also elided — see the clamped index map in the caller.
+    @pl.when(jnp.logical_or(not causal, q_lo + blk_q - 1 >= k_lo))
+    def _tile():
+        q = q_ref[0].reshape(rows, -1)
+        s = lax.dot_general(q, k_ref[0, 0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        if causal:
+            s = _causal_mask(s, q_lo, k_lo, jnp.int32(1), blk_q, group)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        m = m_scr[...]
+        l = l_scr[...]
+        valid = m > NEG_INF / 2
+        out = jnp.where(valid, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
+        o_ref[0] = out.reshape(group, blk_q, -1).astype(o_ref.dtype)
+        L_ref[0] = jnp.where(
+            valid, m + jnp.log(jnp.maximum(l, 1e-30)), 0.0
+        ).reshape(group, blk_q, 1)
+
+
+def _flash_fwd_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                      causal: bool, interpret: bool):
+    """(out [B,H,T,D] in q.dtype, L [B,H,T,1] f32) via the fused kernel."""
+    b, hq, tq, d = q.shape
+    hkv, tk = k.shape[1], k.shape[2]
+    group = _group_of(q, k)
+    blk_q, blk_k = _fwd_blocks(tq, tk, group)
+    nk = tk // blk_k
+    scale = d ** -0.5
+
+    def qo_map(ib, ih, iq, ik):
+        return (ib, ih, iq, 0)
+
+    if causal:
+        def kv_map(ib, ih, iq, ik):
+            # Tiles the causal guard skips clamp to the last contributing
+            # k-tile of this q-block — a revisit of an already-resident
+            # block, so the skipped tile costs no DMA either.
+            last = lax.div((iq + 1) * blk_q - 1, blk_k)
+            return (ib, ih, jnp.minimum(ik, last), 0)
+    else:
+        def kv_map(ib, ih, iq, ik):
+            return (ib, ih, ik, 0)
+
+    q_spec = pl.BlockSpec((1, group, blk_q, d), qo_map)
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_map)
+    o_spec = pl.BlockSpec((1, group, blk_q, d), qo_map)
+    L_spec = pl.BlockSpec((1, group, blk_q, 1), qo_map)
+    rows = group * blk_q
+
+    return pl.pallas_call(
+        functools.partial(_fwd_kernel, causal=causal, scale=scale,
+                          group=group, nk=nk),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=0,
+            grid=(b, hkv, tq // blk_q, nk),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[o_spec, L_spec],
+            scratch_shapes=[
+                pltpu.VMEM((rows, d), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[
+            jax.ShapeDtypeStruct((b, hq, tq, d), q.dtype),
+            jax.ShapeDtypeStruct((b, hq, tq, 1), jnp.float32),
+        ],
+        interpret=interpret,
+    )(q, k, v)
+
+
 # --- fused backward ------------------------------------------------------------
 #
 # The flash backward needs, per (q-block, k-block) tile pair, only the VMEM
@@ -390,13 +539,15 @@ def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
     dS = P (dP - D). Both backward kernels build their accumulations from
     this one definition so the recurrence cannot desynchronize between
     dQ and dK/dV. q/g/L/D arrive group-deep and leave flattened to
-    [group*blk_q, ·] panels."""
+    [group*blk_q, ·] panels. Matmuls run on the inputs' native dtype with
+    f32 accumulation — bf16 training inputs take the full-rate MXU path;
+    f32 (test) inputs keep full-precision matmuls."""
     blk_q = q_ref.shape[2]
     rows = group * blk_q
-    q = q_ref[0].astype(jnp.float32).reshape(rows, -1)
-    k_blk = k_ref[0, 0].astype(jnp.float32)
-    v_blk = v_ref[0, 0].astype(jnp.float32)
-    g = g_ref[0].astype(jnp.float32).reshape(rows, -1)
+    q = q_ref[0].reshape(rows, -1)
+    k_blk = k_ref[0, 0]
+    v_blk = v_ref[0, 0]
+    g = g_ref[0].reshape(rows, -1)
     s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -431,7 +582,7 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
             q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
             causal, scale, group)
         dq = scale * lax.dot_general(
-            ds, k_blk, (((1,), (0,)), ((), ())),
+            ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         dq_out[0] += dq.reshape(group, blk_q, -1)
 
@@ -464,11 +615,11 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
             causal, scale, group)
         # dV += P^T dO (rows contract: sums over q-slots and the group)
         dv_out[0, 0] += lax.dot_general(
-            p, g, (((0,), (0,)), ((), ())),
+            p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dK += dS^T Q
         dk_out[0, 0] += scale * lax.dot_general(
-            ds, q, (((0,), (0,)), ((), ())),
+            ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
 
@@ -574,15 +725,13 @@ def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
 
 
 def _attn_impl(causal, use_pallas, q, k, v):
+    if use_pallas:
+        interpret = jax.default_backend() != "tpu"
+        return _flash_fwd_pallas(q, k, v, causal, interpret)
     b, h, t, d = q.shape
     carry = init_carry(b, h, t, d)
     offsets = _normalize_offsets(jnp.zeros((2,), jnp.int32))
-    o, l, m = [None] * 3
-    if use_pallas:
-        interpret = jax.default_backend() != "tpu"
-        o, l, m = _merge_pallas(q, k, v, *carry, offsets, causal, interpret)
-    else:
-        o, l, m = _merge_ref(q, k, v, *carry, offsets, causal)
+    o, l, m = _merge_ref(q, k, v, *carry, offsets, causal)
     return finalize((o, l, m), q.dtype), _logsumexp_rows(l, m)
 
 
