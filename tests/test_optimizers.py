@@ -1,0 +1,272 @@
+"""adam8 (int8 block-quantized moments) — quantizer properties and
+trajectory parity against f32 optax.adam.
+
+The parity bar: on a convex regression and on gradient streams with
+realistic scale spread, the 8-bit trajectory must track f32 adam closely
+enough that a user switching ``--optimizer adam8`` sees the same training
+curve, not a subtly different optimizer.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+import optax
+
+from tpu_operator.payload import optimizers
+from tpu_operator.payload.optimizers import BLOCK
+
+
+def test_quantize_roundtrip_error_bound():
+    """|x - deq(quant(x))| <= scale per element (stochastic rounding adds
+    at most one ulp on top of the half-ulp nearest bound)."""
+    rng = np.random.default_rng(0)
+    x = jnp.asarray(rng.normal(size=(4, BLOCK)) * 10.0, jnp.float32)
+    t = optimizers._quantize(x, None, False)
+    assert t.q.dtype == jnp.int8
+    back = optimizers._dequantize(t, False)
+    scale = np.asarray(t.scale)[:, None]
+    assert np.all(np.abs(np.asarray(back - x)) <= scale * 0.5 + 1e-9)
+
+    key = jax.random.key(1)
+    t2 = optimizers._quantize(x, key, False)
+    back2 = optimizers._dequantize(t2, False)
+    assert np.all(np.abs(np.asarray(back2 - x)) <= scale * 1.0 + 1e-9)
+
+
+def test_quantize_sqrt_domain_nonnegative():
+    """sqrt-domain roundtrip: relative error on v is bounded by ~2 ulp of
+    the sqrt (error doubles through the square), and results stay >= 0."""
+    rng = np.random.default_rng(2)
+    # 4 orders of magnitude within one block — the hostile case for
+    # linear-domain int8, survivable in sqrt domain.
+    v = jnp.asarray(10.0 ** rng.uniform(-4, 0, size=(2, BLOCK)), jnp.float32)
+    t = optimizers._quantize(v, None, True)
+    back = optimizers._dequantize(t, True)
+    assert np.all(np.asarray(back) >= 0.0)
+    scale = np.asarray(t.scale)[:, None]
+    err_sqrt = np.abs(np.sqrt(np.asarray(back)) - np.sqrt(np.asarray(v)))
+    assert np.all(err_sqrt <= scale * 0.5 + 1e-9)
+
+
+def test_stochastic_rounding_unbiased():
+    """The mean of many stochastic quantizations recovers values far
+    below one ulp — the property that keeps slow EMAs from freezing."""
+    x = jnp.full((1, BLOCK), 0.3, jnp.float32)
+    # Plant one large element so the block scale is 1.0 (absmax 127).
+    x = x.at[0, 0].set(127.0)
+    keys = jax.random.split(jax.random.key(3), 256)
+    deqs = jnp.stack([
+        optimizers._dequantize(optimizers._quantize(x, k, False), False)
+        for k in keys])
+    mean = float(jnp.mean(deqs[:, 0, 1]))
+    # 0.3 is 0.3 ulp at scale 1; nearest rounding would give 0.0 always.
+    assert abs(mean - 0.3) < 0.1
+
+
+def test_adam8_matches_adam_trajectory():
+    """Convex regression, 60 steps: adam8's loss curve tracks f32 adam
+    within a few percent at every step — the drop-in guarantee."""
+    rng = np.random.default_rng(4)
+    a = jnp.asarray(rng.normal(size=(64, 32)), jnp.float32)
+    b = jnp.asarray(rng.normal(size=(64,)), jnp.float32)
+    w0 = {"w": jnp.zeros((32,), jnp.float32),
+          "bias": jnp.zeros((1,), jnp.float32)}
+
+    def loss_fn(p):
+        pred = a @ p["w"] + p["bias"][0]
+        return jnp.mean((pred - b) ** 2)
+
+    def run(tx):
+        p = {k: v for k, v in w0.items()}
+        state = tx.init(p)
+        losses = []
+        step = jax.jit(lambda p, s: _step(tx, p, s))
+        for _ in range(60):
+            p, state, l = step(p, state)
+            losses.append(float(l))
+        return np.asarray(losses)
+
+    def _step(tx, p, s):
+        l, g = jax.value_and_grad(loss_fn)(p)
+        upd, s = tx.update(g, s, p)
+        return optax.apply_updates(p, upd), s, l
+
+    ref = run(optax.adam(1e-1))
+    got = run(optimizers.adam8(1e-1, seed=7))
+    # same curve: every step within 5% relative (plus small abs floor
+    # once the loss is near zero)
+    np.testing.assert_allclose(got, ref, rtol=5e-2, atol=5e-3)
+
+
+def test_adam8_constant_gradient_moments_converge():
+    """Feeding a constant gradient, the dequantized moments must converge
+    to m = g and v = g^2 despite per-step increments far below one int8
+    ulp — the swamping case stochastic rounding exists for."""
+    g = {"w": jnp.asarray(np.linspace(-2.0, 2.0, BLOCK), jnp.float32)}
+    tx = optimizers.adam8(1e-3, seed=5)
+    state = tx.init(g)
+    update = jax.jit(lambda gr, s: tx.update(gr, s))
+    for _ in range(300):
+        _, state = update(g, state)
+    m = optimizers._dequantize(
+        jax.tree_util.tree_leaves(
+            state.m, is_leaf=lambda x: isinstance(x, optimizers.Quantized)
+        )[0], False)[0, :]
+    v = optimizers._dequantize(
+        jax.tree_util.tree_leaves(
+            state.v, is_leaf=lambda x: isinstance(x, optimizers.Quantized)
+        )[0], True)[0, :]
+    gw = np.asarray(g["w"])
+    # EMA bias after 300 steps at b2=0.999 is ~26%: compare against the
+    # biased EMA targets, not the asymptote.
+    m_target = gw * (1 - 0.9 ** 300)
+    v_target = gw ** 2 * (1 - 0.999 ** 300)
+    np.testing.assert_allclose(np.asarray(m), m_target, rtol=0.05,
+                               atol=0.02 * np.max(np.abs(gw)))
+    np.testing.assert_allclose(np.asarray(v), v_target, rtol=0.12,
+                               atol=0.02 * np.max(gw ** 2))
+
+
+def test_adam8_heterogeneous_block_update_bounded():
+    """Regression: an element whose |m| survives the linear int8 code but
+    whose v (~m²) underflows the sqrt-domain code used to divide by
+    ~eps and produce ~1e6·lr steps (flagship divergence, loss 1e9). The
+    denominator's quantization-noise floor must keep every update within
+    Adam's normal step-size envelope."""
+    tx = optimizers.adam8(1e-2, seed=11)
+    # one dominant element per block, the rest 1e-3 of it: m resolvable,
+    # v below sqrt-code resolution
+    g = {"w": jnp.concatenate([
+        jnp.asarray([1.0], jnp.float32),
+        jnp.full((BLOCK - 1,), 1e-3, jnp.float32)])}
+    state = tx.init(g)
+    update = jax.jit(lambda gr, s: tx.update(gr, s))
+    for _ in range(50):
+        upd, state = update(g, state)
+        # bias correction allows a few x lr early; 1e6 x lr is the bug
+        assert float(jnp.max(jnp.abs(upd["w"]))) < 5 * 1e-2
+
+
+def test_adam8_nonaligned_shapes_and_dtypes():
+    """Leaves whose sizes do not divide BLOCK (padding path) and bf16
+    gradients round-trip with correct update shapes/dtypes."""
+    params = {"a": jnp.ones((7, 33), jnp.float32),
+              "b": jnp.ones((5,), jnp.bfloat16)}
+    grads = jax.tree_util.tree_map(
+        lambda p: jnp.full(p.shape, 0.5, p.dtype), params)
+    tx = optimizers.adam8(1e-2)
+    state = tx.init(params)
+    upd, state = jax.jit(lambda g, s: tx.update(g, s))(grads, state)
+    assert upd["a"].shape == (7, 33) and upd["a"].dtype == jnp.float32
+    assert upd["b"].shape == (5,) and upd["b"].dtype == jnp.bfloat16
+    # all-equal gradients -> all-equal updates (padding must not leak in)
+    au = np.asarray(upd["a"], np.float32)
+    np.testing.assert_allclose(au, au.ravel()[0], rtol=1e-6)
+
+
+def test_adam8_composes_with_pipeline_and_fsdp_shardings():
+    """Regression: the flat [nblocks, 256] moment layout broke
+    device_put under the pipeline's path-based sharding rule (a stage-
+    stacked P('pipe', ...) spec cannot apply to a reshaped moment). The
+    last-axis block layout must keep leading axes so moments shard like
+    their parameter under every payload rule."""
+    from tpu_operator.payload import pipeline, transformer
+    from tpu_operator.payload import data as data_mod
+    from jax.sharding import PartitionSpec as P
+
+    args = pipeline.parse_args(
+        ["--dim", "32", "--layers", "4", "--heads", "2", "--batch", "16",
+         "--seq-len", "64", "--vocab", "128", "--pipeline", "2",
+         "--microbatches", "4", "--optimizer", "adam8"])
+    mesh, _m, state, step, batches = pipeline.build(args)
+    batch = next(iter(batches))
+    placed = data_mod.put_global_batch(mesh, *batch, spec=P("data", None))
+    state, metrics = step(state, *placed)
+    assert np.isfinite(float(metrics["loss"]))
+
+    targs = transformer.parse_args(
+        ["--dim", "32", "--layers", "2", "--heads", "2", "--batch", "8",
+         "--seq-len", "64", "--vocab", "128", "--fsdp",
+         "--optimizer", "adam8"])
+    tmesh, _tm, tstate, tstep, tbatches = transformer.build(targs)
+    tb = data_mod.put_global_batch(tmesh, *next(iter(tbatches)),
+                                   spec=P("data", None))
+    tstate, tmetrics = tstep(tstate, *tb)
+    assert np.isfinite(float(tmetrics["loss"]))
+
+
+def test_adam8_moments_shard_like_params_under_name_keyed_rules():
+    """Regression: the Quantized NamedTuple hop appends '.q'/'.scale'
+    path keys and changes rank, so name/rank-keyed rules (MoE expert
+    sharding, Megatron TP) fell through to replicate — forfeiting the
+    moment sharding. train.quantized_aware must map the parameter's spec
+    onto the block layout."""
+    from jax.sharding import Mesh, PartitionSpec as P
+    from tpu_operator.payload import train
+
+    devices = np.array(jax.devices()[:8]).reshape(2, 4)
+    mesh = Mesh(devices, ("expert", "model"))
+
+    def rule(keys, leaf):
+        if keys[-1] == "w1" and leaf.ndim == 3:
+            return P("expert", None, "model")
+        if keys[-1] == "kernel" and leaf.ndim == 2:
+            return P(None, "model")
+        return P()
+
+    wrapped = train.quantized_aware(mesh, rule)
+    params = {"moe": {"w1": jnp.zeros((2, 8, 1024), jnp.float32)},
+              "attn": {"kernel": jnp.zeros((8, 1024), jnp.float32)}}
+    state = optimizers.adam8(1e-3).init(params)
+
+    def keys_of(path):
+        return tuple(getattr(p, "key", str(p)) for p in path)
+
+    specs = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: wrapped(keys_of(path), leaf), state.m)
+    # w1 [2,8,1024] -> q [2,8,4,256]: expert on dim0, model on nb (4 % 4
+    # == 0... 1024/256 = 4 blocks, model axis size 4)
+    assert specs["moe"]["w1"].q == P("expert", None, "model", None)
+    assert specs["moe"]["w1"].scale == P("expert", None, "model")
+    assert specs["attn"]["kernel"].q == P(None, "model", None)
+    # params themselves still go through the raw rule untouched
+    assert wrapped(("moe", "w1"),
+                   params["moe"]["w1"]) == P("expert", None, "model")
+    # non-divisible block count must drop the axis, not crash: last dim
+    # 256 -> nb 1, model size 4 does not divide 1
+    small = optimizers.adam8(1e-3).init({"attn": {"kernel":
+                                        jnp.zeros((8, 256), jnp.float32)}})
+    sp = jax.tree_util.tree_map_with_path(
+        lambda path, leaf: wrapped(keys_of(path), leaf), small.m)
+    assert sp["attn"]["kernel"].q == P(None, None, None)
+
+
+def test_adam8_schedule_sees_preincrement_count():
+    """Callable learning rates must see count 0 on the first update,
+    matching optax.scale_by_schedule — a warmup-from-zero schedule must
+    produce a zero first step."""
+    schedule = lambda count: 0.0 if count < 1 else 1e-2
+    tx = optimizers.adam8(schedule)
+    g = {"w": jnp.ones((4,), jnp.float32)}
+    state = tx.init(g)
+    upd, state = tx.update(g, state)
+    np.testing.assert_allclose(np.asarray(upd["w"]), 0.0)
+    upd, state = tx.update(g, state)
+    assert float(jnp.max(jnp.abs(upd["w"]))) > 0.0
+
+
+def test_adam8_state_memory_is_8bit():
+    """The point of the exercise: moment state bytes ~= 1 byte/param
+    (plus 1/BLOCK of f32 scales), vs 8 for f32 adam."""
+    params = {"w": jnp.zeros((1024, 256), jnp.float32)}
+    state = optimizers.adam8(1e-3).init(params)
+    n = 1024 * 256
+
+    def nbytes(tree):
+        return sum(x.size * x.dtype.itemsize
+                   for x in jax.tree_util.tree_leaves(tree))
+
+    total = nbytes(state.m) + nbytes(state.v)
+    assert total <= n * 2 * (1 + 4 / BLOCK) + 64

@@ -32,6 +32,7 @@ import math
 from typing import Any, Callable, Optional
 
 from tpu_operator.payload import bootstrap
+from tpu_operator.payload import optimizers
 
 log = logging.getLogger(__name__)
 
@@ -78,6 +79,7 @@ def parse_args(argv=None):
                    help="rematerialize each block on backward "
                         "(jax.checkpoint)")
     p.add_argument("--lr", type=float, default=3e-3)
+    optimizers.add_optimizer_flag(p)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
@@ -381,7 +383,6 @@ def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
@@ -390,7 +391,7 @@ def build(args, mesh=None, num_slices: int = 1):
         expert_parallel=args.expert_parallel, num_slices=num_slices,
         tensor_parallel=getattr(args, "tensor_parallel", 1))
     model = _build_model(args, mesh)
-    tx = optax.adam(args.lr)
+    tx = optimizers.from_args(args)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
     shardings = state_shardings(mesh, state)

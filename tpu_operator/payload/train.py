@@ -114,6 +114,51 @@ def make_mesh(num_devices: Optional[int] = None, model_parallel: int = 1,
     return Mesh(arr, axis_names)
 
 
+def quantized_aware(mesh: Mesh,
+                    rule: Callable[[Tuple[str, ...], Any], P]
+                    ) -> Callable[[Tuple[str, ...], Any], P]:
+    """Wrap a path rule so int8 block-quantized adam moments
+    (optimizers.Quantized: ``q`` [..., nb, BLOCK] / ``scale`` [..., nb]
+    under the parameter's own path) shard like their parameter. The
+    NamedTuple hop appends a ``.q``/``.scale`` path key and changes the
+    rank, so name/rank-keyed rules (MoE expert sharding, Megatron TP,
+    pipeline stage stacking) would silently fall through to replicate —
+    at flagship MoE scale that forfeits the E-fold moment sharding the
+    8-bit optimizer exists to afford. The wrapper asks the rule about a
+    parameter-shaped proxy (same path minus the NamedTuple key, last dim
+    the padded block span), then maps the answer onto the block layout:
+    leading axes verbatim, the last axis' mesh assignment onto the
+    ``nb`` axis when divisible (blocks tile the last axis, so sharding
+    blocks IS sharding it), and BLOCK never sharded."""
+    from tpu_operator.payload import optimizers as optimizers_mod
+
+    def axis_size(axis) -> int:
+        axes = axis if isinstance(axis, (tuple, list)) else (axis,)
+        n = 1
+        for a in axes:
+            n *= mesh.shape[a]
+        return n
+
+    def wrapped(keys, leaf):
+        if not (keys and keys[-1] in (".q", ".scale")):
+            return rule(keys, leaf)
+        is_q = keys[-1] == ".q"
+        nb = leaf.shape[-2] if is_q else leaf.shape[-1]
+        lead = leaf.shape[:-2] if is_q else leaf.shape[:-1]
+        proxy = jax.ShapeDtypeStruct(
+            (*lead, nb * optimizers_mod.BLOCK), jnp.float32)
+        spec = tuple(rule(keys[:-1], proxy))
+        spec = spec + (None,) * (proxy.ndim - len(spec))
+        last = spec[-1]
+        if last is not None and nb % axis_size(last) != 0:
+            last = None
+        if is_q:
+            return P(*spec[:-1], last, None)
+        return P(*spec[:-1], last)
+
+    return wrapped
+
+
 def shardings_from_rule(mesh: Mesh, state: TrainState,
                         rule: Callable[[Tuple[str, ...], Any], P]) -> TrainState:
     """TrainState of NamedShardings from one per-leaf rule
@@ -121,7 +166,9 @@ def shardings_from_rule(mesh: Mesh, state: TrainState,
     batch_stats, and opt_state alike (the optimizer state embeds
     params-shaped moment leaves under the same layer names, so a path rule
     shards them identically to their params; scalar counters and stats fall
-    through to the rule's replicate case). ``step`` always replicates."""
+    through to the rule's replicate case; int8 block-quantized moments are
+    adapted via :func:`quantized_aware`). ``step`` always replicates."""
+    rule = quantized_aware(mesh, rule)
 
     def spec(tree: Any) -> Any:
         return jax.tree_util.tree_map_with_path(

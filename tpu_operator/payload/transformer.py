@@ -31,6 +31,7 @@ import os
 from typing import Any, Optional
 
 from tpu_operator.payload import bootstrap
+from tpu_operator.payload import optimizers
 
 log = logging.getLogger(__name__)
 
@@ -99,6 +100,7 @@ def parse_args(argv=None):
                         "negligible quality cost — the m accumulator is a "
                         "smoothed gradient, far less precision-sensitive "
                         "than v or the master params, which stay f32")
+    optimizers.add_optimizer_flag(p)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -343,7 +345,6 @@ def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
-    import optax
 
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import train
@@ -352,9 +353,7 @@ def build(args, mesh=None, num_slices: int = 1):
         seq_parallel=args.seq_parallel, num_slices=num_slices,
         tensor_parallel=getattr(args, "tensor_parallel", 1))
     model = _build_model(args, mesh)
-    mu_dtype = (jnp.bfloat16
-                if getattr(args, "adam_mu_dtype", "f32") == "bf16" else None)
-    tx = optax.adam(args.lr, mu_dtype=mu_dtype)
+    tx = optimizers.from_args(args)
     sample = jnp.zeros((args.batch, args.seq_len), jnp.int32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
     if "model" in mesh.shape and mesh.shape["model"] > 1:
