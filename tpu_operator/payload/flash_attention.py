@@ -381,7 +381,19 @@ _merge.defvjp(_merge_fwd, _merge_bwd)
 
 def use_pallas_default() -> bool:
     """Kernel on real TPUs; jnp fallback elsewhere (tests opt in to the
-    interpreter explicitly)."""
+    interpreter explicitly). ``TPU_OPERATOR_PALLAS`` overrides both ways:
+    ``force``/``1`` selects the kernels even off-TPU (interpret mode —
+    how the dryrun and the sharded-parity tests put the kernel path under
+    GSPMD/shard_map partitioning on the CPU mesh), ``off``/``0`` forces
+    the jnp path even on TPU. Read at trace time: set it before building
+    a payload, not between steps of an already-jitted one."""
+    import os
+
+    mode = os.environ.get("TPU_OPERATOR_PALLAS", "").lower()
+    if mode in ("1", "true", "force"):
+        return True
+    if mode in ("0", "false", "off"):
+        return False
     return jax.default_backend() == "tpu"
 
 
