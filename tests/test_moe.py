@@ -78,6 +78,96 @@ def test_drop_frac_reported_in_training_metrics(mesh):
     assert 0.0 <= float(m_s["drop_frac"]) <= 1.0
 
 
+def test_gather_dispatch_matches_einsum_oracle(mesh):
+    """The scatter/gather dispatch must agree exactly with the GShard
+    one-hot einsum path — same routing (shared top2_routing), same expert
+    math, f32 so the comparison is tight. Covers kept, dropped
+    (capacity-starved), and gate-renormalized tokens."""
+    cls = moe._moe_mlp_class(mesh, jnp.float32)
+    x = jax.random.normal(jax.random.key(3), (4, 32, 32))
+    for cf in (4.0, 0.5):
+        lg = cls(dim=32, experts=4, capacity_factor=cf,
+                 dispatch_mode="gather")
+        le = cls(dim=32, experts=4, capacity_factor=cf,
+                 dispatch_mode="einsum")
+        params = le.init(jax.random.key(4), x)["params"]
+        got = lg.apply({"params": params}, x)
+        want = le.apply({"params": params}, x)
+        np.testing.assert_allclose(np.asarray(got), np.asarray(want),
+                                   rtol=1e-5, atol=1e-5)
+
+        def loss(p, layer):
+            return jnp.sum(layer.apply({"params": p}, x) ** 2)
+
+        gg = jax.grad(lambda p: loss(p, lg))(params)
+        ge = jax.grad(lambda p: loss(p, le))(params)
+        for kg, ke in zip(jax.tree_util.tree_leaves(gg),
+                          jax.tree_util.tree_leaves(ge)):
+            np.testing.assert_allclose(np.asarray(kg), np.asarray(ke),
+                                       rtol=1e-4, atol=1e-4)
+
+
+def test_router_z_loss_reported_and_declines_logits():
+    """z-loss must appear in metrics and actually regularize: training
+    with a large z coefficient must shrink router logit magnitudes vs
+    z-coef 0."""
+    from tpu_operator.payload import data as data_mod
+    from jax.sharding import PartitionSpec as P
+
+    mesh2 = moe.make_moe_mesh(2, expert_parallel=2)
+
+    def run(z_coef, steps=25):
+        args = _args(expert_parallel=2, router_z_coef=z_coef, lr=3e-3)
+        _, _, st, step, batches = moe.build(args, mesh=mesh2)
+        it = iter(batches)
+        m = None
+        for _ in range(steps):
+            (dev,) = data_mod.put_global_batch(mesh2, next(it)[0],
+                                               spec=P("data", None))
+            st, m = step(st, dev)
+        # router kernels live under blockN/moe/router
+        total = 0.0
+        for path, leaf in jax.tree_util.tree_flatten_with_path(
+                st.params)[0]:
+            keys = tuple(getattr(p, "key", str(p)) for p in path)
+            if "router" in keys:
+                total += float(jnp.sum(leaf.astype(jnp.float32) ** 2))
+        return m, total
+
+    m_z, norm_z = run(1.0)
+    m_0, norm_0 = run(0.0)
+    assert np.isfinite(float(m_z["router_z"]))
+    assert norm_z < norm_0, (norm_z, norm_0)
+
+
+def test_aux_loss_trains_drop_frac_down(mesh):
+    """The property the drop_frac metric exists to protect: from a
+    near-init router at a tight capacity factor, K training steps with
+    the Switch aux loss must reduce the dropped-assignment fraction.
+    (Round-3 measured drop_frac 0.64 at an untrained router and had no
+    evidence balancing ever engages — this pins it.)"""
+    from tpu_operator.payload import data as data_mod
+    from jax.sharding import PartitionSpec as P
+
+    args = _args(capacity_factor=1.0, lr=3e-3, aux_coef=5e-2, seq_len=64)
+    _, _, st, step, batches = moe.build(args, mesh=mesh)
+    it = iter(batches)
+
+    def one(st):
+        (dev,) = data_mod.put_global_batch(mesh, next(it)[0],
+                                           spec=P("data", None))
+        return step(st, dev)
+
+    st, m0 = one(st)
+    early = float(m0["drop_frac"])
+    drops = []
+    for _ in range(60):
+        st, m = one(st)
+        drops.append(float(m["drop_frac"]))
+    late = float(np.mean(drops[-10:]))
+    assert late < early - 0.05, (early, late, drops[-5:])
+
+
 def test_identical_experts_degenerate_to_dense_ffn(mesh):
     # When every expert holds the same weights and capacity is ample, the
     # MoE layer must compute exactly gelu(x·w1)·w2 (gates sum to 1).

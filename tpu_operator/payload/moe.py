@@ -71,6 +71,21 @@ def parse_args(argv=None):
                         "for parity tests or checkpoint compatibility)")
     p.add_argument("--aux-coef", type=float, default=1e-2,
                    help="load-balance auxiliary loss coefficient")
+    p.add_argument("--router-z-coef", type=float, default=1e-3,
+                   help="router z-loss coefficient (ST-MoE): penalizes "
+                        "mean(logsumexp(router logits)^2) so logits stay "
+                        "in the range where softmax gradients are alive")
+    p.add_argument("--dispatch", choices=("einsum", "gather"),
+                   default="einsum",
+                   help="token→expert dispatch: einsum = GShard one-hot "
+                        "matmuls — O(n²·cf·D) FLOPs but the MXU eats "
+                        "them (measured ~26 ms/step at the bench shape, "
+                        "identical total step time to the scatter "
+                        "alternative); gather = scatter/gather through a "
+                        "unique-slot buffer — O(n·D) traffic, but TPU "
+                        "scatter lowering costs what the einsums cost, "
+                        "so it is an option (and einsum-parity-tested), "
+                        "not the default")
     p.add_argument("--dtype", choices=("bf16", "f32"), default="bf16")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over K sequential "
@@ -118,26 +133,35 @@ def make_moe_mesh(num_devices: Optional[int] = None, expert_parallel: int = 1,
                            num_slices=num_slices)
 
 
-def top2_dispatch(logits, capacity: int):
-    """Top-2 routing → (dispatch [G,n,E,C] bool-ish, combine [G,n,E,C] f32,
-    aux f32 scalar, drop_frac f32 scalar). Pure function of f32 router
-    logits; all shapes static.
+def top2_routing(logits, capacity: int) -> dict:
+    """Top-2 routing in index form — the one routing definition both
+    dispatch implementations (one-hot einsum and scatter/gather) consume,
+    so they cannot disagree on who goes where. Pure function of f32
+    router logits; all shapes static.
 
-    Position bookkeeping is cumsum algebra (no sort/scatter): token t's slot
-    in expert e is the count of earlier tokens routed to e; slots ≥ C drop.
+    Position bookkeeping is cumsum algebra (no sort): token t's slot in
+    expert e is the count of earlier tokens routed to e; slots ≥ C drop.
     Second choices fill after all first choices (Switch convention), so a
     hot expert drops 2nd-choice traffic before any 1st-choice traffic.
 
-    ``drop_frac`` is the fraction of routed assignments (2 per token) that
-    fell past their expert's capacity — the metric that tells an operator
-    whether the configured --capacity-factor is actually holding (a
-    persistent nonzero drop rate silently degrades quality long before the
-    loss shows it). Exported into training metrics by the MoE loss.
+    Returns a dict of [G,n] index/gate arrays (``idx``/``slot``/``keep``/
+    ``gate`` per choice), the [G,n,E] keep masks the einsum path needs,
+    and three scalars:
+
+    - ``aux`` — the Switch load-balance loss E·Σ_e(f_e·p_e); minimized at
+      uniform routing, the term that trains drop_frac DOWN.
+    - ``z_loss`` — mean(logsumexp(logits)²) (ST-MoE router z-loss, Zoph
+      et al. 2022): keeps router logits from drifting to magnitudes where
+      f32 softmax saturates and routing gradients vanish.
+    - ``drop_frac`` — fraction of routed assignments (2 per token) past
+      their expert's capacity; exported per step into training metrics
+      (the observability contract tests/test_moe.py pins).
     """
     import jax
     import jax.numpy as jnp
 
-    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)  # [G,n,E]
+    logits = logits.astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)                      # [G,n,E]
     num_experts = probs.shape[-1]
 
     idx1 = jnp.argmax(probs, axis=-1)                            # [G,n]
@@ -150,6 +174,8 @@ def top2_dispatch(logits, capacity: int):
     f_e = mask1.mean(axis=1)                                     # [G,E]
     p_e = probs.mean(axis=1)                                     # [G,E]
     aux = num_experts * jnp.mean(jnp.sum(f_e * p_e, axis=-1))
+    z = jax.scipy.special.logsumexp(logits, axis=-1)             # [G,n]
+    z_loss = jnp.mean(z * z)
 
     pos1 = jnp.cumsum(mask1, axis=1) * mask1 - mask1             # slot of each 1st choice
     count1 = mask1.sum(axis=1, keepdims=True)                    # [G,1,E]
@@ -166,15 +192,43 @@ def top2_dispatch(logits, capacity: int):
     denom = jnp.maximum(gate1 + gate2, 1e-9)
     gate1, gate2 = gate1 / denom, gate2 / denom
 
+    return {
+        "idx1": idx1, "idx2": idx2,
+        "slot1": jnp.sum(pos1, axis=-1).astype(jnp.int32),       # [G,n]
+        "slot2": jnp.sum(pos2 * mask2, axis=-1).astype(jnp.int32),
+        "keep1": keep1, "keep2": keep2,                          # [G,n,E]
+        "kept1": jnp.sum(keep1, axis=-1),                        # [G,n] 0/1
+        "kept2": jnp.sum(keep2, axis=-1),
+        "pos1": pos1, "pos2": pos2,
+        "gate1": gate1, "gate2": gate2,
+        "aux": aux, "z_loss": z_loss, "drop_frac": drop_frac,
+    }
+
+
+def _onehot_tensors(r: dict, capacity: int):
+    """Routing dict → (dispatch [G,n,E,C], combine [G,n,E,C], aux,
+    drop_frac) one-hot tensors for the einsum dispatch path."""
+    import jax
+    import jax.numpy as jnp
+
     def slots(keep, pos):
         # [G,n,E] × slot index → one-hot over capacity: [G,n,E,C]
         return keep[..., None] * jax.nn.one_hot(
             (pos * keep).astype(jnp.int32), capacity, dtype=jnp.float32)
 
-    s1, s2 = slots(keep1, pos1), slots(keep2, pos2)
+    s1, s2 = slots(r["keep1"], r["pos1"]), slots(r["keep2"], r["pos2"])
     dispatch = s1 + s2
-    combine = gate1[:, :, None, None] * s1 + gate2[:, :, None, None] * s2
-    return dispatch, combine, aux, drop_frac
+    combine = (r["gate1"][:, :, None, None] * s1
+               + r["gate2"][:, :, None, None] * s2)
+    return dispatch, combine, r["aux"], r["drop_frac"]
+
+
+def top2_dispatch(logits, capacity: int):
+    """One-hot form of :func:`top2_routing`: (dispatch [G,n,E,C] bool-ish,
+    combine [G,n,E,C] f32, aux, drop_frac). The dispatch/combine einsums
+    this feeds cost 2·G·n²·cf·D FLOPs each — quadratic in tokens-per-group
+    — which is why the scatter/gather path exists (see MoEMLP)."""
+    return _onehot_tensors(top2_routing(logits, capacity), capacity)
 
 
 def _moe_mlp_class(mesh, dtype):
@@ -189,11 +243,30 @@ def _moe_mlp_class(mesh, dtype):
     class MoEMLP(nn.Module):
             """Expert-parallel FFN: route → all-to-all → expert matmuls →
             all-to-all back. Token groups G = batch rows (already
-            data-sharded), so routing math is group-local."""
+            data-sharded), so routing math is group-local.
+
+            ``dispatch_mode`` selects how tokens reach their expert slots:
+
+            - ``einsum`` — GShard one-hot [G,n,E,C] matmuls. MXU-friendly
+              but 2·G·n²·cf·D FLOPs per direction, *quadratic* in
+              tokens-per-group: at the bench shape it nearly doubles the
+              layer's FLOPs over the experts' useful math and is the
+              active-MFU tax the round-3 suite measured.
+            - ``gather`` — scatter-add tokens into a [G, E·C + 2n, D]
+              slot buffer (each kept assignment owns a unique slot by
+              construction; dropped assignments land in a private dump
+              row, so indices are provably unique) and gather expert
+              outputs back per token. O(n·D) memory traffic instead of
+              the quadratic matmul; differentiable (scatter-add's VJP is
+              the gather, and vice versa). Routing indices come from the
+              same :func:`top2_routing` as the einsum path, so the two
+              modes agree exactly (tests pin this).
+            """
 
             dim: int
             experts: int
             capacity_factor: float
+            dispatch_mode: str = "einsum"
 
             @nn.compact
             def __call__(self, x):
@@ -211,16 +284,39 @@ def _moe_mlp_class(mesh, dtype):
                 w1 = self.param("w1", init, (e, d, hidden), jnp.float32)
                 w2 = self.param("w2", init, (e, hidden, d), jnp.float32)
 
-                dispatch, combine, aux, drop = top2_dispatch(router(x),
-                                                             capacity)
-                self.sow("intermediates", "aux_loss", aux)
-                self.sow("intermediates", "drop_frac", drop)
+                r = top2_routing(router(x), capacity)
+                self.sow("intermediates", "aux_loss", r["aux"])
+                self.sow("intermediates", "drop_frac", r["drop_frac"])
+                self.sow("intermediates", "router_z", r["z_loss"])
+                xd = x.astype(dtype)
 
-                # [G,n,E,C] × [G,n,D] → [E,G,C,D]; the constraint flips the
-                # sharded dim from G (data) to E (expert): GSPMD emits the
-                # all-to-all.
-                expert_in = jnp.einsum("gnec,gnd->egcd",
-                                       dispatch.astype(dtype), x.astype(dtype))
+                if self.dispatch_mode == "gather":
+                    rows = e * capacity + 2 * n
+                    tok = jnp.arange(n, dtype=jnp.int32)[None, :]
+                    f1 = jnp.where(r["kept1"] > 0,
+                                   r["idx1"] * capacity + r["slot1"],
+                                   e * capacity + tok)
+                    f2 = jnp.where(r["kept2"] > 0,
+                                   r["idx2"] * capacity + r["slot2"],
+                                   e * capacity + n + tok)
+                    garange = jnp.arange(g)[:, None]
+                    buf = jnp.zeros((g, rows, d), dtype)
+                    buf = buf.at[garange,
+                                 jnp.concatenate([f1, f2], axis=1)].add(
+                        jnp.concatenate([xd, xd], axis=1),
+                        unique_indices=True)
+                    expert_in = jnp.swapaxes(
+                        buf[:, :e * capacity].reshape(g, e, capacity, d),
+                        0, 1)                                  # [E,G,C,D]
+                else:
+                    dispatch, combine, _aux, _drop = _onehot_tensors(
+                        r, capacity)
+                    # [G,n,E,C] × [G,n,D] → [E,G,C,D]
+                    expert_in = jnp.einsum("gnec,gnd->egcd",
+                                           dispatch.astype(dtype), xd)
+
+                # The constraint flips the sharded dim from G (data) to E
+                # (expert): GSPMD emits the all-to-all.
                 expert_in = jax.lax.with_sharding_constraint(
                     expert_in, NamedSharding(mesh, P("expert", "data")))
                 h = jnp.einsum("egcd,edf->egcf", expert_in,
@@ -237,6 +333,16 @@ def _moe_mlp_class(mesh, dtype):
                 expert_out = jnp.einsum("egcf,efd->egcd", h, w2.astype(dtype))
                 expert_out = jax.lax.with_sharding_constraint(
                     expert_out, NamedSharding(mesh, P("expert", "data")))
+
+                if self.dispatch_mode == "gather":
+                    out_flat = jnp.swapaxes(expert_out, 0, 1).reshape(
+                        g, e * capacity, d)
+                    out_full = jnp.concatenate(
+                        [out_flat, jnp.zeros((g, 2 * n, d), dtype)], axis=1)
+                    y1 = jnp.take_along_axis(out_full, f1[..., None], axis=1)
+                    y2 = jnp.take_along_axis(out_full, f2[..., None], axis=1)
+                    return (r["gate1"][..., None].astype(dtype) * y1
+                            + r["gate2"][..., None].astype(dtype) * y2)
                 # back to token layout: [G,n,E,C] × [E,G,C,D] → [G,n,D]
                 return jnp.einsum("gnec,egcd->gnd",
                                   combine.astype(dtype), expert_out)
@@ -277,7 +383,9 @@ def _build_model(args, mesh):
 
     def moe_mlp(name):
         return MoEMLP(dim=args.dim, experts=args.experts,
-                      capacity_factor=args.capacity_factor, name=name)
+                      capacity_factor=args.capacity_factor,
+                      dispatch_mode=getattr(args, "dispatch", "einsum"),
+                      name=name)
 
     class MoELM(nn.Module):
         vocab: int
@@ -367,11 +475,14 @@ def make_moe_train_step(args, model, mesh, state, tx, shardings=None):
         logits, inter = model.apply({"params": params}, tokens,
                                     mutable=["intermediates"])
         aux = _mean_sown(inter, "aux_loss")
+        router_z = _mean_sown(inter, "router_z")
         drop = jax.lax.stop_gradient(_mean_sown(inter, "drop_frac"))
         lm_loss = train.next_token_nll(logits, tokens)
-        total = lm_loss + args.aux_coef * aux
+        total = (lm_loss + args.aux_coef * aux
+                 + getattr(args, "router_z_coef", 0.0) * router_z)
         return total, {"loss": lm_loss, "aux_loss": aux,
-                       "drop_frac": drop, "total_loss": total}
+                       "router_z": router_z, "drop_frac": drop,
+                       "total_loss": total}
 
     return train.make_loss_train_step(
         loss_fn, tx, mesh, state, shardings or state_shardings(mesh, state),
@@ -397,7 +508,9 @@ def build(args, mesh=None, num_slices: int = 1):
     shardings = state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
     step = make_moe_train_step(args, model, mesh, state, tx, shardings)
-    batches = data_mod.lm_batches(args)
+    from jax.sharding import PartitionSpec as P
+
+    batches = data_mod.lm_batches(args, mesh=mesh, spec=P("data", None))
     return mesh, model, state, step, batches
 
 
