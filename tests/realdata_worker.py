@@ -1,0 +1,60 @@
+"""Subprocess worker for the per-process real-data sharding test
+(tests/test_realdata_multiprocess.py).
+
+One process of a 2-process CPU jax.distributed group training the tiny
+transformer LM from a shared mmap'd token file. Writes a JSON record of
+the window rows THIS process materialized (data.local_batch_rows) and the
+first 3 step losses, so the parent can assert the reads are disjoint and
+the training trajectory matches a single-process run of the same config.
+
+Usage: realdata_worker.py <port> <pid> <nprocs> <token_path> <out_dir>
+"""
+
+import json
+import os
+import sys
+
+
+def main() -> None:
+    port, pid, nprocs, token_path, out_dir = sys.argv[1:6]
+    os.environ.update({
+        "JAX_PLATFORMS": "cpu",
+        "JAX_COORDINATOR_ADDRESS": f"127.0.0.1:{port}",
+        "JAX_PROCESS_ID": pid,
+        "JAX_NUM_PROCESSES": nprocs,
+        "TPU_WORKER_ID": pid,
+    })
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    from tpu_operator.payload import bootstrap, data as data_mod, transformer
+
+    bootstrap.initialize()
+    argv = ["--batch", "4", "--seq-len", "64", "--dim", "32", "--heads", "2",
+            "--layers", "1", "--vocab", "128", "--data", token_path,
+            "--lr", "1e-2"]
+    args = transformer.parse_args(argv)
+    mesh, _m, state, step, batches = transformer.build(args)
+    spec = transformer.lm_token_spec(mesh)
+    rows = data_mod.local_batch_rows(mesh, args.batch, args.seq_len,
+                                     spec=spec)
+    losses = []
+    it = iter(batches)
+    for _ in range(3):
+        arrays = data_mod.put_global_batch(mesh, *next(it), spec=spec)
+        state, metrics = step(state, *arrays)
+        losses.append(float(jax.device_get(metrics["loss"])))
+
+    with open(os.path.join(out_dir, f"{pid}.json"), "w") as f:
+        json.dump({"rows": list(rows) if rows else None,
+                   "losses": losses}, f)
+
+
+if __name__ == "__main__":
+    main()

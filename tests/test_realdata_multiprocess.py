@@ -1,0 +1,126 @@
+"""Per-process real-data sharding (VERDICT round-3 item 7).
+
+Round 3's token_file_lm materialized the FULL global batch on every
+process — N× the mmap reads a job needs. data.local_batch_rows now gives
+each process its contiguous global-row range from the batch sharding's
+own device→index map, and token_file_lm fills only those rows. This test
+runs a real 2-process CPU jax.distributed group (tests/realdata_worker.py)
+training from one shared token file and asserts:
+
+- the two processes' materialized row ranges are disjoint and cover the
+  global batch;
+- both processes observe the identical (allreduced) loss sequence;
+- that sequence equals a single-process run of the same config on the
+  same file — the sharded-read path changes I/O, not training.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import socket
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "realdata_worker.py")
+
+
+def _free_port() -> int:
+    with socket.socket() as s:
+        s.bind(("127.0.0.1", 0))
+        return s.getsockname()[1]
+
+
+def test_two_processes_read_disjoint_rows_and_match_single_process(tmp_path):
+    rng = np.random.default_rng(7)
+    token_path = str(tmp_path / "tokens.npy")
+    np.save(token_path, rng.integers(0, 128, size=40_000, dtype=np.uint16))
+    out_dir = tmp_path / "out"
+    out_dir.mkdir()
+    port = _free_port()
+
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, WORKER, str(port), str(pid), "2",
+             token_path, str(out_dir)],
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT,
+            text=True, env=env, cwd=REPO)
+        for pid in range(2)
+    ]
+    try:
+        deadline = time.time() + 180
+        for p in procs:
+            p.wait(timeout=max(5, deadline - time.time()))
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+    for p in procs:
+        assert p.returncode == 0, p.stdout.read()
+
+    recs = [json.load(open(out_dir / f"{pid}.json")) for pid in range(2)]
+    ranges = [tuple(r["rows"]) for r in recs]
+    assert all(r is not None for r in ranges)
+    # disjoint, covering [0, 4)
+    (lo0, hi0), (lo1, hi1) = sorted(ranges)
+    assert hi0 <= lo1 and lo0 == 0 and hi1 == 4, ranges
+    assert (hi0 - lo0) + (hi1 - lo1) == 4, ranges
+    # identical allreduced losses on both processes
+    np.testing.assert_allclose(recs[0]["losses"], recs[1]["losses"],
+                               rtol=1e-6)
+
+    # single-process reference on the same file: same mesh shape (data=2),
+    # same batches — the sharded-read path must not change training.
+    from tpu_operator.payload import data as data_mod, transformer
+
+    import jax
+
+    args = transformer.parse_args(
+        ["--batch", "4", "--seq-len", "64", "--dim", "32", "--heads", "2",
+         "--layers", "1", "--vocab", "128", "--data", token_path,
+         "--lr", "1e-2"])
+    mesh = transformer.make_lm_mesh(2, devices=jax.devices()[:2])
+    mesh, _m, state, step, batches = transformer.build(args, mesh=mesh)
+    spec = transformer.lm_token_spec(mesh)
+    ref = []
+    it = iter(batches)
+    for _ in range(3):
+        arrays = data_mod.put_global_batch(mesh, *next(it), spec=spec)
+        state, metrics = step(state, *arrays)
+        ref.append(float(jax.device_get(metrics["loss"])))
+    np.testing.assert_allclose(recs[0]["losses"], ref, rtol=2e-5)
+
+
+def test_local_batch_rows_single_process_is_none():
+    from tpu_operator.payload import data as data_mod, transformer
+
+    mesh = transformer.make_lm_mesh(8)
+    assert data_mod.local_batch_rows(mesh, 8, 64) is None
+
+
+def test_token_file_lm_local_rows_fills_only_local_rows(tmp_path):
+    """Unit: rows outside local_rows stay zero (placeholders), rows inside
+    match the full-read stream exactly."""
+    from tpu_operator.payload import data as data_mod
+
+    rng = np.random.default_rng(3)
+    path = str(tmp_path / "t.npy")
+    np.save(path, rng.integers(1, 100, size=4096, dtype=np.uint16))
+    full = data_mod.token_file_lm(path, seed=5, batch=4, seq_len=32)
+    part = data_mod.token_file_lm(path, seed=5, batch=4, seq_len=32,
+                                  local_rows=(1, 3))
+    for _ in range(3):
+        (f,) = next(full)
+        (p,) = next(part)
+        np.testing.assert_array_equal(p[1:3], f[1:3])
+        assert (p[0] == 0).all() and (p[3] == 0).all()
+        assert (f[0] != 0).any()

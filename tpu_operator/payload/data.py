@@ -124,8 +124,35 @@ def synthetic_lm(seed: int, batch: int, seq_len: int,
         yield (seq.astype(np.int32),)
 
 
+def local_batch_rows(mesh: Mesh, batch: int, seq_len: int,
+                     spec: P = None) -> Optional[Tuple[int, int]]:
+    """The contiguous [lo, hi) range of *global* batch rows this process
+    contributes under ``batch_sharding(mesh, spec)`` — ``None`` when every
+    row is needed (single process). Derived from the sharding's own
+    device→index map, not from device-order assumptions, so it is exact
+    for any (data, seq, …) layout. Multi-host input sharding: each host
+    mmap-reads only the window rows it will actually contribute
+    (token_file_lm ``local_rows``), instead of materializing the full
+    global batch N× across the job."""
+    if jax.process_count() <= 1:
+        return None
+    sharding = batch_sharding(mesh, spec)
+    starts, stops = [], []
+    for dev, idx in sharding.devices_indices_map((batch, seq_len)).items():
+        if dev.process_index != jax.process_index():
+            continue
+        rows = idx[0]
+        starts.append(rows.start or 0)
+        stops.append(rows.stop if rows.stop is not None else batch)
+    if not starts:
+        return (0, 0)
+    return (min(starts), max(stops))
+
+
 def token_file_lm(path: str, seed: int, batch: int, seq_len: int,
-                  vocab: int = 0) -> Iterator[Tuple[np.ndarray]]:
+                  vocab: int = 0,
+                  local_rows: Optional[Tuple[int, int]] = None,
+                  ) -> Iterator[Tuple[np.ndarray]]:
     """Stream [batch, seq_len] i32 token batches from a mounted ``.npy``
     token file — the real-data counterpart of synthetic_lm, mirroring the
     CIFAR ``.npz`` discipline (npz_classification): mounted volume, eager
@@ -149,6 +176,17 @@ def token_file_lm(path: str, seed: int, batch: int, seq_len: int,
     ``vocab`` validates eagerly (min/max over the mapped array — a
     sequential scan, no materialization): out-of-range tokens would
     otherwise train silently wrong through the loss's clamped gather.
+
+    ``local_rows=(lo, hi)`` (from :func:`local_batch_rows`) makes this
+    process mmap-read and copy **only rows lo..hi** of each global batch
+    — the rows it will contribute through ``put_global_batch``. The
+    yielded array keeps the full [batch, seq_len] shape (rows outside
+    the range are zeros, never consumed:
+    ``make_array_from_process_local_data`` slices exactly the
+    addressable portion), and the window permutation is drawn
+    identically on every process, so the global batch sequence — and
+    therefore checkpoint-resume fast-forward — is unchanged from the
+    full-read path.
     """
     tokens = np.load(path, mmap_mode="r")
     if tokens.ndim != 1 or not np.issubdtype(tokens.dtype, np.integer):
@@ -167,28 +205,38 @@ def token_file_lm(path: str, seed: int, batch: int, seq_len: int,
                 f"token file {path} spans [{lo}, {hi}], model vocab is "
                 f"{vocab}")
 
+    lo, hi = local_rows if local_rows is not None else (0, batch)
+
     def stream():
         rng = np.random.default_rng(seed)
         while True:
             perm = rng.permutation(n_windows)
             for i in range(0, n_windows - batch + 1, batch):
                 idx = perm[i:i + batch]
-                out = np.empty((batch, seq_len), np.int32)
-                for row, w in enumerate(idx):
+                out = np.zeros((batch, seq_len), np.int32)
+                for row in range(lo, hi):
+                    w = idx[row]
                     out[row] = tokens[w * seq_len:(w + 1) * seq_len]
                 yield (out,)
 
     return stream()
 
 
-def lm_batches(args) -> Iterator[Tuple[np.ndarray]]:
+def lm_batches(args, mesh: Optional[Mesh] = None,
+               spec: P = None) -> Iterator[Tuple[np.ndarray]]:
     """The shared LM data entry: ``--data /path/tokens.npy`` selects the
     memory-mapped real-token stream, else the synthetic recurrence — one
-    switch for transformer/pipeline/moe so the payloads cannot drift."""
+    switch for transformer/pipeline/moe so the payloads cannot drift.
+    With ``mesh`` (and the batch ``spec`` the payload will pass to
+    put_global_batch), multi-process jobs read only their own rows of
+    the token file (:func:`local_batch_rows`)."""
     data_path = getattr(args, "data", "")
     if data_path:
+        local_rows = (local_batch_rows(mesh, args.batch, args.seq_len,
+                                       spec=spec)
+                      if mesh is not None else None)
         return token_file_lm(data_path, args.seed, args.batch, args.seq_len,
-                             vocab=args.vocab)
+                             vocab=args.vocab, local_rows=local_rows)
     return synthetic_lm(args.seed, args.batch, args.seq_len,
                         vocab=args.vocab)
 

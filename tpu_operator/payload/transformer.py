@@ -371,7 +371,8 @@ def build(args, mesh=None, num_slices: int = 1):
                               grad_accum=getattr(args, "grad_accum", 1),
                               sp_layout=getattr(args, "sp_layout",
                                                 "contiguous"))
-    batches = data_mod.lm_batches(args)
+    batches = data_mod.lm_batches(args, mesh=mesh,
+                                  spec=lm_token_spec(mesh))
     return mesh, model, state, step, batches
 
 
