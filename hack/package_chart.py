@@ -1,0 +1,84 @@
+#!/usr/bin/env python3
+"""Package the Helm chart into a versioned tarball (helm-package parity).
+
+The reference ships its chart as a committed artifact
+(``build/chart/mx-job-operator-chart-0.1.0.tgz``); this writes the
+equivalent ``build/chart/tpu-job-operator-chart-<version>.tgz`` (version
+read from Chart.yaml) with a byte-reproducible tar: sorted member order,
+zeroed timestamps/uids, fixed gzip header — so the committed artifact is
+a pure function of the chart sources and ``--check`` can gate drift in
+hack/verify.sh exactly like the CRD and lockfile generators.
+"""
+
+from __future__ import annotations
+
+import argparse
+import gzip
+import io
+import pathlib
+import sys
+import tarfile
+
+import yaml
+
+REPO = pathlib.Path(__file__).resolve().parent.parent
+CHART_DIR = REPO / "deploy" / "chart" / "tpu-job-operator-chart"
+OUT_DIR = REPO / "build" / "chart"
+
+
+def chart_version() -> str:
+    with open(CHART_DIR / "Chart.yaml", encoding="utf-8") as f:
+        return str(yaml.safe_load(f)["version"])
+
+
+def build_tgz_bytes() -> bytes:
+    """Deterministic .tgz of the chart, members prefixed with the chart
+    name (helm's layout)."""
+    tar_buf = io.BytesIO()
+    with tarfile.open(fileobj=tar_buf, mode="w", format=tarfile.USTAR_FORMAT) as tar:
+        for path in sorted(CHART_DIR.rglob("*")):
+            if not path.is_file():
+                continue
+            rel = f"{CHART_DIR.name}/{path.relative_to(CHART_DIR)}"
+            info = tarfile.TarInfo(rel)
+            data = path.read_bytes()
+            info.size = len(data)
+            info.mtime = 0
+            info.uid = info.gid = 0
+            info.uname = info.gname = ""
+            info.mode = 0o644
+            tar.addfile(info, io.BytesIO(data))
+    gz_buf = io.BytesIO()
+    with gzip.GzipFile(fileobj=gz_buf, mode="wb", mtime=0) as gz:
+        gz.write(tar_buf.getvalue())
+    return gz_buf.getvalue()
+
+
+def main(argv=None) -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--check", action="store_true",
+                   help="fail if the committed artifact differs from the "
+                        "chart sources (drift gate)")
+    args = p.parse_args(argv)
+
+    out = OUT_DIR / f"{CHART_DIR.name}-{chart_version()}.tgz"
+    data = build_tgz_bytes()
+    if args.check:
+        if not out.exists():
+            print(f"package_chart: {out} missing — run "
+                  f"`python hack/package_chart.py`", file=sys.stderr)
+            return 1
+        if out.read_bytes() != data:
+            print(f"package_chart: {out} is stale vs deploy/chart — run "
+                  f"`python hack/package_chart.py`", file=sys.stderr)
+            return 1
+        print(f"package_chart: {out.relative_to(REPO)} up to date")
+        return 0
+    OUT_DIR.mkdir(parents=True, exist_ok=True)
+    out.write_bytes(data)
+    print(f"wrote {out.relative_to(REPO)} ({len(data)} bytes)")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

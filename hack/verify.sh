@@ -12,6 +12,7 @@ cd "$(dirname "$0")/.."
 python hack/check_payload_image.py
 python hack/gen_lock.py --check
 python hack/gen_crd.py --check
+python hack/package_chart.py --check
 python -m pytest tests/ -x -q
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"
