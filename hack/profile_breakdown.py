@@ -40,15 +40,24 @@ QUICK = ["--dim", "64", "--layers", "2", "--heads", "2", "--batch", "4",
          "--seq-len", "128", "--vocab", "256"]
 
 
-def capture(argv, steps: int, outdir: str) -> float:
-    """Run warmup + ``steps`` traced steps; returns measured sec/step."""
+def capture(argv, steps: int, outdir: str,
+            payload: str = "transformer") -> float:
+    """Run warmup + ``steps`` traced steps; returns measured sec/step.
+    ``payload`` selects the LM payload module (transformer / moe /
+    pipeline) so MoE dispatch and pipeline tick schedules get the same
+    attribution treatment as the flagship."""
+    import importlib
+
     import jax
+    from jax.sharding import PartitionSpec as P
 
     from tpu_operator.payload import data as data_mod, transformer
 
-    targs = transformer.parse_args(argv)
-    mesh, _m, state, step, batches = transformer.build(targs)
-    spec = transformer.lm_token_spec(mesh)
+    module = importlib.import_module(f"tpu_operator.payload.{payload}")
+    targs = module.parse_args(argv)
+    mesh, _m, state, step, batches = module.build(targs)
+    spec = (transformer.lm_token_spec(mesh)
+            if payload == "transformer" else P("data", None))
     pregen = [data_mod.put_global_batch(mesh, *b, spec=spec)
               for b in itertools.islice(batches, 4)]
     cycled = itertools.cycle(pregen)
@@ -188,14 +197,23 @@ def main(argv=None) -> int:
     ap.add_argument("--parse-only", action="store_true",
                     help="re-analyze an existing --outdir trace without "
                          "re-capturing (iterate on bucketing for free)")
+    ap.add_argument("--payload",
+                    choices=("transformer", "moe", "pipeline"),
+                    default="transformer",
+                    help="which LM payload to profile (extra argv go to "
+                         "its parser; the FLAGSHIP defaults apply only to "
+                         "transformer)")
     args, extra = ap.parse_known_args(argv)
     if args.quick:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    cfg = (QUICK if args.quick else FLAGSHIP) + extra
+    if args.payload != "transformer":
+        cfg = extra
+    else:
+        cfg = (QUICK if args.quick else FLAGSHIP) + extra
     outdir = args.outdir or tempfile.mkdtemp(prefix="tpu_profile_")
     dt = None
     if not args.parse_only:
-        dt = capture(cfg, args.steps, outdir)
+        dt = capture(cfg, args.steps, outdir, payload=args.payload)
     buckets, busy, wall = parse_xplanes(outdir)
     overlapped = buckets.pop(OVERLAPPED, 0.0)
     per_step = {k: v / args.steps / 1e3 for k, v in buckets.items()}  # ms
