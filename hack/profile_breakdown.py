@@ -197,6 +197,11 @@ def main(argv=None) -> int:
     ap.add_argument("--parse-only", action="store_true",
                     help="re-analyze an existing --outdir trace without "
                          "re-capturing (iterate on bucketing for free)")
+    ap.add_argument("--bare", action="store_true",
+                    help="do not prepend the FLAGSHIP/QUICK defaults — "
+                         "the extra argv IS the whole config (required "
+                         "for store_true flags like --remat, which the "
+                         "defaults could otherwise force on)")
     ap.add_argument("--payload",
                     choices=("transformer", "moe", "pipeline"),
                     default="transformer",
@@ -206,7 +211,7 @@ def main(argv=None) -> int:
     args, extra = ap.parse_known_args(argv)
     if args.quick:
         os.environ.setdefault("JAX_PLATFORMS", "cpu")
-    if args.payload != "transformer":
+    if args.payload != "transformer" or args.bare:
         cfg = extra
     else:
         cfg = (QUICK if args.quick else FLAGSHIP) + extra

@@ -220,6 +220,29 @@ def test_1f1b_matches_gpipe_loss_and_update(mesh):
                                    atol=2e-5, rtol=2e-5)
 
 
+def test_1f1b_stash_residuals_matches_input_stash(mesh):
+    """--stash residuals (store the stage vjp's residual leaves, no
+    recompute) must produce the same loss and updated params as the
+    recompute-from-input path — it is the same math with the forward run
+    once instead of twice. f32 so the comparison is tight."""
+    from tpu_operator.payload import data as data_mod
+
+    a_in = _args(batch=16, microbatches=4, schedule="1f1b")
+    a_res = _args(batch=16, microbatches=4, schedule="1f1b",
+                  stash="residuals")
+    _, _, st_i, step_i, batches = pipeline.build(a_in, mesh=mesh)
+    _, _, st_r, step_r, _ = pipeline.build(a_res, mesh=mesh)
+    (tok,) = next(batches)
+    (dev,) = data_mod.put_global_batch(mesh, tok)
+    new_i, m_i = step_i(st_i, dev)
+    new_r, m_r = step_r(st_r, dev)
+    assert abs(float(m_i["loss"]) - float(m_r["loss"])) < 1e-6
+    for li, lr in zip(jax.tree_util.tree_leaves(new_i.params),
+                      jax.tree_util.tree_leaves(new_r.params)):
+        np.testing.assert_allclose(np.asarray(li), np.asarray(lr),
+                                   atol=1e-5, rtol=1e-5)
+
+
 def test_1f1b_lm_loss_descends(mesh):
     from tpu_operator.payload import data as data_mod
 
