@@ -578,8 +578,11 @@ def bench_attention(quick: bool) -> list:
         # after every fence (hack/attn_microbench.py docstring), so the
         # round-3 2-step windows at T=32768 were ramp-dominated — the
         # 13.9/17.5% spreads on the GQA rows were the harness, not the
-        # kernel.
-        steps = 3 if quick else max(12, 40 * 2048 // t)
+        # kernel. Target ≥~0.8 s per window at measured per-step times
+        # (T2048 ~3.2 ms → 400 steps ≈ 1.3 s; T8192 ~9.6 ms → 100 ≈
+        # 1.0 s; T32768 ~87-101 ms → 25 ≈ 2.2 s): the first 40-step
+        # revision still showed 11-13% spread on the short-T arms.
+        steps = 3 if quick else max(25, 400 * 2048 // t)
         flash_fn = lambda q, k, v: fa.flash_attention(
             q, k, v, causal=True, use_pallas=on_tpu or None)
         xla_ms, xla_status = None, "ran"

@@ -111,6 +111,36 @@ def test_ring_backward_matches_reference(use_pallas):
                                    rtol=2e-4, atol=2e-4)
 
 
+@pytest.mark.parametrize("offsets", [(0, 0, 1), (64, 0, 1), (0, 64, 1),
+                                     (1, 3, 4)])
+def test_bwd_kernels_multi_tile_with_offsets(monkeypatch, offsets):
+    """Multi-tile backward (nq = nk = 4) at nontrivial global offsets and
+    a striped stride: the causal DMA-clamp index maps (k-tiles clamped to
+    the last contributing tile in the dq kernel, q-tiles to the first in
+    the dkv kernel) must not change any gradient — including when whole
+    grid rows are fully masked (negative clamp targets)."""
+    monkeypatch.setattr(fa, "_bwd_blocks", lambda tq, tk, g: (64, 64))
+    q, k, v = qkv(t=256, h=2)
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, h, t, d = qt.shape
+    offs = jnp.array(offsets, jnp.int32)
+    carry = fa.init_carry(b, h, t, d)
+    o, l, m = fa._merge_ref(qt, kt, vt, *carry, offs, True)
+    L = fa._logsumexp_rows(l, m)
+    g = jnp.asarray(np.random.default_rng(9).normal(size=qt.shape),
+                    jnp.float32)
+    D = jnp.sum(g * fa.finalize((o, l, m), jnp.float32), axis=-1,
+                keepdims=True)
+    got = fa.attention_block_grads(qt, kt, vt, g, L, D, offs, causal=True,
+                                   use_pallas=True)
+    want = fa._bwd_ref(qt, kt, vt, g, L, D, offs, True)
+    for gg, ww in zip(got, want):
+        np.testing.assert_allclose(np.asarray(gg), np.asarray(ww),
+                                   rtol=2e-4, atol=2e-4)
+
+
 def test_fully_masked_rows_have_zero_gradient():
     """A query block entirely before every key (causal): out = 0 and all
     gradients must be exactly 0 (the L = 0 guard in _logsumexp_rows keeps

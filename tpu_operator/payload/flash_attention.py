@@ -645,8 +645,27 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     def q_map(ib, ih, iq, ik, offs):
         return (ib, ih, iq, 0)
 
-    def k_map(ib, ih, iq, ik, offs):
-        return (ib, ih, ik, 0)
+    nq, nk = tq // blk_q, tk // blk_k
+
+    if causal:
+        def k_map(ib, ih, iq, ik, offs):
+            # Causally-skipped k-tiles (entirely in this q-block's future)
+            # clamp to the last contributing tile — an already-resident
+            # revisit, so the skipped tile costs no K/V DMA (the same
+            # trick as the fused forward). Global positions: slot i of q
+            # is offs[0] + stride·i, of k offs[1] + stride·i; tile ik
+            # contributes iff k_lo(ik) <= q_hi(iq), i.e.
+            # ik <= floor((stride·((iq+1)·blk_q − 1) − diff)/(stride·blk_k))
+            # with diff = offs[1] − offs[0] (floor_divide handles either
+            # sign exactly).
+            diff = offs[1] - offs[0]
+            last = jnp.floor_divide(
+                offs[2] * ((iq + 1) * blk_q - 1) - diff,
+                offs[2] * blk_k)
+            return (ib, ih, jnp.clip(jnp.minimum(ik, last), 0, nk - 1), 0)
+    else:
+        def k_map(ib, ih, iq, ik, offs):
+            return (ib, ih, ik, 0)
 
     q_spec = pl.BlockSpec((1, group, blk_q, d), q_map)
     kv_spec = pl.BlockSpec((1, 1, blk_k, d), k_map)
@@ -666,8 +685,20 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     )(offsets, q, k, v, g, L, D)[0]
 
     # dkv grid transposes the block roles: k-blocks outer, q-tiles inner.
-    def qT_map(ib, ih, ik, iq, offs):
-        return (ib, ih, iq, 0)
+    if causal:
+        def qT_map(ib, ih, ik, iq, offs):
+            # Mirror clamp: q-tiles entirely before this k-block's past
+            # (q_hi < k_lo) contribute nothing — clamp up to the first
+            # contributing tile, iq >= ceil((diff + stride·(ik·blk_k −
+            # blk_q + 1)) / (stride·blk_q)).
+            diff = offs[1] - offs[0]
+            num = diff + offs[2] * (ik * blk_k - blk_q + 1)
+            den = offs[2] * blk_q
+            first = jnp.floor_divide(num + den - 1, den)
+            return (ib, ih, jnp.clip(jnp.maximum(iq, first), 0, nq - 1), 0)
+    else:
+        def qT_map(ib, ih, ik, iq, offs):
+            return (ib, ih, iq, 0)
 
     def kT_map(ib, ih, ik, iq, offs):
         return (ib, ih, ik, 0)
