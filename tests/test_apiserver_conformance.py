@@ -10,6 +10,25 @@ in the image) and pins down the watch-protocol semantics a real apiserver
 imposes: list-envelope resourceVersions, RV-anchored gap-free watches,
 410 Gone on compacted RVs (HTTP-level and in-stream), BOOKMARK tolerance,
 status-subresource isolation, and conflict-retry on stale RVs.
+
+Each behavior pinned here mirrors a documented upstream Kubernetes
+contract (the conformance anchor, since no kube-apiserver/etcd binaries
+exist in this image):
+
+- resourceVersion list envelopes and RV-anchored watches: Kubernetes API
+  Concepts, "Efficient detection of changes" — a watch started from a
+  list's RV must deliver exactly the events after that snapshot.
+- 410 Gone on a compacted RV (both as the watch-open HTTP status and as
+  an in-stream ERROR event with code 410): same chapter, "410 Gone"
+  responses; client-go's Reflector handles both by falling back to
+  re-list (k8s.io/client-go tools/cache/reflector.go behavior).
+- BOOKMARK events: API Concepts, "Watch bookmarks" — progress markers
+  carrying only resourceVersion; they must not dispatch handlers or
+  mutate the cache.
+- status subresource isolation: API Conventions, "Spec and Status" — a
+  PUT to /status updates only .status and bumps the RV.
+- 409 Conflict on stale-RV writes + read-retry: API Conventions,
+  optimistic concurrency via metadata.resourceVersion.
 """
 
 from __future__ import annotations
