@@ -278,6 +278,27 @@ LM_LADDER = [
                                    "--remat", "--remat-policy", "dots_attn",
                                    "--grad-accum", "4",
                                    "--optimizer", "adam8"], 10),
+    # Model-level long context (the kernel-level rows cover attention
+    # alone): the same architecture trained END TO END at 8k and 32k
+    # tokens on one chip — the capability the flash kernels' O(T) memory
+    # exists for. (The learned position table grows with seq-len — +13M
+    # params at 8k, +63M at 32k — but embeddings are excluded from the
+    # matmul-param MFU accounting, so the rows stay comparable.) 32k
+    # needs full remat + the int8 optimizer's freed HBM (dots_attn at
+    # 32k does not fit).
+    ("lm_longctx_T8192_gqa", ["--dim", "2048", "--layers", "8",
+                              "--heads", "16", "--kv-heads", "4",
+                              "--batch", "8", "--seq-len", "8192",
+                              "--vocab", "32768",
+                              "--remat", "--remat-policy", "dots_attn",
+                              "--grad-accum", "4",
+                              "--adam-mu-dtype", "bf16"], 8),
+    ("lm_longctx_T32768_gqa", ["--dim", "2048", "--layers", "8",
+                               "--heads", "16", "--kv-heads", "4",
+                               "--batch", "2", "--seq-len", "32768",
+                               "--vocab", "32768", "--remat",
+                               "--grad-accum", "2",
+                               "--optimizer", "adam8"], 4),
 ]
 
 LM_LADDER_QUICK = [

@@ -44,6 +44,33 @@ def test_remat_dots_attn_policy_loss_identical():
     assert abs(losses["none"] - losses["dots_attn"]) < 5e-3, losses
 
 
+def test_remat_dots_attn_gelu_policy_loss_identical():
+    """--remat-policy dots_attn_gelu (additionally saves the named MLP
+    gelu output) must also be semantics-preserving — a typo'd saved name
+    or policy-composition regression would silently recompute or, worse,
+    misassociate residuals. Also pins the shared models.remat_policy
+    helper the pipeline/MoE builders consume."""
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import data as data_mod, models
+
+    assert models.remat_policy("full") is None
+    mesh = transformer.make_lm_mesh(8, seq_parallel=4)
+    losses = {}
+    for label, extra in (("none", []),
+                         ("gelu", ["--remat", "--remat-policy",
+                                   "dots_attn_gelu"])):
+        args = transformer.parse_args(_lm_argv(extra))
+        _, _, state, step, batches = transformer.build(args, mesh=mesh)
+        (tokens,) = next(batches)
+        (dev,) = data_mod.put_global_batch(mesh, tokens,
+                                           spec=P("data", "seq"))
+        state, _ = step(state, dev)
+        _, metrics = step(state, dev)
+        losses[label] = float(metrics["loss"])
+    assert abs(losses["none"] - losses["gelu"]) < 5e-3, losses
+
+
 def test_remat_transformer_loss_identical():
     mesh = transformer.make_lm_mesh(8, seq_parallel=4)
     losses = {}

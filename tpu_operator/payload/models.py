@@ -161,7 +161,14 @@ class DecoderBlock(nn.Module):
         if self.mlp is not None:
             return x + self.mlp("moe")(h)
         h = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_up")(h)
-        h = nn.gelu(h)
+        # Named for remat policies: "dots" saves matmul outputs but not
+        # the gelu, so mlp_down's backward recomputes the transcendental
+        # over the 4*dim hidden — the widest elementwise in the block.
+        # A save_only_these_names policy can keep it instead
+        # (transformer --remat-policy dots_attn_gelu).
+        from jax.ad_checkpoint import checkpoint_name
+
+        h = checkpoint_name(nn.gelu(h), "mlp_gelu")
         h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
         return x + h
 
@@ -175,6 +182,48 @@ class LinearRegressor(nn.Module):
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
         return nn.Dense(self.features, dtype=jnp.float32, name="linear")(x)
+
+
+REMAT_POLICIES = ("full", "dots", "dots_attn", "dots_attn_gelu")
+
+
+def remat_policy(mode: str):
+    """jax.checkpoint policy for a ``--remat-policy`` mode — the ONE
+    construction site all LM payloads (transformer, pipeline, MoE) share,
+    so the flag cannot be silently ignored by one builder. ``full``
+    returns None (recompute everything). ``dots`` saves matmul outputs.
+    ``dots_attn`` additionally saves the flash kernels' named residuals
+    (output + logsumexp) so attention is not re-run in the backward.
+    ``dots_attn_gelu`` additionally saves the MLP gelu output — measured
+    slower at the flagship (docs/benchmarks.md negative results) and kept
+    as the documented trade."""
+    import jax
+
+    if mode not in REMAT_POLICIES:
+        raise ValueError(f"unknown remat policy {mode!r}")
+    if mode == "full":
+        return None
+    if mode == "dots":
+        return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
+    names = ["flash_attn_out", "flash_attn_lse"]
+    if mode == "dots_attn_gelu":
+        names.append("mlp_gelu")
+    return jax.checkpoint_policies.save_from_both_policies(
+        jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
+        jax.checkpoint_policies.save_only_these_names(*names))
+
+
+def add_remat_policy_flag(parser) -> None:
+    """``--remat-policy`` CLI flag, shared by every LM payload parser."""
+    parser.add_argument(
+        "--remat-policy", choices=REMAT_POLICIES, default="full",
+        help="what --remat recomputes: full = everything (min memory); "
+             "dots = save matmul outputs, recompute elementwise; "
+             "dots_attn = dots + the flash kernels' residuals (attention "
+             "not re-run in the backward — the flagship setting); "
+             "dots_attn_gelu = dots_attn + the MLP gelu output "
+             "(measured slower at the flagship, see "
+             "docs/benchmarks.md negative results)")
 
 
 def resolve_split_qkv(mode: str, tp: int, log) -> bool:

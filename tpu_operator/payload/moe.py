@@ -93,6 +93,8 @@ def parse_args(argv=None):
     p.add_argument("--remat", action="store_true",
                    help="rematerialize each block on backward "
                         "(jax.checkpoint)")
+    __import__('tpu_operator.payload.models',
+               fromlist=['models']).add_remat_policy_flag(p)
     p.add_argument("--lr", type=float, default=3e-3)
     optimizers.add_optimizer_flag(p)
     p.add_argument("--seed", type=int, default=0)
@@ -373,8 +375,10 @@ def _build_model(args, mesh):
         return ring.reference_attention(q, k, v, causal=True)
 
     MoEMLP = _moe_mlp_class(mesh, dtype)
-    Block = (nn.remat(models.DecoderBlock) if getattr(args, "remat", False)
-             else models.DecoderBlock)
+    Block = (nn.remat(models.DecoderBlock,
+                      policy=models.remat_policy(
+                          getattr(args, "remat_policy", "full")))
+             if getattr(args, "remat", False) else models.DecoderBlock)
     # Under TP, split q/k/v so each model shard owns whole heads
     # (transformer.py's rule — a fused [d,3d] kernel's contiguous column
     # shards would straddle the q/k/v thirds).

@@ -75,17 +75,9 @@ def parse_args(argv=None):
                    help="rematerialize each block on backward (jax.checkpoint"
                         "): activation memory O(layers) -> O(1) blocks, for "
                         "long-context configs that would not fit HBM")
-    p.add_argument("--remat-policy", choices=("full", "dots", "dots_attn"),
-                   default="full",
-                   help="what --remat recomputes: full = everything (min "
-                        "memory, +2*params*tokens recompute FLOPs); dots = "
-                        "save matmul outputs, recompute only elementwise "
-                        "(jax.checkpoint_policies.dots_with_no_batch_dims_"
-                        "saveable) — near no-remat speed at a fraction of "
-                        "its activation memory; dots_attn = dots plus the "
-                        "flash-attention kernel's named residuals, so the "
-                        "attention forward is not re-run in the backward "
-                        "(costs O(B*T*H*D) bf16 per layer)")
+    from tpu_operator.payload import models
+
+    models.add_remat_policy_flag(p)
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over K sequential "
                         "microbatches inside the jit (activation-memory "
@@ -205,18 +197,8 @@ def _build_model(args, mesh):
     # re-runs inside the backward (~1/3 of flagship attention time,
     # docs/benchmarks.md attribution) for no memory it couldn't afford.
     if getattr(args, "remat", False):
-        import jax
-
-        mode = getattr(args, "remat_policy", "full")
-        policy = None
-        if mode == "dots":
-            policy = jax.checkpoint_policies.dots_with_no_batch_dims_saveable
-        elif mode == "dots_attn":
-            policy = jax.checkpoint_policies.save_from_both_policies(
-                jax.checkpoint_policies.dots_with_no_batch_dims_saveable,
-                jax.checkpoint_policies.save_only_these_names(
-                    "flash_attn_out", "flash_attn_lse"))
-        Block = nn.remat(models.DecoderBlock, policy=policy)
+        Block = nn.remat(models.DecoderBlock, policy=models.remat_policy(
+            getattr(args, "remat_policy", "full")))
     else:
         Block = models.DecoderBlock
 
