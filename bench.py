@@ -370,6 +370,7 @@ def bench_moe(quick: bool, windows: int = 3) -> list:
                 "--experts", "4", "--batch", "4", "--seq-len", "128",
                 "--vocab", "256", "--dtype", "f32"]
         steps, windows, train_steps = 3, 1, 5
+        config_rev = "quick"
     else:
         # batch 8: the [E,G,C,D] expert buffers scale with G — batch 16 at
         # this config OOMs the 16G chip in HLO temps (measured), 8 fits.
@@ -383,6 +384,7 @@ def bench_moe(quick: bool, windows: int = 3) -> list:
                 "--seq-len", "2048", "--vocab", "32768",
                 "--capacity-factor", "1.25"]
         steps, train_steps = 20, 300
+        config_rev = "r4-h8kv4"
     margs = moe.parse_args(argv)
     mesh, _model, state, step, batches = moe.build(margs)
 
@@ -437,6 +439,11 @@ def bench_moe(quick: bool, windows: int = 3) -> list:
             "windows": timing["windows"],
             "spread_pct": timing["spread_pct"],
             "config": " ".join(argv),
+            # Round-over-round tooling: the metric NAME predates round 4's
+            # head-geometry change (16 h / d_head 64 -> 8 h / 4 kv /
+            # d_head 128); rows with different config_rev are not the same
+            # measurement and must not be diffed as one series.
+            "config_rev": config_rev,
         }
 
     rows = [measure("moe_e8_top2_single_chip")]

@@ -12,7 +12,7 @@ exactly how a real per-worker input pipeline feeds a TPU pod slice.
 
 from __future__ import annotations
 
-from typing import Iterator, Tuple
+from typing import Iterator, Optional, Tuple
 
 import numpy as np
 
@@ -142,11 +142,40 @@ def local_batch_rows(mesh: Mesh, batch: int, seq_len: int,
         if dev.process_index != jax.process_index():
             continue
         rows = idx[0]
+        # NamedSharding over a Mesh only ever produces contiguous row
+        # blocks per device; a strided slice would break the single-span
+        # collapse below, so refuse it rather than silently over-reading.
+        if rows.step not in (None, 1):
+            raise ValueError(
+                f"local_batch_rows: strided batch shard {rows} is not "
+                f"supported (contiguous spans only)")
         starts.append(rows.start or 0)
         stops.append(rows.stop if rows.stop is not None else batch)
     if not starts:
         return (0, 0)
-    return (min(starts), max(stops))
+    lo, hi = min(starts), max(stops)
+    # Merge before summing: replicated batch rows (a model/seq axis within
+    # this process) report identical spans, and a raw sum would double-
+    # count them and mask a real gap.
+    merged, owned = [], 0
+    for a, b in sorted(zip(starts, stops)):
+        if merged and a <= merged[-1][1]:
+            merged[-1][1] = max(merged[-1][1], b)
+        else:
+            merged.append([a, b])
+    owned = sum(b - a for a, b in merged)
+    if owned < hi - lo:
+        # Device order gave this process non-adjacent row blocks: the
+        # collapsed span over-reads the gap rows. Correct (extras are
+        # dropped by make_array_from_process_local_data) but the N-fold
+        # read saving degrades — surface it instead of hiding it.
+        import logging
+        logging.getLogger(__name__).warning(
+            "local_batch_rows: process %d owns %d rows but spans [%d, %d) "
+            "(%d rows read); non-contiguous shard layout degrades the "
+            "sharded-read saving", jax.process_index(), owned, lo, hi,
+            hi - lo)
+    return (lo, hi)
 
 
 def token_file_lm(path: str, seed: int, batch: int, seq_len: int,
