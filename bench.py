@@ -293,12 +293,21 @@ LM_LADDER = [
                               "--remat", "--remat-policy", "dots_attn",
                               "--grad-accum", "4",
                               "--adam-mu-dtype", "bf16"], 8),
+    # Round 5: the [B, T, 32768] logits never materialize (--loss-chunk,
+    # train.chunked_next_token_nll) and the freed HBM upgrades full remat
+    # to the attn policy (flash residuals saved — the attention forward,
+    # over half the FLOPs at 32k, is not re-run in the backward):
+    # 46.6% -> 53.7% MFU measured. Saving MORE (q/k/v, the post-attn
+    # residual — attn_block) fits but buys nothing: the step is
+    # attention-kernel-bound (profile: 60.9% of busy), not recompute-bound.
     ("lm_longctx_T32768_gqa", ["--dim", "2048", "--layers", "8",
                                "--heads", "16", "--kv-heads", "4",
                                "--batch", "2", "--seq-len", "32768",
                                "--vocab", "32768", "--remat",
+                               "--remat-policy", "attn",
                                "--grad-accum", "2",
-                               "--optimizer", "adam8"], 4),
+                               "--optimizer", "adam8",
+                               "--loss-chunk", "2048"], 4),
 ]
 
 LM_LADDER_QUICK = [

@@ -1,0 +1,127 @@
+"""Sequence-chunked lm_head/loss (train.chunked_next_token_nll*).
+
+The chunked form is a pure re-association of the unchunked loss — same
+bf16 head matmul, same f32 lse/target gather per position, chunk-partial
+sums — so value AND gradient parity must hold to f32 reduction tolerance.
+These tests pin that, the validation contract, and the end-to-end
+transformer step with --loss-chunk (the 32k-context activation lever,
+docs/benchmarks.md round 5).
+"""
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from tpu_operator.payload import train
+
+
+def _case(b=2, t=64, d=32, v=96, seed=0):
+    rng = np.random.default_rng(seed)
+    hidden = jnp.asarray(rng.standard_normal((b, t, d)), jnp.bfloat16)
+    w = jnp.asarray(rng.standard_normal((d, v)) * 0.1, jnp.float32)
+    tokens = jnp.asarray(rng.integers(0, v, size=(b, t)), jnp.int32)
+    return hidden, w, tokens
+
+
+def _dense_loss(hidden, w, tokens):
+    logits = hidden @ w.astype(hidden.dtype)
+    return train.next_token_nll(logits, tokens)
+
+
+@pytest.mark.parametrize("chunk", [8, 16, 64])
+def test_chunked_nll_matches_dense(chunk):
+    hidden, w, tokens = _case()
+    dense = _dense_loss(hidden, w, tokens)
+    chunked = train.chunked_next_token_nll(hidden, w, tokens, chunk)
+    assert float(chunked) == pytest.approx(float(dense), rel=1e-5)
+
+
+def test_chunked_nll_grad_parity():
+    hidden, w, tokens = _case()
+
+    g_dense = jax.grad(_dense_loss, argnums=(0, 1))(hidden, w, tokens)
+    g_chunk = jax.grad(train.chunked_next_token_nll, argnums=(0, 1))(
+        hidden, w, tokens, 16)
+    for gd, gc in zip(g_dense, g_chunk):
+        np.testing.assert_allclose(np.asarray(gd, np.float32),
+                                   np.asarray(gc, np.float32),
+                                   rtol=2e-2, atol=3e-4)
+
+
+def test_chunked_masked_matches_dense_masked():
+    hidden, w, tokens = _case()
+    b, t = tokens.shape
+    rng = np.random.default_rng(7)
+    targets = jnp.asarray(rng.integers(0, w.shape[1], (b, t)), jnp.int32)
+    mask = jnp.asarray(rng.integers(0, 2, (b, t)), bool)
+    logits = hidden @ w.astype(hidden.dtype)
+    dense = train.next_token_nll_masked(logits, targets, mask)
+    chunked = train.chunked_next_token_nll_masked(hidden, w, targets, mask,
+                                                  16)
+    assert float(chunked) == pytest.approx(float(dense), rel=1e-5)
+
+
+def test_chunk_must_divide_t():
+    hidden, w, tokens = _case(t=64)
+    with pytest.raises(ValueError, match="divide"):
+        train.chunked_next_token_nll(hidden, w, tokens, 24)
+    with pytest.raises(ValueError, match="positive"):
+        train.chunked_next_token_nll(hidden, w, tokens, 0)
+
+
+def _lm_args(extra):
+    from tpu_operator.payload import transformer
+
+    return transformer.parse_args(
+        ["--dim", "64", "--layers", "2", "--heads", "2", "--batch", "8",
+         "--seq-len", "128", "--vocab", "256", "--steps", "1"] + extra)
+
+
+def test_transformer_step_parity_with_loss_chunk():
+    """Same seed, same batch: the --loss-chunk step's loss equals the
+    unchunked step's (the whole pipeline — trunk, head, reduction — is
+    numerically the same computation)."""
+    from tpu_operator.payload import transformer
+
+    losses = {}
+    for extra in ([], ["--loss-chunk", "32"]):
+        args = _lm_args(extra)
+        mesh, _model, state, step, batches = transformer.build(args)
+        from tpu_operator.payload import data as data_mod
+
+        batch = data_mod.put_global_batch(
+            mesh, *next(batches), spec=transformer.lm_token_spec(mesh))
+        _state, metrics = step(state, *batch)
+        losses[bool(extra)] = float(metrics["loss"])
+    assert losses[True] == pytest.approx(losses[False], rel=1e-4), losses
+
+
+def test_loss_chunk_trains_with_remat_attn():
+    """--loss-chunk composes with --remat --remat-policy attn (the
+    32k-context configuration) and the loss descends."""
+    from tpu_operator.payload import bootstrap, transformer
+
+    args = _lm_args(["--loss-chunk", "32", "--remat",
+                     "--remat-policy", "attn", "--steps", "20",
+                     "--log-every", "0"])
+    info = bootstrap.ProcessInfo("", 0, 1, 0, ())
+    metrics = transformer.run(info, args)
+    assert np.isfinite(metrics["loss"])
+    assert metrics["loss"] < 5.6  # ln(256) = 5.545; synthetic stream learns
+
+
+def test_loss_chunk_rejects_sequence_parallel():
+    from tpu_operator.payload import transformer
+
+    args = _lm_args(["--loss-chunk", "32", "--seq-parallel", "2"])
+    with pytest.raises(ValueError, match="seq-parallel"):
+        transformer.build(args)
+
+
+def test_loss_chunk_must_divide_seq_len():
+    from tpu_operator.payload import transformer
+
+    args = _lm_args(["--loss-chunk", "48"])
+    with pytest.raises(ValueError, match="divide"):
+        transformer.build(args)

@@ -147,27 +147,32 @@ class DecoderBlock(nn.Module):
             qkv = nn.Dense(3 * self.dim, use_bias=False, dtype=self.dtype,
                            name="qkv")(h)
             q, k, v = jnp.split(qkv, 3, axis=-1)
-        q = q.reshape(b, t, self.heads, head_dim)
+        from jax.ad_checkpoint import checkpoint_name
+
+        # Named for remat policies (no-ops otherwise). "attn_block" saves
+        # q/k/v — the flash backward's operands, so their projections are
+        # not re-run — and the post-attention residual, which severs the
+        # block's serial recompute chain: with q/k/v + attn_residual +
+        # the flash residuals resident, the only matmul left to recompute
+        # is mlp_up (mlp_down's output is DCE'd from the backward anyway).
+        q = checkpoint_name(q.reshape(b, t, self.heads, head_dim), "attn_q")
         # K/V stay at kv_heads: every attend implementation is GQA-native
         # (no jnp.repeat — a broadcast here would materialize full-head
         # K/V activations + gradients, forfeiting GQA's bandwidth win).
-        k = k.reshape(b, t, kv_heads, head_dim)
-        v = v.reshape(b, t, kv_heads, head_dim)
+        k = checkpoint_name(k.reshape(b, t, kv_heads, head_dim), "attn_k")
+        v = checkpoint_name(v.reshape(b, t, kv_heads, head_dim), "attn_v")
         out = self.attend(q, k, v)
         out = nn.Dense(self.dim, use_bias=False, dtype=self.dtype,
                        name="attn_out")(out.reshape(b, t, self.dim))
-        x = x + out
+        x = checkpoint_name(x + out, "attn_residual")
         h = nn.LayerNorm(dtype=jnp.float32, name="ln_mlp")(x)
         if self.mlp is not None:
             return x + self.mlp("moe")(h)
         h = nn.Dense(4 * self.dim, dtype=self.dtype, name="mlp_up")(h)
-        # Named for remat policies: "dots" saves matmul outputs but not
-        # the gelu, so mlp_down's backward recomputes the transcendental
-        # over the 4*dim hidden — the widest elementwise in the block.
-        # A save_only_these_names policy can keep it instead
-        # (transformer --remat-policy dots_attn_gelu).
-        from jax.ad_checkpoint import checkpoint_name
-
+        # "dots" saves matmul outputs but not the gelu, so mlp_down's
+        # backward recomputes the transcendental over the 4*dim hidden —
+        # the widest elementwise in the block. A save_only_these_names
+        # policy can keep it instead (--remat-policy dots_attn_gelu).
         h = checkpoint_name(nn.gelu(h), "mlp_gelu")
         h = nn.Dense(self.dim, dtype=self.dtype, name="mlp_down")(h)
         return x + h
@@ -184,7 +189,8 @@ class LinearRegressor(nn.Module):
         return nn.Dense(self.features, dtype=jnp.float32, name="linear")(x)
 
 
-REMAT_POLICIES = ("full", "dots", "dots_attn", "dots_attn_gelu")
+REMAT_POLICIES = ("full", "dots", "dots_attn", "dots_attn_gelu", "attn",
+                  "attn_block")
 
 
 def remat_policy(mode: str):
@@ -196,13 +202,32 @@ def remat_policy(mode: str):
     (output + logsumexp) so attention is not re-run in the backward.
     ``dots_attn_gelu`` additionally saves the MLP gelu output — measured
     slower at the flagship (docs/benchmarks.md negative results) and kept
-    as the documented trade."""
+    as the documented trade. ``attn`` saves ONLY the flash residuals —
+    every block matmul recomputes, but the attention forward (over half
+    the FLOPs at 32k context, quadratic in T) does not: per-layer
+    residency is one [B, T, dim] output + an [B, H, T] logsumexp
+    (~130 MiB/layer at the 32k flagship, vs ~1 GiB/layer for dots_attn
+    whose saved set includes the 4·dim-wide mlp_up) — the long-context
+    policy between ``full`` and ``dots_attn``. On attend paths without
+    the flash kernels (CPU oracle, jnp reference) the names never occur
+    and ``attn`` degrades to ``full``."""
     import jax
 
     if mode not in REMAT_POLICIES:
         raise ValueError(f"unknown remat policy {mode!r}")
     if mode == "full":
         return None
+    if mode == "attn":
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_attn_out", "flash_attn_lse")
+    if mode == "attn_block":
+        # flash residuals + q/k/v + post-attention residual: the backward
+        # recomputes ONLY the mlp_up matmul + gelu (DecoderBlock comment) —
+        # ~3.5x less saved bytes than dots_attn (no 4·dim mlp_up/gelu
+        # stream), ~4x less recompute than "attn".
+        return jax.checkpoint_policies.save_only_these_names(
+            "flash_attn_out", "flash_attn_lse", "attn_q", "attn_k",
+            "attn_v", "attn_residual")
     if mode == "dots":
         return jax.checkpoint_policies.dots_with_no_batch_dims_saveable
     names = ["flash_attn_out", "flash_attn_lse"]
@@ -223,7 +248,13 @@ def add_remat_policy_flag(parser) -> None:
              "not re-run in the backward — the flagship setting); "
              "dots_attn_gelu = dots_attn + the MLP gelu output "
              "(measured slower at the flagship, see "
-             "docs/benchmarks.md negative results)")
+             "docs/benchmarks.md negative results); "
+             "attn = ONLY the flash residuals (block matmuls recompute, "
+             "attention does not — the long-context setting where "
+             "dots_attn's saved set does not fit); "
+             "attn_block = attn + q/k/v + the post-attention residual "
+             "(only mlp_up+gelu recompute; between attn and dots_attn "
+             "in residency)")
 
 
 def resolve_split_qkv(mode: str, tp: int, log) -> bool:

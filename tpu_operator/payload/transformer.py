@@ -82,6 +82,15 @@ def parse_args(argv=None):
                    help="accumulate gradients over K sequential "
                         "microbatches inside the jit (activation-memory "
                         "knob; optimizer sees the full-batch gradient)")
+    p.add_argument("--loss-chunk", type=int, default=0,
+                   help="compute the lm_head matmul + loss in sequence "
+                        "chunks of this many tokens (lax.scan with a "
+                        "checkpointed body): the [B, T, vocab] logits "
+                        "tensor — ~2 GiB at the 32k flagship, plus its "
+                        "cotangent — is never materialized. 0 = off. "
+                        "Must divide --seq-len; single-shard sequence "
+                        "only (under --seq-parallel the logits are "
+                        "already sequence-sharded)")
     p.add_argument("--fsdp", action="store_true",
                    help="ZeRO/FSDP param+optimizer sharding over the data "
                         "axis (train.fsdp_shardings): per-device state "
@@ -210,10 +219,16 @@ def _build_model(args, mesh):
         max_seq: int
 
         @nn.compact
-        def __call__(self, tokens, train: bool = True, positions=None):
+        def __call__(self, tokens, train: bool = True, positions=None,
+                     return_hidden: bool = False):
             # ``positions``: per-slot global position ids (striped layout
             # feeds permuted tokens, so slot index != position); default
-            # natural order.
+            # natural order. ``return_hidden`` skips the lm_head and
+            # returns the post-ln_final hidden states — the chunked-loss
+            # step (train.chunked_next_token_nll) applies the head itself,
+            # chunk by chunk, so the full [B, T, vocab] logits never
+            # materialize. lm_head params exist either way (init runs the
+            # default path).
             _b, t = tokens.shape
             if positions is None:
                 positions = jnp.arange(t)
@@ -227,6 +242,8 @@ def _build_model(args, mesh):
                           split_qkv=split_qkv, kv_heads=kv_heads,
                           name=f"block{i}")(x)
             x = nn.LayerNorm(dtype=jnp.float32, name="ln_final")(x)
+            if return_hidden:
+                return x.astype(jnp.bfloat16)
             return nn.Dense(self.vocab, use_bias=False, dtype=jnp.bfloat16,
                             name="lm_head")(x)
 
@@ -273,7 +290,8 @@ def lm_tp_shardings(mesh, state):
 
 
 def make_lm_train_step(model, tx, mesh, state, shardings=None,
-                       grad_accum: int = 1, sp_layout: str = "contiguous"):
+                       grad_accum: int = 1, sp_layout: str = "contiguous",
+                       loss_chunk: int = 0):
     """Next-token cross-entropy step, jitted with (data, seq) shardings.
 
     ``sp_layout="striped"``: the step still takes *natural-order* token
@@ -281,13 +299,46 @@ def make_lm_train_step(model, tx, mesh, state, shardings=None,
     layout (a [B, T] int32 all-to-all across the seq axis — bytes-wise
     noise), the model runs with explicit position ids, and the loss pairs
     each slot with its true next token. Semantically identical to the
-    contiguous step; only the ring's work balance changes."""
+    contiguous step; only the ring's work balance changes.
+
+    ``loss_chunk > 0``: the lm_head + loss run sequence-chunked
+    (train.chunked_next_token_nll) so the [B, T, vocab] logits are never
+    materialized — the long-context activation-memory lever. Requires an
+    unsharded sequence axis: under sequence parallelism the logits are
+    already T/P-sized per device and chunking a sharded T would reshard
+    every scan slice."""
     import jax
     import jax.numpy as jnp
     from jax.sharding import NamedSharding, PartitionSpec as P
 
     from tpu_operator.payload import ring_attention as ring_mod
     from tpu_operator.payload import train
+
+    if loss_chunk:
+        if mesh.shape.get("seq", 1) > 1:
+            raise ValueError(
+                "--loss-chunk requires --seq-parallel 1: sequence "
+                "parallelism already shards the logits over T")
+        if sp_layout == "striped":
+            raise ValueError(
+                "--loss-chunk with --sp-layout striped is unsupported "
+                "(striped requires --seq-parallel > 1)")
+        if model.max_seq % loss_chunk != 0:
+            raise ValueError(
+                f"--loss-chunk {loss_chunk} must divide --seq-len "
+                f"{model.max_seq}")
+
+        def loss_fn(params, tokens):
+            hidden = model.apply({"params": params}, tokens,
+                                 return_hidden=True)
+            loss = train.chunked_next_token_nll(
+                hidden, params["lm_head"]["kernel"], tokens, loss_chunk)
+            return loss, {"loss": loss}
+
+        return train.make_loss_train_step(loss_fn, tx, mesh, state,
+                                          shardings,
+                                          batch_spec=lm_token_spec(mesh),
+                                          grad_accum=grad_accum)
 
     if sp_layout == "striped":
         seq_shards = mesh.shape.get("seq", 1)
@@ -352,7 +403,8 @@ def build(args, mesh=None, num_slices: int = 1):
     step = make_lm_train_step(model, tx, mesh, state, shardings,
                               grad_accum=getattr(args, "grad_accum", 1),
                               sp_layout=getattr(args, "sp_layout",
-                                                "contiguous"))
+                                                "contiguous"),
+                              loss_chunk=getattr(args, "loss_chunk", 0))
     batches = data_mod.lm_batches(args, mesh=mesh,
                                   spec=lm_token_spec(mesh))
     return mesh, model, state, step, batches
