@@ -602,9 +602,15 @@ def bench_attention(quick: bool) -> list:
     rows = []
 
     def timed_grad(fn, q, k, v, steps):
+        # Differentiate wrt ALL of (q, k, v): a grad wrt q alone lets XLA
+        # dead-code-eliminate the entire dK/dV kernel (pallas_call is
+        # side-effect-free), so the round-4 "fwd_bwd" rows measured only
+        # fwd + dQ — ~55-60% of the real backward. Training always needs
+        # all three.
         loss = jax.jit(jax.grad(
-            lambda q: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2)))
-        return _timed_steps(lambda: loss(q)[0, 0, 0, 0], steps,
+            lambda q, k, v: jnp.sum(fn(q, k, v).astype(jnp.float32) ** 2),
+            argnums=(0, 1, 2)))
+        return _timed_steps(lambda: loss(q, k, v)[0][0, 0, 0, 0], steps,
                             warmup=5, windows=windows)
 
     for t, b, h, d in configs:
@@ -647,18 +653,20 @@ def bench_attention(quick: bool) -> list:
             # hits both arms equally and the speedup separates from
             # noise (VERDICT round-3 item 6).
             kg, vg = mk(h // 4), mk(h // 4)
-            loss_m = jax.jit(jax.grad(lambda q: jnp.sum(
-                flash_fn(q, k, v).astype(jnp.float32) ** 2)))
-            loss_g = jax.jit(jax.grad(lambda q: jnp.sum(
-                flash_fn(q, kg, vg).astype(jnp.float32) ** 2)))
+            # Full grads (see timed_grad): wrt-q-only would DCE the dK/dV
+            # kernel and measure ~60% of the backward.
+            full = lambda q, k, v: jnp.sum(
+                flash_fn(q, k, v).astype(jnp.float32) ** 2)
+            loss_m = jax.jit(lambda q: jax.grad(full, (0, 1, 2))(q, k, v))
+            loss_g = jax.jit(lambda q: jax.grad(full, (0, 1, 2))(q, kg, vg))
 
             def window(loss):
-                jax.device_get(loss(q)[0, 0, 0, 0])  # warm re-entry
+                jax.device_get(loss(q)[0][0, 0, 0, 0])  # warm re-entry
                 t0 = time.perf_counter()
                 v_ = None
                 for _ in range(steps):
                     v_ = loss(q)
-                jax.device_get(v_[0, 0, 0, 0])
+                jax.device_get(v_[0][0, 0, 0, 0])
                 return (time.perf_counter() - t0) / steps
 
             for w in range(2):  # compile+warm both arms

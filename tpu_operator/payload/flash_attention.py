@@ -89,18 +89,40 @@ def _pick_block(t: int, target: int = 512, floor: int = 128) -> int:
 
 
 def _panel_blocks(tq: int, tk: int, group: int, q_budget: int,
-                  area: int, k_cap: int) -> Tuple[int, int]:
+                  area: int, k_cap: int, q_cap: int = 512
+                  ) -> Tuple[int, int]:
     """Shared (blk_q, blk_k) selection for all three kernel families:
-    blk_q targets ``q_budget // group`` flattened rows, then blk_k fills
-    the f32 score-panel area budget ``area`` up to ``k_cap``. The three
-    callers differ only in budgets — one definition so a resweep cannot
-    desynchronize them."""
+    blk_q targets ``q_budget // group`` flattened rows (capped at
+    ``q_cap``), then blk_k fills the f32 score-panel area budget ``area``
+    up to ``k_cap``. The three callers differ only in budgets — one
+    definition so a resweep cannot desynchronize them."""
     floor = 64 if group > 8 else 128
-    blk_q = _pick_block(tq, target=max(floor, min(512, q_budget // group)),
+    blk_q = _pick_block(tq, target=max(floor, min(q_cap, q_budget // group)),
                         floor=floor)
     flat = group * blk_q
     blk_k = _pick_block(tk, target=max(128, min(k_cap, area // flat)))
     return blk_q, blk_k
+
+
+def _blocks_override(env: str, tq: int, tk: int) -> Optional[Tuple[int, int]]:
+    """Sweep hook shared by the block pickers: ``env`` = "blk_q,blk_k"
+    overrides the heuristic. Read at trace time (like
+    TPU_OPERATOR_PALLAS) — a resweep runs one fresh process per
+    candidate. A non-dividing override RAISES instead of falling through:
+    the hook's only consumer is sweeps, and silently running the
+    heuristic blocks would record a time under the wrong label —
+    corrupting exactly the measurements the default budgets are derived
+    from."""
+    import os
+
+    override = os.environ.get(env, "")
+    if override:
+        bq, bk = (int(x) for x in override.split(","))
+        if tq % bq != 0 or tk % bk != 0:
+            raise ValueError(
+                f"{env}={override} does not divide (tq={tq}, tk={tk})")
+        return bq, bk
+    return None
 
 
 def _fwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
@@ -111,9 +133,17 @@ def _fwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
     ranking): MHA (512,1024) 4.02 ms beats (512,512) 4.14 and (256,512)
     5.26; GQA kv4 (256,1024) 2.77 ms beats (256,512) 3.17 and (512,512)
     3.19. (512,1024) at group 4 (8 MB panel) fails to compile — the area
-    cap is the compile-feasibility boundary, not taste."""
+    cap is the compile-feasibility boundary, not taste. Round-5 resweep:
+    MHA prefers the full (1024,1024) panel — 40.3 vs 43.7 ms at T32768,
+    2.93 vs 3.02 at T2048 — so the q-cap is 1024 (only group 1 reaches
+    it; GQA shapes keep their round-4 optima, and (1024,*) at group 4
+    does not compile). ``TPU_OPERATOR_FWD_BLOCKS=q,k`` overrides
+    (sweep hook)."""
+    override = _blocks_override("TPU_OPERATOR_FWD_BLOCKS", tq, tk)
+    if override:
+        return override
     return _panel_blocks(tq, tk, group, q_budget=1024,
-                         area=1024 * 1024, k_cap=1024)
+                         area=1024 * 1024, k_cap=1024, q_cap=1024)
 
 
 def _merge_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
@@ -130,11 +160,21 @@ def _merge_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
 def _bwd_blocks(tq: int, tk: int, group: int) -> Tuple[int, int]:
     """Backward kernel blocks: three [group*blk_q, blk_k] f32 panels
     (P, dP, dS) live at once — half the forward's q rows. Swept at steady
-    state (same method as :func:`_fwd_blocks`): MHA (512,1024) 10.90 ms
-    fwd+bwd beats (512,512) 11.82; GQA kv4 (128,1024) 9.33 ms beats the
-    pre-round-4 (128,512) 9.88."""
-    return _panel_blocks(tq, tk, group, q_budget=512,
-                         area=512 * 1024, k_cap=1024)
+    state with the FULL backward — grad wrt (q, k, v), both kernels live:
+    the round-4 sweep differentiated wrt q only, which let XLA dead-code-
+    eliminate the dK/dV kernel entirely and tuned blocks for half the
+    backward. Round-5 full-grad sweep (median of 3 long windows): GQA kv4
+    (512,512) wins at BOTH T2048 (7.85 ms vs 8.55 at the old 128,1024)
+    and T32768 (139.2 vs 147.2); MHA keeps (512,1024) (9.87/152.2 ms —
+    its 12 MB group-4 panel equivalent (512,1024) does not compile at
+    group 4). The q_budget 2048 / area 1024x1024 pair lands exactly
+    those per group. ``TPU_OPERATOR_BWD_BLOCKS=q,k`` overrides both
+    (sweep hook; read at trace time, like TPU_OPERATOR_PALLAS)."""
+    override = _blocks_override("TPU_OPERATOR_BWD_BLOCKS", tq, tk)
+    if override:
+        return override
+    return _panel_blocks(tq, tk, group, q_budget=2048,
+                         area=1024 * 1024, k_cap=1024)
 
 
 def _group_of(q: jnp.ndarray, k: jnp.ndarray) -> int:
@@ -544,22 +584,37 @@ def _logsumexp_rows(l: jnp.ndarray, m: jnp.ndarray) -> jnp.ndarray:
                      m + jnp.log(jnp.maximum(l, 1e-30)), 0.0)
 
 
-def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
-                   stride, causal: bool, scale: float, group: int):
+def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, o_ref, q_lo, k_lo,
+                   stride, causal: bool, scale: float, group: int,
+                   fused_d: bool):
     """The shared per-tile backward recurrence: recompute this tile's
     probabilities from Q/K and the forward's logsumexp, then
-    dS = P (dP - D). Both backward kernels build their accumulations from
-    this one definition so the recurrence cannot desynchronize between
-    dQ and dK/dV. q/g/L/D arrive group-deep and leave flattened to
-    [group*blk_q, ·] panels. Matmuls run on the inputs' native dtype with
-    f32 accumulation — bf16 training inputs take the full-rate MXU path;
-    f32 (test) inputs keep full-precision matmuls."""
+    dS = P (dP - D). ``fused_d``: D = rowsum(dO * O) is computed
+    IN-KERNEL from the forward output block — O streams through the same
+    q-indexed BlockSpec D used to, and the separate XLA pass that
+    materialized D (one full read of dO and O per invocation) is gone;
+    the rowsum is VPU noise (rows x D MACs) next to the tile matmuls.
+    With ``fused_d=False``, ``o_ref`` is the precomputed [.., blk_q, 1]
+    D block instead — the backward ring's path, which reuses one D
+    across every ring step rather than re-streaming the full [B,H,T,D]
+    output each step. Both backward kernels build their accumulations
+    from this one definition so the recurrence cannot desynchronize
+    between dQ and dK/dV. q/g/L/O arrive group-deep and leave flattened
+    to [group*blk_q, ·] panels. Matmuls run on the inputs' native dtype
+    with f32 accumulation — bf16 training inputs take the full-rate MXU
+    path; f32 (test) inputs keep full-precision matmuls."""
     blk_q = q_ref.shape[2]
     rows = group * blk_q
     q = q_ref[0].reshape(rows, -1)
     k_blk = k_ref[0, 0]
     v_blk = v_ref[0, 0]
     g = g_ref[0].reshape(rows, -1)
+    if fused_d:
+        o = o_ref[0].reshape(rows, -1)
+        d_row = jnp.sum(g.astype(jnp.float32) * o.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+    else:
+        d_row = o_ref[0].reshape(rows, 1)
     s = lax.dot_general(q, k_blk, (((1,), (1,)), ((), ())),
                         preferred_element_type=jnp.float32) * scale
     if causal:
@@ -567,14 +622,18 @@ def _bwd_tile_p_ds(q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo,
     p = jnp.exp(s - L_ref[0].reshape(rows, 1))            # [rows, blk_k]
     dp = lax.dot_general(g, v_blk, (((1,), (1,)), ((), ())),
                          preferred_element_type=jnp.float32)
-    ds = p * (dp - D_ref[0].reshape(rows, 1))
+    ds = p * (dp - d_row)
     return q, k_blk, g, p, ds
 
 
-def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
-                   dq_out, *, causal: bool, scale: float, group: int):
-    """dQ for one (batch, kv-head, q-block) — k-tiles innermost so the dq
-    output block revisits its index and accumulates in VMEM."""
+def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, o_ref,
+                   dq_out, acc_scr, *, causal: bool, scale: float,
+                   group: int, nk: int, fused_d: bool):
+    """dQ for one (batch, kv-head, q-block) — k-tiles innermost; the
+    accumulator lives in f32 VMEM scratch and the output block is written
+    once, at the last k-tile, cast to the requested gradient dtype (bf16
+    in training): the f32 [B, H, T, D] HBM round-trip plus the separate
+    downstream cast of the old output-block accumulation never happen."""
     blk_q = q_ref.shape[2]
     blk_k = k_ref.shape[2]
     iq = pl.program_id(2)
@@ -585,27 +644,33 @@ def _bwd_dq_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
 
     @pl.when(ik == 0)
     def _zero():
-        dq_out[...] = jnp.zeros_like(dq_out)
+        acc_scr[...] = jnp.zeros_like(acc_scr)
 
     @pl.when(jnp.logical_or(not causal,
                             q_lo + stride * (blk_q - 1) >= k_lo))
     def _acc():
         _q, k_blk, _g, _p, ds = _bwd_tile_p_ds(
-            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
-            causal, scale, group)
-        dq = scale * lax.dot_general(
+            q_ref, k_ref, v_ref, g_ref, L_ref, o_ref, q_lo, k_lo, stride,
+            causal, scale, group, fused_d)
+        acc_scr[...] += scale * lax.dot_general(
             ds.astype(k_blk.dtype), k_blk, (((1,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
-        dq_out[0] += dq.reshape(group, blk_q, -1)
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        dq_out[0] = acc_scr[...].reshape(group, blk_q, -1).astype(
+            dq_out.dtype)
 
 
-def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
-                    dk_out, dv_out, *, causal: bool, scale: float,
-                    group: int):
-    """dK/dV for one (batch, kv-head, k-block) — q-tiles innermost so both
-    output blocks accumulate in VMEM. The flattened [group*blk_q, blk_k]
-    P/dS panels contract over their row dim, so each matmul already sums
-    the whole query-head group into the KV-sized output."""
+def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, o_ref,
+                    dk_out, dv_out, dk_scr, dv_scr, *, causal: bool,
+                    scale: float, group: int, nq: int, fused_d: bool):
+    """dK/dV for one (batch, kv-head, k-block) — q-tiles innermost; both
+    accumulators ride f32 VMEM scratch and emit once at the last q-tile
+    (cast to the gradient dtype), like the dq kernel. The flattened
+    [group*blk_q, blk_k] P/dS panels contract over their row dim, so each
+    matmul already sums the whole query-head group into the KV-sized
+    output."""
     blk_q = q_ref.shape[2]
     blk_k = k_ref.shape[2]
     ik = pl.program_id(2)
@@ -616,36 +681,44 @@ def _bwd_dkv_kernel(offs_ref, q_ref, k_ref, v_ref, g_ref, L_ref, D_ref,
 
     @pl.when(iq == 0)
     def _zero():
-        dk_out[...] = jnp.zeros_like(dk_out)
-        dv_out[...] = jnp.zeros_like(dv_out)
+        dk_scr[...] = jnp.zeros_like(dk_scr)
+        dv_scr[...] = jnp.zeros_like(dv_scr)
 
     @pl.when(jnp.logical_or(not causal,
                             q_lo + stride * (blk_q - 1) >= k_lo))
     def _acc():
         q, _k, g, p, ds = _bwd_tile_p_ds(
-            q_ref, k_ref, v_ref, g_ref, L_ref, D_ref, q_lo, k_lo, stride,
-            causal, scale, group)
+            q_ref, k_ref, v_ref, g_ref, L_ref, o_ref, q_lo, k_lo, stride,
+            causal, scale, group, fused_d)
         # dV += P^T dO (rows contract: sums over q-slots and the group)
-        dv_out[0, 0] += lax.dot_general(
+        dv_scr[...] += lax.dot_general(
             p.astype(g.dtype), g, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
         # dK += dS^T Q
-        dk_out[0, 0] += scale * lax.dot_general(
+        dk_scr[...] += scale * lax.dot_general(
             ds.astype(q.dtype), q, (((0,), (0,)), ((), ())),
             preferred_element_type=jnp.float32)
 
+    @pl.when(iq == nq - 1)
+    def _emit():
+        dk_out[0, 0] = dk_scr[...].astype(dk_out.dtype)
+        dv_out[0, 0] = dv_scr[...].astype(dv_out.dtype)
 
-def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
+
+def _bwd_pallas(q, k, v, g, L, d_or_o, offsets, causal: bool,
+                interpret: bool, grad_dtype, fused_d: bool):
     b, hq, tq, d = q.shape
     hkv, tk = k.shape[1], k.shape[2]
     group = _group_of(q, k)
     blk_q, blk_k = _bwd_blocks(tq, tk, group)
     scale = d ** -0.5
+    d_width = d if fused_d else 1  # O blocks when fused, D rows when not
 
     def q_map(ib, ih, iq, ik, offs):
         return (ib, ih, iq, 0)
 
     nq, nk = tq // blk_q, tk // blk_k
+    rows = group * blk_q
 
     if causal:
         def k_map(ib, ih, iq, ik, offs):
@@ -671,18 +744,20 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     kv_spec = pl.BlockSpec((1, 1, blk_k, d), k_map)
     row_spec = pl.BlockSpec((1, group, blk_q, 1), q_map)
 
+    do_spec = pl.BlockSpec((1, group, blk_q, d_width), q_map)
     dq = pl.pallas_call(
         functools.partial(_bwd_dq_kernel, causal=causal, scale=scale,
-                          group=group),
+                          group=group, nk=nk, fused_d=fused_d),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, hkv, tq // blk_q, tk // blk_k),
-            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, row_spec],
+            in_specs=[q_spec, kv_spec, kv_spec, q_spec, row_spec, do_spec],
             out_specs=[q_spec],
+            scratch_shapes=[pltpu.VMEM((rows, d), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct(q.shape, jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(q.shape, grad_dtype)],
         interpret=interpret,
-    )(offsets, q, k, v, g, L, D)[0]
+    )(offsets, q, k, v, g, L, d_or_o)[0]
 
     # dkv grid transposes the block roles: k-blocks outer, q-tiles inner.
     if causal:
@@ -707,20 +782,23 @@ def _bwd_pallas(q, k, v, g, L, D, offsets, causal: bool, interpret: bool):
     kvT_spec = pl.BlockSpec((1, 1, blk_k, d), kT_map)
     rowT_spec = pl.BlockSpec((1, group, blk_q, 1), qT_map)
 
+    doT_spec = pl.BlockSpec((1, group, blk_q, d_width), qT_map)
     dk, dv = pl.pallas_call(
         functools.partial(_bwd_dkv_kernel, causal=causal, scale=scale,
-                          group=group),
+                          group=group, nq=nq, fused_d=fused_d),
         grid_spec=pltpu.PrefetchScalarGridSpec(
             num_scalar_prefetch=1,
             grid=(b, hkv, tk // blk_k, tq // blk_q),
             in_specs=[qT_spec, kvT_spec, kvT_spec, qT_spec, rowT_spec,
-                      rowT_spec],
+                      doT_spec],
             out_specs=[kvT_spec, kvT_spec],
+            scratch_shapes=[pltpu.VMEM((blk_k, d), jnp.float32),
+                            pltpu.VMEM((blk_k, d), jnp.float32)],
         ),
-        out_shape=[jax.ShapeDtypeStruct(k.shape, jnp.float32),
-                   jax.ShapeDtypeStruct(v.shape, jnp.float32)],
+        out_shape=[jax.ShapeDtypeStruct(k.shape, grad_dtype),
+                   jax.ShapeDtypeStruct(v.shape, grad_dtype)],
         interpret=interpret,
-    )(offsets, q, k, v, g, L, D)
+    )(offsets, q, k, v, g, L, d_or_o)
     return dq, dk, dv
 
 
@@ -748,13 +826,25 @@ def _bwd_ref(q, k, v, g, L, D, offsets, causal: bool):
     return dq.reshape(b, hq, tq, d), dk, dv
 
 
-def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
-                          use_pallas: Optional[bool] = None):
-    """(dq, dk, dv) f32 contributions of one K/V block to the gradients,
-    given the *global* row logsumexp ``L`` and ``D = rowsum(dO * O)`` —
-    the building block of both the single-shard fused backward and the
-    backward ring (ring_attention.py). Blocks are [B, H, T, D]; K/V may
-    carry fewer (grouped) heads, and dk/dv come back at that KV size."""
+def attention_block_grads(q, k, v, g, L, out, offsets, *,
+                          causal: bool = True,
+                          use_pallas: Optional[bool] = None,
+                          grad_dtype=jnp.float32, D=None):
+    """(dq, dk, dv) contributions of one K/V block to the gradients,
+    given the *global* row logsumexp ``L`` and the forward output ``out``
+    — the building block of both the single-shard fused backward and the
+    backward ring (ring_attention.py). By default ``D = rowsum(dO * O)``
+    is fused into the kernels (computed per tile from the streamed dO/O
+    blocks), so no separate pass materializes it. Callers that invoke
+    this repeatedly with the SAME dO/O (the backward ring — one call per
+    ring step) pass a precomputed ``D`` instead: the kernels then stream
+    the [B, H, T, 1] D rows rather than re-reading the full [B, H, T, D]
+    output every step. Blocks are [B, H, T, D]; K/V may carry fewer
+    (grouped) heads, and dk/dv come back at that KV size. ``grad_dtype``:
+    f32 (default) for callers that accumulate contributions (the ring);
+    the single-shard path passes the input dtype so the kernels emit
+    bf16 directly from their f32 VMEM accumulators — no f32 HBM
+    round-trip + downstream cast."""
     offsets = _normalize_offsets(offsets)
     if use_pallas is None:
         use_pallas = use_pallas_default()
@@ -762,9 +852,18 @@ def attention_block_grads(q, k, v, g, L, D, offsets, *, causal: bool = True,
                            and _kernel_feasible(k.shape[2])):
         use_pallas = False
     if not use_pallas:
-        return _bwd_ref(q, k, v, g, L, D, offsets, causal)
+        if D is None:
+            D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
+                        axis=-1, keepdims=True)
+        dq, dk, dv = _bwd_ref(q, k, v, g, L, D, offsets, causal)
+        return (dq.astype(grad_dtype), dk.astype(grad_dtype),
+                dv.astype(grad_dtype))
     interpret = jax.default_backend() != "tpu"
-    return _bwd_pallas(q, k, v, g, L, D, offsets, causal, interpret)
+    if D is not None:
+        return _bwd_pallas(q, k, v, g, L, D, offsets, causal, interpret,
+                           grad_dtype, fused_d=False)
+    return _bwd_pallas(q, k, v, g, L, out, offsets, causal, interpret,
+                       grad_dtype, fused_d=True)
 
 
 def _attn_impl(causal, use_pallas, q, k, v):
@@ -803,12 +902,13 @@ def _attn_fwd(causal, use_pallas, q, k, v):
 
 def _attn_bwd(causal, use_pallas, residuals, g):
     q, k, v, out, L = residuals
-    D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
-                axis=-1, keepdims=True)
-    dq, dk, dv = attention_block_grads(
-        q, k, v, g, L, D, jnp.zeros((2,), jnp.int32),
-        causal=causal, use_pallas=use_pallas)
-    return dq.astype(q.dtype), dk.astype(k.dtype), dv.astype(v.dtype)
+    # grad_dtype = the input dtype: the kernels cast their f32 VMEM
+    # accumulators on emission, so bf16 training grads never round-trip
+    # HBM as f32. (Same value as the old downstream .astype — the
+    # accumulation itself stays f32 either way.)
+    return attention_block_grads(
+        q, k, v, g, L, out, jnp.zeros((2,), jnp.int32),
+        causal=causal, use_pallas=use_pallas, grad_dtype=q.dtype)
 
 
 _attn.defvjp(_attn_fwd, _attn_bwd)

@@ -179,13 +179,18 @@ def _ring_local_bwd(axis_name, causal, use_pallas, stripe, residuals, g):
     tq, tk = qt.shape[2], kt.shape[2]
     idx, offsets = _ring_offsets_fn(axis_name, tq, tk, stripe)
     perm = [(j, (j + 1) % axis_size) for j in range(axis_size)]
+    # D precomputed ONCE per backward and reused across every ring step:
+    # the fused-D kernel path would re-stream the full [B, H, T, D]
+    # output through both kernels at each step, where these [B, H, T, 1]
+    # rows ride a d=1 BlockSpec. Grads stay f32 — they accumulate across
+    # ring steps below.
     D = jnp.sum(g.astype(jnp.float32) * out.astype(jnp.float32),
                 axis=-1, keepdims=True)
 
     def block_grads(k_cur, v_cur, kv_idx):
-        return fa.attention_block_grads(qt, k_cur, v_cur, g, L, D,
+        return fa.attention_block_grads(qt, k_cur, v_cur, g, L, out,
                                         offsets(kv_idx), causal=causal,
-                                        use_pallas=use_pallas)
+                                        use_pallas=use_pallas, D=D)
 
     # Home block first (mirrors the forward), then rotate K/V together
     # with their f32 gradient accumulators so each block's dK/dV ride
