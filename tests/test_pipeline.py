@@ -511,3 +511,37 @@ def test_interleaved_v1_default_works():
     _, m_i = step_i(st_i, dev)
     _, m_p = step_p(st_p, dev)
     assert abs(float(m_i["loss"]) - float(m_p["loss"])) < 2e-5
+
+
+def test_crossover_tool_calibration_reproduces_measurements(monkeypatch):
+    """hack/pipeline_crossover.py: the (rho, m0) calibration must exactly
+    reproduce both measured S=1 rows by construction, rho must land in
+    (0, 1) (a recompute fraction), and the projection must respect the
+    two structural facts the schedules guarantee: at matched (S, M),
+    interleaved V=2 strictly shrinks the bubble term but adds ticks, and
+    plain 1F1B wall time is monotone non-increasing in M (bigger M =
+    smaller bubble fraction at fixed machinery-per-activation)."""
+    import pathlib
+
+    monkeypatch.syspath_prepend(
+        str(pathlib.Path(__file__).resolve().parent.parent / "hack"))
+    import pipeline_crossover as pc
+
+    dense, plain, inter, m0_batch = 327.4, 393.8, 418.0, 4
+    rho, m0 = pc.calibrate(dense, plain, inter, m0_batch)
+    assert 0.0 < rho < 1.0
+    assert m0 > 0.0
+    got_p = pc.simulate("plain", 1, 1, m0_batch, dense, rho, m0, m0_batch)
+    got_i = pc.simulate("interleaved", 1, 2, m0_batch, dense, rho, m0,
+                        m0_batch)
+    assert abs(got_p - plain) < 0.1, (got_p, plain)
+    assert abs(got_i - inter) < 0.1, (got_i, inter)
+    # bubble-dominated corner (M == S): interleaving projected to win
+    assert pc.simulate("interleaved", 4, 2, 4, dense, rho, m0, m0_batch) \
+        < pc.simulate("plain", 4, 1, 4, dense, rho, m0, m0_batch)
+    # machinery-dominated corner (M >> S): plain projected to win
+    assert pc.simulate("plain", 4, 1, 32, dense, rho, m0, m0_batch) \
+        < pc.simulate("interleaved", 4, 2, 32, dense, rho, m0, m0_batch)
+    walls = [pc.simulate("plain", 4, 1, m, dense, rho, m0, m0_batch)
+             for m in (4, 8, 16, 32)]
+    assert all(a >= b for a, b in zip(walls, walls[1:])), walls
