@@ -13,6 +13,11 @@ python hack/check_payload_image.py
 python hack/gen_lock.py --check
 python hack/gen_crd.py --check
 python hack/package_chart.py --check
-python -m pytest tests/ -x -q
+# Standalone observability gate: every /metrics line must parse as valid
+# Prometheus exposition format (HELP/TYPE, label escaping, bucket
+# monotonicity, _sum/_count consistency) with deterministic-clock
+# histograms — run first so a telemetry regression fails fast and alone.
+python -m pytest tests/test_metrics_conformance.py -x -q
+python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

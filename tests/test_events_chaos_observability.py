@@ -1,0 +1,87 @@
+"""Satellite observability: the event-dedup cache is bounded, and chaos
+kills are attributable (Event + counter) instead of silent."""
+
+import random
+
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.controller.chaos import ChaosMonkey
+from tpu_operator.controller.events import EventRecorder
+from tpu_operator.controller.statusserver import Metrics
+
+
+class Obj:
+    def __init__(self, name, namespace="default"):
+        self.name = name
+        self.namespace = namespace
+        self.metadata = {"name": name, "namespace": namespace, "uid": f"u-{name}"}
+
+
+def test_event_seen_cache_lru_bounded():
+    cs, metrics = FakeClientset(), Metrics()
+    rec = EventRecorder(cs, seen_cap=2, metrics=metrics)
+    for i in range(4):
+        rec.event(Obj(f"job{i}"), "Normal", "Reason", "msg")
+    assert len(rec._seen) == 2
+    snap = metrics.snapshot()
+    assert snap["events_emitted_total"] == 4
+    assert snap["events_pruned_total"] == 2
+    # evicted entry re-records as a fresh Event instead of crashing
+    rec.event(Obj("job0"), "Normal", "Reason", "msg")
+    assert len(rec._seen) == 2
+
+
+def test_event_aggregation_counts_and_forget_object():
+    cs, metrics = FakeClientset(), Metrics()
+    rec = EventRecorder(cs, metrics=metrics)
+    job = Obj("agg")
+    rec.event(job, "Normal", "Reason", "same msg")
+    rec.event(job, "Normal", "Reason", "same msg")
+    (ev,) = cs.events.list("default")
+    assert ev["count"] == 2
+    snap = metrics.snapshot()
+    assert snap["events_emitted_total"] == 2
+    assert snap["events_aggregated_total"] == 1
+    # object deleted → its dedup entries prune, counted
+    assert rec.forget_object("default", "agg") == 1
+    assert metrics.snapshot()["events_pruned_total"] == 1
+    assert not rec._seen
+
+
+def test_chaos_kill_records_event_and_counter():
+    cs, metrics = FakeClientset(), Metrics()
+    rec = EventRecorder(cs, metrics=metrics)
+    cs.pods.create("default", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {
+            "name": "victim", "namespace": "default",
+            "labels": {"tpuoperator.dev": ""},
+            "ownerReferences": [{"kind": "TPUJob", "name": "myjob",
+                                 "uid": "u-1", "controller": True}],
+        },
+        "status": {"phase": "Running"},
+    })
+    monkey = ChaosMonkey(cs, "default", level=0, rng=random.Random(0),
+                         recorder=rec, metrics=metrics)
+    assert monkey.kill_once() == 1
+    assert metrics.snapshot()["chaos_kills_total"] == 1
+    events = cs.events.list("default")
+    kill_events = [e for e in events if e["reason"] == "ChaosPodKill"]
+    assert kill_events, events
+    ev = kill_events[0]
+    assert ev["involvedObject"]["name"] == "myjob"
+    assert ev["involvedObject"]["kind"] == "TPUJob"
+    assert "victim" in ev["message"]
+
+
+def test_chaos_without_recorder_still_counts():
+    cs, metrics = FakeClientset(), Metrics()
+    cs.pods.create("default", {
+        "apiVersion": "v1", "kind": "Pod",
+        "metadata": {"name": "p", "namespace": "default",
+                     "labels": {"tpuoperator.dev": ""}},
+        "status": {"phase": "Running"},
+    })
+    monkey = ChaosMonkey(cs, "default", level=0, rng=random.Random(0),
+                         metrics=metrics)
+    assert monkey.kill_once() == 1
+    assert metrics.snapshot()["chaos_kills_total"] == 1

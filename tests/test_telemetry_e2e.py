@@ -1,0 +1,389 @@
+"""End-to-end telemetry: payload heartbeat → CRD status → rollup → metrics,
+plus reconcile trace IDs in spans and log records.
+
+The operator runs in-process against the HTTP test apiserver (real REST
+client, real informers, real status-subresource schema admission — so the
+new ``status.phaseTimeline``/``status.lastHeartbeat`` fields prove they
+pass a strict structural schema), while a simulated payload posts step
+heartbeats exactly the way payload/heartbeat.py does in a pod.
+"""
+
+import json
+import logging
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.util import tracing
+
+
+def wait_for(pred, timeout=20.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def worker_job(name, replicas=1):
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicaSpecs": [{
+            "replicas": replicas, "tpuReplicaType": "WORKER",
+            "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu",
+                                                  "image": "x"}]}}}]},
+    }
+
+
+@pytest.fixture()
+def harness():
+    tracing.clear_spans()
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    # interval 0: persist every heartbeat immediately (the coalescing path
+    # has its own test below)
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def _run_job(api, cs, name):
+    cs.tpujobs.create("default", worker_job(name))
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) >= 1)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", name)
+                    .get("status", {}).get("phase") == "Running")
+
+
+def test_heartbeat_flows_to_status_rollup_and_metrics(harness):
+    api, cs, controller, server = harness
+    _run_job(api, cs, "hb")
+
+    # simulated payload: process 0 posts through the real reporter with the
+    # env contract the operator injects into pods
+    reporter = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "hb", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "0", "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=2048)
+    assert reporter is not None
+    assert reporter.report(10, {"loss": 3.25})
+
+    # → CRD status via the operator's normal write-back (strict schema!)
+    assert wait_for(lambda: (cs.tpujobs.get("default", "hb")
+                             .get("status", {}).get("lastHeartbeat")
+                             or {}).get("step") == 10)
+    status = cs.tpujobs.get("default", "hb")["status"]
+    assert status["lastHeartbeat"]["loss"] == 3.25
+    assert status["lastHeartbeat"]["time"]
+
+    # phase timeline recorded Creating and Running, in order
+    timeline = status["phaseTimeline"]
+    assert set(timeline) >= {"Creating", "Running"}
+    assert timeline["Creating"] <= timeline["Running"]
+
+    # → /api/jobs rollup with derived durations
+    jobs = json.loads(get(server.port, "/api/jobs"))
+    (job,) = [j for j in jobs if j["name"] == "hb"]
+    assert job["lastHeartbeat"]["step"] == 10
+    assert "receivedAt" not in job["lastHeartbeat"]  # internal field
+    assert job["phaseTimeline"]["Running"]
+    assert job["durations"]["timeToRunningSeconds"] >= 0
+
+    # → per-job gauges in /metrics
+    body = get(server.port, "/metrics")
+    assert ('tpu_operator_job_last_step{name="hb",namespace="default"} 10'
+            in body)
+    assert "tpu_operator_heartbeats_total 1" in body
+    assert "tpu_operator_job_last_heartbeat_timestamp_seconds" in body
+
+    # a second report carries derived step-time/tokens-per-sec
+    reporter._clock = lambda: time.monotonic()  # keep real clock monotonic
+    assert reporter.report(20, {"loss": 3.0})
+    assert wait_for(lambda: (cs.tpujobs.get("default", "hb")
+                             .get("status", {}).get("lastHeartbeat")
+                             or {}).get("step") == 20)
+
+    # negative loss is legal (some objectives); only loss is unbounded
+    ok, _ = server.record_heartbeat({"namespace": "default", "name": "hb",
+                                     "loss": -0.5})
+    assert ok
+
+    # failover: a fresh server (empty in-memory map) still emits the gauge,
+    # seeded from persisted status.lastHeartbeat — stale, not absent
+    failover = StatusServer(0, metrics=controller.metrics)
+    failover.start()
+    try:
+        failover.set_controller(controller)
+        body = get(failover.port, "/metrics")
+        assert 'tpu_operator_job_last_step{name="hb",namespace="default"}' \
+            in body
+        assert "tpu_operator_job_last_heartbeat_timestamp_seconds" in body
+    finally:
+        failover.stop()
+
+
+def test_heartbeat_rejects_garbage(harness):
+    _api, _cs, _controller, server = harness
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/heartbeat",
+        data=b"not json", headers={"Content-Type": "application/json"},
+        method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(req, timeout=5)
+    assert ei.value.code == 400
+    ok, msg = server.record_heartbeat({"namespace": "default"})
+    assert not ok and "name" in msg
+    ok, msg = server.record_heartbeat({"name": "x", "step": "NaN-ish"})
+    assert not ok
+    # non-finite floats would poison CRD status JSON on a real apiserver
+    ok, msg = server.record_heartbeat({"name": "x", "loss": float("nan")})
+    assert not ok and "non-finite" in msg
+    ok, msg = server.record_heartbeat({"name": "x",
+                                       "tokensPerSec": float("inf")})
+    assert not ok
+    # negatives violate the CRD's minimum: 0 and would wedge status writes
+    ok, msg = server.record_heartbeat({"name": "x", "step": -1})
+    assert not ok and "negative" in msg
+    # a heartbeat for a job the informer doesn't know is an error, not a
+    # silent 200 — the payload's log must surface the misconfig
+    ok, msg = server.record_heartbeat({"name": "x", "step": 1})
+    assert not ok and "unknown job" in msg
+    # a standby (no controller) must not blackhole heartbeats with a 200 —
+    # 503 tells the payload to retry (and hit the leader next interval)
+    solo = StatusServer(0)
+    try:
+        ok, msg = solo.record_heartbeat({"name": "x", "loss": -0.5})
+        assert not ok and msg.startswith("standby")
+    finally:
+        solo.server.server_close()
+    # oversized bodies are rejected before buffering
+    big = urllib.request.Request(
+        f"http://127.0.0.1:{server.port}/api/heartbeat",
+        data=b"x" * (65 * 1024),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(big, timeout=5)
+    assert ei.value.code == 413
+    # bad ?limit= on the traces endpoint is a client error, not a 500
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/api/traces?limit=abc", timeout=5)
+    assert ei.value.code == 400
+    # a diverged payload still heartbeats, minus the loss field
+    posts = []
+    r = heartbeat_mod.HeartbeatReporter(
+        "http://x:1", "j", poster=lambda _u, b: posts.append(b),
+        clock=lambda: 0.0)
+    assert r.report(1, {"loss": float("nan")})
+    assert "loss" not in posts[0]
+
+
+def test_reconcile_traces_and_log_tagging(harness):
+    api, cs, _controller, server = harness
+    _run_job(api, cs, "traced")
+
+    spans = json.loads(get(server.port, "/api/traces"))["spans"]
+    reconciles = [s for s in spans if s["name"] == "reconcile"]
+    assert reconciles, spans
+    root = reconciles[0]
+    assert root["traceId"] and root["parentId"] == ""
+    assert root["attrs"]["key"] == "default/traced"
+    # nested @traced children share the root's trace id
+    children = [s for s in spans
+                if s["traceId"] == root["traceId"] and s is not root]
+    assert any(s["name"].endswith("reconcile") or "sync" in s["name"]
+               or "training" in s["name"] for s in children), spans
+    for child in children:
+        assert child["parentId"], child
+
+    # ?limit= caps the response
+    limited = json.loads(get(server.port, "/api/traces?limit=2"))["spans"]
+    assert len(limited) == 2
+
+
+def test_log_records_carry_trace_id():
+    tracing.clear_spans()
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    handler = Capture()
+    handler.addFilter(tracing._FilenameFilter())
+    logger = logging.getLogger("test.trace.tag")
+    logger.addHandler(handler)
+    logger.setLevel(logging.INFO)
+    try:
+        with tracing.span("reconcile", key="ns/job") as sp:
+            logger.info("inside")
+        logger.info("outside")
+    finally:
+        logger.removeHandler(handler)
+    inside, outside = records
+    assert inside.trace_id == sp.trace_id
+    assert f"trace={sp.trace_id} " == inside.trace_tag
+    assert outside.trace_id == "" and outside.trace_tag == ""
+
+
+def test_trace_flag_enter_exit_stream_still_works(caplog):
+    tracing.enable(True)
+    try:
+        with caplog.at_level(logging.INFO, logger="tpu_operator.trace"):
+            with tracing.span("outer"):
+                with tracing.span("inner"):
+                    pass
+    finally:
+        tracing.enable(False)
+    text = caplog.text
+    assert "[0]ENTER: outer" in text
+    assert "[1]ENTER: inner" in text
+    assert "[1]EXIT:  inner" in text
+    assert "[0]EXIT:  outer" in text
+
+
+def test_span_ring_buffer_bounded():
+    tracing.configure(span_buffer=8)
+    try:
+        for i in range(50):
+            with tracing.span(f"s{i}"):
+                pass
+        spans = tracing.recent_spans()
+        assert len(spans) == 8
+        assert spans[0]["name"] == "s49"  # newest first
+    finally:
+        tracing.configure(span_buffer=tracing.DEFAULT_SPAN_BUFFER)
+        tracing.clear_spans()
+
+
+def test_heartbeat_reporter_rate_limit_and_failure_isolation():
+    clock_now = [0.0]
+    posts = []
+
+    def poster(url, body):
+        posts.append((url, dict(body)))
+
+    r = heartbeat_mod.HeartbeatReporter(
+        "http://x:1", "job", interval=10.0, tokens_per_batch=100,
+        clock=lambda: clock_now[0], poster=poster)
+    assert r.maybe_report(1, {"loss": 1.0})
+    assert not r.maybe_report(2)          # rate-limited
+    clock_now[0] += 10.0
+    assert r.maybe_report(11, {"loss": 0.5})
+    assert len(posts) == 2
+    second = posts[1][1]
+    assert second["stepTimeSeconds"] == pytest.approx(1.0)  # 10s / 10 steps
+    assert second["tokensPerSec"] == pytest.approx(100.0)
+    assert second["loss"] == 0.5
+
+    # a dead sink never raises into the training loop
+    def exploding(_url, _body):
+        raise OSError("connection refused")
+
+    r2 = heartbeat_mod.HeartbeatReporter(
+        "http://x:1", "job", poster=exploding, clock=lambda: 0.0)
+    assert r2.report(1) is False
+
+    # non-zero process id or missing URL → disabled
+    assert heartbeat_mod.from_env({"TPUJOB_STATUS_URL": "http://x",
+                                   "TPUJOB_NAME": "j",
+                                   "JAX_PROCESS_ID": "1"}) is None
+    assert heartbeat_mod.from_env({"TPUJOB_NAME": "j"}) is None
+
+    # a malformed interval knob must not kill training (best-effort contract)
+    r3 = heartbeat_mod.from_env({"TPUJOB_STATUS_URL": "http://x",
+                                 "TPUJOB_NAME": "j",
+                                 "TPUJOB_HEARTBEAT_INTERVAL": "10s"})
+    assert r3 is not None and r3.interval == heartbeat_mod.DEFAULT_INTERVAL
+
+
+def test_heartbeat_persistence_coalesced():
+    """Telemetry must not multiply apiserver load: within the persist
+    interval, heartbeats update the in-memory status only; the first
+    heartbeat and an attempt change enqueue an immediate write."""
+    from tpu_operator.apis.tpujob.v1alpha1.types import TPUJob
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.trainer.training import TrainingJob
+
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=3600.0)
+    job = TPUJob.from_dict(worker_job("co"))
+    controller.jobs["default/co"] = TrainingJob(cs, None, job)
+
+    hb = {"time": "2026-08-03T00:00:00.000000Z", "step": 1, "attempt": 0}
+    assert controller.record_heartbeat("default", "co", hb)
+    assert controller.queue.get(timeout=0) == "default/co"  # first: persist
+    controller.queue.done("default/co")
+
+    hb2 = {"time": "2026-08-03T00:00:10.000000Z", "step": 2, "attempt": 0}
+    assert controller.record_heartbeat("default", "co", hb2)
+    assert len(controller.queue) == 0  # within interval: in-memory only
+    assert controller.jobs["default/co"].job.status.last_heartbeat["step"] == 2
+
+    hb3 = {"time": "2026-08-03T00:00:20.000000Z", "step": 0, "attempt": 1}
+    assert controller.record_heartbeat("default", "co", hb3)
+    assert controller.queue.get(timeout=0) == "default/co"  # attempt bump
+    controller.queue.done("default/co")
+
+    # steady sub-interval cadence must STILL persist once the interval has
+    # elapsed since the last *persisted* stamp (not the last received one)
+    controller.heartbeat_persist_interval = 25.0
+    for sec, expect_queued in ((30, False), (40, False), (50, True)):
+        hbn = {"time": f"2026-08-03T00:00:{sec}.000000Z",
+               "step": sec, "attempt": 1}
+        assert controller.record_heartbeat("default", "co", hbn)
+        assert (len(controller.queue) > 0) == expect_queued, sec
+
+    assert not controller.record_heartbeat("default", "nope", hb)
+
+
+def test_tokens_per_batch_inference():
+    import numpy as np
+
+    from tpu_operator.payload import train
+
+    # LM-shaped: one [B, T] integer array
+    assert train._infer_tokens_per_batch(
+        (np.zeros((4, 128), dtype=np.int32),)) == 512
+    # classifier-shaped: (images, labels) → no token notion
+    assert train._infer_tokens_per_batch(
+        (np.zeros((4, 32, 32, 3), dtype=np.float32),
+         np.zeros((4,), dtype=np.int32))) == 0
+    # float batch → not tokens
+    assert train._infer_tokens_per_batch(
+        (np.zeros((4, 128), dtype=np.float32),)) == 0

@@ -45,6 +45,13 @@ def _int(minimum=None, maximum=None) -> Dict[str, Any]:
     return out
 
 
+def _num(minimum=None) -> Dict[str, Any]:
+    out: Dict[str, Any] = {"type": "number"}
+    if minimum is not None:
+        out["minimum"] = minimum
+    return out
+
+
 def _obj(properties: Dict[str, Any], required: List[str] = ()) -> Dict[str, Any]:
     out: Dict[str, Any] = {"type": "object", "properties": properties}
     if required:
@@ -116,6 +123,22 @@ def status_schema() -> Dict[str, Any]:
                 "additionalProperties": _int(minimum=0),
             },
         })),
+        # First-entry timestamp per phase (RFC3339); keys are phase names,
+        # which excludes the empty NONE phase by construction.
+        "phaseTimeline": {
+            "type": "object",
+            "additionalProperties": _str(),
+        },
+        # Last payload heartbeat (statusserver POST /api/heartbeat).
+        "lastHeartbeat": _obj({
+            "step": _int(minimum=0),
+            "attempt": _int(minimum=0),
+            "processId": _int(minimum=0),
+            "stepTimeSeconds": _num(minimum=0),
+            "tokensPerSec": _num(minimum=0),
+            "loss": _num(),
+            "time": _str(),
+        }),
     })
 
 
@@ -211,6 +234,13 @@ def validate_strict(value: Any, schema: Dict[str, Any] = None,
             _fail(path, f"{value} < minimum {lo}")
         if hi is not None and value > hi:
             _fail(path, f"{value} > maximum {hi}")
+        return
+    if t == "number":
+        if isinstance(value, bool) or not isinstance(value, (int, float)):
+            _fail(path, f"expected number, got {type(value).__name__}")
+        lo = schema.get("minimum")
+        if lo is not None and value < lo:
+            _fail(path, f"{value} < minimum {lo}")
         return
     _fail(path, f"unhandled schema type {t!r}")
 

@@ -314,15 +314,28 @@ class TPUJobStatus:
     replica_statuses: List[TPUReplicaStatus] = field(default_factory=list)
     # TPU-native: whole-group restart attempt counter.
     attempt: int = 0
+    # Observability: RFC3339 timestamp of the *first* entry into each phase
+    # (trainer/training.py stamps transitions); derived durations — time to
+    # scheduled/running, total runtime — come straight from this map.
+    phase_timeline: Dict[str, str] = field(default_factory=dict)
+    # Last training-step heartbeat posted by the payload (process 0) via the
+    # status server: {step, stepTimeSeconds, tokensPerSec, loss, time, ...}.
+    # ``kubectl get -o yaml`` shows a hung slice as a stale timestamp here.
+    last_heartbeat: Optional[Dict[str, Any]] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {
+        d: Dict[str, Any] = {
             "phase": self.phase,
             "reason": self.reason,
             "state": self.state,
             "replicaStatuses": [r.to_dict() for r in self.replica_statuses],
             "attempt": self.attempt,
         }
+        if self.phase_timeline:
+            d["phaseTimeline"] = dict(self.phase_timeline)
+        if self.last_heartbeat:
+            d["lastHeartbeat"] = dict(self.last_heartbeat)
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "TPUJobStatus":
@@ -335,6 +348,12 @@ class TPUJobStatus:
                 TPUReplicaStatus.from_dict(r) for r in d.get("replicaStatuses", [])
             ],
             attempt=int(d.get("attempt", 0)),
+            phase_timeline={
+                str(k): str(v)
+                for k, v in (d.get("phaseTimeline") or {}).items()
+            },
+            last_heartbeat=(dict(d["lastHeartbeat"])
+                            if d.get("lastHeartbeat") else None),
         )
 
 
@@ -443,15 +462,24 @@ class ControllerConfig:
     """Admin-provided operator config (ref: types.go:170-178).
 
     ``accelerators`` maps a Kubernetes resource name to its injection recipe.
+    ``status_url`` is the operator's advertised status-server base URL
+    (``--advertise-status-url`` / config ``statusUrl``); when set, worker
+    pods get ``TPUJOB_STATUS_URL`` so payloads can post step heartbeats.
     The reference also carried an unused ``GrpcServerFilePath`` field
     (types.go:176-177) — deliberately dropped here (SURVEY.md "quirks to
     fix, not copy").
     """
 
     accelerators: Dict[str, TPUAcceleratorConfig] = field(default_factory=dict)
+    status_url: str = ""
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"accelerators": {k: v.to_dict() for k, v in self.accelerators.items()}}
+        d: Dict[str, Any] = {
+            "accelerators": {k: v.to_dict() for k, v in self.accelerators.items()}
+        }
+        if self.status_url:
+            d["statusUrl"] = self.status_url
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ControllerConfig":
@@ -460,5 +488,6 @@ class ControllerConfig:
             accelerators={
                 str(k): TPUAcceleratorConfig.from_dict(v or {})
                 for k, v in (d.get("accelerators") or {}).items()
-            }
+            },
+            status_url=str(d.get("statusUrl", "")),
         )

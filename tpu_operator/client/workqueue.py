@@ -14,8 +14,17 @@ depends on them:
 - ``add_rate_limited`` applies per-item exponential backoff;
 - ``forget`` resets the item's failure count.
 
+Observability mirrors client-go's workqueue metrics provider: with a
+``metrics`` registry attached (controller/statusserver.Metrics), the queue
+counts adds and retries, and observes queue latency (add → get, which
+includes any backoff delay) and work duration (get → done) into fixed-bucket
+histograms. The depth / unfinished-work / longest-running gauges are sampled
+at scrape time via ``__len__``/``unfinished_work_seconds``/
+``longest_running_processor_seconds``.
+
 The clock is injectable for tests (the reference's tests never covered its
-queue; these do).
+queue; these do), and the metrics observations derive purely from it — so
+histogram tests are deterministic.
 """
 
 from __future__ import annotations
@@ -35,10 +44,12 @@ class RateLimitingQueue:
         base_delay: float = DEFAULT_BASE_DELAY,
         max_delay: float = DEFAULT_MAX_DELAY,
         clock: Callable[[], float] = time.monotonic,
+        metrics: Optional[Any] = None,
     ):
         self._base = base_delay
         self._max = max_delay
         self._clock = clock
+        self._metrics = metrics
         self._cond = threading.Condition()
         self._queue: List[Any] = []
         self._dirty: Set[Any] = set()
@@ -47,17 +58,26 @@ class RateLimitingQueue:
         self._delayed: List[tuple] = []  # heap of (ready_at, seq, item)
         self._seq = 0
         self._shutdown = False
+        # telemetry state: when items entered the queue / started processing
+        self._added_at: Dict[Any, float] = {}
+        self._processing_since: Dict[Any, float] = {}
 
     # -- core queue -----------------------------------------------------------
+
+    def _enqueue_locked(self, item: Any) -> None:
+        self._queue.append(item)
+        self._added_at.setdefault(item, self._clock())
 
     def add(self, item: Any) -> None:
         with self._cond:
             if self._shutdown or item in self._dirty:
                 return
+            if self._metrics is not None:
+                self._metrics.inc("workqueue_adds_total")
             self._dirty.add(item)
             if item in self._processing:
                 return  # will be re-queued on done()
-            self._queue.append(item)
+            self._enqueue_locked(item)
             self._cond.notify()
 
     def get(self, timeout: Optional[float] = None) -> Optional[Any]:
@@ -72,6 +92,12 @@ class RateLimitingQueue:
                     item = self._queue.pop(0)
                     self._processing.add(item)
                     self._dirty.discard(item)
+                    now = self._clock()
+                    added = self._added_at.pop(item, None)
+                    if self._metrics is not None and added is not None:
+                        self._metrics.observe(
+                            "workqueue_queue_duration_seconds", now - added)
+                    self._processing_since[item] = now
                     return item
                 if self._shutdown:
                     return None
@@ -86,13 +112,19 @@ class RateLimitingQueue:
                 wait = min(waits) if waits else None
                 if wait is not None and wait <= 0:
                     continue  # a delayed item became due; loop re-drains it
-                self._cond.wait(wait if wait is not None else 0.05)
+                # No timeout and nothing pending: block on the condition
+                # (add/add_after/shutdown notify) instead of polling.
+                self._cond.wait(wait)
 
     def done(self, item: Any) -> None:
         with self._cond:
             self._processing.discard(item)
+            since = self._processing_since.pop(item, None)
+            if self._metrics is not None and since is not None:
+                self._metrics.observe("workqueue_work_duration_seconds",
+                                      self._clock() - since)
             if item in self._dirty:
-                self._queue.append(item)
+                self._enqueue_locked(item)
                 self._cond.notify()
 
     # -- rate limiting --------------------------------------------------------
@@ -107,10 +139,16 @@ class RateLimitingQueue:
         with self._cond:
             if self._shutdown:
                 return
+            if self._metrics is not None:
+                self._metrics.inc("workqueue_retries_total")
             failures = self._failures.get(item, 0)
             delay = min(self._base * (2 ** failures), self._max)
             self._failures[item] = failures + 1
             self._seq += 1
+            # Latency is measured from *scheduling*, so the backoff delay
+            # shows up in workqueue_queue_duration_seconds — that is the
+            # "how long did the job sit queued?" number.
+            self._added_at.setdefault(item, self._clock())
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 
@@ -118,7 +156,10 @@ class RateLimitingQueue:
         with self._cond:
             if self._shutdown:
                 return
+            if self._metrics is not None:
+                self._metrics.inc("workqueue_retries_total")
             self._seq += 1
+            self._added_at.setdefault(item, self._clock())
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 
@@ -134,9 +175,31 @@ class RateLimitingQueue:
             self._shutdown = True
             self._cond.notify_all()
 
+    @property
+    def is_shutdown(self) -> bool:
+        with self._cond:
+            return self._shutdown
+
     def __len__(self) -> int:
         with self._cond:
             return len(self._queue)
+
+    # -- telemetry gauges (sampled at /metrics scrape) -------------------------
+
+    def unfinished_work_seconds(self) -> float:
+        """Seconds of in-flight processing not yet marked done, summed over
+        workers (client-go: UnfinishedWorkSeconds)."""
+        with self._cond:
+            now = self._clock()
+            return sum(now - t for t in self._processing_since.values())
+
+    def longest_running_processor_seconds(self) -> float:
+        """Age of the oldest in-flight item (client-go:
+        LongestRunningProcessorSeconds); 0 when idle."""
+        with self._cond:
+            if not self._processing_since:
+                return 0.0
+            return self._clock() - min(self._processing_since.values())
 
     # -- internals (call with lock held) --------------------------------------
 
@@ -148,5 +211,4 @@ class RateLimitingQueue:
                 continue
             self._dirty.add(item)
             if item not in self._processing:
-                self._queue.append(item)
-
+                self._enqueue_locked(item)

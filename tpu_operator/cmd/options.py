@@ -54,8 +54,17 @@ def build_parser() -> argparse.ArgumentParser:
                    help="leader-election retry period in seconds")
     p.add_argument("--trace", action="store_true",
                    help="function-level call tracing (the go-tracey equivalent)")
+    p.add_argument("--trace-buffer", type=int, default=512,
+                   help="spans kept in the in-memory ring buffer served at "
+                        "GET /api/traces")
     p.add_argument("--status-port", type=int, default=0,
-                   help="port for /healthz, /readyz, /metrics, and the job "
-                        "dashboard (0 = disabled; the chart passes 8080; "
-                        "the reference had none of these)")
+                   help="port for /healthz, /readyz, /metrics, traces, "
+                        "heartbeats, and the job dashboard (0 = disabled; "
+                        "the chart passes 8080; the reference had none of "
+                        "these)")
+    p.add_argument("--advertise-status-url", default="",
+                   help="base URL workers reach the status server at (e.g. "
+                        "http://tpu-operator.kubeflow:8080); injected into "
+                        "pods as TPUJOB_STATUS_URL so payloads post step "
+                        "heartbeats (empty = heartbeats off)")
     return p

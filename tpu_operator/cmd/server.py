@@ -20,7 +20,7 @@ from tpu_operator.controller.chaos import ChaosMonkey
 from tpu_operator.controller.controller import Controller
 from tpu_operator.controller.leaderelection import LeaderElector
 from tpu_operator.controller.statusserver import StatusServer
-from tpu_operator.util import k8sutil
+from tpu_operator.util import k8sutil, tracing
 from tpu_operator.util.util import get_operator_namespace
 
 log = logging.getLogger(__name__)
@@ -45,6 +45,10 @@ def run(opts: Any, clientset: Optional[Any] = None,
     if clientset is None:
         clientset = k8sutil.must_new_kube_client(opts.master, opts.kubeconfig)
     config = read_controller_config(opts.controller_config_file)
+    if getattr(opts, "advertise_status_url", ""):
+        config.status_url = opts.advertise_status_url
+    tracing.configure(span_buffer=getattr(opts, "trace_buffer",
+                                          tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()
 
     factory = SharedInformerFactory(clientset, namespace,
@@ -69,7 +73,9 @@ def run(opts: Any, clientset: Optional[Any] = None,
                          daemon=True, name="gc").start()
         if opts.chaos_level >= 0:
             monkey = ChaosMonkey(clientset, namespace, opts.chaos_level,
-                                 opts.chaos_interval)
+                                 opts.chaos_interval,
+                                 recorder=controller.recorder,
+                                 metrics=controller.metrics)
             threading.Thread(target=monkey.run, args=(leading_stop,),
                              daemon=True, name="chaos").start()
         controller.run(opts.threadiness, leading_stop)

@@ -15,7 +15,7 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Any
+from typing import Any, Dict, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import LABEL_GROUP_KEY
 from tpu_operator.client import errors
@@ -23,14 +23,48 @@ from tpu_operator.client import errors
 log = logging.getLogger(__name__)
 
 
+class _OwnerRef:
+    """Minimal EventRecorder target for the TPUJob that owns a killed pod —
+    enough identity (.name/.namespace/.metadata) to anchor the Event without
+    fetching the full object."""
+
+    def __init__(self, namespace: str, name: str, uid: str):
+        self.namespace = namespace
+        self.name = name
+        self.metadata = {"name": name, "namespace": namespace, "uid": uid}
+
+
 class ChaosMonkey:
     def __init__(self, clientset: Any, namespace: str = "", level: int = 0,
-                 interval: float = 30.0, rng: random.Random | None = None):
+                 interval: float = 30.0, rng: random.Random | None = None,
+                 recorder: Optional[Any] = None,
+                 metrics: Optional[Any] = None):
         self.clientset = clientset
         self.namespace = namespace
         self.level = level
         self.interval = interval
         self.rng = rng or random.Random()
+        self.recorder = recorder
+        self.metrics = metrics
+
+    def _record_kill(self, pod: Dict[str, Any]) -> None:
+        """A chaos kill must be attributable after the fact: a ChaosPodKill
+        event on the owning TPUJob (so ``kubectl describe`` explains the
+        restart) and a chaos_kills_total tick (so dashboards separate
+        injected faults from organic ones)."""
+        if self.metrics is not None:
+            self.metrics.inc("chaos_kills_total")
+        if self.recorder is None:
+            return
+        md = pod.get("metadata") or {}
+        for ref in md.get("ownerReferences") or []:
+            if ref.get("kind") == "TPUJob":
+                owner = _OwnerRef(md.get("namespace", "default"),
+                                  ref.get("name", ""), ref.get("uid", ""))
+                self.recorder.event(
+                    owner, "Warning", "ChaosPodKill",
+                    f"chaos monkey deleted pod {md.get('name', '')}")
+                break
 
     def kill_once(self) -> int:
         """Delete up to level+1 random managed running pods; returns count."""
@@ -50,6 +84,7 @@ class ChaosMonkey:
                 self.clientset.pods.delete(md.get("namespace", "default"), md["name"])
                 killed += 1
                 log.warning("chaos: killed pod %s", md["name"])
+                self._record_kill(pod)
             except errors.ApiError as e:
                 if not errors.is_not_found(e):
                     log.warning("chaos: failed to kill %s: %s", md["name"], e)

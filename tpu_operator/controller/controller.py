@@ -28,7 +28,8 @@ from __future__ import annotations
 
 import logging
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
@@ -41,6 +42,7 @@ from tpu_operator.client.informer import SharedInformerFactory, object_key
 from tpu_operator.client.workqueue import RateLimitingQueue
 from tpu_operator.controller.events import EventRecorder
 from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
 
 log = logging.getLogger(__name__)
@@ -57,21 +59,33 @@ class Controller:
         namespace: str = "",
         queue: Optional[RateLimitingQueue] = None,
         metrics: Optional[Any] = None,
+        clock: Callable[[], float] = time.monotonic,
+        heartbeat_persist_interval: float = 30.0,
     ):
         self.clientset = clientset
         self.factory = informer_factory
         self.config = config or ControllerConfig()
         self.namespace = namespace
-        self.queue = queue or RateLimitingQueue()
-        # Prometheus-style counters (controller/statusserver.py); a plain
-        # no-op-free Metrics by default so call sites never branch.
+        self._clock = clock
+        # Minimum seconds between heartbeat-triggered status writes per job
+        # (see record_heartbeat); 0 persists every heartbeat immediately.
+        self.heartbeat_persist_interval = heartbeat_persist_interval
+        # Prometheus-style registry (controller/statusserver.py); a real
+        # Metrics by default so call sites never branch. The workqueue and
+        # event recorder feed the same registry (client-go-style workqueue
+        # metrics, event aggregation counters).
         from tpu_operator.controller.statusserver import Metrics
         self.metrics = metrics if metrics is not None else Metrics()
-        self.recorder = EventRecorder(clientset)
+        self.queue = queue or RateLimitingQueue(clock=clock,
+                                               metrics=self.metrics)
+        self.recorder = EventRecorder(clientset, metrics=self.metrics)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
         self.jobs: Dict[str, TrainingJob] = {}
         self._jobs_lock = threading.Lock()
+        # key -> heartbeat "time" of the last persist-enqueued heartbeat
+        # (guarded by _jobs_lock; see record_heartbeat's coalescing).
+        self._hb_persisted: Dict[str, float] = {}
 
         self.job_informer = self.factory.informer_for("tpujobs")
         self.job_informer.add_event_handler(
@@ -125,27 +139,36 @@ class Controller:
     def _worker(self, stop_event: threading.Event) -> None:
         while not stop_event.is_set():
             if not self.process_next_work_item(timeout=0.5):
-                if self.queue._shutdown:  # drained and closed
+                if self.queue.is_shutdown:  # drained and closed
                     return
 
     def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
         """One queue pop → sync → ack cycle (ref: controller.go:175-203).
-        Returns False if nothing was processed."""
+        Returns False if nothing was processed.
+
+        Each cycle runs under a root tracing span, so every log record and
+        every nested ``@traced`` call (sync_tpujob → reconcile → ...) shares
+        one trace id, visible in ``GET /api/traces``; the reconcile duration
+        feeds the ``reconcile_duration_seconds`` histogram."""
         key = self.queue.get(timeout=timeout)
         if key is None:
             return False
-        try:
-            forget = self.sync_tpujob(key)
-            self.metrics.inc("reconcile_total")
-            if forget:
-                self.queue.forget(key)
-        except Exception as e:  # noqa: BLE001 — requeue with backoff
-            log.warning("error syncing %s (requeueing): %s", key, e)
-            self.metrics.inc("reconcile_total")
-            self.metrics.inc("reconcile_errors_total")
-            self.queue.add_rate_limited(key)
-        finally:
-            self.queue.done(key)
+        start = self._clock()
+        with tracing.span("reconcile", key=key):
+            try:
+                forget = self.sync_tpujob(key)
+                self.metrics.inc("reconcile_total")
+                if forget:
+                    self.queue.forget(key)
+            except Exception as e:  # noqa: BLE001 — requeue with backoff
+                log.warning("error syncing %s (requeueing): %s", key, e)
+                self.metrics.inc("reconcile_total")
+                self.metrics.inc("reconcile_errors_total")
+                self.queue.add_rate_limited(key)
+            finally:
+                self.metrics.observe("reconcile_duration_seconds",
+                                     self._clock() - start)
+                self.queue.done(key)
         return True
 
     # -- sync (ref: controller.go:207-267) -------------------------------------
@@ -161,6 +184,8 @@ class Controller:
             # OwnerReferences (ref: controller.go:227-232 just forgets).
             with self._jobs_lock:
                 self.jobs.pop(key, None)
+                self._hb_persisted.pop(key, None)
+            self.recorder.forget_object(namespace, name)
             return True
 
         job = TPUJob.from_dict(cached)
@@ -169,7 +194,8 @@ class Controller:
             if tj is None or tj.uid != job.uid:
                 # New job, or same name re-created with a new UID
                 # (ref: controller.go:237-245).
-                tj = TrainingJob(self.clientset, self.recorder, job, self.config)
+                tj = TrainingJob(self.clientset, self.recorder, job,
+                                 self.config, metrics=self.metrics)
                 self.jobs[key] = tj
             else:
                 tj.refresh(job)
@@ -178,6 +204,46 @@ class Controller:
         return tj.job.status.phase in (
             TPUJobPhase.CLEANUP, TPUJobPhase.DONE, TPUJobPhase.FAILED
         )
+
+    # -- heartbeats (statusserver POST /api/heartbeat → CRD status) ------------
+
+    def record_heartbeat(self, namespace: str, name: str,
+                         heartbeat: Dict[str, Any]) -> bool:
+        """Attach a payload heartbeat to the in-memory job (the status source
+        of truth). Writing through the in-memory job instead of straight to
+        the apiserver keeps the single-writer status discipline — a direct
+        write would be clobbered by the next ``update_crd_status``.
+
+        Persistence is *coalesced*: the key is enqueued for an immediate
+        status write only for the first heartbeat, an attempt change, or
+        when ``heartbeat_persist_interval`` has passed since the last
+        persisted one — otherwise the in-memory copy rides along on the
+        next natural reconcile (child events, informer resync). Without
+        this, every 10 s post per job costs a reconcile + status PUT +
+        watch-echo reconcile of pure telemetry churn."""
+        from tpu_operator.util.util import parse_rfc3339
+
+        key = f"{namespace}/{name}"
+        new_t = parse_rfc3339(str(heartbeat.get("time", ""))) or 0.0
+        with self._jobs_lock:
+            tj = self.jobs.get(key)
+            if tj is None:
+                return False
+            prev = tj.job.status.last_heartbeat
+            tj.job.status.last_heartbeat = dict(heartbeat)
+            # Compare against the last *persisted* stamp, not the last
+            # received one — a steady sub-interval cadence would otherwise
+            # keep resetting the baseline and never persist again.
+            last = self._hb_persisted.get(key)
+            persist = (prev is None
+                       or prev.get("attempt") != heartbeat.get("attempt")
+                       or last is None
+                       or new_t - last >= self.heartbeat_persist_interval)
+            if persist:
+                self._hb_persisted[key] = new_t
+        if persist:
+            self.queue.add(key)
+        return True
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

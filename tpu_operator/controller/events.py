@@ -12,15 +12,21 @@ with per-(object,reason) aggregation counts — same API-visible result
 
 from __future__ import annotations
 
+import collections
 import datetime
 import logging
 import threading
-from typing import Any, Dict, Tuple
+from typing import Any, Optional, Tuple
 
 from tpu_operator.client import errors
 from tpu_operator.util.util import rand_string
 
 log = logging.getLogger(__name__)
+
+# Dedup-cache bound: entries beyond this are evicted least-recently-used.
+# Unbounded, the cache grew one entry per distinct (object, reason, message)
+# forever across job churn — a slow leak in a long-lived operator.
+DEFAULT_SEEN_CAP = 1024
 
 
 def _now() -> str:
@@ -34,12 +40,29 @@ class EventRecorder:
     """Records events against involved objects (ref: record.EventRecorder as
     used at controller.go:97-100; component name "tpu-operator")."""
 
-    def __init__(self, clientset: Any, component: str = "tpu-operator"):
+    def __init__(self, clientset: Any, component: str = "tpu-operator",
+                 seen_cap: int = DEFAULT_SEEN_CAP,
+                 metrics: Optional[Any] = None):
         self.clientset = clientset
         self.component = component
+        self.metrics = metrics
+        self._seen_cap = max(1, seen_cap)
         self._lock = threading.Lock()
-        # (ns, name, reason, message) -> (event_name, count)
-        self._seen: Dict[Tuple[str, str, str, str], Tuple[str, int]] = {}
+        # LRU: (ns, name, reason, message) -> (event_name, count)
+        self._seen: "collections.OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
+            collections.OrderedDict())
+
+    def forget_object(self, namespace: str, name: str) -> int:
+        """Drop dedup entries for a deleted object (the controller calls this
+        when a TPUJob disappears), so churn never pins cache slots. Returns
+        the number of entries pruned."""
+        with self._lock:
+            stale = [k for k in self._seen if k[0] == namespace and k[1] == name]
+            for k in stale:
+                del self._seen[k]
+        if stale and self.metrics is not None:
+            self.metrics.inc("events_pruned_total", len(stale))
+        return len(stale)
 
     def event(self, obj: Any, event_type: str, reason: str, message: str) -> None:
         """``obj`` is anything with .metadata/.name/.namespace (TrainingJob or
@@ -63,6 +86,7 @@ class EventRecorder:
         with self._lock:
             prior = self._seen.get(key)
             if prior:
+                self._seen.move_to_end(key)
                 name, count = prior
                 try:
                     ev = self.clientset.events.get(namespace, name)
@@ -70,6 +94,9 @@ class EventRecorder:
                     ev["lastTimestamp"] = _now()
                     self.clientset.events.update(namespace, ev)
                     self._seen[key] = (name, count + 1)
+                    if self.metrics is not None:
+                        self.metrics.inc("events_emitted_total")
+                        self.metrics.inc("events_aggregated_total")
                     return
                 except errors.ApiError:
                     pass  # fall through to create fresh
@@ -89,3 +116,12 @@ class EventRecorder:
             }
             self.clientset.events.create(namespace, event)
             self._seen[key] = (name, 1)
+            self._seen.move_to_end(key)
+            evicted = 0
+            while len(self._seen) > self._seen_cap:
+                self._seen.popitem(last=False)
+                evicted += 1
+            if self.metrics is not None:
+                self.metrics.inc("events_emitted_total")
+                if evicted:
+                    self.metrics.inc("events_pruned_total", evicted)

@@ -1,21 +1,36 @@
-"""Operator observability: /healthz, /readyz, /metrics, and a job dashboard.
+"""Operator observability: probes, Prometheus metrics, traces, heartbeats,
+and a job dashboard.
 
 The reference had **no** metrics endpoint, no probes, and its Helm chart's
 dashboard referenced a binary that was not even in the repo (SURVEY.md §5
 "No Prometheus /metrics endpoint"; §2 #18 dashboard.yaml:25-35). This module
-closes all three gaps with one stdlib HTTP server (no new dependencies,
-matching the operator's pure-control-plane footprint):
+closes all of it with one stdlib HTTP server (no new dependencies, matching
+the operator's pure-control-plane footprint):
 
 - ``GET /healthz``  — process liveness (always 200 while the thread serves).
 - ``GET /readyz``   — 200 once the informer caches of the *leading* instance
   have synced; a non-leading standby also reports 200 (it is a healthy hot
   spare) with ``standby`` in the body so probes don't flap during elections.
-- ``GET /metrics``  — Prometheus text format: reconcile totals/errors, queue
-  depth, jobs by phase, leadership, GC deletions.
-- ``GET /api/jobs`` — JSON roll-up of every TPUJob (phase, state, replicas)
-  straight from the informer cache: the dashboard the reference's chart
-  promised but never shipped.
+- ``GET /metrics``  — Prometheus text format: counters, gauges, and
+  fixed-bucket histograms (reconcile duration, workqueue queue-latency and
+  work-duration, job phase durations), jobs by phase, per-job training
+  heartbeat gauges, leadership, GC deletions.
+- ``GET /api/traces`` — recent reconcile spans (util/tracing ring buffer),
+  each carrying the trace id that also tags the log stream.
+- ``POST /api/heartbeat`` — step telemetry from training payloads (process 0
+  posts step/step-time/tokens-per-sec/loss); flows into per-job gauges here
+  and into ``status.lastHeartbeat`` through the controller, so a hung TPU
+  slice is visible from ``kubectl get`` and ``/metrics`` instead of from
+  silence.
+- ``GET /api/jobs`` — JSON roll-up of every TPUJob (phase, state, replicas,
+  phase timeline, derived durations, last heartbeat) straight from the
+  informer cache: the dashboard the reference's chart promised but never
+  shipped.
 - ``GET /``         — minimal HTML rendering of the same roll-up.
+
+The :class:`Metrics` registry is deterministic by construction — callers
+pass durations they computed from their own (injectable) clocks, so tests
+drive every histogram with a fake clock and assert exact bucket contents.
 """
 
 from __future__ import annotations
@@ -23,32 +38,288 @@ from __future__ import annotations
 import html
 import json
 import logging
+import math
 import threading
+import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
-from typing import Any, Dict, Optional
+from typing import Any, Dict, List, Optional, Tuple
+
+from tpu_operator.util import tracing
+from tpu_operator.util.util import now_rfc3339, parse_rfc3339
 
 log = logging.getLogger(__name__)
 
+METRIC_PREFIX = "tpu_operator_"
+
+# Fixed histogram buckets (upper bounds, seconds). Queue latency includes
+# rate-limit backoff (base 10 s, cap 360 s — workqueue.py), so its buckets
+# reach past the cap; work/reconcile durations are control-plane-fast.
+RECONCILE_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0)
+WORK_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0, 10.0, 30.0)
+QUEUE_BUCKETS = (0.001, 0.01, 0.1, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0,
+                 360.0, 600.0)
+# Job lifecycle durations: scheduling is seconds, runtimes are hours.
+PHASE_BUCKETS = (1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
+RUNTIME_BUCKETS = (10.0, 60.0, 300.0, 600.0, 1800.0, 3600.0, 10800.0,
+                   43200.0, 86400.0)
+
+LabelsT = Optional[Dict[str, str]]
+
+# Upper bound on retained per-job heartbeats (evicted stalest-first); far
+# above any real job count, purely an unbounded-growth backstop.
+HEARTBEAT_CAP = 4096
+# Reject heartbeat POSTs larger than this (real bodies are ~200 bytes).
+MAX_HEARTBEAT_BODY = 64 * 1024
+
+
+def _escape_label(value: str) -> str:
+    return (str(value).replace("\\", "\\\\").replace('"', '\\"')
+            .replace("\n", "\\n"))
+
+
+def _escape_help(text: str) -> str:
+    return str(text).replace("\\", "\\\\").replace("\n", "\\n")
+
+
+def _fmt(value: float) -> str:
+    f = float(value)
+    return str(int(f)) if f.is_integer() else repr(f)
+
+
+def _fmt_le(bound: float) -> str:
+    return "+Inf" if bound == float("inf") else _fmt(bound)
+
+
+def _label_str(labels: Dict[str, str]) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape_label(v)}"'
+                     for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+class _Histogram:
+    __slots__ = ("counts", "sum", "count")
+
+    def __init__(self, nbuckets: int):
+        self.counts = [0] * nbuckets  # per-bucket (non-cumulative)
+        self.sum = 0.0
+        self.count = 0
+
+
+class _Family:
+    __slots__ = ("name", "mtype", "help", "buckets", "series")
+
+    def __init__(self, name: str, mtype: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None):
+        self.name = name
+        self.mtype = mtype
+        self.help = help_text
+        self.buckets = tuple(buckets or ()) if mtype == "histogram" else ()
+        # label tuple (sorted (k, v) pairs) -> float | _Histogram
+        self.series: Dict[Tuple[Tuple[str, str], ...], Any] = {}
+
+
+def _series_key(labels: LabelsT) -> Tuple[Tuple[str, str], ...]:
+    return tuple(sorted((str(k), str(v)) for k, v in (labels or {}).items()))
+
 
 class Metrics:
-    """Thread-safe monotonic counters (gauges are sampled at scrape time)."""
+    """Thread-safe Prometheus metric registry: labeled counters, gauges, and
+    fixed-bucket histograms, rendered in valid text exposition format.
+
+    Values are pure accumulators — no internal clock. Duration observations
+    come from callers with injectable clocks (workqueue, controller,
+    trainer), which is what keeps histogram tests deterministic.
+    """
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._counters: Dict[str, float] = {
-            "reconcile_total": 0,
-            "reconcile_errors_total": 0,
-            "gc_deleted_total": 0,
-            "leader_elections_won_total": 0,
-        }
+        self._families: Dict[str, _Family] = {}
+        for name in ("reconcile_total", "reconcile_errors_total",
+                     "gc_deleted_total", "leader_elections_won_total"):
+            self.register(name, "counter",
+                          f"Total {name.replace('_', ' ')}.")
+        self.register("workqueue_adds_total", "counter",
+                      "Total adds handled by the reconcile workqueue.")
+        self.register("workqueue_retries_total", "counter",
+                      "Total delayed re-queues (rate-limited backoff and "
+                      "add_after).")
+        self.register("heartbeats_total", "counter",
+                      "Training-step heartbeats received from payloads.")
+        self.register("chaos_kills_total", "counter",
+                      "Pods deleted by the chaos monkey.")
+        self.register("events_emitted_total", "counter",
+                      "Kubernetes Events written (created or aggregated).")
+        self.register("events_aggregated_total", "counter",
+                      "Events folded into an existing Event's count.")
+        self.register("events_pruned_total", "counter",
+                      "Event-dedup cache entries evicted (LRU bound or "
+                      "object deletion).")
+        self.register("reconcile_duration_seconds", "histogram",
+                      "Wall time of one reconcile pass.", RECONCILE_BUCKETS)
+        self.register("workqueue_queue_duration_seconds", "histogram",
+                      "Time keys wait in the workqueue before processing "
+                      "(includes rate-limit backoff).", QUEUE_BUCKETS)
+        self.register("workqueue_work_duration_seconds", "histogram",
+                      "Time spent processing a popped key.", WORK_BUCKETS)
+        self.register("job_time_to_scheduled_seconds", "histogram",
+                      "Creation to first reconcile (phase Creating).",
+                      PHASE_BUCKETS)
+        self.register("job_time_to_running_seconds", "histogram",
+                      "Phase Creating to phase Running.", PHASE_BUCKETS)
+        self.register("job_runtime_seconds", "histogram",
+                      "Phase Creating to a terminal phase (Done/Failed).",
+                      RUNTIME_BUCKETS)
 
-    def inc(self, name: str, amount: float = 1) -> None:
+    # -- registry --------------------------------------------------------------
+
+    def register(self, name: str, mtype: str, help_text: str,
+                 buckets: Optional[Tuple[float, ...]] = None) -> None:
+        """Idempotently declare a metric family. Unlabeled families
+        materialize a zero series so they render even before first use."""
         with self._lock:
-            self._counters[name] = self._counters.get(name, 0) + amount
+            fam = self._families.get(name)
+            if fam is None:
+                fam = _Family(name, mtype, help_text, buckets)
+                self._families[name] = fam
+                self._series_locked(fam, ())
+
+    def _series_locked(self, fam: _Family, key: Tuple) -> Any:
+        s = fam.series.get(key)
+        if s is None:
+            s = _Histogram(len(fam.buckets)) if fam.mtype == "histogram" else 0.0
+            fam.series[key] = s
+        return s
+
+    def _family(self, name: str, mtype: str,
+                buckets: Optional[Tuple[float, ...]] = None) -> _Family:
+        fam = self._families.get(name)
+        if fam is None:
+            fam = _Family(name, mtype,
+                          f"Total {name.replace('_', ' ')}." if
+                          mtype == "counter" else f"{name}.", buckets)
+            self._families[name] = fam
+        return fam
+
+    # -- write paths -----------------------------------------------------------
+
+    def inc(self, name: str, amount: float = 1, labels: LabelsT = None) -> None:
+        with self._lock:
+            fam = self._family(name, "counter")
+            key = _series_key(labels)
+            fam.series[key] = self._series_locked(fam, key) + amount
+
+    def set_gauge(self, name: str, value: float, labels: LabelsT = None) -> None:
+        with self._lock:
+            fam = self._family(name, "gauge")
+            fam.series[_series_key(labels)] = float(value)
+
+    def observe(self, name: str, value: float, labels: LabelsT = None) -> None:
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.mtype != "histogram":
+                # Unlike counters/gauges, histograms need meaningful buckets:
+                # auto-registering would hand a typo'd call site a valid-
+                # looking family with wrong buckets while the intended one
+                # stays empty — fail at first observation instead.
+                raise KeyError(f"unregistered histogram {name!r}; "
+                               f"register() it with explicit buckets")
+            hist: _Histogram = self._series_locked(fam, _series_key(labels))
+            for i, bound in enumerate(fam.buckets):
+                if value <= bound:
+                    hist.counts[i] += 1
+                    break
+            hist.sum += value
+            hist.count += 1
+
+    # -- read paths ------------------------------------------------------------
 
     def snapshot(self) -> Dict[str, float]:
+        """Unlabeled-counter view (back-compat; labeled series are summed)."""
+        out: Dict[str, float] = {}
         with self._lock:
-            return dict(self._counters)
+            for fam in self._families.values():
+                if fam.mtype == "counter":
+                    out[fam.name] = sum(fam.series.values())
+        return out
+
+    def histogram_snapshot(self, name: str, labels: LabelsT = None
+                           ) -> Optional[Dict[str, Any]]:
+        """Test/introspection view of one histogram series:
+        {"buckets": {le: cumulative_count}, "sum": s, "count": n}."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.mtype != "histogram":
+                return None
+            hist = fam.series.get(_series_key(labels))
+            if hist is None:
+                return None
+            cum, buckets = 0, {}
+            for bound, n in zip(fam.buckets, hist.counts):
+                cum += n
+                buckets[_fmt_le(bound)] = cum
+            buckets["+Inf"] = hist.count
+            return {"buckets": buckets, "sum": hist.sum, "count": hist.count}
+
+    def render_lines(self, prefix: str = METRIC_PREFIX) -> List[str]:
+        lines: List[str] = []
+        with self._lock:
+            for name in sorted(self._families):
+                fam = self._families[name]
+                full = prefix + name
+                lines.append(f"# HELP {full} {_escape_help(fam.help)}")
+                lines.append(f"# TYPE {full} {fam.mtype}")
+                for key in sorted(fam.series):
+                    labels = dict(key)
+                    if fam.mtype == "histogram":
+                        hist: _Histogram = fam.series[key]
+                        cum = 0
+                        for bound, n in zip(fam.buckets, hist.counts):
+                            cum += n
+                            lines.append(
+                                f"{full}_bucket"
+                                f"{_label_str({**labels, 'le': _fmt_le(bound)})}"
+                                f" {cum}")
+                        lines.append(
+                            f"{full}_bucket"
+                            f"{_label_str({**labels, 'le': '+Inf'})}"
+                            f" {hist.count}")
+                        lines.append(
+                            f"{full}_sum{_label_str(labels)} {_fmt(hist.sum)}")
+                        lines.append(
+                            f"{full}_count{_label_str(labels)} {hist.count}")
+                    else:
+                        lines.append(f"{full}{_label_str(labels)} "
+                                     f"{_fmt(fam.series[key])}")
+        return lines
+
+
+def _public_heartbeat(hb: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
+    if not hb:
+        return None
+    return {k: v for k, v in hb.items() if k != "receivedAt"}
+
+
+def derived_durations(md: Dict[str, Any], timeline: Dict[str, str]
+                      ) -> Dict[str, float]:
+    """Seconds between lifecycle marks, from status.phaseTimeline (+ the
+    object's creationTimestamp when the apiserver stamped one)."""
+    out: Dict[str, float] = {}
+    created = parse_rfc3339(md.get("creationTimestamp", ""))
+    creating = parse_rfc3339(timeline.get("Creating", ""))
+    running = parse_rfc3339(timeline.get("Running", ""))
+    terminal = (parse_rfc3339(timeline.get("Done", ""))
+                or parse_rfc3339(timeline.get("Failed", "")))
+    # Clamped like the histogram path (TrainingJob._transition): apiserver
+    # vs operator clock skew must not surface negative durations.
+    if created and creating:
+        out["timeToScheduledSeconds"] = round(max(0.0, creating - created), 6)
+    if creating and running:
+        out["timeToRunningSeconds"] = round(max(0.0, running - creating), 6)
+    if creating and terminal:
+        out["runtimeSeconds"] = round(max(0.0, terminal - creating), 6)
+    return out
 
 
 class StatusServer:
@@ -65,9 +336,18 @@ class StatusServer:
         self._controller_lock = threading.Lock()
         self._controller = controller
         self._leading = threading.Event()
+        self._heartbeats_lock = threading.Lock()
+        # (namespace, name) -> last heartbeat dict (+ receivedAt epoch)
+        self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = {}
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
+            # Socket read timeout: a client that declares a Content-Length
+            # and never sends the body must not park a handler thread
+            # forever (the unauthenticated POST endpoint makes this an
+            # in-cluster DoS vector otherwise).
+            timeout = 10
+
             def log_message(self, fmt: str, *args: Any) -> None:
                 log.debug("status: " + fmt, *args)
 
@@ -81,7 +361,7 @@ class StatusServer:
                 self.wfile.write(data)
 
             def do_GET(self) -> None:  # noqa: N802 — http.server API
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
                 try:
                     if path == "/healthz":
                         self._send(200, "ok")
@@ -94,12 +374,68 @@ class StatusServer:
                     elif path == "/api/jobs":
                         self._send(200, json.dumps(outer.jobs_rollup()),
                                    "application/json")
+                    elif path == "/api/traces":
+                        import urllib.parse
+                        params = dict(urllib.parse.parse_qsl(query))
+                        try:
+                            limit = int(params.get("limit") or 256)
+                        except ValueError:
+                            self._send(400, "bad limit: not an integer")
+                            return
+                        if limit <= 0:
+                            limit = 256  # documented default, never "all"
+                        self._send(200, json.dumps(
+                            {"spans": tracing.recent_spans(limit)}),
+                            "application/json")
                     elif path == "/":
                         self._send(200, outer.render_dashboard(),
                                    "text/html; charset=utf-8")
                     else:
                         self._send(404, "not found")
                 except Exception as e:  # noqa: BLE001 — never kill the probe thread
+                    log.warning("status endpoint %s failed: %s", path, e)
+                    try:
+                        self._send(500, f"error: {e}")
+                    except Exception:  # noqa: BLE001
+                        pass
+
+            def do_POST(self) -> None:  # noqa: N802 — http.server API
+                path = self.path.split("?", 1)[0]
+                try:
+                    if path != "/api/heartbeat":
+                        self._send(404, "not found")
+                        return
+                    try:
+                        length = int(self.headers.get("Content-Length") or 0)
+                    except ValueError:
+                        self._send(400, "bad Content-Length")
+                        return
+                    # Heartbeat bodies are ~200 bytes; an unauthenticated
+                    # endpoint must not buffer an attacker-sized body, and a
+                    # negative length would turn read() into read-to-EOF,
+                    # parking the handler thread until the client hangs up.
+                    if length < 0:
+                        self._send(400, "bad Content-Length")
+                        return
+                    if length > MAX_HEARTBEAT_BODY:
+                        self._send(413, "heartbeat body too large")
+                        return
+                    try:
+                        body = json.loads(self.rfile.read(length) or b"{}")
+                        if not isinstance(body, dict):
+                            raise ValueError("body must be a JSON object")
+                    except (ValueError, json.JSONDecodeError) as e:
+                        self._send(400, f"bad heartbeat: {e}")
+                        return
+                    ok, message = outer.record_heartbeat(body)
+                    if ok:
+                        self._send(200, json.dumps({"ok": True}),
+                                   "application/json")
+                    else:
+                        self._send(
+                            503 if message.startswith("standby") else 400,
+                            message)
+                except Exception as e:  # noqa: BLE001 — never kill the thread
                     log.warning("status endpoint %s failed: %s", path, e)
                     try:
                         self._send(500, f"error: {e}")
@@ -135,6 +471,105 @@ class StatusServer:
         with self._controller_lock:
             return self._controller
 
+    # -- heartbeats ------------------------------------------------------------
+
+    def record_heartbeat(self, body: Dict[str, Any]) -> Tuple[bool, str]:
+        """Ingest one payload heartbeat: stash for per-job gauges and pass it
+        to the controller so ``status.lastHeartbeat`` persists to the CRD."""
+        name = str(body.get("name") or "")
+        if not name:
+            return False, "bad heartbeat: missing job name"
+        namespace = str(body.get("namespace") or "default")
+        hb: Dict[str, Any] = {"time": now_rfc3339()}
+        for field, cast in (("step", int), ("attempt", int),
+                            ("processId", int), ("stepTimeSeconds", float),
+                            ("tokensPerSec", float), ("loss", float)):
+            if body.get(field) is not None:
+                try:
+                    value = cast(body[field])
+                except (TypeError, ValueError):
+                    return False, f"bad heartbeat: non-numeric {field}"
+                # Values that can't round-trip the CRD schema must be
+                # rejected at the door: persisted into status, a NaN breaks
+                # JSON serialization and a negative violates the schema's
+                # minimum: 0 — either way every subsequent status write for
+                # the job is rejected by a real apiserver, wedging
+                # reconcile. (loss is legitimately negative for some
+                # objectives; the schema leaves it unbounded.)
+                if not math.isfinite(value):
+                    return False, f"bad heartbeat: non-finite {field}"
+                if field != "loss" and value < 0:
+                    return False, f"bad heartbeat: negative {field}"
+                hb[field] = value
+        c = self.controller
+        if c is None:
+            # A standby cannot persist the heartbeat (no in-memory job) nor
+            # render its gauges (no informer cache) — a 200 here would
+            # blackhole the posts a Service round-robins to standbys and
+            # false-trip the staleness alarm on the leader. 503 tells the
+            # payload to just retry next interval (it lands on the leader
+            # eventually).
+            return False, "standby: not leading; retry"
+        if c.job_informer.store.get(namespace, name) is None:
+            # A 200 here would silently unarm the hung-slice alarm: the
+            # gauges would prune at the next scrape and status.lastHeartbeat
+            # would never appear. Failing loudly surfaces the misconfig
+            # (wrong namespace/name) in the payload's log instead.
+            return False, f"unknown job {namespace}/{name}"
+        with self._heartbeats_lock:
+            self._heartbeats[(namespace, name)] = {
+                **hb, "receivedAt": time.time()}
+            # Bound the map even on instances that never scrape or hold no
+            # controller (standby behind a Service): evict the stalest
+            # entries — same slow-leak class the event dedup cache fixes.
+            while len(self._heartbeats) > HEARTBEAT_CAP:
+                oldest = min(self._heartbeats,
+                             key=lambda k: self._heartbeats[k]["receivedAt"])
+                del self._heartbeats[oldest]
+        self.metrics.inc("heartbeats_total")
+        if hasattr(c, "record_heartbeat"):
+            # May return False before the first reconcile builds the
+            # TrainingJob — transient; the job is in the informer cache, so
+            # the gauges hold and status catches up on the next heartbeat.
+            c.record_heartbeat(namespace, name, hb)
+        return True, ""
+
+    def _live_heartbeats(self, c: Optional[Any]) -> Dict[Tuple[str, str], Dict[str, Any]]:
+        """Current heartbeats, pruned of jobs the informer no longer knows —
+        a deleted job must not leave immortal gauge series behind — and
+        seeded from persisted ``status.lastHeartbeat`` for jobs this process
+        hasn't heard from. The seeding is what keeps the staleness alarm
+        armed across restart/failover: a hung slice stops posting, the new
+        leader's in-memory map is empty, and without the persisted stamp the
+        gauge would be *absent* (alert never fires) instead of *stale*."""
+        with self._heartbeats_lock:
+            beats = dict(self._heartbeats)
+        if c is not None:
+            # "default" fallback matches informer.object_key and the payload
+            # env contract — an empty-string default would prune heartbeats
+            # of namespace-less objects as stale.
+            live = {}
+            for obj in c.job_informer.store.list():
+                md = obj.get("metadata") or {}
+                live[(md.get("namespace", "default"),
+                      md.get("name", ""))] = obj
+            stale = [k for k in beats if k not in live]
+            if stale:
+                with self._heartbeats_lock:
+                    for k in stale:
+                        self._heartbeats.pop(k, None)
+                for k in stale:
+                    beats.pop(k, None)
+            for key, obj in live.items():
+                if key in beats:
+                    continue
+                persisted = (obj.get("status") or {}).get("lastHeartbeat")
+                if persisted:
+                    received = parse_rfc3339(str(persisted.get("time", "")))
+                    beats[key] = {**persisted,
+                                  "receivedAt": received or 0.0}
+        return beats
+
     # -- endpoint bodies -------------------------------------------------------
 
     def readyz(self) -> tuple:
@@ -149,14 +584,17 @@ class StatusServer:
         c = self.controller
         if c is None:
             return []
+        beats = self._live_heartbeats(c)
         out = []
         for obj in c.job_informer.store.list():
             md = obj.get("metadata") or {}
             status = obj.get("status") or {}
             spec = obj.get("spec") or {}
+            timeline = status.get("phaseTimeline") or {}
+            ns, name = md.get("namespace", "default"), md.get("name", "")
             out.append({
-                "namespace": md.get("namespace", ""),
-                "name": md.get("name", ""),
+                "namespace": ns,
+                "name": name,
                 "phase": status.get("phase", ""),
                 "state": status.get("state", ""),
                 "attempt": status.get("attempt", 0),
@@ -165,52 +603,110 @@ class StatusServer:
                     for rs in spec.get("replicaSpecs", [])
                 },
                 "replicaStatuses": status.get("replicaStatuses", []),
+                "phaseTimeline": timeline,
+                "durations": derived_durations(md, timeline),
+                # The in-memory heartbeat is fresher than the informer-cached
+                # status copy (which lags by a reconcile + watch round-trip);
+                # the internal receivedAt bookkeeping stays out of the API.
+                "lastHeartbeat": _public_heartbeat(
+                    beats.get((ns, name)) or status.get("lastHeartbeat")),
             })
         return out
 
     def render_metrics(self) -> str:
-        lines = []
+        lines = self.metrics.render_lines()
 
         def emit(name: str, value: float, help_text: str,
-                 mtype: str = "counter", labels: str = "") -> None:
-            full = f"tpu_operator_{name}"
-            lines.append(f"# HELP {full} {help_text}")
+                 mtype: str = "gauge", labels: Optional[Dict[str, str]] = None
+                 ) -> None:
+            full = METRIC_PREFIX + name
+            lines.append(f"# HELP {full} {_escape_help(help_text)}")
             lines.append(f"# TYPE {full} {mtype}")
-            lines.append(f"{full}{labels} {value}")
-
-        for name, value in sorted(self.metrics.snapshot().items()):
-            emit(name, value, f"Total {name.replace('_', ' ')}.")
+            lines.append(f"{full}{_label_str(labels or {})} {_fmt(value)}")
 
         emit("leading", 1 if self._leading.is_set() else 0,
-             "1 if this instance holds the leader lease.", "gauge")
+             "1 if this instance holds the leader lease.")
 
         c = self.controller
         if c is not None:
-            emit("workqueue_depth", len(c.queue),
-                 "Pending keys in the reconcile workqueue.", "gauge")
+            q = c.queue
+            emit("workqueue_depth", len(q),
+                 "Pending keys in the reconcile workqueue.")
+            if hasattr(q, "unfinished_work_seconds"):
+                emit("workqueue_unfinished_work_seconds",
+                     q.unfinished_work_seconds(),
+                     "Seconds of work in progress that has not been marked "
+                     "done yet, summed over workers.")
+            if hasattr(q, "longest_running_processor_seconds"):
+                emit("workqueue_longest_running_processor_seconds",
+                     q.longest_running_processor_seconds(),
+                     "Seconds the longest-running worker has been processing "
+                     "its current key.")
+
             phases: Dict[str, int] = {}
             for obj in c.job_informer.store.list():
                 phase = (obj.get("status") or {}).get("phase") or "None"
                 phases[phase] = phases.get(phase, 0) + 1
-            full = "tpu_operator_jobs"
+            full = METRIC_PREFIX + "jobs"
             lines.append(f"# HELP {full} TPUJobs known to the informer cache, by phase.")
             lines.append(f"# TYPE {full} gauge")
             for phase, n in sorted(phases.items()):
-                lines.append(f'{full}{{phase="{phase}"}} {n}')
+                lines.append(f'{full}{{phase="{_escape_label(phase)}"}} {n}')
+
+            beats = self._live_heartbeats(c)
+            if beats:
+                gauges = (
+                    ("job_last_step", "step",
+                     "Last training step reported by the payload."),
+                    ("job_step_time_seconds", "stepTimeSeconds",
+                     "Last reported seconds per training step."),
+                    ("job_tokens_per_second", "tokensPerSec",
+                     "Last reported training throughput in tokens/sec."),
+                    ("job_loss", "loss", "Last reported training loss."),
+                )
+                for metric, field, help_text in gauges:
+                    rows = [((ns, name), hb[field])
+                            for (ns, name), hb in sorted(beats.items())
+                            if field in hb]
+                    if not rows:
+                        continue
+                    full = METRIC_PREFIX + metric
+                    lines.append(f"# HELP {full} {_escape_help(help_text)}")
+                    lines.append(f"# TYPE {full} gauge")
+                    for (ns, name), value in rows:
+                        labels = _label_str({"namespace": ns, "name": name})
+                        lines.append(f"{full}{labels} {_fmt(value)}")
+                full = METRIC_PREFIX + "job_last_heartbeat_timestamp_seconds"
+                lines.append(f"# HELP {full} Unix time the operator last "
+                             f"received a heartbeat for the job.")
+                lines.append(f"# TYPE {full} gauge")
+                for (ns, name), hb in sorted(beats.items()):
+                    labels = _label_str({"namespace": ns, "name": name})
+                    lines.append(f"{full}{labels} {_fmt(hb['receivedAt'])}")
         return "\n".join(lines) + "\n"
 
     def render_dashboard(self) -> str:
         rows = []
         for j in self.jobs_rollup():
             replicas = ", ".join(f"{k}×{v}" for k, v in j["replicas"].items())
+            hb = j.get("lastHeartbeat") or {}
+            heartbeat = (f"step {hb.get('step', '?')} @ {hb.get('time', '')}"
+                         if hb else "—")
+            runtime = (j.get("durations") or {}).get("runtimeSeconds")
+            ttr = (j.get("durations") or {}).get("timeToRunningSeconds")
+            timing = " / ".join(
+                f"{label} {value:.1f}s"
+                for label, value in (("to-running", ttr), ("runtime", runtime))
+                if value is not None) or "—"
             rows.append(
                 "<tr>" + "".join(
                     f"<td>{html.escape(str(v))}</td>"
                     for v in (j["namespace"], j["name"], j["phase"],
-                              j["state"], j["attempt"], replicas)
+                              j["state"], j["attempt"], replicas,
+                              timing, heartbeat)
                 ) + "</tr>"
             )
-        body = "".join(rows) or '<tr><td colspan="6"><i>no jobs</i></td></tr>'
+        body = "".join(rows) or '<tr><td colspan="8"><i>no jobs</i></td></tr>'
         leading = "leading" if self._leading.is_set() else "standby"
         return (
             "<!doctype html><html><head><title>tpu-operator</title>"
@@ -219,8 +715,10 @@ class StatusServer:
             "padding:.4em .8em;text-align:left}</style></head><body>"
             f"<h1>tpu-operator <small>({leading})</small></h1>"
             "<table><tr><th>Namespace</th><th>Name</th><th>Phase</th>"
-            "<th>State</th><th>Attempt</th><th>Replicas</th></tr>"
+            "<th>State</th><th>Attempt</th><th>Replicas</th>"
+            "<th>Timing</th><th>Heartbeat</th></tr>"
             f"{body}</table>"
-            '<p><a href="/metrics">metrics</a> · <a href="/api/jobs">json</a></p>'
+            '<p><a href="/metrics">metrics</a> · <a href="/api/jobs">json</a>'
+            ' · <a href="/api/traces">traces</a></p>'
             "</body></html>"
         )

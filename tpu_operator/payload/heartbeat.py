@@ -1,0 +1,153 @@
+"""Training-step heartbeats: payload → operator status server.
+
+The missing liveness signal of the whole reference lineage: a TPU slice
+whose JAX group hangs (deadlocked collective, stuck host transfer, wedged
+DCN link) keeps every pod Running — kubelet sees a healthy process, the
+operator sees healthy pods, and the only symptom is *silence*. The
+heartbeat closes that gap from the inside: process 0 of the group posts
+step telemetry (step, step-time, tokens/sec, loss) to the operator's
+status server (``POST /api/heartbeat``), which surfaces it as per-job
+gauges in ``/metrics`` and as ``status.lastHeartbeat`` on the TPUJob — a
+stale timestamp there IS the hang alarm, visible from ``kubectl get``.
+
+Strictly best-effort by design: the reporter never raises, never blocks
+the step loop beyond a short socket timeout, and rate-limits itself — a
+down status server costs the payload one failed connect per interval,
+nothing more. The env contract (TPUJOB_STATUS_URL, injected by
+trainer/replicas.py when the operator advertises a URL) gates the whole
+feature: unset means ``from_env`` returns None and training runs exactly
+as before.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import math
+import os
+import time
+from typing import Any, Callable, Dict, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_INTERVAL = 10.0  # seconds between posts (per process)
+POST_TIMEOUT = 2.0       # socket timeout: never stall a training step
+
+
+def _http_post(url: str, body: Dict[str, Any]) -> None:
+    import urllib.request
+
+    req = urllib.request.Request(
+        url, data=json.dumps(body).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    with urllib.request.urlopen(req, timeout=POST_TIMEOUT):
+        pass
+
+
+class HeartbeatReporter:
+    """Posts step telemetry to ``{base_url}/api/heartbeat``.
+
+    ``tokens_per_batch`` (> 0) turns step cadence into tokens/sec — LM
+    payloads pass B·T; payloads without a token notion leave it 0 and the
+    field is omitted. ``clock``/``poster`` are injectable for tests."""
+
+    def __init__(self, base_url: str, job_name: str,
+                 namespace: str = "default", process_id: int = 0,
+                 attempt: int = 0, interval: float = DEFAULT_INTERVAL,
+                 tokens_per_batch: int = 0,
+                 clock: Callable[[], float] = time.monotonic,
+                 poster: Optional[Callable[[str, Dict[str, Any]], None]] = None):
+        self.url = base_url.rstrip("/") + "/api/heartbeat"
+        self.job_name = job_name
+        self.namespace = namespace
+        self.process_id = process_id
+        self.attempt = attempt
+        self.interval = interval
+        self.tokens_per_batch = tokens_per_batch
+        self._clock = clock
+        self._poster = poster or _http_post
+        self._last_post: Optional[float] = None
+        self._last_step: Optional[int] = None
+        self._failed_once = False
+
+    def due(self, _step: int) -> bool:
+        now = self._clock()
+        return self._last_post is None or now - self._last_post >= self.interval
+
+    def report(self, step: int, metrics: Optional[Dict[str, Any]] = None) -> bool:
+        """Post one heartbeat; returns True when the post succeeded. Step
+        time is averaged over the steps since the previous post, so it is
+        meaningful at any reporting interval."""
+        now = self._clock()
+        body: Dict[str, Any] = {
+            "namespace": self.namespace,
+            "name": self.job_name,
+            "step": int(step),
+            "processId": self.process_id,
+            "attempt": self.attempt,
+        }
+        if self._last_post is not None and self._last_step is not None \
+                and step > self._last_step:
+            per_step = (now - self._last_post) / (step - self._last_step)
+            body["stepTimeSeconds"] = round(per_step, 6)
+            if self.tokens_per_batch > 0 and per_step > 0:
+                body["tokensPerSec"] = round(self.tokens_per_batch / per_step, 3)
+        loss = (metrics or {}).get("loss")
+        if loss is not None:
+            try:
+                loss = float(loss)
+                # A diverged step yields NaN/Inf — the server rejects those
+                # (they would poison CRD status JSON), so skip the field and
+                # let the heartbeat still carry liveness.
+                if math.isfinite(loss):
+                    body["loss"] = loss
+            except (TypeError, ValueError):
+                pass
+        self._last_post, self._last_step = now, int(step)
+        try:
+            self._poster(self.url, body)
+            self._failed_once = False
+            return True
+        except Exception as e:  # noqa: BLE001 — heartbeats never kill training
+            if not self._failed_once:  # log the first failure, not a stream
+                log.warning("heartbeat post to %s failed: %s", self.url, e)
+                self._failed_once = True
+            return False
+
+    def maybe_report(self, step: int,
+                     metrics: Optional[Dict[str, Any]] = None) -> bool:
+        if not self.due(step):
+            return False
+        return self.report(step, metrics)
+
+
+def from_env(env: Optional[Dict[str, str]] = None,
+             tokens_per_batch: int = 0) -> Optional[HeartbeatReporter]:
+    """Reporter from the operator's env contract, or None when heartbeats
+    are not wired (no TPUJOB_STATUS_URL) or this is not process 0 — only
+    the group's first process posts, so the operator sees one stream per
+    job, not one per worker."""
+    e = env if env is not None else os.environ
+    url = e.get("TPUJOB_STATUS_URL", "")
+    job = e.get("TPUJOB_NAME", "")
+    if not url or not job:
+        return None
+
+    # Best-effort contract: malformed env must not kill training.
+    def _num(var: str, default, cast):
+        try:
+            return cast(e.get(var) or default)
+        except ValueError:
+            log.warning("ignoring malformed %s=%r", var, e.get(var))
+            return default
+
+    if _num("JAX_PROCESS_ID", 0, int) != 0:
+        return None
+    return HeartbeatReporter(
+        url, job,
+        namespace=e.get("TPUJOB_NAMESPACE", "default"),
+        process_id=0,
+        attempt=_num("TPUJOB_ATTEMPT", 0, int),
+        interval=_num("TPUJOB_HEARTBEAT_INTERVAL", DEFAULT_INTERVAL, float),
+        tokens_per_batch=tokens_per_batch,
+    )

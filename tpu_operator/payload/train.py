@@ -519,6 +519,22 @@ def make_regression_train_step(model: Any, tx: optax.GradientTransformation,
     )
 
 
+def _infer_tokens_per_batch(batch_args: tuple) -> int:
+    """Tokens per global batch when the batch is LM-shaped — a single [B, T]
+    integer array (transformer/pipeline/MoE payloads) — else 0. Lets the
+    auto-wired heartbeat report tokens/sec without every payload plumbing
+    its batch geometry through."""
+    if len(batch_args) != 1:
+        return 0
+    arr = batch_args[0]
+    shape = getattr(arr, "shape", ())
+    dtype = getattr(arr, "dtype", None)
+    if len(shape) == 2 and dtype is not None and \
+            jnp.issubdtype(dtype, jnp.integer):
+        return int(shape[0] * shape[1])
+    return 0
+
+
 def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                batches, steps: int,
                log_every: int = 0,
@@ -526,7 +542,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                checkpointer=None, spec=None,
                profile_dir: str = "",
                profile_range: Tuple[int, int] = (10, 20),
-               prefetch: int = 2) -> Tuple[TrainState, dict]:
+               prefetch: int = 2,
+               heartbeat="auto") -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
     interval — and the batch transfers run ``prefetch`` deep ahead of the
@@ -552,7 +569,17 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     attempt still profiles post-compile steady state, not its compile step —
     viewable in TensorBoard/XProf. The payload-side half of the reference's
     tracing subsystem (SURVEY.md §5; control-plane half is util/tracing.py).
+
+    ``heartbeat`` posts step telemetry to the operator's status server
+    (payload/heartbeat.py): ``"auto"`` (default) builds a reporter from the
+    operator's env contract — a no-op unless TPUJOB_STATUS_URL is injected
+    and this is process 0 — or pass a HeartbeatReporter / None explicitly.
+    The post is rate-limited inside the reporter and fetches metrics only
+    when actually due, so it stays off the steady-state step path.
     """
+    if heartbeat == "auto":
+        from tpu_operator.payload import heartbeat as heartbeat_mod
+        heartbeat = heartbeat_mod.from_env()
     start = 0
     if checkpointer is not None:
         state, start = checkpointer.restore(state)
@@ -606,7 +633,11 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                     and i >= trace_from):
                 jax.profiler.start_trace(profile_dir)
                 tracing = True
-            state, metrics = train_step(state, *next(dev_batches))
+            batch_args = next(dev_batches)
+            if heartbeat is not None and i == start \
+                    and getattr(heartbeat, "tokens_per_batch", 0) == 0:
+                heartbeat.tokens_per_batch = _infer_tokens_per_batch(batch_args)
+            state, metrics = train_step(state, *batch_args)
             if tracing and (i + 1) >= trace_to:
                 jax.device_get(metrics)  # drain async work into the trace
                 jax.profiler.stop_trace()
@@ -615,6 +646,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 checkpointer.maybe_save(i + 1, state)
             if log_every and log_fn and (i + 1) % log_every == 0:
                 log_fn(i + 1, jax.device_get(metrics))
+            if heartbeat is not None and heartbeat.due(i + 1):
+                heartbeat.report(i + 1, jax.device_get(metrics))
     finally:
         bootstrap_mod.exit_step_loop()
         if tracing:

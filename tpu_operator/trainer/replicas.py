@@ -372,6 +372,14 @@ class TPUReplicaSet:
             self.job.name, job_spec.runtime_id, job_spec,
             self.replica_type, index, attempt,
         )
+        # Identity + telemetry sink (payload/heartbeat.py): the namespace
+        # and — when the operator advertises one — the status-server URL
+        # process 0 posts step heartbeats to.
+        env["TPUJOB_NAMESPACE"] = self.job.namespace
+        status_url = getattr(getattr(self.job, "config", None),
+                             "status_url", "")
+        if status_url:
+            env["TPUJOB_STATUS_URL"] = status_url
         injected = False
         for container in pod_spec.get("containers") or []:
             # Only the magic container gets the contract (ref: replicas.go:235

@@ -65,24 +65,65 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 from tpu_operator.client import errors
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.util.tracing import traced
-from tpu_operator.util.util import rand_string
+from tpu_operator.util.util import now_rfc3339, parse_rfc3339, rand_string
 
 log = logging.getLogger(__name__)
+
+# Patchable timestamp source for the phase timeline (tests freeze it).
+_now = now_rfc3339
 
 
 class TrainingJob:
     """One reconciled TPUJob (ref: TrainingJob, training.go:45-86)."""
 
     def __init__(self, clientset: Any, recorder: Any, job: TPUJob,
-                 config: Optional[ControllerConfig] = None):
+                 config: Optional[ControllerConfig] = None,
+                 metrics: Optional[Any] = None):
         self.clientset = clientset
         self.recorder = recorder
         self.job = job
         self.config = config or ControllerConfig()
+        self.metrics = metrics
         self.replica_sets: List[replicas_mod.TPUReplicaSet] = []
         # True only while setup's spec mutations (defaults, runtimeId) await
         # persistence; status writebacks must not overwrite user spec edits.
         self._spec_dirty = False
+
+    # -- phase transitions (observability: status.phaseTimeline) ---------------
+
+    def _transition(self, phase: str) -> None:
+        """Set the phase, stamping ``status.phaseTimeline`` on the *first*
+        entry into each phase, and export the derived lifecycle durations
+        (time-to-scheduled / time-to-running / total runtime) as histograms.
+        Re-entries (group restart driving Running→Creating→Running) keep
+        the original stamps, so durations always measure the first pass."""
+        status = self.job.status
+        status.phase = phase
+        if not phase:
+            return
+        timeline = status.phase_timeline
+        if phase in timeline:
+            return
+        timeline[phase] = _now()
+        if self.metrics is None:
+            return
+        stamp = parse_rfc3339(timeline[phase])
+        creating = parse_rfc3339(timeline.get(TPUJobPhase.CREATING, ""))
+        if stamp is None:
+            return
+        if phase == TPUJobPhase.CREATING:
+            created = parse_rfc3339(
+                self.job.metadata.get("creationTimestamp", ""))
+            if created is not None:
+                self.metrics.observe("job_time_to_scheduled_seconds",
+                                     max(0.0, stamp - created))
+        elif phase == TPUJobPhase.RUNNING and creating is not None:
+            self.metrics.observe("job_time_to_running_seconds",
+                                 max(0.0, stamp - creating))
+        elif phase in (TPUJobPhase.DONE, TPUJobPhase.FAILED) \
+                and creating is not None:
+            self.metrics.observe("job_runtime_seconds",
+                                 max(0.0, stamp - creating))
 
     # -- identity passthrough -------------------------------------------------
 
@@ -145,7 +186,7 @@ class TrainingJob:
             validation.validate_tpu_resources(self.job.spec)
             helper.configure_accelerators(self.job.spec, self.config)
         except validation.ValidationError as e:
-            self.job.status.phase = TPUJobPhase.FAILED
+            self._transition(TPUJobPhase.FAILED)
             self.job.status.state = State.FAILED
             self.job.status.reason = f"invalid job spec: {e}"
             if self.recorder:
@@ -154,7 +195,7 @@ class TrainingJob:
         if not self.job.spec.runtime_id:
             self.job.spec.runtime_id = rand_string(4)
         self._spec_dirty = True
-        self.job.status.phase = TPUJobPhase.CREATING
+        self._transition(TPUJobPhase.CREATING)
         self.job.status.state = State.RUNNING
 
     @traced
@@ -293,7 +334,7 @@ class TrainingJob:
 
         if phase == TPUJobPhase.CLEANUP:
             self.delete_resources()
-            self.job.status.phase = TPUJobPhase.DONE
+            self._transition(TPUJobPhase.DONE)
             self.update_crd_status()
             return
 
@@ -312,7 +353,7 @@ class TrainingJob:
                 # exited 0 must still roll up to Done on resume, not
                 # re-run.
                 self._delete_live_pods()
-                self.job.status.phase = TPUJobPhase.SUSPENDED
+                self._transition(TPUJobPhase.SUSPENDED)
                 self.job.status.state = State.UNKNOWN
                 self.job.status.reason = "suspended by spec"
                 # Pre-suspend replica roll-ups describe pods that no longer
@@ -325,7 +366,7 @@ class TrainingJob:
             self.update_crd_status()
             return
         if phase == TPUJobPhase.SUSPENDED:
-            self.job.status.phase = TPUJobPhase.CREATING
+            self._transition(TPUJobPhase.CREATING)
             self.job.status.state = State.RUNNING
             self.job.status.reason = ""
             if self.recorder:
@@ -348,7 +389,7 @@ class TrainingJob:
             self._fail("chief or group replica failed permanently")
         elif state == State.SUCCEEDED:
             self.job.status.state = State.SUCCEEDED
-            self.job.status.phase = TPUJobPhase.DONE
+            self._transition(TPUJobPhase.DONE)
             self.job.status.reason = ""
             if self.recorder:
                 self.recorder.event(self, "Normal", "JobSucceeded",
@@ -366,7 +407,7 @@ class TrainingJob:
                     for s in statuses
                 )
                 self.job.status.state = State.RUNNING
-                self.job.status.phase = (
+                self._transition(
                     TPUJobPhase.RUNNING if running else TPUJobPhase.CREATING
                 )
 
@@ -374,7 +415,7 @@ class TrainingJob:
 
     def _fail(self, reason: str) -> None:
         self.job.status.state = State.FAILED
-        self.job.status.phase = TPUJobPhase.FAILED
+        self._transition(TPUJobPhase.FAILED)
         self.job.status.reason = reason
         if self.recorder:
             self.recorder.event(self, "Warning", "JobFailed", reason)
@@ -412,7 +453,7 @@ class TrainingJob:
         for rs in self.replica_sets:
             rs.delete_pods_for_attempt(attempt)
         self.job.status.attempt = attempt + 1
-        self.job.status.phase = TPUJobPhase.CREATING
+        self._transition(TPUJobPhase.CREATING)
         self.job.status.state = State.RUNNING
         self.job.status.reason = f"group restart: attempt {attempt + 1}"
         if self.recorder:
@@ -453,7 +494,7 @@ class TrainingJob:
         """Explicit teardown: phase → CLEANUP, remove children, → DONE
         (ref: training.go:305-323; K8s GC via OwnerReferences covers the
         CRD-deletion path without any operator action)."""
-        self.job.status.phase = TPUJobPhase.CLEANUP
+        self._transition(TPUJobPhase.CLEANUP)
         self.delete_resources()
-        self.job.status.phase = TPUJobPhase.DONE
+        self._transition(TPUJobPhase.DONE)
         self.update_crd_status()

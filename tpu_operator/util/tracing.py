@@ -1,4 +1,4 @@
-"""Function-level call tracing.
+"""Structured call tracing: spans, trace IDs, and the --trace log stream.
 
 Reference parity: the go-tracey subsystem (SURVEY.md §5) — the reference
 wraps nearly every function in ``defer Exit(Enter("file $FN"))``
@@ -6,27 +6,46 @@ wraps nearly every function in ``defer Exit(Enter("file $FN"))``
 replicas.go:82), printing nested ENTER/EXIT lines to stdout, plus a logrus
 hook tagging each log line with its source file (main.go:27-32).
 
-Re-designed rather than translated: one ``@traced`` decorator per function
-(applied where the reference had the defer pairs), a thread-local depth
-counter for nesting, and an off-by-default switch — the reference traced
-unconditionally, which is noisy; here ``enable()`` is wired to the
-``--trace`` flag. Also provides ``install_filename_log_format`` for the
-source-file log tag.
+Re-designed rather than translated, in two layers:
+
+- **Spans** (always on, cheap): every ``@traced`` function and every
+  explicit ``with span("name", key=...)`` block records a structured span —
+  trace id, span id, parent id, wall-clock start, duration — into a
+  bounded in-memory ring buffer. The controller opens one *root* span per
+  reconcile, so every downstream ``@traced`` call nests under a single
+  trace id, and every log record emitted inside the trace carries that id
+  (``trace=<id>`` via the logging filter below). The status server exposes
+  the buffer at ``GET /api/traces``.
+- **ENTER/EXIT log lines** (off by default): the reference traced
+  unconditionally, which is noisy; here ``enable()`` is wired to the
+  ``--trace`` flag and reuses the same span machinery for the nested
+  ENTER/EXIT stream.
+
+Also provides ``install_filename_log_format`` for the source-file log tag.
 """
 
 from __future__ import annotations
 
+import collections
+import dataclasses
 import functools
 import logging
+import os
+import random
 import threading
 import time
-from typing import Any, Callable, TypeVar
+from typing import Any, Callable, Dict, List, Optional, TypeVar
 
 F = TypeVar("F", bound=Callable[..., Any])
 
 _local = threading.local()
 _enabled = False
 _logger = logging.getLogger("tpu_operator.trace")
+
+DEFAULT_SPAN_BUFFER = 512
+
+_spans_lock = threading.Lock()
+_spans: "collections.deque" = collections.deque(maxlen=DEFAULT_SPAN_BUFFER)
 
 
 def enable(on: bool = True) -> None:
@@ -38,48 +57,167 @@ def is_enabled() -> bool:
     return _enabled
 
 
-def _depth() -> int:
-    return getattr(_local, "depth", 0)
+def configure(span_buffer: int = DEFAULT_SPAN_BUFFER) -> None:
+    """Resize the span ring buffer (wired to --trace-buffer); 0 disables
+    buffering entirely (spans still carry trace ids into log records)."""
+    global _spans
+    with _spans_lock:
+        _spans = collections.deque(_spans, maxlen=max(0, span_buffer))
+
+
+def _new_id(nbytes: int) -> str:
+    # Per-thread PRNG seeded once from the OS: spans are always on, so ids
+    # must not cost a syscall per @traced call on the reconcile path.
+    rng = getattr(_local, "rng", None)
+    if rng is None:
+        rng = random.Random(int.from_bytes(os.urandom(8), "big"))
+        _local.rng = rng
+    return f"{rng.getrandbits(nbytes * 8):0{nbytes * 2}x}"
+
+
+@dataclasses.dataclass
+class Span:
+    """One completed (or in-flight) operation in a trace tree."""
+
+    trace_id: str
+    span_id: str
+    parent_id: str
+    name: str
+    start: float           # epoch seconds (wall clock, for display)
+    duration_ms: float = 0.0
+    attrs: Dict[str, Any] = dataclasses.field(default_factory=dict)
+    error: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        out = {
+            "traceId": self.trace_id,
+            "spanId": self.span_id,
+            "parentId": self.parent_id,
+            "name": self.name,
+            "start": self.start,
+            "durationMs": round(self.duration_ms, 3),
+        }
+        if self.attrs:
+            out["attrs"] = dict(self.attrs)
+        if self.error:
+            out["error"] = self.error
+        return out
+
+
+def _stack() -> List[Span]:
+    st = getattr(_local, "span_stack", None)
+    if st is None:
+        st = []
+        _local.span_stack = st
+    return st
+
+
+def current_span() -> Optional[Span]:
+    st = _stack()
+    return st[-1] if st else None
+
+
+def current_trace_id() -> str:
+    sp = current_span()
+    return sp.trace_id if sp is not None else ""
+
+
+class span:
+    """Context manager opening one span. The outermost span on a thread
+    starts a fresh trace id; nested spans become its children. Extra
+    keyword arguments become span attributes (shown in /api/traces)."""
+
+    def __init__(self, name: str, **attrs: Any):
+        self.name = name
+        self.attrs = attrs
+        self.span: Optional[Span] = None
+        self._t0 = 0.0
+
+    def __enter__(self) -> Span:
+        parent = current_span()
+        sp = Span(
+            trace_id=parent.trace_id if parent else _new_id(8),
+            span_id=_new_id(4),
+            parent_id=parent.span_id if parent else "",
+            name=self.name,
+            start=time.time(),
+            attrs=dict(self.attrs),
+        )
+        _stack().append(sp)
+        self._t0 = time.monotonic()
+        self.span = sp
+        if _enabled:
+            depth = len(_stack()) - 1
+            _logger.info("%s[%d]ENTER: %s", "  " * depth, depth, self.name)
+        return sp
+
+    def __exit__(self, exc_type, exc, _tb) -> None:
+        sp = self.span
+        assert sp is not None
+        sp.duration_ms = (time.monotonic() - self._t0) * 1e3
+        if exc is not None:
+            sp.error = f"{type(exc).__name__}: {exc}"
+        st = _stack()
+        if st and st[-1] is sp:
+            st.pop()
+        # configure(span_buffer=0) turns buffering off (trace ids still flow
+        # into log records) — no cross-thread lock traffic for data nothing
+        # serves.
+        if _spans.maxlen:
+            with _spans_lock:
+                _spans.append(sp)
+        if _enabled:
+            depth = len(st)
+            _logger.info("%s[%d]EXIT:  %s (%.1fms)", "  " * depth, depth,
+                         sp.name, sp.duration_ms)
+
+
+def recent_spans(limit: int = 0) -> List[Dict[str, Any]]:
+    """Completed spans, newest first (the /api/traces body)."""
+    with _spans_lock:
+        items = list(_spans)
+    items.reverse()
+    if limit > 0:
+        items = items[:limit]
+    return [sp.to_dict() for sp in items]
+
+
+def clear_spans() -> None:
+    """Test hook: empty the ring buffer."""
+    with _spans_lock:
+        _spans.clear()
 
 
 def traced(fn: F) -> F:
-    """Trace entry/exit of fn with nesting and wall time
-    (ref: tracey.New Enter/Exit defers)."""
+    """Record a span around fn (ref: tracey.New Enter/Exit defers). The
+    nested ENTER/EXIT log stream additionally appears when --trace is on."""
 
     label = f"{fn.__module__.rsplit('.', 1)[-1]}.{fn.__qualname__}"
 
     @functools.wraps(fn)
     def wrapper(*args: Any, **kwargs: Any) -> Any:
-        if not _enabled:
+        with span(label):
             return fn(*args, **kwargs)
-        depth = _depth()
-        pad = "  " * depth
-        _logger.info("%s[%d]ENTER: %s", pad, depth, label)
-        _local.depth = depth + 1
-        start = time.monotonic()
-        try:
-            return fn(*args, **kwargs)
-        finally:
-            _local.depth = depth
-            _logger.info(
-                "%s[%d]EXIT:  %s (%.1fms)", pad, depth, label,
-                (time.monotonic() - start) * 1e3,
-            )
 
     return wrapper  # type: ignore[return-value]
 
 
 class _FilenameFilter(logging.Filter):
-    """Attach short source-file tag (ref: logrus filename hook, main.go:27-32)."""
+    """Attach short source-file tag (ref: logrus filename hook, main.go:27-32)
+    plus the active trace id, so every log record written inside a reconcile
+    span is correlatable with its /api/traces entry."""
 
     def filter(self, record: logging.LogRecord) -> bool:
         record.srcfile = f"{record.filename}:{record.lineno}"
+        tid = current_trace_id()
+        record.trace_id = tid
+        record.trace_tag = f"trace={tid} " if tid else ""
         return True
 
 
 def install_filename_log_format(json_format: bool = False, level: int = logging.INFO) -> None:
-    """Configure root logging with source-file tags; JSON format optional
-    (ref: --json-log-format for Stackdriver, main.go:40-43)."""
+    """Configure root logging with source-file + trace-id tags; JSON format
+    optional (ref: --json-log-format for Stackdriver, main.go:40-43)."""
     root = logging.getLogger()
     root.setLevel(level)
     handler = logging.StreamHandler()
@@ -89,19 +227,19 @@ def install_filename_log_format(json_format: bool = False, level: int = logging.
 
         class _JsonFormatter(logging.Formatter):
             def format(self, record: logging.LogRecord) -> str:
-                return _json.dumps(
-                    {
-                        "severity": record.levelname,
-                        "message": record.getMessage(),
-                        "file": getattr(record, "srcfile", ""),
-                        "logger": record.name,
-                        "timestamp": self.formatTime(record),
-                    }
-                )
+                out = {
+                    "severity": record.levelname,
+                    "message": record.getMessage(),
+                    "file": getattr(record, "srcfile", ""),
+                    "logger": record.name,
+                    "timestamp": self.formatTime(record),
+                }
+                if getattr(record, "trace_id", ""):
+                    out["trace"] = record.trace_id
+                return _json.dumps(out)
 
         handler.setFormatter(_JsonFormatter())
     else:
-        handler.setFormatter(
-            logging.Formatter("%(asctime)s %(levelname)s %(srcfile)s %(message)s")
-        )
+        handler.setFormatter(logging.Formatter(
+            "%(asctime)s %(levelname)s %(srcfile)s %(trace_tag)s%(message)s"))
     root.handlers[:] = [handler]

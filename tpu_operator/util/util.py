@@ -14,11 +14,12 @@ Reference parity: pkg/util/util.go:
 
 from __future__ import annotations
 
+import datetime
 import json
 import os
 import random
 import string
-from typing import Any
+from typing import Any, Optional
 
 # DNS-1035-safe alphabet (ref: util.go:55 uses lowercase letters+digits; we
 # keep letters-only first char responsibility at call sites).
@@ -44,6 +45,30 @@ def pformat(value: Any) -> str:
         return json.dumps(value, indent=2, sort_keys=True, default=str)
     except (TypeError, ValueError):
         return repr(value)
+
+
+def now_rfc3339() -> str:
+    """Current UTC time as RFC3339 with fractional seconds — the timestamp
+    format for status.phaseTimeline / lastHeartbeat / Events. Fractional
+    precision matters: phase transitions in tests are sub-second, and the
+    derived durations (statusserver.derived_durations) subtract these."""
+    return (datetime.datetime.now(datetime.timezone.utc)
+            .strftime("%Y-%m-%dT%H:%M:%S.%fZ"))
+
+
+def parse_rfc3339(value: str) -> Optional[float]:
+    """RFC3339 string (with or without fractional seconds) → epoch seconds;
+    None when empty/unparseable. Tolerant of both forms because K8s stamps
+    whole seconds (creationTimestamp) while the operator stamps micros."""
+    if not value:
+        return None
+    for fmt in ("%Y-%m-%dT%H:%M:%S.%fZ", "%Y-%m-%dT%H:%M:%SZ"):
+        try:
+            dt = datetime.datetime.strptime(value, fmt)
+            return dt.replace(tzinfo=datetime.timezone.utc).timestamp()
+        except ValueError:
+            continue
+    return None
 
 
 def get_operator_namespace() -> str:
