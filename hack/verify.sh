@@ -11,6 +11,9 @@ cd "$(dirname "$0")/.."
 
 python hack/check_payload_image.py
 python hack/gen_lock.py --check
+# Manifests-in-sync gate: examples/crd.yml and the Helm chart CRD are
+# GENERATED from tpu_operator/apis/tpujob/v1alpha1/schema.py; any schema
+# edit must ship the regenerated YAML (and repackaged chart) or CI fails.
 python hack/gen_crd.py --check
 python hack/package_chart.py --check
 # Standalone observability gate: every /metrics line must parse as valid
@@ -18,6 +21,12 @@ python hack/package_chart.py --check
 # monotonicity, _sum/_count consistency) with deterministic-clock
 # histograms — run first so a telemetry regression fails fast and alone.
 python -m pytest tests/test_metrics_conformance.py -x -q
-python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py
+# Standalone robustness gate: the chaos soak (level-1 pod kills + 10% flaky
+# API against the in-process apiserver, seeded RNG) must drive a
+# checkpointed, twice-preempted job to DONE through the Backoff phase with
+# no leaked pods — the whole time-aware recovery stack under fire.
+python -m pytest tests/test_chaos_soak.py -x -q
+python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
+  --ignore=tests/test_chaos_soak.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

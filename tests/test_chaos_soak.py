@@ -1,0 +1,195 @@
+"""Chaos soak: the full control loop survives compound, seeded misery.
+
+The operator (informers → workqueue → reconcile, in-process threads) runs
+against the real HTTP apiserver harness through a :class:`FlakyClientset`
+injecting 429/500s into 10% of its own API calls, while a chaos monkey at
+level 1 deletes managed pods and a simulated kubelet preempts the first two
+generations outright. The checkpointed job must still reach DONE:
+
+- the preemptions draw from the enlarged preemption budget (``maxRestarts``
+  is 1 — the seed-era shared budget would have failed the job on the second
+  preemption);
+- restarts are spaced through the BACKOFF phase (observed in the phase
+  timeline), released by the deadline manager's exact-time wakeup;
+- afterwards no pods from stale generations survive.
+
+Every random source is seeded; timing is thread-scheduling dependent but
+the outcome (restart count, final phase, pod set) is not.
+"""
+
+import random
+import threading
+import time
+
+import pytest
+
+from tpu_operator.client.errors import ApiError
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.client.workqueue import RateLimitingQueue
+from tpu_operator.controller.chaos import ChaosMonkey, FlakyClientset
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import Metrics
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tests.test_informer_controller import wait_for
+
+
+def soak_job_dict():
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "soak", "namespace": "default"},
+        "spec": {
+            "replicaSpecs": [{
+                "replicas": 2, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+                "template": {"spec": {"containers": [{"name": "tpu"}]}},
+            }],
+            # ONE application restart — two preemptions under the old
+            # shared budget would have failed this job.
+            "maxRestarts": 1,
+            "checkpointDir": "/ckpt/soak",
+            "restartBackoff": {"baseSeconds": 1, "maxSeconds": 4},
+        },
+    }
+
+
+class KubeletSim(threading.Thread):
+    """Walks pods Pending → Running; preempts every Running pod of
+    generations 0 and 1 (Failed with reason Preempted — kubelet-level, no
+    container record) until those generations are gone, then lets later
+    generations run briefly and succeed. Re-preempting replacements is
+    deliberate: a chaos kill can delete a Failed pod before the operator
+    observes it, and a real preempted slice keeps killing whatever lands on
+    it. The ledger's one-record-per-attempt invariant keeps the budget
+    math at exactly one preemption per generation regardless."""
+
+    PREEMPTED_ATTEMPTS = ("0", "1")
+
+    def __init__(self, cs, stop):
+        super().__init__(daemon=True, name="kubelet-sim")
+        self.cs = cs
+        self.stop_event = stop
+        self.running_since = {}
+
+    def run(self):
+        while not self.stop_event.is_set():
+            try:
+                self.tick()
+            except ApiError:
+                pass  # racing the operator's teardown is expected
+            time.sleep(0.05)
+
+    def tick(self):
+        now = time.monotonic()
+        for pod in self.cs.pods.list("default"):
+            md = pod["metadata"]
+            name = md["name"]
+            attempt = (md.get("labels") or {}).get("attempt", "")
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("", "Pending"):
+                pod["status"] = {
+                    "phase": "Running",
+                    "containerStatuses": [
+                        {"name": "tpu", "state": {"running": {}}}],
+                }
+                self.running_since.setdefault(name, now)
+                self.cs.pods.update_status("default", pod)
+            elif phase == "Running":
+                ran = now - self.running_since.get(name, now)
+                if attempt in self.PREEMPTED_ATTEMPTS and ran >= 0.2:
+                    # slice preemption: pod Failed at the kubelet level
+                    pod["status"] = {"phase": "Failed",
+                                     "reason": "Preempted",
+                                     "message": "node preempted"}
+                    self.cs.pods.update_status("default", pod)
+                elif attempt not in self.PREEMPTED_ATTEMPTS and ran >= 0.8:
+                    # checkpointed payload finishes its remaining steps
+                    pod["status"] = {
+                        "phase": "Succeeded",
+                        "containerStatuses": [
+                            {"name": "tpu",
+                             "state": {"terminated": {"exitCode": 0}}}],
+                    }
+                    self.cs.pods.update_status("default", pod)
+
+
+def test_chaos_soak_checkpointed_job_reaches_done():
+    harness = ApiServerHarness().start()
+    raw = Clientset(RestConfig(host=harness.url, timeout=5.0))
+    # The operator's own view of the world is flaky: 10% of CRUD calls
+    # throw 429/500 (seeded), exercising requeue + gang rollback paths.
+    metrics = Metrics()
+    flaky = FlakyClientset(
+        Clientset(RestConfig(host=harness.url, timeout=5.0)),
+        error_rate=0.10, rng=random.Random(7), metrics=metrics)
+
+    factory = SharedInformerFactory(flaky, "default", resync_period=1.0)
+    controller = Controller(
+        flaky, factory, namespace="default", metrics=metrics,
+        queue=RateLimitingQueue(base_delay=0.2, max_delay=1.0),
+    )
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True, name="soak-controller")
+    runner.start()
+
+    kubelet = KubeletSim(raw, stop)
+    kubelet.start()
+
+    # Level-1 chaos monkey against the raw client, seeded; stopped once the
+    # final generation appears so the run has a deterministic end state.
+    chaos_stop = threading.Event()
+    monkey = ChaosMonkey(raw, "default", level=1, interval=0.3,
+                         rng=random.Random(3), metrics=metrics)
+    chaos = threading.Thread(target=monkey.run, args=(chaos_stop,),
+                             daemon=True, name="soak-chaos")
+    chaos.start()
+
+    try:
+        raw.tpujobs.create("default", soak_job_dict())
+
+        def job_status():
+            try:
+                return raw.tpujobs.get("default", "soak").get("status") or {}
+            except ApiError:
+                return {}
+
+        # both preemption rounds must pass through the backoff phase
+        assert wait_for(lambda: job_status().get("attempt", 0) >= 2,
+                        timeout=60.0), job_status()
+        chaos_stop.set()
+
+        assert wait_for(lambda: job_status().get("phase") == "Done",
+                        timeout=60.0), job_status()
+
+        status = job_status()
+        assert status["state"] == "Succeeded"
+        assert status["attempt"] == 2
+        # backoff was observed between generations
+        assert "Backoff" in (status.get("phaseTimeline") or {}), status
+        # the ledger classified both restarts as preemption — the
+        # application budget (1) was never touched
+        kinds = [f["kind"] for f in status.get("failures") or []]
+        assert kinds == ["preemption", "preemption"], status.get("failures")
+
+        # no pods leak: only the final generation's pods remain, terminal
+        def only_final_generation():
+            pods = raw.pods.list("default")
+            return (len(pods) == 2
+                    and all(p["metadata"]["labels"]["attempt"] == "2"
+                            for p in pods)
+                    and all((p.get("status") or {}).get("phase")
+                            == "Succeeded" for p in pods))
+        assert wait_for(only_final_generation, timeout=30.0), [
+            (p["metadata"]["name"],
+             p["metadata"]["labels"].get("attempt"),
+             (p.get("status") or {}).get("phase"))
+            for p in raw.pods.list("default")]
+
+        # the soak actually exercised the chaos paths it claims to
+        snap = metrics.snapshot()
+        assert snap["chaos_api_errors_total"] > 0
+    finally:
+        chaos_stop.set()
+        stop.set()
+        runner.join(timeout=10.0)
+        harness.stop()

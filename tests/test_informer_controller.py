@@ -136,7 +136,10 @@ def test_controller_success_flow(harness):
 
 def test_controller_group_restart_flow(harness):
     cs, _controller = harness
-    cs.tpujobs.create("default", worker_job_dict())
+    job = worker_job_dict()
+    # instant re-gang: the backoff path is covered by test_time_recovery.py
+    job["spec"]["restartBackoff"] = {"baseSeconds": 0, "maxSeconds": 0}
+    cs.tpujobs.create("default", job)
     assert wait_for(lambda: len(cs.pods.list("default")) == 2)
     victim = cs.pods.list("default")[0]
     victim["status"] = {

@@ -320,3 +320,138 @@ def test_server_run_end_to_end_over_http():
             stop.set()
             th.join(timeout=10.0)
         assert not th.is_alive()
+
+
+# --- bounded retry with jittered backoff (client/rest.py) --------------------
+
+import random as _random
+
+from tpu_operator.client.rest import RestClient
+from tpu_operator.controller.chaos import FlakyClientset
+from tpu_operator.controller.statusserver import Metrics
+
+
+def retrying_client(monkeypatch, outcomes, method="GET"):
+    """RestClient whose wire layer plays back ``outcomes`` (exception
+    instances or return values); returns (client, sleeps, calls)."""
+    sleeps, calls = [], []
+    client = RestClient(RestConfig(host="http://stub:1", max_retries=3,
+                                   retry_base_delay=0.25,
+                                   retry_max_delay=2.0),
+                        metrics=Metrics(),
+                        sleep=sleeps.append,
+                        rng=_random.Random(42))
+    script = list(outcomes)
+
+    def fake_once(method_, path, body=None):
+        calls.append(method_)
+        outcome = script.pop(0)
+        if isinstance(outcome, BaseException):
+            raise outcome
+        return outcome
+
+    monkeypatch.setattr(client, "_request_once",
+                        lambda m, p, b: fake_once(m, p, b))
+    return client, sleeps, calls
+
+
+def test_rest_retries_transient_500_then_succeeds(monkeypatch):
+    client, sleeps, calls = retrying_client(monkeypatch, [
+        errors.ApiError(500, message="boom"),
+        ConnectionResetError("reset"),
+        {"ok": True},
+    ])
+    assert client.request("GET", "/api/v1/pods") == {"ok": True}
+    assert len(calls) == 3
+    assert len(sleeps) == 2
+    assert all(0 <= s <= 2.0 for s in sleeps)
+    assert client.metrics.snapshot()["api_request_retries_total"] == 2
+
+
+def test_rest_retry_honors_retry_after_on_429(monkeypatch):
+    throttled = errors.ApiError(429, message="slow down")
+    throttled.retry_after = 1.5
+    client, sleeps, _calls = retrying_client(monkeypatch,
+                                             [throttled, {"ok": 1}])
+    assert client.request("GET", "/x") == {"ok": 1}
+    assert sleeps == [1.5]  # server-directed, not jittered
+
+
+def test_rest_retry_exhausts_budget(monkeypatch):
+    client, sleeps, calls = retrying_client(
+        monkeypatch, [errors.ApiError(503, message="down")] * 4)
+    with pytest.raises(errors.ApiError) as exc:
+        client.request("GET", "/x")
+    assert exc.value.code == 503
+    assert len(calls) == 4  # initial + max_retries
+    assert len(sleeps) == 3
+
+
+def test_rest_never_retries_non_idempotent_verbs(monkeypatch):
+    for method in ("POST", "PUT"):
+        client, sleeps, calls = retrying_client(
+            monkeypatch, [errors.ApiError(500, message="boom")])
+        with pytest.raises(errors.ApiError):
+            client.request(method, "/x", body={"a": 1})
+        assert len(calls) == 1 and sleeps == []
+
+
+def test_rest_never_retries_permanent_errors(monkeypatch):
+    for code in (404, 409, 410, 422):
+        client, sleeps, calls = retrying_client(
+            monkeypatch, [errors.ApiError(code, message="no")])
+        with pytest.raises(errors.ApiError):
+            client.request("GET", "/x")
+        assert len(calls) == 1 and sleeps == []
+
+
+def test_rest_retry_against_live_server_connection_refused():
+    """The whole-path check: first attempts hit a dead port, the retry
+    budget is spent, and the failure surfaces as the transport error."""
+    sleeps = []
+    client = RestClient(RestConfig(host="http://127.0.0.1:9", timeout=0.2,
+                                   max_retries=2),
+                        sleep=sleeps.append, rng=_random.Random(1))
+    with pytest.raises(OSError):
+        client.request("GET", "/api/v1/pods")
+    assert len(sleeps) == 2
+
+
+# --- FlakyClientset (API-level chaos) ----------------------------------------
+
+def test_flaky_clientset_injects_and_passes_through():
+    from tpu_operator.client.fake import FakeClientset
+
+    metrics = Metrics()
+    flaky = FlakyClientset(FakeClientset(), error_rate=0.5,
+                           rng=_random.Random(0), metrics=metrics)
+    outcomes = {"ok": 0, "fail": 0}
+    codes = set()
+    for i in range(200):
+        try:
+            flaky.pods.create("default", {"metadata": {"name": f"p{i}"}})
+            outcomes["ok"] += 1
+        except errors.ApiError as e:
+            outcomes["fail"] += 1
+            codes.add(e.code)
+            assert "chaos: injected" in e.message
+    # seeded rng: the split is deterministic and near the configured rate
+    assert outcomes["fail"] == metrics.snapshot()["chaos_api_errors_total"]
+    assert 60 <= outcomes["fail"] <= 140
+    assert codes <= {429, 500}
+    # successful calls really landed in the backing store
+    assert len(flaky.pods.list("default") or []) >= 1 or outcomes["ok"] == 0
+
+
+def test_flaky_clientset_zero_rate_is_transparent():
+    from tpu_operator.client.fake import FakeClientset
+
+    inner = FakeClientset()
+    flaky = FlakyClientset(inner, error_rate=0.0)
+    flaky.tpujobs.create("default", worker_job_dict("clean"))
+    assert flaky.tpujobs.get("default", "clean")["metadata"]["name"] == "clean"
+    # watch passes through untouched (same object protocol)
+    w = flaky.pods.watch("default")
+    w.stop()
+    # non-resource attributes defer to the wrapped clientset
+    assert flaky.actions is inner.actions

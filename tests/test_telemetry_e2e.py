@@ -372,6 +372,43 @@ def test_heartbeat_persistence_coalesced():
     assert not controller.record_heartbeat("default", "nope", hb)
 
 
+def test_stale_generation_heartbeat_dropped():
+    """A terminating pod from the previous generation keeps posting during
+    its grace period; its heartbeat must not refresh the stall watchdog's
+    liveness baseline for the new (possibly hung) attempt."""
+    from tpu_operator.apis.tpujob.v1alpha1.types import TPUJob
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.trainer.training import TrainingJob
+
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0))
+    job = TPUJob.from_dict(worker_job("gen"))
+    job.status.attempt = 2
+    controller.jobs["default/gen"] = TrainingJob(cs, None, job)
+
+    stale = {"time": "2026-08-03T00:00:00.000000Z", "step": 9, "attempt": 1}
+    # None (not False): the status server must tell a stale drop apart from
+    # an unknown job — only the former skips the liveness-gauge stash
+    assert controller.record_heartbeat("default", "gen", stale) is None
+    assert job.status.last_heartbeat is None
+
+    # a payload that doesn't post attempt must not be stall-looped after
+    # the first restart: missing attempt is treated as current
+    legacy = {"time": "2026-08-03T00:00:00.500000Z", "step": 9}
+    assert controller.record_heartbeat("default", "gen", legacy) is True
+    assert job.status.last_heartbeat["step"] == 9
+
+    current = {"time": "2026-08-03T00:00:01.000000Z", "step": 0, "attempt": 2}
+    assert controller.record_heartbeat("default", "gen", current)
+    assert job.status.last_heartbeat["step"] == 0
+
+    # newer-than-status (informer cache lagging a just-bumped attempt) is
+    # accepted — dropping it would blind the watchdog on the live attempt
+    newer = {"time": "2026-08-03T00:00:02.000000Z", "step": 1, "attempt": 3}
+    assert controller.record_heartbeat("default", "gen", newer)
+    assert job.status.last_heartbeat["attempt"] == 3
+
+
 def test_tokens_per_batch_inference():
     import numpy as np
 

@@ -1,0 +1,761 @@
+"""Time-aware failure recovery: stall watchdog, restart backoff, per-kind
+retry budgets, active deadline, finished-TTL, and the deadline manager —
+all driven by injected clocks so every release time is asserted exactly.
+"""
+
+import pytest
+
+from tpu_operator.apis.tpujob import validation
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.workqueue import RateLimitingQueue
+from tpu_operator.controller.deadlines import GRACE_SECONDS, DeadlineManager
+from tpu_operator.controller.events import EventRecorder
+from tpu_operator.controller.statusserver import Metrics
+from tpu_operator.trainer import policy
+from tpu_operator.trainer import training
+from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.util.util import format_rfc3339, parse_rfc3339
+from tests.test_types import make_template
+
+T0 = 1_700_000_000.0  # arbitrary fixed epoch
+
+
+class FakeNow:
+    """Injectable wall clock for trainer.training._now (RFC3339 strings)."""
+
+    def __init__(self, start: float = T0):
+        self.t = start
+
+    def __call__(self) -> str:
+        return format_rfc3339(self.t)
+
+    def advance(self, dt: float) -> None:
+        self.t += dt
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    fake = FakeNow()
+    monkeypatch.setattr(training, "_now", fake)
+    return fake
+
+
+def make_job(name="timely", replicas=2, max_restarts=3, **spec_kw):
+    return t.TPUJob(
+        metadata={"name": name, "namespace": "default", "uid": "uid-t",
+                  "creationTimestamp": format_rfc3339(T0)},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=replicas, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER)
+            ],
+            runtime_id="tm01",
+            max_restarts=max_restarts,
+            **spec_kw,
+        ),
+    )
+
+
+def new_tj(job, metrics=None):
+    cs = FakeClientset()
+    cs.tpujobs.create(job.namespace, job.to_dict())
+    return cs, TrainingJob(cs, EventRecorder(cs), job, metrics=metrics)
+
+
+def set_pod_state(cs, pod, phase, state=None, reason=""):
+    status = {"phase": phase}
+    if reason:
+        status["reason"] = reason
+    if state is not None:
+        status["containerStatuses"] = [{"name": "tpu", "state": state}]
+    pod["status"] = status
+    cs.pods.update("default", pod)
+
+
+def all_running(cs):
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Running", state={"running": {}})
+
+
+def fail_pod(cs, exit_code=None, reason=""):
+    victim = cs.pods.list("default")[0]
+    if exit_code is not None:
+        set_pod_state(cs, victim, "Failed",
+                      state={"terminated": {"exitCode": exit_code}})
+    else:
+        set_pod_state(cs, victim, "Failed", reason=reason)
+
+
+# --- failure classification --------------------------------------------------
+
+@pytest.mark.parametrize("pod_status,expected_kind", [
+    ({"phase": "Failed", "reason": "Evicted"}, "preemption"),
+    ({"phase": "Failed", "reason": "Preempted"}, "preemption"),
+    ({"phase": "Failed", "containerStatuses": [
+        {"name": "tpu", "state": {"terminated": {"exitCode": 137}}}]},
+     "preemption"),  # SIGKILL, non-OOM: external termination
+    ({"phase": "Failed", "containerStatuses": [
+        {"name": "tpu", "state": {"terminated": {"exitCode": 143}}}]},
+     "preemption"),  # SIGTERM: node drain
+    ({"phase": "Failed", "containerStatuses": [
+        {"name": "tpu", "state": {"terminated": {"exitCode": 139}}}]},
+     "application"),  # SIGSEGV: payload crash
+    ({"phase": "Failed", "containerStatuses": [
+        {"name": "tpu", "state": {"terminated": {"exitCode": 1}}}]},
+     None),  # permanent, not retryable
+    ({"phase": "Failed", "containerStatuses": [
+        {"name": "tpu", "state": {"terminated":
+                                  {"exitCode": 137, "reason": "OOMKilled"}}}]},
+     None),  # OOM never retries
+])
+def test_classify_pod_failure(pod_status, expected_kind):
+    pod = {"metadata": {"name": "p"}, "status": pod_status}
+    info = policy.classify_pod_failure(pod)
+    if expected_kind is None:
+        assert info is None
+    else:
+        assert info is not None and info[0] == expected_kind
+
+
+# --- restart backoff (exact release times via injected clock) ----------------
+
+def test_backoff_parks_then_releases_exact_times(clock):
+    job = make_job(restart_backoff=t.RestartBackoffSpec(base_seconds=10,
+                                                        max_seconds=360))
+    cs, tj = new_tj(job, metrics=Metrics())
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+
+    # restart 1: teardown is immediate, gang-create parks for base seconds
+    fail_pod(cs, exit_code=139)
+    clock.advance(5.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    assert tj.job.status.attempt == 1
+    assert cs.pods.list("default") == []  # slice freed immediately
+    release1 = parse_rfc3339(tj.job.status.backoff_until)
+    assert release1 == pytest.approx(clock.t + 10.0)
+
+    # before the release time: still parked, no pods
+    clock.advance(9.5)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    assert cs.pods.list("default") == []
+
+    # past the release time: re-gangs attempt 1
+    clock.advance(1.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    assert tj.job.status.backoff_until == ""
+    pods = cs.pods.list("default")
+    assert len(pods) == 2
+    assert all(p["metadata"]["labels"]["attempt"] == "1" for p in pods)
+    events = [e["reason"] for e in cs.events.list("default")]
+    assert "BackoffComplete" in events
+
+    # restart 2 doubles the delay: exactly 20 s
+    all_running(cs)
+    tj.reconcile()
+    fail_pod(cs, exit_code=139)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    release2 = parse_rfc3339(tj.job.status.backoff_until)
+    assert release2 == pytest.approx(clock.t + 20.0)
+
+    hist = tj.metrics.histogram_snapshot("group_restart_backoff_seconds")
+    assert hist["count"] == 2
+    assert hist["sum"] == pytest.approx(30.0)
+
+
+def test_backoff_delay_capped_at_max():
+    bo = t.RestartBackoffSpec(base_seconds=10, max_seconds=60)
+    assert [bo.delay_for_restart(n) for n in (1, 2, 3, 4, 5)] == \
+        [10.0, 20.0, 40.0, 60.0, 60.0]
+    assert t.RestartBackoffSpec(base_seconds=0).delay_for_restart(1) == 0.0
+
+
+def test_zero_base_backoff_regangs_instantly(clock):
+    job = make_job(restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    fail_pod(cs, exit_code=139)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    tj.reconcile()
+    assert len(cs.pods.list("default")) == 2
+
+
+def test_reason_cleared_when_job_recovers(clock):
+    """Bugfix: a recovered job must not report its last restart forever."""
+    job = make_job(restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    fail_pod(cs, exit_code=139)
+    tj.reconcile()
+    assert "group restart" in tj.job.status.reason
+    tj.reconcile()  # recreate generation
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.reason == ""
+
+
+def test_backoff_exponent_decays_after_sustained_health(clock):
+    """An old crash burst must not inflate the delay applied to a failure
+    weeks later: the consecutive-failure streak resets after the job has
+    been Running healthily for BACKOFF_RESET_SECONDS."""
+    job = make_job(restart_backoff=t.RestartBackoffSpec(base_seconds=10,
+                                                        max_seconds=360),
+                   max_restarts=10)
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    # two quick failures escalate the delay to 2*base
+    for _ in range(2):
+        fail_pod(cs, exit_code=143)
+        tj.reconcile()
+        clock.advance(400.0)  # past any backoff
+        tj.reconcile()
+    assert tj.job.status.consecutive_failures == 2
+
+    # a long healthy stretch resets the streak...
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    clock.advance(training.BACKOFF_RESET_SECONDS + 1.0)
+    tj.reconcile()
+    assert tj.job.status.consecutive_failures == 0
+
+    # ...so the next failure waits the BASE delay again, not 4*base
+    fail_pod(cs, exit_code=143)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    release = parse_rfc3339(tj.job.status.backoff_until)
+    assert release == pytest.approx(clock.t + 10.0)
+    # lifetime counters kept the full history for the budget math
+    assert tj.job.status.restart_counts["preemption"] == 3
+
+
+def test_backoff_spec_base_only_defaults_sane_max():
+    """Omitting maxSeconds must never contradict an explicit large base."""
+    spec = t.TPUJobSpec.from_dict({
+        "replicaSpecs": [{"template": {"spec": {"containers": [
+            {"name": "tpu"}]}}}],
+        "restartBackoff": {"baseSeconds": 600},
+    })
+    set_defaults(spec)
+    validation.validate_tpujob_spec(spec)  # must not raise
+    assert spec.restart_backoff.max_seconds >= 600
+
+
+def test_backoff_spec_max_only_defaults_sane_base():
+    """Omitting baseSeconds must never contradict an explicit small max."""
+    spec = t.TPUJobSpec.from_dict({
+        "replicaSpecs": [{"template": {"spec": {"containers": [
+            {"name": "tpu"}]}}}],
+        "restartBackoff": {"maxSeconds": 5},
+    })
+    set_defaults(spec)
+    validation.validate_tpujob_spec(spec)  # must not raise
+    assert spec.restart_backoff.base_seconds <= 5
+    assert spec.restart_backoff.max_seconds == 5
+
+
+# --- per-kind retry budgets --------------------------------------------------
+
+def test_application_crash_wins_across_replica_sets(clock):
+    """A crash in a later replica set must be billed to the application
+    budget even when an earlier set's collateral SIGKILL (preemption-kind)
+    is discovered first — same application-wins rule as within one set."""
+    job = t.TPUJob(
+        metadata={"name": "ps", "namespace": "default", "uid": "uid-ps",
+                  "creationTimestamp": format_rfc3339(T0)},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=1, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.SCHEDULER),
+                t.TPUReplicaSpec(replicas=1, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.SERVER),
+            ],
+            runtime_id="ps01",
+            max_restarts=3,
+            restart_policy=t.RestartPolicy.WHOLE_GROUP,
+            restart_backoff=t.RestartBackoffSpec(base_seconds=0),
+        ),
+    )
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    # scheduler pod (first set) dies by SIGKILL, server pod segfaults
+    for p in cs.pods.list("default"):
+        code = 137 if "scheduler" in p["metadata"]["name"] else 139
+        set_pod_state(cs, p, "Failed",
+                      state={"terminated": {"exitCode": code}})
+    tj.reconcile()
+    assert [f.kind for f in tj.job.status.failures] == ["application"]
+
+
+def test_ledger_dedups_per_attempt_and_kind(clock):
+    """Re-entry with the same attempt+kind (teardown died mid-restart) must
+    not double-bill; a different kind on the same attempt (deadline expiring
+    before the attempt bump persisted) must still be recorded, or the
+    postmortem trail would contradict the terminal reason."""
+    cs, tj = new_tj(make_job())
+    tj._record_failure(0, "application", "segfault")
+    tj._record_failure(0, "application", "segfault (requeue)")
+    tj._record_failure(0, "deadline", "activeDeadlineSeconds exceeded")
+    assert [(f.attempt, f.kind) for f in tj.job.status.failures] == [
+        (0, "application"), (0, "deadline")]
+    assert tj.job.status.restart_counts == {"application": 1, "deadline": 1}
+
+
+def test_preemptions_do_not_spend_application_budget(clock):
+    # maxRestarts=1 → 1 application restart, 4 preemption restarts.
+    job = make_job(max_restarts=1,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+
+    # three consecutive preemptions: all restart, none fails the job
+    for round_ in range(3):
+        fail_pod(cs, reason="Evicted")
+        tj.reconcile()
+        assert tj.job.status.phase == t.TPUJobPhase.CREATING, round_
+        tj.reconcile()  # recreate
+    assert tj.job.status.attempt == 3
+    assert [f.kind for f in tj.job.status.failures] == ["preemption"] * 3
+
+    # application budget is still intact: one crash restarts...
+    fail_pod(cs, exit_code=139)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    tj.reconcile()
+    # ...the second exhausts it
+    fail_pod(cs, exit_code=139)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "retry budget exhausted" in tj.job.status.reason
+
+
+def test_preemption_budget_is_larger_but_finite(clock):
+    job = make_job(max_restarts=1,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    budget = 1 * t.PREEMPTION_BUDGET_FACTOR
+    for round_ in range(budget):
+        fail_pod(cs, reason="Preempted")
+        tj.reconcile()
+        assert tj.job.status.phase == t.TPUJobPhase.CREATING, round_
+        tj.reconcile()
+    fail_pod(cs, reason="Preempted")
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "retry budget exhausted" in tj.job.status.reason
+
+
+def test_failure_ledger_bounded_but_counters_lifetime(clock):
+    job = make_job(max_restarts=1000,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    n = t.FAILURE_LEDGER_CAP + 5
+    for _ in range(n):
+        fail_pod(cs, exit_code=143)
+        tj.reconcile()
+        tj.reconcile()
+    assert len(tj.job.status.failures) == t.FAILURE_LEDGER_CAP
+    # the budget counters are NOT bounded by the ledger
+    assert tj.job.status.restart_counts["preemption"] == n
+
+
+def test_budget_enforced_beyond_ledger_cap(clock):
+    """The retry budget must stay armed even when it exceeds the ledger's
+    retention: eviction of old entries cannot re-arm an exhausted budget."""
+    job = make_job(max_restarts=10,  # preemption budget 40 > ledger cap 32
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    budget = 10 * t.PREEMPTION_BUDGET_FACTOR
+    for round_ in range(budget):
+        fail_pod(cs, reason="Preempted")
+        tj.reconcile()
+        assert tj.job.status.phase == t.TPUJobPhase.CREATING, round_
+        tj.reconcile()
+    fail_pod(cs, reason="Preempted")
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "retry budget exhausted" in tj.job.status.reason
+    assert len(tj.job.status.failures) == t.FAILURE_LEDGER_CAP  # still capped
+
+
+# --- stall watchdog ----------------------------------------------------------
+
+def stalled_job(clock, stall=60, **kw):
+    job = make_job(stall_timeout_seconds=stall,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=0), **kw)
+    cs, tj = new_tj(job, metrics=Metrics())
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    return cs, tj
+
+
+def test_stall_detected_without_any_heartbeat(clock):
+    """Payload hung before its first heartbeat: the baseline falls back to
+    the last phase transition (entry into Running)."""
+    cs, tj = stalled_job(clock)
+    clock.advance(59.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING  # not yet
+    clock.advance(2.0)
+    tj.reconcile()
+    assert tj.job.status.attempt == 1
+    assert "StallDetected" in tj.job.status.reason
+    assert tj.job.status.failures[-1].kind == "stall"
+    assert tj.metrics.snapshot()["job_stalls_total"] == 1
+    assert any(e["reason"] == "StallDetected"
+               for e in cs.events.list("default"))
+    # hung pods were torn down with the generation
+    assert all(p["metadata"]["labels"]["attempt"] == "1"
+               for p in cs.pods.list("default"))
+
+
+def test_fresh_heartbeat_defers_stall(clock):
+    cs, tj = stalled_job(clock)
+    clock.advance(50.0)
+    tj.job.status.last_heartbeat = {"time": training._now(), "step": 10,
+                                    "attempt": 0}
+    clock.advance(50.0)  # 100 s after Running, 50 s after the heartbeat
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.attempt == 0
+    clock.advance(11.0)  # now 61 s since the heartbeat
+    tj.reconcile()
+    assert tj.job.status.attempt == 1
+    assert tj.job.status.failures[-1].kind == "stall"
+
+
+def test_stall_restart_respects_backoff(clock):
+    """Stale heartbeat drives the same teardown + backoff path as pod
+    death: teardown immediate, re-gang parked."""
+    job = make_job(stall_timeout_seconds=30,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=15,
+                                                        max_seconds=60))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    clock.advance(31.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    assert cs.pods.list("default") == []
+    release = parse_rfc3339(tj.job.status.backoff_until)
+    assert release == pytest.approx(clock.t + 15.0)
+
+
+def test_no_stall_when_not_configured(clock):
+    cs, tj = stalled_job(clock, stall=None)
+    clock.advance(100000.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.attempt == 0
+
+
+# --- active deadline ---------------------------------------------------------
+
+def test_deadline_exceeded_fails_job_and_frees_slice(clock):
+    job = make_job(active_deadline_seconds=300)
+    cs, tj = new_tj(job, metrics=Metrics())
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    clock.advance(299.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    clock.advance(2.0)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "DeadlineExceeded" in tj.job.status.reason
+    assert tj.job.status.failures[-1].kind == "deadline"
+    assert tj.metrics.snapshot()["job_deadline_exceeded_total"] == 1
+    assert any(e["reason"] == "DeadlineExceeded"
+               for e in cs.events.list("default"))
+    # running pods were deleted (slice freed), terminal state persisted
+    assert cs.pods.list("default") == []
+    stored = cs.tpujobs.get("default", "timely")
+    assert stored["status"]["phase"] == "Failed"
+
+
+def test_deadline_counts_from_first_creating(clock):
+    job = make_job(active_deadline_seconds=100)
+    cs, tj = new_tj(job)
+    tj.reconcile()  # stamps Creating at T0
+    # a group restart later must not reset the deadline clock
+    fail_pod(cs, exit_code=143)
+    clock.advance(50.0)
+    tj.reconcile()
+    tj.reconcile()
+    clock.advance(51.0)  # 101 s since Creating, 51 s since restart
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+    assert "DeadlineExceeded" in tj.job.status.reason
+
+
+# --- TTL after finished ------------------------------------------------------
+
+def test_ttl_reaps_finished_job(clock):
+    job = make_job(ttl_seconds_after_finished=120)
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Succeeded",
+                      state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+
+    clock.advance(119.0)
+    tj.reconcile()
+    assert cs.tpujobs.list("default")  # still there
+    clock.advance(2.0)
+    tj.reconcile()
+    assert cs.tpujobs.list("default") == []  # object reaped
+    assert cs.pods.list("default") == []     # children reaped
+    assert cs.services.list("default") == []
+    assert any(e["reason"] == "TTLExpired"
+               for e in cs.events.list("default"))
+
+
+def test_ttl_reap_disarms_obligation_and_is_idempotent(clock):
+    """After the reap, the informer cache may echo the object for a few more
+    reconciles; the past TTL must not be re-armed (50 ms wakeup hot loop)
+    and the reap path must not re-run (duplicate TTLExpired events)."""
+    job = make_job(ttl_seconds_after_finished=120)
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Succeeded",
+                      state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    clock.advance(121.0)
+    tj.reconcile()
+    assert cs.tpujobs.list("default") == []
+    assert tj.next_time_obligation() is None
+    ttl_events = [e for e in cs.events.list("default")
+                  if e["reason"] == "TTLExpired"]
+    tj.reconcile()  # cache echo: must be a no-op
+    assert tj.next_time_obligation() is None
+    assert [e for e in cs.events.list("default")
+            if e["reason"] == "TTLExpired"] == ttl_events
+
+
+def test_no_ttl_keeps_finished_job_forever(clock):
+    cs, tj = new_tj(make_job())
+    tj.reconcile()
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Succeeded",
+                      state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    clock.advance(10_000_000.0)
+    tj.reconcile()
+    assert cs.tpujobs.list("default")
+    assert len(cs.pods.list("default")) == 2  # logs retained
+
+
+# --- next_time_obligation ----------------------------------------------------
+
+def test_next_time_obligation_picks_earliest(clock):
+    job = make_job(active_deadline_seconds=1000, stall_timeout_seconds=60)
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    # Creating: only the deadline applies (stall arms on Running)
+    assert tj.next_time_obligation() == pytest.approx(T0 + 1000.0)
+    all_running(cs)
+    tj.reconcile()
+    # Running: the stall check (entry into Running + 60) is sooner
+    assert tj.next_time_obligation() == pytest.approx(
+        (parse_rfc3339(tj.job.status.last_transition_time)) + 60.0)
+
+
+def test_next_time_obligation_backoff_and_ttl(clock):
+    job = make_job(ttl_seconds_after_finished=500,
+                   restart_backoff=t.RestartBackoffSpec(base_seconds=40))
+    cs, tj = new_tj(job)
+    tj.reconcile()
+    fail_pod(cs, exit_code=143)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.BACKOFF
+    assert tj.next_time_obligation() == pytest.approx(clock.t + 40.0)
+
+    # drive to Done, expect the TTL obligation
+    clock.advance(41.0)
+    tj.reconcile()
+    for p in cs.pods.list("default"):
+        set_pod_state(cs, p, "Succeeded",
+                      state={"terminated": {"exitCode": 0}})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.DONE
+    assert tj.next_time_obligation() == pytest.approx(clock.t + 500.0)
+
+
+def test_no_obligation_for_plain_running_job(clock):
+    cs, tj = new_tj(make_job())
+    tj.reconcile()
+    all_running(cs)
+    tj.reconcile()
+    assert tj.next_time_obligation() is None
+
+
+# --- deadline manager --------------------------------------------------------
+
+class SharedClock:
+    def __init__(self):
+        self.now = 1000.0
+
+    def __call__(self):
+        return self.now
+
+
+def test_deadline_manager_schedules_exact_wakeup():
+    clock = SharedClock()
+    q = RateLimitingQueue(clock=clock)
+    dm = DeadlineManager(q, clock=clock)
+    dm.sync("default/j", clock.now + 30.0)
+    assert q.get(timeout=0) is None  # not due yet
+    clock.now += 30.0 + GRACE_SECONDS
+    assert q.get(timeout=0) == "default/j"
+
+
+def test_deadline_manager_dedups_pending_wakeups():
+    clock = SharedClock()
+    q = RateLimitingQueue(clock=clock)
+    dm = DeadlineManager(q, clock=clock)
+    # every reconcile re-syncs the same obligation: only one timer armed
+    for _ in range(5):
+        dm.sync("k", clock.now + 10.0)
+    clock.now += 60.0
+    assert q.get(timeout=0) == "k"
+    q.done("k")
+    assert q.get(timeout=0) is None
+
+
+def test_deadline_manager_earlier_obligation_wins():
+    clock = SharedClock()
+    q = RateLimitingQueue(clock=clock)
+    dm = DeadlineManager(q, clock=clock)
+    dm.sync("k", clock.now + 100.0)
+    dm.sync("k", clock.now + 10.0)  # new, earlier obligation re-arms
+    clock.now += 10.0 + GRACE_SECONDS
+    assert q.get(timeout=0) == "k"
+
+
+def test_timer_wakeups_stay_out_of_workqueue_metrics():
+    """Deadline wakeups are scheduled work, not error requeues: they must
+    not tick workqueue_retries_total, and their queue latency counts from
+    the due time, not from (possibly hours-earlier) scheduling."""
+    clock = SharedClock()
+    metrics = Metrics()
+    q = RateLimitingQueue(clock=clock, metrics=metrics)
+    dm = DeadlineManager(q, clock=clock)
+    dm.sync("k", clock.now + 86400.0)  # a day-long TTL park
+    assert metrics.snapshot()["workqueue_retries_total"] == 0
+    clock.now += 86400.0 + GRACE_SECONDS
+    assert q.get(timeout=0) == "k"
+    hist = metrics.histogram_snapshot("workqueue_queue_duration_seconds")
+    # latency sample reflects due→pop (~0), not the 86400 s park
+    assert hist["sum"] < 60.0, hist
+    # an error requeue still counts as before
+    q.add_rate_limited("k2")
+    assert metrics.snapshot()["workqueue_retries_total"] == 1
+
+
+def test_deadline_manager_forget():
+    clock = SharedClock()
+    q = RateLimitingQueue(clock=clock)
+    dm = DeadlineManager(q, clock=clock)
+    dm.sync("k", clock.now + 5.0)
+    assert dm.pending("k") is not None
+    dm.forget("k")
+    assert dm.pending("k") is None
+    dm.sync("k", None)
+    assert len(dm) == 0
+
+
+# --- spec plumbing -----------------------------------------------------------
+
+def test_new_spec_fields_roundtrip_and_default():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(template=make_template())],
+        active_deadline_seconds=600,
+        stall_timeout_seconds=120,
+        ttl_seconds_after_finished=0,
+        restart_backoff=t.RestartBackoffSpec(base_seconds=5, max_seconds=50),
+    )
+    wire = spec.to_dict()
+    assert wire["activeDeadlineSeconds"] == 600
+    assert wire["stallTimeoutSeconds"] == 120
+    assert wire["ttlSecondsAfterFinished"] == 0
+    assert wire["restartBackoff"] == {"baseSeconds": 5, "maxSeconds": 50}
+    back = t.TPUJobSpec.from_dict(wire)
+    assert back.active_deadline_seconds == 600
+    assert back.stall_timeout_seconds == 120
+    assert back.ttl_seconds_after_finished == 0
+    assert back.restart_backoff.base_seconds == 5
+
+    # unset: absent from the wire; defaulting fills only the backoff
+    plain = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(template=make_template())])
+    wire = plain.to_dict()
+    for key in ("activeDeadlineSeconds", "stallTimeoutSeconds",
+                "ttlSecondsAfterFinished", "restartBackoff"):
+        assert key not in wire
+    set_defaults(plain)
+    assert plain.restart_backoff.base_seconds == t.DEFAULT_RESTART_BACKOFF_BASE
+    assert plain.restart_backoff.max_seconds == t.DEFAULT_RESTART_BACKOFF_MAX
+    assert plain.active_deadline_seconds is None
+
+    # an explicit zero-base backoff survives defaulting (opt-out)
+    zero = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(template=make_template())],
+        restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    set_defaults(zero)
+    assert zero.restart_backoff.base_seconds == 0
+
+
+def test_status_ledger_roundtrip():
+    st = t.TPUJobStatus(
+        phase=t.TPUJobPhase.BACKOFF,
+        backoff_until=format_rfc3339(T0),
+        last_transition_time=format_rfc3339(T0),
+        failures=[t.FailureRecord(attempt=0, kind="preemption",
+                                  reason="pod x failed: Evicted",
+                                  time=format_rfc3339(T0))],
+    )
+    wire = st.to_dict()
+    assert wire["backoffUntil"] == format_rfc3339(T0)
+    assert wire["failures"][0]["kind"] == "preemption"
+    back = t.TPUJobStatus.from_dict(wire)
+    assert back.phase == t.TPUJobPhase.BACKOFF
+    assert back.failures[0].reason == "pod x failed: Evicted"
+    assert back.to_dict() == wire
+
+
+@pytest.mark.parametrize("kw,msg", [
+    ({"active_deadline_seconds": 0}, "activeDeadlineSeconds"),
+    ({"stall_timeout_seconds": -5}, "stallTimeoutSeconds"),
+    ({"ttl_seconds_after_finished": -1}, "ttlSecondsAfterFinished"),
+    ({"restart_backoff": t.RestartBackoffSpec(base_seconds=-1)},
+     "baseSeconds"),
+    ({"restart_backoff": t.RestartBackoffSpec(base_seconds=10,
+                                              max_seconds=5)},
+     "maxSeconds"),
+])
+def test_validation_rejects_bad_time_fields(kw, msg):
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(template=make_template())], **kw)
+    set_defaults(spec)
+    with pytest.raises(validation.ValidationError) as exc:
+        validation.validate_tpujob_spec(spec)
+    assert msg in str(exc.value)

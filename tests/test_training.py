@@ -44,7 +44,10 @@ def test_exit_code_contract(term, retryable, permanent, success):
 
 # --- fixtures ----------------------------------------------------------------
 
-def worker_job(replicas=2, name="train", max_restarts=3):
+def worker_job(replicas=2, name="train", max_restarts=3, backoff_base=0):
+    # backoff_base 0: these lifecycle tests assert the *instant* re-gang
+    # semantics; the time-aware backoff path has its own fake-clock tests
+    # (test_time_recovery.py).
     return t.TPUJob(
         metadata={"name": name, "namespace": "default", "uid": "uid-9"},
         spec=t.TPUJobSpec(
@@ -54,6 +57,7 @@ def worker_job(replicas=2, name="train", max_restarts=3):
             ],
             runtime_id="r1d2",
             max_restarts=max_restarts,
+            restart_backoff=t.RestartBackoffSpec(base_seconds=backoff_base),
         ),
     )
 
@@ -274,16 +278,21 @@ def test_permanent_failure_frees_live_pods():
 
 
 def test_group_restart_budget_exhausted():
+    # exit 139 (SIGSEGV): application-kind crash, billed to maxRestarts
+    # (exit 137/143 are preemption-kind and draw the larger budget —
+    # test_time_recovery.py covers that split).
     cs, tj = new_training_job(worker_job(max_restarts=1))
     tj.reconcile()
     for round_ in range(2):
         victim = cs.pods.list("default")[0]
         set_container_state(cs, victim, "Failed",
-                            state={"terminated": {"exitCode": 137}})
+                            state={"terminated": {"exitCode": 139}})
         tj.reconcile()
         tj.reconcile()  # recreate next generation if restarted
     assert tj.job.status.phase == t.TPUJobPhase.FAILED
     assert "retry budget exhausted" in tj.job.status.reason
+    # the classification ledger recorded both application-kind failures
+    assert [f.kind for f in tj.job.status.failures] == ["application"] * 2
 
 
 def test_per_pod_mode_no_group_restart():

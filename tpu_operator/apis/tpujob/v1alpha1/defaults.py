@@ -14,6 +14,7 @@ from __future__ import annotations
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_TPU_PORT,
     DEFAULT_TPU_REPLICAS,
+    RestartBackoffSpec,
     RestartPolicy,
     TerminationPolicySpec,
     TPUJobSpec,
@@ -62,4 +63,10 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
         spec.max_restarts = 0
     if spec.num_slices < 1:
         spec.num_slices = 1
+
+    # Restart backoff (time-aware recovery): default to the exponential
+    # 10 s → 360 s schedule; an explicit ``baseSeconds: 0`` (kept as-is)
+    # opts a job out of backoff entirely.
+    if spec.restart_backoff is None:
+        spec.restart_backoff = RestartBackoffSpec()
     return spec

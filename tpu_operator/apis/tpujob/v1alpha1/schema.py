@@ -97,6 +97,14 @@ def spec_schema() -> Dict[str, Any]:
         "checkpointDir": _str(),
         "profileDir": _str(),
         "suspend": {"type": "boolean"},
+        # Time-aware recovery (batch/v1 Job analogues).
+        "activeDeadlineSeconds": _int(minimum=1),
+        "stallTimeoutSeconds": _int(minimum=1),
+        "ttlSecondsAfterFinished": _int(minimum=0),
+        "restartBackoff": _obj({
+            "baseSeconds": _int(minimum=0),
+            "maxSeconds": _int(minimum=0),
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -104,7 +112,7 @@ def status_schema() -> Dict[str, Any]:
     phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
               types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
               types.TPUJobPhase.FAILED, types.TPUJobPhase.DONE,
-              types.TPUJobPhase.SUSPENDED]
+              types.TPUJobPhase.SUSPENDED, types.TPUJobPhase.BACKOFF]
     states = [types.State.UNKNOWN, types.State.RUNNING,
               types.State.SUCCEEDED, types.State.FAILED]
     replica_states = [types.ReplicaState.UNKNOWN, types.ReplicaState.STARTING,
@@ -139,6 +147,25 @@ def status_schema() -> Dict[str, Any]:
             "loss": _num(),
             "time": _str(),
         }),
+        # Most recent phase *change* (stall-watchdog baseline; RFC3339).
+        "lastTransitionTime": _str(),
+        # Gang-create release time while phase is Backoff (RFC3339).
+        "backoffUntil": _str(),
+        # Failure-classification ledger (bounded postmortem trail).
+        "failures": _arr(_obj({
+            "attempt": _int(minimum=0),
+            "kind": _str(enum=list(types.FailureKind.ALL)),
+            "reason": _str(),
+            "time": _str(),
+        })),
+        # Lifetime failure counters by kind (retry budgets charge these).
+        "restartCounts": {
+            "type": "object",
+            "additionalProperties": _int(minimum=0),
+        },
+        # Failures since the last sustained healthy stretch (backoff
+        # exponent; decays, unlike restartCounts).
+        "consecutiveFailures": _int(minimum=0),
     })
 
 

@@ -97,6 +97,12 @@ class TPUJobPhase:
     # services remain, and clearing the flag resumes the same attempt
     # (payloads continue from their checkpoint).
     SUSPENDED = "Suspended"
+    # Time-aware recovery: the failed generation is already torn down (the
+    # slice is freed immediately) but the next gang-create is parked until
+    # ``status.backoffUntil`` — exponential spacing between group restarts
+    # so a crash-looping payload cannot burn its whole retry budget in
+    # seconds (batch/v1 Job backoff semantics, whole-group flavored).
+    BACKOFF = "Backoff"
 
 
 class State:
@@ -112,6 +118,40 @@ class ReplicaState:
     RUNNING = "Running"
     SUCCEEDED = "Succeeded"
     FAILED = "Failed"
+
+
+# --- Failure taxonomy (time-aware recovery) ----------------------------------
+
+class FailureKind:
+    """Classification of one group-restart-triggering failure, recorded in
+    the ``status.failures`` ledger. Preemption-kind failures (slice
+    preempted, node drained, SIGTERM/SIGKILL from outside) draw from a
+    separate, larger retry budget than application crashes — a
+    preemption-heavy slice must not exhaust the budget meant to stop
+    genuinely crash-looping payloads (podFailurePolicy-style
+    classification, batch/v1 Job)."""
+
+    PREEMPTION = "preemption"
+    APPLICATION = "application"
+    STALL = "stall"
+    DEADLINE = "deadline"
+
+    ALL = (PREEMPTION, APPLICATION, STALL, DEADLINE)
+
+
+# Preemption-kind restarts get this multiple of spec.maxRestarts as their
+# own budget (application/stall restarts use spec.maxRestarts directly).
+PREEMPTION_BUDGET_FACTOR = 4
+
+# Upper bound on retained status.failures entries (newest kept); the ledger
+# is a postmortem aid, not an unbounded event log.
+FAILURE_LEDGER_CAP = 32
+
+# Restart backoff defaults (exponential, per group restart): base doubles
+# each attempt, capped. Mirrors the workqueue's 10 s base and K8s Job's
+# 6-minute cap.
+DEFAULT_RESTART_BACKOFF_BASE = 10
+DEFAULT_RESTART_BACKOFF_MAX = 360
 
 
 # --- Restart / gang policy (TPU-native addition) ----------------------------
@@ -160,6 +200,48 @@ class TerminationPolicySpec:
             chief_replica_name=chief.get("replicaName", TPUReplicaType.WORKER),
             chief_replica_index=int(chief.get("replicaIndex", 0)),
         )
+
+
+@dataclass
+class RestartBackoffSpec:
+    """Exponential spacing between whole-group restarts: restart N waits
+    ``min(baseSeconds * 2**(N-1), maxSeconds)`` in phase Backoff before the
+    next gang-create (teardown is immediate — the slice frees right away).
+    ``baseSeconds: 0`` disables backoff (instant re-gang, the pre-backoff
+    behavior)."""
+
+    base_seconds: int = DEFAULT_RESTART_BACKOFF_BASE
+    max_seconds: int = DEFAULT_RESTART_BACKOFF_MAX
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"baseSeconds": self.base_seconds,
+                "maxSeconds": self.max_seconds}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["RestartBackoffSpec"]:
+        if d is None:
+            return None
+        # A defaulted field must never contradict an explicit one, or
+        # validation would fail the job over a field the user never wrote:
+        # an omitted base caps at an explicit small max, and an omitted max
+        # floors at an explicit large base.
+        base_default = DEFAULT_RESTART_BACKOFF_BASE
+        if d.get("maxSeconds") is not None:
+            base_default = min(base_default, int(d["maxSeconds"]))
+        base = int(d.get("baseSeconds", base_default))
+        max_default = max(base, DEFAULT_RESTART_BACKOFF_MAX)
+        return cls(
+            base_seconds=base,
+            max_seconds=int(d.get("maxSeconds", max_default)),
+        )
+
+    def delay_for_restart(self, n: int) -> float:
+        """Backoff before restart ``n`` (1-indexed)."""
+        if self.base_seconds <= 0 or n < 1:
+            return 0.0
+        return float(min(self.base_seconds * (2 ** (n - 1)),
+                         self.max_seconds))
 
 
 @dataclass
@@ -234,6 +316,24 @@ class TPUJobSpec:
     # slice frees for other work; false resumes the same attempt (retry
     # budget untouched; checkpointed payloads continue where they stopped).
     suspend: bool = False
+    # Time-aware recovery (batch/v1 Job analogues). All wall-clock driven;
+    # enforcement is exact-time via the controller's deadline manager, not
+    # resync-granularity.
+    # Hard cap on total job wall time measured from the first entry into
+    # phase Creating; exceeding it fails the job with DeadlineExceeded.
+    active_deadline_seconds: Optional[int] = None
+    # Hung-payload watchdog: while Running, if neither a heartbeat nor a
+    # phase transition happened in this many seconds, the whole group is
+    # restarted with reason StallDetected. Only set this on jobs whose
+    # payload posts heartbeats (TPUJOB_STATUS_URL) — a silent payload is
+    # indistinguishable from a hung one.
+    stall_timeout_seconds: Optional[int] = None
+    # Exponential spacing between whole-group restarts (None → defaulted).
+    restart_backoff: Optional[RestartBackoffSpec] = None
+    # Once the job is Done/Failed for this many seconds, the operator
+    # deletes the TPUJob (children follow via OwnerReferences / explicit
+    # teardown) — batch/v1 ttlSecondsAfterFinished.
+    ttl_seconds_after_finished: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -258,10 +358,21 @@ class TPUJobSpec:
             d["profileDir"] = self.profile_dir
         if self.suspend:
             d["suspend"] = True
+        if self.active_deadline_seconds is not None:
+            d["activeDeadlineSeconds"] = self.active_deadline_seconds
+        if self.stall_timeout_seconds is not None:
+            d["stallTimeoutSeconds"] = self.stall_timeout_seconds
+        if self.restart_backoff is not None:
+            d["restartBackoff"] = self.restart_backoff.to_dict()
+        if self.ttl_seconds_after_finished is not None:
+            d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
         return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "TPUJobSpec":
+        def opt_int(key: str) -> Optional[int]:
+            return int(d[key]) if d.get(key) is not None else None
+
         return cls(
             replica_specs=[TPUReplicaSpec.from_dict(r) for r in d.get("replicaSpecs", [])],
             termination_policy=TerminationPolicySpec.from_dict(d.get("terminationPolicy")),
@@ -274,6 +385,11 @@ class TPUJobSpec:
             checkpoint_dir=str(d.get("checkpointDir", "")),
             profile_dir=str(d.get("profileDir", "")),
             suspend=bool(d.get("suspend", False)),
+            active_deadline_seconds=opt_int("activeDeadlineSeconds"),
+            stall_timeout_seconds=opt_int("stallTimeoutSeconds"),
+            restart_backoff=RestartBackoffSpec.from_dict(
+                d.get("restartBackoff")),
+            ttl_seconds_after_finished=opt_int("ttlSecondsAfterFinished"),
         )
 
 
@@ -305,6 +421,32 @@ class TPUReplicaStatus:
 
 
 @dataclass
+class FailureRecord:
+    """One entry of the failure-classification ledger
+    (``status.failures``): which attempt failed, how it was classified
+    (FailureKind), and why — the record the retry budgets are computed
+    from, and the postmortem trail ``kubectl get -o yaml`` shows."""
+
+    attempt: int = 0
+    kind: str = FailureKind.APPLICATION
+    reason: str = ""
+    time: str = ""
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"attempt": self.attempt, "kind": self.kind,
+                "reason": self.reason, "time": self.time}
+
+    @classmethod
+    def from_dict(cls, d: Dict[str, Any]) -> "FailureRecord":
+        return cls(
+            attempt=int(d.get("attempt", 0)),
+            kind=str(d.get("kind", FailureKind.APPLICATION)),
+            reason=str(d.get("reason", "")),
+            time=str(d.get("time", "")),
+        )
+
+
+@dataclass
 class TPUJobStatus:
     """Job status written back to the CRD (ref: types.go:117-135)."""
 
@@ -322,6 +464,24 @@ class TPUJobStatus:
     # status server: {step, stepTimeSeconds, tokensPerSec, loss, time, ...}.
     # ``kubectl get -o yaml`` shows a hung slice as a stale timestamp here.
     last_heartbeat: Optional[Dict[str, Any]] = None
+    # Time-aware recovery state:
+    # RFC3339 stamp of the most recent phase *change* (unlike phaseTimeline,
+    # which keeps only the first entry into each phase) — the stall
+    # watchdog's fallback baseline for jobs that have not heartbeated since
+    # the current attempt started running.
+    last_transition_time: str = ""
+    # While phase is Backoff: RFC3339 release time of the next gang-create.
+    backoff_until: str = ""
+    # Failure-classification ledger (newest last, bounded at
+    # FAILURE_LEDGER_CAP) — the human-readable postmortem trail.
+    failures: List[FailureRecord] = field(default_factory=list)
+    # Per-kind lifetime failure counters — the retry budgets are charged
+    # against THESE, not the bounded ledger (whose eviction would otherwise
+    # silently re-arm an exhausted budget).
+    restart_counts: Dict[str, int] = field(default_factory=dict)
+    # Failures since the job last ran healthily for a sustained stretch —
+    # the restart-backoff exponent (decays, unlike the lifetime counters).
+    consecutive_failures: int = 0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -335,6 +495,16 @@ class TPUJobStatus:
             d["phaseTimeline"] = dict(self.phase_timeline)
         if self.last_heartbeat:
             d["lastHeartbeat"] = dict(self.last_heartbeat)
+        if self.last_transition_time:
+            d["lastTransitionTime"] = self.last_transition_time
+        if self.backoff_until:
+            d["backoffUntil"] = self.backoff_until
+        if self.failures:
+            d["failures"] = [f.to_dict() for f in self.failures]
+        if self.restart_counts:
+            d["restartCounts"] = dict(self.restart_counts)
+        if self.consecutive_failures:
+            d["consecutiveFailures"] = self.consecutive_failures
         return d
 
     @classmethod
@@ -354,6 +524,13 @@ class TPUJobStatus:
             },
             last_heartbeat=(dict(d["lastHeartbeat"])
                             if d.get("lastHeartbeat") else None),
+            last_transition_time=str(d.get("lastTransitionTime", "")),
+            backoff_until=str(d.get("backoffUntil", "")),
+            failures=[FailureRecord.from_dict(f)
+                      for f in d.get("failures", [])],
+            restart_counts={str(k): int(v) for k, v in
+                            (d.get("restartCounts") or {}).items()},
+            consecutive_failures=int(d.get("consecutiveFailures", 0)),
         )
 
 

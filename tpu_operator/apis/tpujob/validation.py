@@ -89,6 +89,22 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
     if spec.num_slices < 1:
         raise ValidationError("numSlices must be >= 1")
 
+    # Time-aware recovery fields (batch/v1 Job analogues).
+    if spec.active_deadline_seconds is not None and spec.active_deadline_seconds < 1:
+        raise ValidationError("activeDeadlineSeconds must be >= 1")
+    if spec.stall_timeout_seconds is not None and spec.stall_timeout_seconds < 1:
+        raise ValidationError("stallTimeoutSeconds must be >= 1")
+    if spec.ttl_seconds_after_finished is not None and spec.ttl_seconds_after_finished < 0:
+        raise ValidationError("ttlSecondsAfterFinished must be >= 0")
+    if spec.restart_backoff is not None:
+        bo = spec.restart_backoff
+        if bo.base_seconds < 0:
+            raise ValidationError("restartBackoff.baseSeconds must be >= 0")
+        if bo.max_seconds < bo.base_seconds:
+            raise ValidationError(
+                "restartBackoff.maxSeconds must be >= baseSeconds"
+            )
+
 
 def _validate_template(index: int, template: dict) -> None:
     """Template must contain a container named DEFAULT_CONTAINER_NAME

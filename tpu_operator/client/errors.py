@@ -16,11 +16,15 @@ class ApiError(Exception):
     """A Kubernetes API error carrying the HTTP status code and Status body."""
 
     def __init__(self, code: int, reason: str = "", message: str = "",
-                 status: Optional[Dict[str, Any]] = None):
+                 status: Optional[Dict[str, Any]] = None,
+                 retry_after: Optional[float] = None):
         self.code = code
         self.reason = reason or _default_reason(code)
         self.message = message
         self.status = status or {}
+        # Delta-seconds Retry-After from a 429 response, for the retry
+        # layer to honor; None everywhere else.
+        self.retry_after = retry_after
         super().__init__(f"{self.code} {self.reason}: {message}")
 
 

@@ -26,19 +26,36 @@ from __future__ import annotations
 
 import http.client
 import json
+import random
 import socket
 import ssl
 import threading
+import time
 import urllib.parse
 from dataclasses import dataclass, field
 from http.client import HTTPConnection, HTTPSConnection
-from typing import Any, Dict, Iterator, List, Optional, Tuple
+from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from tpu_operator.client import errors
 
 # Sentinel distinguishing "use the config default" from an explicit None
 # (= no socket timeout, required for long-lived watch streams).
 _DEFAULT_TIMEOUT = object()
+
+# Verbs safe to replay blindly: repeating a read (or a delete — the second
+# attempt just 404s) cannot double-apply anything, unlike POST/PUT where the
+# first attempt may have landed before the connection died.
+_IDEMPOTENT_VERBS = frozenset({"GET", "HEAD", "DELETE"})
+
+# Status codes worth retrying on idempotent verbs: throttling and transient
+# server-side failures. 4xx other than 429 are the caller's bug; 410 Gone is
+# a watch-protocol signal the informer must see, never retried here.
+_RETRYABLE_CODES = frozenset({429, 500, 502, 503, 504})
+
+# Ceiling on a server-supplied Retry-After: the header is honored (it beats
+# blind jitter) but must not let a hostile or misconfigured proxy park a
+# controller thread for an hour per retry.
+RETRY_AFTER_CAP = 30.0
 
 
 @dataclass
@@ -53,6 +70,11 @@ class RestConfig:
     insecure_skip_tls_verify: bool = False
     timeout: float = 30.0
     extra_headers: Dict[str, str] = field(default_factory=dict)
+    # Bounded retry for transient failures (connection reset, 429, 5xx) on
+    # idempotent verbs; 0 restores the old one-shot behavior.
+    max_retries: int = 3
+    retry_base_delay: float = 0.25  # doubles per retry, full jitter applied
+    retry_max_delay: float = 2.0
 
     def ssl_context(self) -> Optional[ssl.SSLContext]:
         if not self.host.startswith("https"):
@@ -127,10 +149,23 @@ class _StreamWatch:
 
 class RestClient:
     """Low-level request runner; one connection per call (watch holds its
-    own), so it is thread-safe without pooling complexity."""
+    own), so it is thread-safe without pooling complexity.
 
-    def __init__(self, config: RestConfig):
+    Idempotent verbs (GET/HEAD/DELETE — list, get, delete, watch open) get
+    bounded retry with jittered exponential backoff on transient failures:
+    connection resets/timeouts, 429 (honoring ``Retry-After``), and 5xx.
+    POST/PUT are never replayed — the first attempt may have been applied
+    before the failure. Each retry ticks ``api_request_retries_total`` when
+    a metrics registry is attached (``metrics`` is assigned post-construction
+    by the server bootstrap, once the controller's registry exists)."""
+
+    def __init__(self, config: RestConfig, metrics: Optional[Any] = None,
+                 sleep: Callable[[float], None] = time.sleep,
+                 rng: Optional[random.Random] = None):
         self.config = config
+        self.metrics = metrics
+        self._sleep = sleep
+        self._rng = rng or random.Random()
         parsed = urllib.parse.urlparse(config.host)
         self._https = parsed.scheme == "https"
         self._netloc = parsed.netloc or parsed.path
@@ -149,11 +184,55 @@ class RestClient:
         headers.update(self.config.extra_headers)
         return headers
 
+    # -- retry plumbing --------------------------------------------------------
+
+    def _retry_delay(self, attempt: int,
+                     retry_after: Optional[float]) -> float:
+        """Server-directed wait (429 Retry-After) or full-jitter exponential
+        backoff: uniform in (0, min(base * 2^attempt, cap)] — the AWS
+        full-jitter shape, which decorrelates a thundering herd of
+        controllers hitting one throttled apiserver."""
+        if retry_after is not None:
+            return min(retry_after, RETRY_AFTER_CAP)
+        cap = min(self.config.retry_base_delay * (2 ** attempt),
+                  self.config.retry_max_delay)
+        return cap * self._rng.random()
+
+    def _run_with_retry(self, method: str, once: Callable[[], Any]) -> Any:
+        attempt = 0
+        while True:
+            try:
+                return once()
+            except errors.ApiError as e:
+                if (method not in _IDEMPOTENT_VERBS
+                        or e.code not in _RETRYABLE_CODES
+                        or attempt >= self.config.max_retries):
+                    raise
+                delay = self._retry_delay(attempt, e.retry_after)
+            except (OSError, http.client.HTTPException):
+                # Connection-level failure before a response arrived
+                # (reset, refused, timeout, truncated status line).
+                if (method not in _IDEMPOTENT_VERBS
+                        or attempt >= self.config.max_retries):
+                    raise
+                delay = self._retry_delay(attempt, None)
+            attempt += 1
+            if self.metrics is not None:
+                self.metrics.inc("api_request_retries_total")
+            self._sleep(delay)
+
+    # -- verbs -----------------------------------------------------------------
+
     def request(self, method: str, path: str,
                 params: Optional[Dict[str, str]] = None,
                 body: Optional[dict] = None) -> Any:
         if params:
             path = f"{path}?{urllib.parse.urlencode(params)}"
+        return self._run_with_retry(
+            method, lambda: self._request_once(method, path, body))
+
+    def _request_once(self, method: str, path: str,
+                      body: Optional[dict]) -> Any:
         conn = self._connect()
         try:
             conn.request(
@@ -164,25 +243,35 @@ class RestClient:
             resp = conn.getresponse()
             data = resp.read()
             if resp.status >= 300:
-                raise _status_error(resp.status, data)
+                raise _status_error(resp.status, data,
+                                    resp.getheader("Retry-After"))
             return json.loads(data) if data else None
         finally:
             conn.close()
 
     def stream(self, path: str, params: Dict[str, str]) -> _StreamWatch:
-        """Open a watch stream (no read timeout — watches are long-lived)."""
+        """Open a watch stream (no read timeout — watches are long-lived).
+        The *open* is retried like any idempotent GET (watch re-open races
+        an apiserver restart constantly); an established stream's errors
+        stay the informer's to handle (re-list + re-watch)."""
         qs = urllib.parse.urlencode(params)
+        return self._run_with_retry(
+            "GET", lambda: self._stream_once(f"{path}?{qs}"))
+
+    def _stream_once(self, path_qs: str) -> _StreamWatch:
         conn = self._connect(timeout=None)
-        conn.request("GET", f"{path}?{qs}", headers=self._headers())
+        conn.request("GET", path_qs, headers=self._headers())
         resp = conn.getresponse()
         if resp.status >= 300:
             data = resp.read()
+            retry_after = resp.getheader("Retry-After")
             conn.close()
-            raise _status_error(resp.status, data)
+            raise _status_error(resp.status, data, retry_after)
         return _StreamWatch(resp, conn)
 
 
-def _status_error(code: int, data: bytes) -> errors.ApiError:
+def _status_error(code: int, data: bytes,
+                  retry_after_header: Optional[str] = None) -> errors.ApiError:
     reason, message, status = "", "", {}
     try:
         status = json.loads(data)
@@ -190,7 +279,16 @@ def _status_error(code: int, data: bytes) -> errors.ApiError:
         message = status.get("message", "")
     except (json.JSONDecodeError, AttributeError):
         message = data.decode("utf-8", "replace")[:500]
-    return errors.ApiError(code, reason, message, status)
+    # Delta-seconds Retry-After (the throttling form; HTTP-date is ignored)
+    # rides along for the retry layer to honor on 429s.
+    retry_after = None
+    if retry_after_header:
+        try:
+            retry_after = max(0.0, float(retry_after_header))
+        except ValueError:
+            pass
+    return errors.ApiError(code, reason, message, status,
+                           retry_after=retry_after)
 
 
 class RestResourceClient:

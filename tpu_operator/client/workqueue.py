@@ -152,14 +152,22 @@ class RateLimitingQueue:
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 
-    def add_after(self, item: Any, delay: float) -> None:
+    def add_after(self, item: Any, delay: float, timer: bool = False) -> None:
+        """Delayed enqueue. ``timer=True`` marks a scheduled wakeup (the
+        deadline manager's exact-time obligations) rather than an error
+        requeue: it is excluded from ``workqueue_retries_total``, and its
+        queue latency is measured from when the item becomes *due* (stamped
+        at drain time) instead of from scheduling — a TTL wakeup parked for
+        a day must not land a 86400 s sample in the queue-duration
+        histogram that exists to answer "how long did work wait?"."""
         with self._cond:
             if self._shutdown:
                 return
-            if self._metrics is not None:
-                self._metrics.inc("workqueue_retries_total")
+            if not timer:
+                if self._metrics is not None:
+                    self._metrics.inc("workqueue_retries_total")
+                self._added_at.setdefault(item, self._clock())
             self._seq += 1
-            self._added_at.setdefault(item, self._clock())
             heapq.heappush(self._delayed, (self._clock() + delay, self._seq, item))
             self._cond.notify()
 

@@ -162,6 +162,13 @@ def cmd_describe(cs, opts) -> int:
     for rs in spec.get("replicaSpecs", []):
         print(f"  {rs.get('tpuReplicaType', 'WORKER')}: "
               f"{rs.get('replicas', 0)} × port {rs.get('tpuPort', '')}")
+    if status.get("backoffUntil"):
+        print(f"Backoff:    re-gang parked until {status['backoffUntil']}")
+    if status.get("failures"):
+        print("Failures:")
+        for f in status["failures"][-10:]:
+            print(f"  attempt {f.get('attempt', 0)}\t{f.get('kind', '')}\t"
+                  f"{f.get('reason', '')}\t{f.get('time', '')}")
     if status.get("replicaStatuses"):
         print("Replica statuses:")
         for rstat in status["replicaStatuses"]:

@@ -23,6 +23,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "one managed pod per chaos interval (default: off)")
     p.add_argument("--chaos-interval", type=float, default=30.0,
                    help="seconds between chaos kills when --chaos-level >= 0")
+    p.add_argument("--chaos-api-error-rate", type=float, default=0.0,
+                   help="DANGEROUS: probability (0-1) of injecting a 429/500 "
+                        "ApiError into each of the operator's own API calls "
+                        "(FlakyClientset; default: off)")
+    p.add_argument("--chaos-api-latency", type=float, default=0.0,
+                   help="max seconds of uniform latency injected per API "
+                        "call when --chaos-api-error-rate is set")
     p.add_argument("--gc-interval", type=float, default=600.0,
                    help="seconds between orphaned-child GC sweeps")
     p.add_argument("--controller-config-file", default="",

@@ -16,7 +16,7 @@ from typing import Any, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import ControllerConfig
 from tpu_operator.client.informer import SharedInformerFactory
-from tpu_operator.controller.chaos import ChaosMonkey
+from tpu_operator.controller.chaos import ChaosMonkey, FlakyClientset
 from tpu_operator.controller.controller import Controller
 from tpu_operator.controller.leaderelection import LeaderElector
 from tpu_operator.controller.statusserver import StatusServer
@@ -51,9 +51,27 @@ def run(opts: Any, clientset: Optional[Any] = None,
                                           tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()
 
+    api_error_rate = getattr(opts, "chaos_api_error_rate", 0.0)
+    if api_error_rate > 0:
+        # API-level chaos: the controller (and its informers) see injected
+        # 429/500s + latency on every call; the leader elector and chaos
+        # monkey below share the same flaky view — production-shaped misery.
+        clientset = FlakyClientset(
+            clientset, error_rate=api_error_rate,
+            max_latency=getattr(opts, "chaos_api_latency", 0.0))
+        log.warning("chaos: flaky clientset enabled (error rate %.0f%%)",
+                    api_error_rate * 100)
+
     factory = SharedInformerFactory(clientset, namespace,
                                     resync_period=opts.resync_period)
     controller = Controller(clientset, factory, config, namespace)
+    # Late-bind the metrics registry into the chaos wrapper and the REST
+    # transport (both exist before the controller's registry does).
+    if isinstance(clientset, FlakyClientset):
+        clientset.metrics = controller.metrics
+    rest = getattr(clientset, "rest", None)
+    if rest is not None and getattr(rest, "metrics", None) is None:
+        rest.metrics = controller.metrics
 
     # Observability binds before leader election: standbys must answer
     # kubelet probes too (statusserver.py; the reference had no probes,

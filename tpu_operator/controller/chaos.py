@@ -6,6 +6,13 @@ deletes one random **running, operator-managed** pod, exercising exactly the
 failure path TPU jobs live with in production (slice preemption → whole-group
 restart). Level scales aggression: level N kills up to N+1 pods per tick.
 
+Beyond pod kills, :class:`FlakyClientset` (opt-in ``--chaos-api-error-rate``)
+attacks the operator's *own* control-plane calls: it wraps a clientset and
+injects ApiError 429/500s and latency into CRUD verbs, so the retry/requeue
+machinery (client/rest.py backoff, workqueue rate limiting, gang-create
+rollback) is exercised continuously instead of only when production
+misbehaves.
+
 Never touches pods without the operator's group label, and never runs unless
 explicitly enabled — same blast-radius discipline kube-monkey uses.
 """
@@ -15,7 +22,8 @@ from __future__ import annotations
 import logging
 import random
 import threading
-from typing import Any, Dict, Optional
+import time
+from typing import Any, Callable, Dict, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import LABEL_GROUP_KEY
 from tpu_operator.client import errors
@@ -100,3 +108,88 @@ class ChaosMonkey:
                 self.kill_once()
             except Exception as e:  # noqa: BLE001
                 log.warning("chaos tick failed: %s", e)
+
+
+# --- API-level fault injection ----------------------------------------------
+
+# Verbs the flaky wrapper intercepts — every CRUD surface the operator uses.
+# ``watch`` deliberately passes through: a failed watch *open* already goes
+# through the REST retry path, and mid-stream faults are the apiserver
+# harness's kill() domain.
+FLAKY_VERBS = frozenset({
+    "create", "get", "list", "list_with_version", "update", "update_status",
+    "delete", "delete_collection",
+})
+
+
+class _FlakyResourceClient:
+    """One resource client with fault injection in front of every verb."""
+
+    def __init__(self, inner: Any, chaos: "FlakyClientset"):
+        self._inner = inner
+        self._chaos = chaos
+
+    def __getattr__(self, name: str) -> Any:
+        attr = getattr(self._inner, name)
+        if name not in FLAKY_VERBS or not callable(attr):
+            return attr
+
+        def flaky(*args: Any, **kwargs: Any) -> Any:
+            self._chaos.maybe_fail(name, getattr(self._inner, "kind", ""))
+            self._chaos.maybe_lag()
+            return attr(*args, **kwargs)
+
+        return flaky
+
+
+class FlakyClientset:
+    """Wraps a clientset (fake or REST) so each CRUD call fails with an
+    injected ApiError 429/500 at ``error_rate`` probability, optionally
+    adding uniform latency up to ``max_latency`` seconds — the operator's
+    own API weather, made reproducible (seeded ``rng``) for the chaos soak
+    test and opt-in in production via ``--chaos-api-error-rate``."""
+
+    RESOURCES = ("pods", "services", "events", "endpoints", "configmaps",
+                 "leases", "tpujobs")
+
+    def __init__(self, inner: Any, error_rate: float = 0.1,
+                 max_latency: float = 0.0,
+                 rng: Optional[random.Random] = None,
+                 metrics: Optional[Any] = None,
+                 sleep: Callable[[float], None] = time.sleep):
+        self._inner = inner
+        self.error_rate = max(0.0, min(1.0, error_rate))
+        self.max_latency = max(0.0, max_latency)
+        # One lock around the RNG: verbs fire from every controller thread,
+        # and an unguarded Random would shear its state (and determinism).
+        self._rng = rng or random.Random()
+        self._rng_lock = threading.Lock()
+        self.metrics = metrics
+        self._sleep = sleep
+        for resource in self.RESOURCES:
+            if hasattr(inner, resource):
+                setattr(self, resource,
+                        _FlakyResourceClient(getattr(inner, resource), self))
+
+    def __getattr__(self, name: str) -> Any:
+        # Non-resource attributes (e.g. ``rest``) pass straight through.
+        return getattr(self._inner, name)
+
+    def maybe_fail(self, verb: str, kind: str) -> None:
+        with self._rng_lock:
+            roll = self._rng.random()
+            flavor = self._rng.random()
+        if roll >= self.error_rate:
+            return
+        if self.metrics is not None:
+            self.metrics.inc("chaos_api_errors_total")
+        code = 429 if flavor < 0.5 else 500
+        raise errors.ApiError(
+            code, message=f"chaos: injected {code} on {verb} {kind}")
+
+    def maybe_lag(self) -> None:
+        if self.max_latency <= 0:
+            return
+        with self._rng_lock:
+            lag = self._rng.random() * self.max_latency
+        self._sleep(lag)

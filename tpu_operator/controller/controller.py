@@ -40,6 +40,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 from tpu_operator.client import errors
 from tpu_operator.client.informer import SharedInformerFactory, object_key
 from tpu_operator.client.workqueue import RateLimitingQueue
+from tpu_operator.controller.deadlines import DeadlineManager
 from tpu_operator.controller.events import EventRecorder
 from tpu_operator.trainer.training import TrainingJob
 from tpu_operator.util import tracing
@@ -61,6 +62,7 @@ class Controller:
         metrics: Optional[Any] = None,
         clock: Callable[[], float] = time.monotonic,
         heartbeat_persist_interval: float = 30.0,
+        wall_clock: Callable[[], float] = time.time,
     ):
         self.clientset = clientset
         self.factory = informer_factory
@@ -78,6 +80,11 @@ class Controller:
         self.metrics = metrics if metrics is not None else Metrics()
         self.queue = queue or RateLimitingQueue(clock=clock,
                                                metrics=self.metrics)
+        # Exact-time wakeups for time obligations (backoff release, stall
+        # watchdog, active deadline, finished-TTL): the TrainingJob reports
+        # its next obligation after every reconcile and the manager parks a
+        # delayed enqueue for that moment (controller/deadlines.py).
+        self.deadlines = DeadlineManager(self.queue, clock=wall_clock)
         self.recorder = EventRecorder(clientset, metrics=self.metrics)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
@@ -186,6 +193,7 @@ class Controller:
                 self.jobs.pop(key, None)
                 self._hb_persisted.pop(key, None)
             self.recorder.forget_object(namespace, name)
+            self.deadlines.forget(key)
             return True
 
         job = TPUJob.from_dict(cached)
@@ -201,6 +209,11 @@ class Controller:
                 tj.refresh(job)
 
         tj.reconcile()
+        # Arm (or clear) the exact-time wakeup for the job's next time
+        # obligation — this is what makes deadline/stall/backoff/TTL
+        # enforcement land at the configured second instead of the next
+        # resync.
+        self.deadlines.sync(key, tj.next_time_obligation())
         return tj.job.status.phase in (
             TPUJobPhase.CLEANUP, TPUJobPhase.DONE, TPUJobPhase.FAILED
         )
@@ -208,11 +221,16 @@ class Controller:
     # -- heartbeats (statusserver POST /api/heartbeat → CRD status) ------------
 
     def record_heartbeat(self, namespace: str, name: str,
-                         heartbeat: Dict[str, Any]) -> bool:
+                         heartbeat: Dict[str, Any]) -> Optional[bool]:
         """Attach a payload heartbeat to the in-memory job (the status source
         of truth). Writing through the in-memory job instead of straight to
         the apiserver keeps the single-writer status discipline — a direct
         write would be clobbered by the next ``update_crd_status``.
+
+        Returns True when recorded, False when the job is unknown (the
+        TrainingJob may simply not be built yet — transient), and None when
+        the heartbeat was dropped as stale (older generation); the status
+        server uses the distinction to keep its liveness gauges honest.
 
         Persistence is *coalesced*: the key is enqueued for an immediate
         status write only for the first heartbeat, an attempt change, or
@@ -229,6 +247,23 @@ class Controller:
             tj = self.jobs.get(key)
             if tj is None:
                 return False
+            # A terminating pod from a previous generation keeps posting
+            # during its grace period; accepting its heartbeat would refresh
+            # the stall watchdog's baseline for the new, possibly-hung
+            # attempt. Drop an *explicitly* older attempt (returning None so
+            # the server can tell this from an unknown job). A missing
+            # attempt is treated as current — payloads that don't post it
+            # must not be stall-looped after the first restart — and newer
+            # is accepted: the status cache may lag a just-bumped attempt.
+            hb_attempt = heartbeat.get("attempt")
+            if hb_attempt is not None:
+                try:
+                    hb_attempt = int(hb_attempt)
+                except (TypeError, ValueError):
+                    hb_attempt = None
+            if (hb_attempt is not None
+                    and hb_attempt < tj.job.status.attempt):
+                return None
             prev = tj.job.status.last_heartbeat
             tj.job.status.last_heartbeat = dict(heartbeat)
             # Compare against the last *persisted* stamp, not the last

@@ -1,0 +1,82 @@
+"""Deadline manager: exact-time reconcile wakeups for time obligations.
+
+The operator's recovery machinery is event-driven — informer events enqueue
+keys, reconciles react. Time-based obligations (backoff release, stall
+watchdog, active deadline, finished-TTL) have no triggering event: without
+help, they would only be noticed at resync granularity (30 s by default),
+which on a TPU slice is 30 s of stranded hardware per incident.
+
+This manager closes the gap using the workqueue's existing ``add_after``:
+after every reconcile the controller asks the TrainingJob for its next time
+obligation (``TrainingJob.next_time_obligation`` — an epoch timestamp) and
+``sync``s it here; the manager schedules a delayed enqueue for that exact
+moment. When the wakeup fires, the normal reconcile path runs and the
+TrainingJob enforces whatever came due. Scheduling is idempotent: a wakeup
+already pending at or before the requested time is not duplicated (each
+reconcile re-syncs, so naive scheduling would arm one timer per pass).
+
+The wall clock is injectable (tests drive exact release-time assertions);
+it must be the same timebase the TrainingJob stamps status with (epoch
+seconds via RFC3339), *not* the queue's monotonic clock — only the final
+relative delay crosses into queue time.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Any, Callable, Dict, Optional
+
+# Scheduling slack added to every wakeup so the reconcile runs just *after*
+# the obligation (a wakeup landing a hair early would see nothing due,
+# reschedule, and hop once more for no reason).
+GRACE_SECONDS = 0.05
+
+
+class DeadlineManager:
+    """Schedules per-key reconcile wakeups at absolute wall-clock times."""
+
+    def __init__(self, queue: Any,
+                 clock: Callable[[], float] = time.time) -> None:
+        self._queue = queue
+        self._clock = clock
+        self._lock = threading.Lock()
+        # key -> pending wakeup epoch (best-effort view; the queue owns the
+        # actual timers, which are never cancelled — a stale wakeup just
+        # causes one cheap no-op reconcile).
+        self._scheduled: Dict[str, float] = {}
+
+    def sync(self, key: str, due: Optional[float]) -> None:
+        """Ensure a reconcile of ``key`` runs at epoch ``due``.
+
+        ``None`` clears the tracked obligation (already-armed queue timers
+        still fire once; the reconcile they trigger is a no-op)."""
+        if due is None:
+            self.forget(key)
+            return
+        with self._lock:
+            now = self._clock()
+            pending = self._scheduled.get(key)
+            if pending is not None and now < pending <= due + GRACE_SECONDS:
+                # An earlier-or-equal wakeup is already in flight; it will
+                # re-sync when it fires.
+                return
+            self._scheduled[key] = due
+            delay = max(0.0, due - now) + GRACE_SECONDS
+        # timer=True: a scheduled wakeup is not an error requeue — it stays
+        # out of workqueue_retries_total, and queue latency counts from the
+        # due time, not from (possibly hours-earlier) scheduling.
+        self._queue.add_after(key, delay, timer=True)
+
+    def forget(self, key: str) -> None:
+        with self._lock:
+            self._scheduled.pop(key, None)
+
+    def pending(self, key: str) -> Optional[float]:
+        """Tracked wakeup epoch for ``key`` (introspection/tests)."""
+        with self._lock:
+            return self._scheduled.get(key)
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._scheduled)

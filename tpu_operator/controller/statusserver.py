@@ -62,6 +62,9 @@ QUEUE_BUCKETS = (0.001, 0.01, 0.1, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0,
 PHASE_BUCKETS = (1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 RUNTIME_BUCKETS = (10.0, 60.0, 300.0, 600.0, 1800.0, 3600.0, 10800.0,
                    43200.0, 86400.0)
+# Restart-backoff delays: exponential from the 10 s default base up to the
+# 360 s default cap (plus headroom for custom maxSeconds).
+BACKOFF_BUCKETS = (1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 360.0, 600.0)
 
 LabelsT = Optional[Dict[str, str]]
 
@@ -144,11 +147,23 @@ class Metrics:
                       "Total adds handled by the reconcile workqueue.")
         self.register("workqueue_retries_total", "counter",
                       "Total delayed re-queues (rate-limited backoff and "
-                      "add_after).")
+                      "non-timer add_after; deadline-manager wakeups are "
+                      "excluded).")
         self.register("heartbeats_total", "counter",
                       "Training-step heartbeats received from payloads.")
         self.register("chaos_kills_total", "counter",
                       "Pods deleted by the chaos monkey.")
+        self.register("chaos_api_errors_total", "counter",
+                      "API errors injected by the flaky-clientset chaos "
+                      "wrapper (--chaos-api-error-rate).")
+        self.register("api_request_retries_total", "counter",
+                      "Transient-failure retries of idempotent apiserver "
+                      "requests (client/rest.py backoff).")
+        self.register("job_stalls_total", "counter",
+                      "Whole-group restarts triggered by the stall watchdog "
+                      "(no heartbeat within stallTimeoutSeconds).")
+        self.register("job_deadline_exceeded_total", "counter",
+                      "Jobs failed for exceeding activeDeadlineSeconds.")
         self.register("events_emitted_total", "counter",
                       "Kubernetes Events written (created or aggregated).")
         self.register("events_aggregated_total", "counter",
@@ -171,6 +186,9 @@ class Metrics:
         self.register("job_runtime_seconds", "histogram",
                       "Phase Creating to a terminal phase (Done/Failed).",
                       RUNTIME_BUCKETS)
+        self.register("group_restart_backoff_seconds", "histogram",
+                      "Backoff delay applied between whole-group restarts.",
+                      BACKOFF_BUCKETS)
 
     # -- registry --------------------------------------------------------------
 
@@ -516,6 +534,16 @@ class StatusServer:
             # would never appear. Failing loudly surfaces the misconfig
             # (wrong namespace/name) in the payload's log instead.
             return False, f"unknown job {namespace}/{name}"
+        if hasattr(c, "record_heartbeat"):
+            # May return False before the first reconcile builds the
+            # TrainingJob — transient; the job is in the informer cache, so
+            # the gauges hold and status catches up on the next heartbeat.
+            # None means the controller dropped the heartbeat as stale (a
+            # terminating pod from a previous generation): the gauges must
+            # not advertise liveness the stall watchdog ignores, so skip
+            # the stash — but still 200 the dying pod.
+            if c.record_heartbeat(namespace, name, hb) is None:
+                return True, ""
         with self._heartbeats_lock:
             self._heartbeats[(namespace, name)] = {
                 **hb, "receivedAt": time.time()}
@@ -527,11 +555,6 @@ class StatusServer:
                              key=lambda k: self._heartbeats[k]["receivedAt"])
                 del self._heartbeats[oldest]
         self.metrics.inc("heartbeats_total")
-        if hasattr(c, "record_heartbeat"):
-            # May return False before the first reconcile builds the
-            # TrainingJob — transient; the job is in the informer cache, so
-            # the gauges hold and status catches up on the next heartbeat.
-            c.record_heartbeat(namespace, name, hb)
         return True, ""
 
     def _live_heartbeats(self, c: Optional[Any]) -> Dict[Tuple[str, str], Dict[str, Any]]:
@@ -605,6 +628,10 @@ class StatusServer:
                 "replicaStatuses": status.get("replicaStatuses", []),
                 "phaseTimeline": timeline,
                 "durations": derived_durations(md, timeline),
+                # Time-aware recovery state: the classified failure ledger
+                # and, while parked in Backoff, the re-gang release time.
+                "failures": status.get("failures", []),
+                "backoffUntil": status.get("backoffUntil", ""),
                 # The in-memory heartbeat is fresher than the informer-cached
                 # status copy (which lags by a reconcile + watch round-trip);
                 # the internal receivedAt bookkeeping stays out of the API.

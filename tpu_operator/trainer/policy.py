@@ -20,8 +20,9 @@ is the most table-testable function in the system
 
 from __future__ import annotations
 
-from typing import Any, Dict, Optional
+from typing import Any, Dict, Optional, Tuple
 
+from tpu_operator.apis.tpujob.v1alpha1.types import FailureKind
 
 # Pod-level failure reasons that carry no container exit code but are
 # transient infrastructure events — on TPU these are routine (slice
@@ -33,12 +34,27 @@ RETRYABLE_POD_REASONS = frozenset(
      "UnexpectedAdmissionError", "DeadlineExceeded"}
 )
 
+# Exit codes produced by *external* termination signals: 137 (SIGKILL, when
+# not OOM) and 143 (SIGTERM) are how node drains and slice preemptions look
+# from inside the container — the graceful-shutdown signal and the
+# follow-up kill. Classified preemption-kind so they draw from the larger
+# preemption retry budget; every other retryable signal exit (SIGSEGV 139,
+# SIGABRT 134, SIGBUS 135, ...) is the payload crashing — application-kind.
+PREEMPTION_EXIT_CODES = frozenset({137, 143})
 
-def pod_failed_retryably(pod: Dict[str, Any], container_name: str = "tpu") -> bool:
-    """True if this pod's failure is transient: either its magic container
-    terminated with a retryable exit code, or the pod failed at the kubelet
-    level (Evicted/Preempted/...) without any container termination record."""
+
+def classify_pod_failure(pod: Dict[str, Any], container_name: str = "tpu"
+                         ) -> Optional[Tuple[str, str]]:
+    """(FailureKind, reason detail) for a retryably-failed pod, None when
+    the pod did not fail retryably.
+
+    Kubelet-level failures (Evicted/Preempted/... with no container
+    termination record) and external-signal exits (137 non-OOM, 143) are
+    **preemption**-kind — routine TPU slice churn, billed to the larger
+    preemption budget. Other retryable exits (128-255 band: SIGSEGV,
+    SIGABRT, ...) are the payload dying — **application**-kind."""
     status = pod.get("status") or {}
+    name = (pod.get("metadata") or {}).get("name", "")
     saw_container = False
     for cs in status.get("containerStatuses") or []:
         if cs.get("name") != container_name:
@@ -48,13 +64,17 @@ def pod_failed_retryably(pod: Dict[str, Any], container_name: str = "tpu") -> bo
         if term:
             saw_container = True
             if is_retryable_termination_state(term):
-                return True
+                code = int(term.get("exitCode"))
+                kind = (FailureKind.PREEMPTION
+                        if code in PREEMPTION_EXIT_CODES
+                        else FailureKind.APPLICATION)
+                return kind, f"pod {name} exited {code}"
     if saw_container:
-        return False
-    return (
-        status.get("phase") == "Failed"
-        and status.get("reason", "") in RETRYABLE_POD_REASONS
-    )
+        return None
+    reason = status.get("reason", "")
+    if status.get("phase") == "Failed" and reason in RETRYABLE_POD_REASONS:
+        return FailureKind.PREEMPTION, f"pod {name} failed: {reason}"
+    return None
 
 
 def is_retryable_termination_state(terminated: Optional[Dict[str, Any]]) -> bool:

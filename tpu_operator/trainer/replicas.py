@@ -45,6 +45,7 @@ from typing import Any, Dict, List, Optional, Tuple
 from tpu_operator.apis.tpujob import helper
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
+    FailureKind,
     RestartPolicy,
     ReplicaState,
     TPUJobSpec,
@@ -539,18 +540,31 @@ class TPUReplicaSet:
             return ReplicaState.STARTING
         return ReplicaState.UNKNOWN
 
-    def has_retryable_failure(self, attempt: int) -> bool:
-        """True if any pod of this generation died retryably — the
-        whole-group restart trigger. Covers both a retryable container exit
-        (128-255, not OOM) and kubelet-level failures with no container
-        record at all (Evicted/Preempted/NodeLost — routine TPU slice
-        preemption). In WHOLE_GROUP mode pods run with restartPolicy Never,
-        so every such death surfaces as a Failed pod."""
+    def retryable_failure_info(self, attempt: int) -> Optional[Tuple[str, str]]:
+        """(FailureKind, reason) of this generation's retryable failure, or
+        None — the whole-group restart trigger, feeding the per-kind retry
+        budgets and the ``status.failures`` ledger. Covers both a retryable
+        container exit (128-255, not OOM) and kubelet-level failures with no
+        container record at all (Evicted/Preempted/NodeLost — routine TPU
+        slice preemption). In WHOLE_GROUP mode pods run with restartPolicy
+        Never, so every such death surfaces as a Failed pod.
+
+        When one generation holds BOTH kinds (a segfaulting worker often
+        takes a SIGKILLed sibling down with it), application-kind evidence
+        wins: the restart is billed to the stricter crash-loop budget, not
+        the 4x preemption budget — otherwise a crash-looper whose crashes
+        collaterally kill siblings would sidestep its own cap."""
+        first_preemption: Optional[Tuple[str, str]] = None
         for index in range(self.spec.replicas):
             for pod in self.pods_for_index(index, attempt):
-                if policy.pod_failed_retryably(pod, DEFAULT_CONTAINER_NAME):
-                    return True
-        return False
+                info = policy.classify_pod_failure(pod, DEFAULT_CONTAINER_NAME)
+                if info is None:
+                    continue
+                if info[0] != FailureKind.PREEMPTION:
+                    return info
+                if first_preemption is None:
+                    first_preemption = info
+        return first_preemption
 
     def get_single_replica_status(self, index: int, attempt: Optional[int] = None) -> str:
         """ref: GetSingleReplicaStatus (replicas.go:400-434), minus the

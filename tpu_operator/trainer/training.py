@@ -17,15 +17,38 @@ and the reconcile driver:
 - ``delete`` (training.go:305-323).
 
 Phase machine (reference semantics at training.go:154-165,392-430, with the
-TPU whole-group additions):
+TPU whole-group and time-aware additions):
 
     NONE ──setup──▶ CREATING ──chief running──▶ RUNNING
       │ invalid spec                │ chief succeeded ▶ DONE  (state Succeeded)
       ▼                            │ permanent failure ▶ FAILED
-    FAILED                         │ retryable group failure:
-                                   │   attempt < maxRestarts ▶ group restart
+    FAILED                         │ retryable group failure / stall:
+                                   │   within per-kind budget ▶ teardown,
+                                   │     then BACKOFF ──release──▶ CREATING
+                                   │     (instant when backoff base is 0)
                                    │   else ▶ FAILED (RetryBudgetExhausted)
     CLEANUP (explicit Delete) ──▶ DONE after children removed
+
+Time-aware recovery (this file enforces; controller/deadlines.py wakes
+reconciles at the exact obligation times):
+
+- **stall watchdog** (``spec.stallTimeoutSeconds``): Running + no heartbeat
+  and no phase change for the window → whole-group restart, reason
+  ``StallDetected``, ledger kind ``stall``;
+- **active deadline** (``spec.activeDeadlineSeconds``): wall time since the
+  first entry into Creating exceeds it → terminal FAILED with reason
+  ``DeadlineExceeded`` (suspension does not stop this clock — a parked job
+  still ages toward its deadline, unlike batch/v1's startTime reset);
+- **restart backoff** (``spec.restartBackoff``): teardown is immediate (the
+  slice frees), the next gang-create parks in BACKOFF until
+  ``status.backoffUntil``;
+- **per-kind retry budgets**: the ``status.failures`` ledger classifies
+  every restart (preemption/application/stall); application+stall restarts
+  spend ``maxRestarts``, preemption restarts spend the larger
+  ``maxRestarts * PREEMPTION_BUDGET_FACTOR`` — slice churn cannot exhaust
+  the crash-loop budget;
+- **TTL** (``spec.ttlSecondsAfterFinished``): a finished job is reaped
+  (children then the TPUJob itself) once the TTL elapses.
 
 Completed pods are retained so ``kubectl logs`` keeps working
 (tf_job_design_doc.md:86); children are removed by Kubernetes GC through the
@@ -54,6 +77,10 @@ from tpu_operator.apis.tpujob import helper, validation
 from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
+    FAILURE_LEDGER_CAP,
+    FailureKind,
+    FailureRecord,
+    PREEMPTION_BUDGET_FACTOR,
     RestartPolicy,
     ReplicaState,
     State,
@@ -65,12 +92,22 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 from tpu_operator.client import errors
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.util.tracing import traced
-from tpu_operator.util.util import now_rfc3339, parse_rfc3339, rand_string
+from tpu_operator.util.util import (
+    format_rfc3339,
+    now_rfc3339,
+    parse_rfc3339,
+    rand_string,
+)
 
 log = logging.getLogger(__name__)
 
 # Patchable timestamp source for the phase timeline (tests freeze it).
 _now = now_rfc3339
+
+# Seconds of continuous healthy Running after which the consecutive-failure
+# streak (the restart-backoff exponent) resets — the K8s Job controller's
+# "pod ran long enough, forget the backoff" idiom.
+BACKOFF_RESET_SECONDS = 300.0
 
 
 class TrainingJob:
@@ -88,6 +125,10 @@ class TrainingJob:
         # True only while setup's spec mutations (defaults, runtimeId) await
         # persistence; status writebacks must not overwrite user spec edits.
         self._spec_dirty = False
+        # True once the TTL reaper has deleted this job: the informer cache
+        # may echo the object for a few more reconciles, and re-arming the
+        # (already past) TTL obligation would hot-loop the reap path.
+        self._reaped = False
 
     # -- phase transitions (observability: status.phaseTimeline) ---------------
 
@@ -96,8 +137,12 @@ class TrainingJob:
         entry into each phase, and export the derived lifecycle durations
         (time-to-scheduled / time-to-running / total runtime) as histograms.
         Re-entries (group restart driving Running→Creating→Running) keep
-        the original stamps, so durations always measure the first pass."""
+        the original stamps, so durations always measure the first pass;
+        ``status.lastTransitionTime`` complements this by stamping every
+        phase *change* (the stall watchdog's fallback baseline)."""
         status = self.job.status
+        if status.phase != phase:
+            status.last_transition_time = _now()
         status.phase = phase
         if not phase:
             return
@@ -322,6 +367,7 @@ class TrainingJob:
     def reconcile(self) -> None:
         """One idempotent reconcile pass."""
         phase = self.job.status.phase
+        now = parse_rfc3339(_now())
 
         if phase == TPUJobPhase.NONE:
             self.setup()
@@ -329,12 +375,39 @@ class TrainingJob:
             phase = self.job.status.phase
 
         if phase in (TPUJobPhase.FAILED, TPUJobPhase.DONE):
+            # TTL reaper (batch/v1 ttlSecondsAfterFinished): a finished job
+            # past its TTL is deleted outright — children first, then the
+            # TPUJob — so completed jobs don't accumulate forever.
+            ttl_at = self._ttl_epoch()
+            if ttl_at is not None and now is not None and now >= ttl_at:
+                if not self._reaped:
+                    self._reap_finished()
+                return
             self.update_crd_status()
             return
 
         if phase == TPUJobPhase.CLEANUP:
             self.delete_resources()
             self._transition(TPUJobPhase.DONE)
+            self.update_crd_status()
+            return
+
+        # Active deadline: total wall time since the job first entered
+        # Creating. Checked before any child sync so an expired job never
+        # creates another generation (applies to Suspended/Backoff too —
+        # parked time still ages toward the deadline).
+        deadline_at = self._deadline_epoch()
+        if deadline_at is not None and now is not None and now >= deadline_at:
+            self.setup_replicas()
+            self._record_failure(
+                self.job.status.attempt, FailureKind.DEADLINE,
+                f"activeDeadlineSeconds={self.job.spec.active_deadline_seconds} exceeded")
+            if self.metrics is not None:
+                self.metrics.inc("job_deadline_exceeded_total")
+            self._fail(
+                f"DeadlineExceeded: job active longer than "
+                f"{self.job.spec.active_deadline_seconds}s",
+                event_reason="DeadlineExceeded")
             self.update_crd_status()
             return
 
@@ -369,11 +442,32 @@ class TrainingJob:
             self._transition(TPUJobPhase.CREATING)
             self.job.status.state = State.RUNNING
             self.job.status.reason = ""
+            # Resume forfeits any pending restart backoff: the user's
+            # explicit action is a better signal than the crash-spacing
+            # heuristic.
+            self.job.status.backoff_until = ""
             if self.recorder:
                 self.recorder.event(
                     self, "Normal", "JobResumed",
                     f"re-ganging attempt {attempt}")
             # fall through: the normal sync below recreates the gang.
+
+        if phase == TPUJobPhase.BACKOFF:
+            # The failed generation is already torn down; hold the next
+            # gang-create until the release time (the controller's deadline
+            # manager schedules a wakeup for that exact moment).
+            release = parse_rfc3339(self.job.status.backoff_until)
+            if release is not None and now is not None and now < release:
+                self.update_crd_status()
+                return
+            self.job.status.backoff_until = ""
+            self._transition(TPUJobPhase.CREATING)
+            self.job.status.state = State.RUNNING
+            if self.recorder:
+                self.recorder.event(
+                    self, "Normal", "BackoffComplete",
+                    f"backoff elapsed; re-ganging attempt {attempt}")
+            # fall through: the normal sync below creates the new gang.
 
         # Services first: the coordinator's DNS name must resolve before any
         # worker calls jax.distributed.initialize (SURVEY.md hard part (c)).
@@ -395,12 +489,41 @@ class TrainingJob:
                 self.recorder.event(self, "Normal", "JobSucceeded",
                                     f"chief exited 0 on attempt {attempt}")
         else:
-            # Whole-group restart check: retryable member death?
-            if (
-                self.job.spec.restart_policy == RestartPolicy.WHOLE_GROUP
-                and any(rs.has_retryable_failure(attempt) for rs in self.replica_sets)
-            ):
-                self._group_restart(attempt)
+            # Whole-group restart check: retryable member death (classified
+            # preemption vs application), or a stalled payload?
+            failure: Optional[tuple] = None
+            if self.job.spec.restart_policy == RestartPolicy.WHOLE_GROUP:
+                # Application-wins across replica sets, same as within one
+                # (replicas.retryable_failure_info): a crashing set must be
+                # billed to the strict crash-loop budget even when another
+                # set's collateral SIGKILL is discovered first.
+                for rs in self.replica_sets:
+                    info = rs.retryable_failure_info(attempt)
+                    if info is None:
+                        continue
+                    failure = info
+                    if info[0] != FailureKind.PREEMPTION:
+                        break
+            stall_at = self._stall_epoch()
+            if failure is not None:
+                self._group_restart(attempt, failure[0], failure[1])
+            elif stall_at is not None and now is not None and now >= stall_at:
+                # Pods report Running but the payload made no observable
+                # progress (no heartbeat, no phase change) for the whole
+                # stall window: a hung collective holds the slice — same
+                # teardown path as pod death.
+                if self.metrics is not None:
+                    self.metrics.inc("job_stalls_total")
+                if self.recorder:
+                    self.recorder.event(
+                        self, "Warning", "StallDetected",
+                        f"no heartbeat within "
+                        f"{self.job.spec.stall_timeout_seconds}s; "
+                        f"restarting whole group")
+                self._group_restart(
+                    attempt, FailureKind.STALL,
+                    f"StallDetected: no heartbeat within "
+                    f"{self.job.spec.stall_timeout_seconds}s")
             else:
                 running = all(
                     s.state in (ReplicaState.RUNNING, ReplicaState.SUCCEEDED)
@@ -410,15 +533,32 @@ class TrainingJob:
                 self._transition(
                     TPUJobPhase.RUNNING if running else TPUJobPhase.CREATING
                 )
+                if running:
+                    # A recovered job must not keep reporting its last
+                    # restart ("group restart: attempt N") forever — clear
+                    # the reason once the group is healthy again.
+                    self.job.status.reason = ""
+                    # Sustained health decays the backoff exponent (the
+                    # workqueue's forget() idiom): the streak resets once
+                    # the group has been Running for the reset window, so
+                    # an old crash burst stops inflating the delay applied
+                    # to unrelated future failures.
+                    if self.job.status.consecutive_failures and now is not None:
+                        entered = parse_rfc3339(
+                            self.job.status.last_transition_time)
+                        if (entered is not None
+                                and now - entered >= BACKOFF_RESET_SECONDS):
+                            self.job.status.consecutive_failures = 0
 
         self.update_crd_status()
 
-    def _fail(self, reason: str) -> None:
+    def _fail(self, reason: str, event_reason: str = "JobFailed") -> None:
         self.job.status.state = State.FAILED
         self._transition(TPUJobPhase.FAILED)
         self.job.status.reason = reason
+        self.job.status.backoff_until = ""
         if self.recorder:
-            self.recorder.event(self, "Warning", "JobFailed", reason)
+            self.recorder.event(self, "Warning", event_reason, reason)
         # Free the slice: surviving workers of a permanently-failed group sit
         # blocked in collectives holding TPU hardware forever. Delete the
         # still-live pods; terminated ones are kept so their logs survive
@@ -441,27 +581,191 @@ class TrainingJob:
                             log.warning("freeing pod %s: %s",
                                         pod["metadata"]["name"], e)
 
-    def _group_restart(self, attempt: int) -> None:
+    def _record_failure(self, attempt: int, kind: str, reason: str) -> None:
+        """Record one classified failure: an entry in the ``status.failures``
+        ledger (bounded postmortem trail: oldest entries fall off past
+        FAILURE_LEDGER_CAP), a tick of the per-kind lifetime counter the
+        retry budgets charge (counters never decay — the bounded ledger
+        must not silently re-arm an exhausted budget), and a tick of the
+        consecutive-failure streak the backoff exponent uses.
+
+        At most one record per failed attempt *and kind*: a group restart
+        that dies mid-teardown (transient API error) is requeued and
+        re-enters with the same attempt — double-recording would
+        double-bill the retry budget. A different kind on the same attempt
+        is a genuinely new failure (e.g. the deadline expiring after a
+        retryable death, before the attempt bump persisted) and must still
+        land in the ledger, or the postmortem trail would contradict the
+        terminal reason."""
+        status = self.job.status
+        ledger = status.failures
+        if any(f.attempt == attempt and f.kind == kind for f in ledger):
+            return
+        ledger.append(FailureRecord(attempt=attempt, kind=kind,
+                                    reason=reason, time=_now()))
+        if len(ledger) > FAILURE_LEDGER_CAP:
+            del ledger[:len(ledger) - FAILURE_LEDGER_CAP]
+        status.restart_counts[kind] = status.restart_counts.get(kind, 0) + 1
+        status.consecutive_failures += 1
+
+    def _group_restart(self, attempt: int, kind: str, reason: str) -> None:
         """Tear down the failed generation and start the next one
-        (TPU-native; no reference equivalent — MXNet PS restarts per-pod)."""
-        if attempt >= self.job.spec.max_restarts:
+        (TPU-native; no reference equivalent — MXNet PS restarts per-pod).
+
+        Time-aware: the failure is classified into the ledger first and the
+        retry budget is **per kind** — application/stall restarts spend
+        ``maxRestarts``, preemption restarts spend the larger
+        ``maxRestarts * PREEMPTION_BUDGET_FACTOR`` — then teardown happens
+        immediately (the slice frees) while the next gang-create is spaced
+        by exponential backoff in phase Backoff."""
+        self._record_failure(attempt, kind, reason)
+        counts = self.job.status.restart_counts
+        if kind == FailureKind.PREEMPTION:
+            used = counts.get(FailureKind.PREEMPTION, 0)
+            budget = self.job.spec.max_restarts * PREEMPTION_BUDGET_FACTOR
+            budget_desc = f"{budget} preemption restarts"
+        else:
+            used = (counts.get(FailureKind.APPLICATION, 0)
+                    + counts.get(FailureKind.STALL, 0))
+            budget = self.job.spec.max_restarts
+            budget_desc = f"{budget} application restarts"
+        if used > budget:
             self._fail(
-                f"retry budget exhausted: attempt {attempt} of "
-                f"{self.job.spec.max_restarts} failed retryably"
+                f"retry budget exhausted: {used} {kind} failures exceed "
+                f"{budget_desc} ({reason})"
             )
             return
         for rs in self.replica_sets:
             rs.delete_pods_for_attempt(attempt)
-        self.job.status.attempt = attempt + 1
-        self._transition(TPUJobPhase.CREATING)
+        next_attempt = attempt + 1
+        self.job.status.attempt = next_attempt
         self.job.status.state = State.RUNNING
-        self.job.status.reason = f"group restart: attempt {attempt + 1}"
+        delay = 0.0
+        backoff = self.job.spec.restart_backoff
+        if backoff is not None:
+            # Exponent = consecutive failures since the last sustained
+            # healthy stretch (this one included): restart 1 waits base,
+            # restart 2 waits 2*base, ... capped. The streak resets after
+            # BACKOFF_RESET_SECONDS of healthy Running, so a lone routine
+            # preemption weeks after an early crash burst starts back at
+            # the base delay instead of near the cap.
+            delay = backoff.delay_for_restart(
+                self.job.status.consecutive_failures)
+        if delay > 0:
+            release = (parse_rfc3339(_now()) or 0.0) + delay
+            self.job.status.backoff_until = format_rfc3339(release)
+            self._transition(TPUJobPhase.BACKOFF)
+            self.job.status.reason = (
+                f"group restart: attempt {next_attempt} in backoff for "
+                f"{delay:.0f}s ({reason})")
+            if self.metrics is not None:
+                self.metrics.observe("group_restart_backoff_seconds", delay)
+        else:
+            self.job.status.backoff_until = ""
+            self._transition(TPUJobPhase.CREATING)
+            self.job.status.reason = (
+                f"group restart: attempt {next_attempt} ({reason})")
         if self.recorder:
             self.recorder.event(
                 self, "Normal", "GroupRestart",
-                f"worker died retryably; restarting whole group "
-                f"(attempt {attempt + 1}/{self.job.spec.max_restarts})",
+                f"{kind} failure ({reason}); restarting whole group "
+                f"(attempt {next_attempt}; {used}/{budget} {kind} budget "
+                f"used; backoff {delay:.0f}s)",
             )
+
+    # -- time obligations (enforced here; woken exactly on time by
+    # controller/deadlines.DeadlineManager) ------------------------------------
+
+    def _start_epoch(self) -> Optional[float]:
+        """When the job became active: first entry into Creating, falling
+        back to the apiserver's creationTimestamp."""
+        return (parse_rfc3339(
+                    self.job.status.phase_timeline.get(TPUJobPhase.CREATING, ""))
+                or parse_rfc3339(
+                    self.job.metadata.get("creationTimestamp", "")))
+
+    def _deadline_epoch(self) -> Optional[float]:
+        """Epoch at which activeDeadlineSeconds expires (None: no deadline)."""
+        ads = self.job.spec.active_deadline_seconds
+        if not ads:
+            return None
+        start = self._start_epoch()
+        if start is None:
+            return None
+        return start + ads
+
+    def _stall_epoch(self) -> Optional[float]:
+        """Epoch at which the stall watchdog fires: the freshest sign of
+        life (payload heartbeat, else the last phase change) plus
+        stallTimeoutSeconds. Armed only while Running under WholeGroup —
+        a stalled JAX group can only be recovered by group restart."""
+        st = self.job.spec.stall_timeout_seconds
+        if (not st
+                or self.job.status.phase != TPUJobPhase.RUNNING
+                or self.job.spec.restart_policy != RestartPolicy.WHOLE_GROUP):
+            return None
+        # hb["time"] is stamped by the OPERATOR at receipt
+        # (statusserver.record_heartbeat), not by the payload — so a skewed
+        # container clock cannot fake liveness or trigger false stalls.
+        hb = self.job.status.last_heartbeat or {}
+        candidates = [parse_rfc3339(str(hb.get("time", ""))),
+                      parse_rfc3339(self.job.status.last_transition_time)]
+        baseline = max((c for c in candidates if c is not None), default=None)
+        if baseline is None:
+            return None
+        return baseline + st
+
+    def _ttl_epoch(self) -> Optional[float]:
+        """Epoch at which a finished job is reaped (None: keep forever)."""
+        ttl = self.job.spec.ttl_seconds_after_finished
+        if ttl is None:
+            return None
+        timeline = self.job.status.phase_timeline
+        finished = (parse_rfc3339(timeline.get(TPUJobPhase.DONE, ""))
+                    or parse_rfc3339(timeline.get(TPUJobPhase.FAILED, "")))
+        if finished is None:
+            return None
+        return finished + ttl
+
+    def next_time_obligation(self) -> Optional[float]:
+        """Earliest future epoch at which this job needs a time-driven
+        reconcile (backoff release, stall-watchdog expiry, active deadline,
+        finished-TTL) — None when the job has no pending time obligation.
+        The controller feeds this into its deadline manager after every
+        reconcile, so enforcement is exact-time instead of waiting for the
+        next resync."""
+        if self._reaped:
+            return None
+        phase = self.job.status.phase
+        candidates = []
+        if phase in (TPUJobPhase.DONE, TPUJobPhase.FAILED):
+            candidates.append(self._ttl_epoch())
+        elif phase in (TPUJobPhase.CREATING, TPUJobPhase.RUNNING,
+                       TPUJobPhase.BACKOFF, TPUJobPhase.SUSPENDED):
+            if phase == TPUJobPhase.BACKOFF:
+                candidates.append(
+                    parse_rfc3339(self.job.status.backoff_until))
+            candidates.append(self._stall_epoch())
+            candidates.append(self._deadline_epoch())
+        live = [c for c in candidates if c is not None]
+        return min(live) if live else None
+
+    def _reap_finished(self) -> None:
+        """TTL expiry: delete children, then the TPUJob itself (the K8s
+        TTL-after-finished controller's behavior for batch Jobs)."""
+        if self.recorder:
+            self.recorder.event(
+                self, "Normal", "TTLExpired",
+                f"finished longer than "
+                f"{self.job.spec.ttl_seconds_after_finished}s ago; "
+                f"deleting job")
+        self.delete_resources()
+        try:
+            self.clientset.tpujobs.delete(self.namespace, self.name)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                raise
+        self._reaped = True
 
     def _sync_headless_service(self) -> None:
         svc = replicas_mod.headless_service_spec(self)

@@ -19,6 +19,7 @@ import json
 import os
 import random
 import string
+import time
 from typing import Any, Optional
 
 # DNS-1035-safe alphabet (ref: util.go:55 uses lowercase letters+digits; we
@@ -52,7 +53,14 @@ def now_rfc3339() -> str:
     format for status.phaseTimeline / lastHeartbeat / Events. Fractional
     precision matters: phase transitions in tests are sub-second, and the
     derived durations (statusserver.derived_durations) subtract these."""
-    return (datetime.datetime.now(datetime.timezone.utc)
+    return format_rfc3339(time.time())
+
+
+def format_rfc3339(epoch: float) -> str:
+    """Epoch seconds → the operator's RFC3339 form (UTC, fractional
+    seconds) — the inverse of :func:`parse_rfc3339`, used to stamp
+    computed future times (``status.backoffUntil``)."""
+    return (datetime.datetime.fromtimestamp(epoch, datetime.timezone.utc)
             .strftime("%Y-%m-%dT%H:%M:%S.%fZ"))
 
 
