@@ -26,7 +26,17 @@ python -m pytest tests/test_metrics_conformance.py -x -q
 # checkpointed, twice-preempted job to DONE through the Backoff phase with
 # no leaked pods — the whole time-aware recovery stack under fire.
 python -m pytest tests/test_chaos_soak.py -x -q
+# Standalone control-plane budget gate: steady-state reconcile must issue
+# ZERO read RPCs (all reads served by the informer indexes) and the first
+# reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
+# regression fails CI by name, not as a slow bench row.
+python -m pytest tests/test_api_budget.py -x -q
+# And the measured form of the same contract: bench.py --control-plane
+# exits nonzero if reads-per-reconcile leaves zero or the parallel gang
+# create stops beating sequential (--quick: 16-32 replicas, seconds).
+python bench.py --control-plane --quick
 python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
-  --ignore=tests/test_chaos_soak.py
+  --ignore=tests/test_chaos_soak.py \
+  --ignore=tests/test_api_budget.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

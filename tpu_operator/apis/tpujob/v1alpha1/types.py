@@ -642,6 +642,9 @@ class ControllerConfig:
     ``status_url`` is the operator's advertised status-server base URL
     (``--advertise-status-url`` / config ``statusUrl``); when set, worker
     pods get ``TPUJOB_STATUS_URL`` so payloads can post step heartbeats.
+    ``create_parallelism`` (``--create-parallelism`` / config
+    ``createParallelism``) bounds the concurrent child-create RPCs per gang
+    sync; 1 degrades to the sequential path.
     The reference also carried an unused ``GrpcServerFilePath`` field
     (types.go:176-177) — deliberately dropped here (SURVEY.md "quirks to
     fix, not copy").
@@ -649,6 +652,7 @@ class ControllerConfig:
 
     accelerators: Dict[str, TPUAcceleratorConfig] = field(default_factory=dict)
     status_url: str = ""
+    create_parallelism: int = 16
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -656,6 +660,8 @@ class ControllerConfig:
         }
         if self.status_url:
             d["statusUrl"] = self.status_url
+        if self.create_parallelism != 16:
+            d["createParallelism"] = self.create_parallelism
         return d
 
     @classmethod
@@ -667,4 +673,5 @@ class ControllerConfig:
                 for k, v in (d.get("accelerators") or {}).items()
             },
             status_url=str(d.get("statusUrl", "")),
+            create_parallelism=int(d.get("createParallelism", 16) or 16),
         )

@@ -264,6 +264,12 @@ class FakeClientset:
         import collections
 
         self.lock = threading.RLock()
+        # Optional metrics registry (controller.statusserver.Metrics):
+        # when attached, every recorded action ticks
+        # ``api_requests_total{verb,resource}`` — same ledger the REST
+        # client maintains, so API-budget assertions and the control-plane
+        # bench read one metric regardless of transport.
+        self.metrics: Optional[Any] = None
         self._version = 0
         self._events: "collections.deque" = collections.deque(
             maxlen=self.EVENT_LOG_SIZE)
@@ -313,6 +319,9 @@ class FakeClientset:
 
     def record(self, verb: str, resource: str, namespace: str, name: str) -> None:
         self.actions.append((verb, resource, namespace, name))
+        if self.metrics is not None:
+            self.metrics.inc("api_requests_total",
+                             labels={"verb": verb, "resource": resource})
 
     def clear_actions(self) -> None:
         self.actions.clear()

@@ -22,6 +22,7 @@ from __future__ import annotations
 
 import logging
 import threading
+from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 log = logging.getLogger(__name__)
@@ -29,6 +30,14 @@ log = logging.getLogger(__name__)
 DEFAULT_RESYNC_PERIOD = 30.0  # seconds (ref: server.go:85)
 
 Handler = Callable[..., None]
+# An index function maps one object to the index values it appears under
+# (client-go's cache.IndexFunc).
+IndexFunc = Callable[[Dict[str, Any]], List[str]]
+
+# Built-in index names (ref: client-go's cache.NamespaceIndex idiom; these
+# two are what turns every per-reconcile child lookup into a cache hit).
+INDEX_OWNER_UID = "controller-uid"
+INDEX_JOB = "job"
 
 
 def object_key(obj: Dict[str, Any]) -> str:
@@ -37,12 +46,45 @@ def object_key(obj: Dict[str, Any]) -> str:
     return f"{md.get('namespace', 'default')}/{md.get('name', '')}"
 
 
+def index_by_controlling_tpujob_uid(obj: Dict[str, Any]) -> List[str]:
+    """Index values: UIDs of the controlling TPUJob OwnerReference."""
+    md = obj.get("metadata") or {}
+    return [
+        ref.get("uid", "")
+        for ref in md.get("ownerReferences") or []
+        if ref.get("kind") == "TPUJob" and ref.get("controller")
+        and ref.get("uid")
+    ]
+
+
+def index_by_job_label(obj: Dict[str, Any]) -> List[str]:
+    """Index values: ``namespace/job_name`` from the child's job label."""
+    md = obj.get("metadata") or {}
+    job = (md.get("labels") or {}).get("job_name", "")
+    if not job:
+        return []
+    return [f"{md.get('namespace', 'default')}/{job}"]
+
+
+def add_child_indexes(store: "Store") -> None:
+    """Install the built-in pod/service indexes (owner UID + job label)."""
+    store.add_index(INDEX_OWNER_UID, index_by_controlling_tpujob_uid)
+    store.add_index(INDEX_JOB, index_by_job_label)
+
+
 class Store:
-    """Thread-safe object cache (the lister; ref: listers/.../mxjob.go:29-90)."""
+    """Thread-safe object cache (the lister; ref: listers/.../mxjob.go:29-90)
+    with client-go-style indexers: ``add_index`` registers an IndexFunc and
+    ``by_index`` answers reads from the maintained inverted index, so a
+    reconcile can fetch "all pods owned by job UID X" without scanning the
+    store, let alone the apiserver."""
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
         self._items: Dict[str, Dict[str, Any]] = {}
+        self._indexers: Dict[str, IndexFunc] = {}
+        # index name -> index value -> {object key: object}
+        self._indices: Dict[str, Dict[str, Dict[str, Dict[str, Any]]]] = {}
 
     def get(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -63,20 +105,80 @@ class Store:
         with self._lock:
             return list(self._items.keys())
 
+    # -- indexers (ref: client-go cache.Indexer AddIndexers/ByIndex) ----------
+
+    def add_index(self, name: str, fn: IndexFunc) -> None:
+        """Register an index and backfill it over the current contents.
+        Idempotent per name (re-registering replaces and rebuilds)."""
+        with self._lock:
+            self._indexers[name] = fn
+            index: Dict[str, Dict[str, Dict[str, Any]]] = {}
+            for key, obj in self._items.items():
+                for value in fn(obj):
+                    index.setdefault(value, {})[key] = obj
+            self._indices[name] = index
+
+    def by_index(self, name: str, value: str) -> List[Dict[str, Any]]:
+        """All cached objects whose index ``name`` contains ``value``."""
+        with self._lock:
+            if name not in self._indexers:
+                raise KeyError(f"unknown index {name!r}")
+            return list(self._indices[name].get(value, {}).values())
+
+    def _index_remove_locked(self, key: str, obj: Dict[str, Any]) -> None:
+        for name, fn in self._indexers.items():
+            index = self._indices[name]
+            for value in fn(obj):
+                bucket = index.get(value)
+                if bucket is not None:
+                    bucket.pop(key, None)
+                    if not bucket:
+                        del index[value]
+
+    def _index_insert_locked(self, key: str, obj: Dict[str, Any]) -> None:
+        for name, fn in self._indexers.items():
+            for value in fn(obj):
+                self._indices[name].setdefault(value, {})[key] = obj
+
+    # -- mutation -------------------------------------------------------------
+
     def upsert(self, obj: Dict[str, Any]) -> Optional[Dict[str, Any]]:
         with self._lock:
             key = object_key(obj)
             old = self._items.get(key)
+            if old is not None:
+                self._index_remove_locked(key, old)
             self._items[key] = obj
+            self._index_insert_locked(key, obj)
             return old
 
     def delete(self, obj: Dict[str, Any]) -> None:
         with self._lock:
-            self._items.pop(object_key(obj), None)
+            key = object_key(obj)
+            old = self._items.pop(key, None)
+            if old is not None:
+                self._index_remove_locked(key, old)
 
     def replace(self, objs: List[Dict[str, Any]]) -> None:
         with self._lock:
             self._items = {object_key(o): o for o in objs}
+            for name, fn in self._indexers.items():
+                index: Dict[str, Dict[str, Dict[str, Any]]] = {}
+                for key, obj in self._items.items():
+                    for value in fn(obj):
+                        index.setdefault(value, {})[key] = obj
+                self._indices[name] = index
+
+
+@dataclass
+class Listers:
+    """The informer caches a reconcile reads from (client-go's listers
+    bundle): every steady-state read is served here; the apiserver only
+    sees writes."""
+
+    tpujobs: Store
+    pods: Store
+    services: Store
 
 
 class Informer:
@@ -222,11 +324,19 @@ class Informer:
             watch.stop()
 
     def _resync_loop(self, stop_event: threading.Event) -> None:
-        """Periodic re-list + re-delivery so missed edge cases self-heal
+        """Periodic re-list + delete-repair so missed edge cases self-heal
         (ref: 30 s resync, server.go:85). Unlike client-go's cache-only
         resync this re-lists from the source of truth, so an event lost to
         any race (including deletions) is repaired within one period instead
-        of persisting forever."""
+        of persisting forever.
+
+        Unchanged objects are NOT re-dispatched: an object whose
+        resourceVersion matches the cached copy carries no new information,
+        and re-delivering ``update(obj, obj)`` for the whole world every
+        period enqueued a full reconcile of every idle job — pure queue
+        churn at O(jobs) per resync. Only objects with a differing (or
+        absent) resourceVersion dispatch; the delete-repair sweep is kept
+        in full."""
         while not stop_event.wait(self._resync_period):
             try:
                 fresh = {object_key(o): o for o in self._client.list(self._namespace)}
@@ -240,8 +350,14 @@ class Informer:
                         self.store.delete(gone)
                         self._dispatch_delete(gone)
             for obj in fresh.values():
+                old = self.store.get_by_key(object_key(obj))
                 self.store.upsert(obj)
-                self._dispatch_update(obj, obj)
+                if old is not None:
+                    old_rv = (old.get("metadata") or {}).get("resourceVersion")
+                    new_rv = (obj.get("metadata") or {}).get("resourceVersion")
+                    if old_rv and old_rv == new_rv:
+                        continue  # unchanged since last delivery
+                self._dispatch_update(old if old is not None else obj, obj)
 
     # -- dispatch -------------------------------------------------------------
 

@@ -225,11 +225,21 @@ class RestClient:
 
     def request(self, method: str, path: str,
                 params: Optional[Dict[str, str]] = None,
-                body: Optional[dict] = None) -> Any:
+                body: Optional[dict] = None,
+                verb: str = "", resource: str = "") -> Any:
         if params:
             path = f"{path}?{urllib.parse.urlencode(params)}"
+        self._count(verb or method.lower(), resource)
         return self._run_with_retry(
             method, lambda: self._request_once(method, path, body))
+
+    def _count(self, verb: str, resource: str) -> None:
+        """One tick of ``api_requests_total{verb,resource}`` per logical
+        request (retries are counted separately) — the same ledger the fake
+        clientset maintains, so API-budget accounting is transport-agnostic."""
+        if self.metrics is not None:
+            self.metrics.inc("api_requests_total",
+                             labels={"verb": verb, "resource": resource or "?"})
 
     def _request_once(self, method: str, path: str,
                       body: Optional[dict]) -> Any:
@@ -249,12 +259,14 @@ class RestClient:
         finally:
             conn.close()
 
-    def stream(self, path: str, params: Dict[str, str]) -> _StreamWatch:
+    def stream(self, path: str, params: Dict[str, str],
+               resource: str = "") -> _StreamWatch:
         """Open a watch stream (no read timeout — watches are long-lived).
         The *open* is retried like any idempotent GET (watch re-open races
         an apiserver restart constantly); an established stream's errors
         stay the informer's to handle (re-list + re-watch)."""
         qs = urllib.parse.urlencode(params)
+        self._count("watch", resource)
         return self._run_with_retry(
             "GET", lambda: self._stream_once(f"{path}?{qs}"))
 
@@ -306,10 +318,12 @@ class RestResourceClient:
         return f"{base}/{name}" if name else base
 
     def create(self, namespace: str, obj: dict) -> dict:
-        return self._rest.request("POST", self._path(namespace), body=obj)
+        return self._rest.request("POST", self._path(namespace), body=obj,
+                                  verb="create", resource=self.kind)
 
     def get(self, namespace: str, name: str) -> dict:
-        return self._rest.request("GET", self._path(namespace, name))
+        return self._rest.request("GET", self._path(namespace, name),
+                                  verb="get", resource=self.kind)
 
     def list(self, namespace: str = "", label_selector: str = "") -> List[dict]:
         params: Dict[str, str] = {}
@@ -319,7 +333,8 @@ class RestResourceClient:
             path = self._path(namespace)
         else:
             path = f"{self._prefix}/{self.resource}"  # all namespaces
-        result = self._rest.request("GET", path, params=params)
+        result = self._rest.request("GET", path, params=params,
+                                    verb="list", resource=self.kind)
         return (result or {}).get("items", [])
 
     def list_with_version(self, namespace: str = "",
@@ -334,26 +349,32 @@ class RestResourceClient:
             path = self._path(namespace)
         else:
             path = f"{self._prefix}/{self.resource}"
-        result = self._rest.request("GET", path, params=params) or {}
+        result = self._rest.request("GET", path, params=params,
+                                    verb="list", resource=self.kind) or {}
         return (result.get("items", []),
                 (result.get("metadata") or {}).get("resourceVersion", ""))
 
     def update(self, namespace: str, obj: dict) -> dict:
         name = (obj.get("metadata") or {}).get("name", "")
-        return self._rest.request("PUT", self._path(namespace, name), body=obj)
+        return self._rest.request("PUT", self._path(namespace, name), body=obj,
+                                  verb="update", resource=self.kind)
 
     def update_status(self, namespace: str, obj: dict) -> dict:
         name = (obj.get("metadata") or {}).get("name", "")
         return self._rest.request(
-            "PUT", self._path(namespace, name) + "/status", body=obj
+            "PUT", self._path(namespace, name) + "/status", body=obj,
+            verb="update_status", resource=self.kind,
         )
 
     def delete(self, namespace: str, name: str, options: Optional[dict] = None) -> None:
-        self._rest.request("DELETE", self._path(namespace, name), body=options)
+        self._rest.request("DELETE", self._path(namespace, name), body=options,
+                           verb="delete", resource=self.kind)
 
     def delete_collection(self, namespace: str, label_selector: str = "") -> int:
         params = {"labelSelector": label_selector} if label_selector else {}
-        result = self._rest.request("DELETE", self._path(namespace), params=params)
+        result = self._rest.request("DELETE", self._path(namespace),
+                                    params=params,
+                                    verb="delete", resource=self.kind)
         return len((result or {}).get("items", []))
 
     def watch(self, namespace: str = "", label_selector: str = "",
@@ -364,7 +385,8 @@ class RestResourceClient:
         if resource_version:
             params["resourceVersion"] = resource_version
         return self._rest.stream(self._path(namespace) if namespace
-                                 else f"{self._prefix}/{self.resource}", params)
+                                 else f"{self._prefix}/{self.resource}", params,
+                                 resource=self.kind)
 
 
 class Clientset:

@@ -69,6 +69,11 @@ def build_parser() -> argparse.ArgumentParser:
                         "heartbeats, and the job dashboard (0 = disabled; "
                         "the chart passes 8080; the reference had none of "
                         "these)")
+    p.add_argument("--create-parallelism", type=int, default=None,
+                   help="max concurrent child-create RPCs per gang sync "
+                        "(pods + services); 1 = sequential (default: 16, or "
+                        "the config file's createParallelism). A 256-pod "
+                        "gang costs ~N/parallelism create round trips")
     p.add_argument("--advertise-status-url", default="",
                    help="base URL workers reach the status server at (e.g. "
                         "http://tpu-operator.kubeflow:8080); injected into "

@@ -47,6 +47,8 @@ def run(opts: Any, clientset: Optional[Any] = None,
     config = read_controller_config(opts.controller_config_file)
     if getattr(opts, "advertise_status_url", ""):
         config.status_url = opts.advertise_status_url
+    if getattr(opts, "create_parallelism", None) is not None:
+        config.create_parallelism = opts.create_parallelism
     tracing.configure(span_buffer=getattr(opts, "trace_buffer",
                                           tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()

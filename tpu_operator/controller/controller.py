@@ -38,7 +38,12 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobPhase,
 )
 from tpu_operator.client import errors
-from tpu_operator.client.informer import SharedInformerFactory, object_key
+from tpu_operator.client.informer import (
+    Listers,
+    SharedInformerFactory,
+    add_child_indexes,
+    object_key,
+)
 from tpu_operator.client.workqueue import RateLimitingQueue
 from tpu_operator.controller.deadlines import DeadlineManager
 from tpu_operator.controller.events import EventRecorder
@@ -78,6 +83,11 @@ class Controller:
         # metrics, event aggregation counters).
         from tpu_operator.controller.statusserver import Metrics
         self.metrics = metrics if metrics is not None else Metrics()
+        # Late-bind the API-request ledger into a fake clientset (the REST
+        # transport gets the same binding in the server bootstrap): every
+        # clientset call then ticks api_requests_total{verb,resource}.
+        if getattr(clientset, "metrics", "absent") is None:
+            clientset.metrics = self.metrics
         self.queue = queue or RateLimitingQueue(clock=clock,
                                                metrics=self.metrics)
         # Exact-time wakeups for time obligations (backoff release, stall
@@ -100,14 +110,23 @@ class Controller:
             on_update=lambda _old, new: self.enqueue(new),
             on_delete=self.enqueue,
         )
-        # Child informers → owner enqueue (upgrade; see module docstring).
+        # Child informers → owner enqueue (upgrade; see module docstring),
+        # indexed by controlling-owner UID + job label so reconciles read
+        # children from the cache instead of LISTing the apiserver.
         for resource in ("pods", "services"):
             inf = self.factory.informer_for(resource)
+            add_child_indexes(inf.store)
             inf.add_event_handler(
                 on_add=self._enqueue_owner,
                 on_update=lambda _old, new: self._enqueue_owner(new),
                 on_delete=self._enqueue_owner,
             )
+        # The read path handed to every TrainingJob: informer stores only.
+        self.listers = Listers(
+            tpujobs=self.job_informer.store,
+            pods=self.factory.informer_for("pods").store,
+            services=self.factory.informer_for("services").store,
+        )
 
     # -- enqueue (ref: controller.go:270-279) ----------------------------------
 
@@ -203,7 +222,8 @@ class Controller:
                 # New job, or same name re-created with a new UID
                 # (ref: controller.go:237-245).
                 tj = TrainingJob(self.clientset, self.recorder, job,
-                                 self.config, metrics=self.metrics)
+                                 self.config, metrics=self.metrics,
+                                 listers=self.listers)
                 self.jobs[key] = tj
             else:
                 tj.refresh(job)
@@ -265,7 +285,25 @@ class Controller:
                     and hb_attempt < tj.job.status.attempt):
                 return None
             prev = tj.job.status.last_heartbeat
-            tj.job.status.last_heartbeat = dict(heartbeat)
+            merged = dict(heartbeat)
+            if prev is not None:
+                # Same generation (missing attempt = current, as above): a
+                # partial post must not erase telemetry it didn't carry —
+                # a liveness-only beat would otherwise wipe step/loss from
+                # status and drop the per-job gauges until the next full
+                # post. Resolve BOTH sides against the current attempt so
+                # a stored pre-restart beat never leaks stale step/loss
+                # into the new generation's heartbeat.
+                now_attempt = tj.job.status.attempt
+                prev_attempt = prev.get("attempt")
+                hb_gen = now_attempt if hb_attempt is None else hb_attempt
+                prev_gen = now_attempt if prev_attempt is None else prev_attempt
+                if hb_gen == prev_gen:
+                    for field in ("step", "processId", "stepTimeSeconds",
+                                  "tokensPerSec", "loss"):
+                        if field not in merged and field in prev:
+                            merged[field] = prev[field]
+            tj.job.status.last_heartbeat = merged
             # Compare against the last *persisted* stamp, not the last
             # received one — a steady sub-interval cadence would otherwise
             # keep resetting the baseline and never persist again.

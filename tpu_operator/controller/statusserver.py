@@ -159,6 +159,10 @@ class Metrics:
         self.register("api_request_retries_total", "counter",
                       "Transient-failure retries of idempotent apiserver "
                       "requests (client/rest.py backoff).")
+        self.register("api_requests_total", "counter",
+                      "Apiserver requests issued by the operator, by "
+                      "{verb,resource} — the read/write budget ledger "
+                      "(fake and REST clientsets both tick it).")
         self.register("job_stalls_total", "counter",
                       "Whole-group restarts triggered by the stall watchdog "
                       "(no heartbeat within stallTimeoutSeconds).")

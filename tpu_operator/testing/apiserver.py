@@ -213,7 +213,15 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
     """ThreadingHTTPServer that remembers accepted sockets so the harness can
     sever them abruptly (kill()) — a clean shutdown() ends chunked watch
     streams with the terminal 0-chunk, which never exercises the client's
-    torn-stream (IncompleteRead) path."""
+    torn-stream (IncompleteRead) path.
+
+    The listen backlog is raised from socketserver's default of 5: a
+    parallel gang sync opens up to ``createParallelism`` connections at
+    once, and an overflowed backlog drops SYNs that the clients only
+    retransmit after ~1 s — turning the parallel path *slower* than
+    sequential on localhost."""
+
+    request_queue_size = 128
 
     def __init__(self, *args: Any, **kwargs: Any):
         super().__init__(*args, **kwargs)
@@ -228,8 +236,12 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
 class ApiServerHarness:
     """Lifecycle wrapper: ``with ApiServerHarness() as srv: srv.url ...``"""
 
-    def __init__(self, host: str = "127.0.0.1", port: int = 0):
-        self.clientset = FakeClientset()
+    def __init__(self, host: str = "127.0.0.1", port: int = 0,
+                 clientset: Optional[Any] = None):
+        # ``clientset`` lets a caller serve a wrapped store — e.g. a
+        # FlakyClientset injecting per-request latency so a localhost bench
+        # has an RTT worth overlapping (handler threads sleep off-GIL).
+        self.clientset = clientset if clientset is not None else FakeClientset()
         self._httpd = _TrackingHTTPServer((host, port), _Handler)
         self._httpd.daemon_threads = True
         # Never join handler threads on close: a handler can be parked inside

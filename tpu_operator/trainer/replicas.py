@@ -40,7 +40,8 @@ from __future__ import annotations
 
 import copy
 import logging
-from typing import Any, Dict, List, Optional, Tuple
+from concurrent.futures import FIRST_EXCEPTION, ThreadPoolExecutor, wait
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_operator.apis.tpujob import helper
 from tpu_operator.apis.tpujob.v1alpha1.types import (
@@ -56,6 +57,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 from tpu_operator.client import errors
 from tpu_operator.trainer import labels as labels_mod
 from tpu_operator.trainer import policy
+from tpu_operator.trainer.snapshot import ReplicaSnapshot
 from tpu_operator.util.tracing import traced
 from tpu_operator.util.util import rand_string
 
@@ -66,6 +68,41 @@ log = logging.getLogger(__name__)
 PORT_NAME = "tpujob-port"
 
 _MAX_DNS_LABEL = 63
+
+# Bound on concurrent child-create RPCs per sync (--create-parallelism):
+# a 256-pod gang costs ~N/16 round trips instead of N sequential ones.
+DEFAULT_CREATE_PARALLELISM = 16
+
+
+def run_creates(tasks: List[Callable[[], Any]], parallelism: int) -> None:
+    """Run create thunks across a bounded worker pool with first-error
+    propagation: on the first failure, queued tasks are cancelled, in-flight
+    ones are allowed to finish (their effects are visible to the caller's
+    rollback), and the first exception is re-raised. ``parallelism <= 1``
+    degrades to the plain sequential loop."""
+    if not tasks:
+        return
+    if parallelism <= 1 or len(tasks) == 1:
+        for task in tasks:
+            task()
+        return
+    with ThreadPoolExecutor(max_workers=min(parallelism, len(tasks)),
+                            thread_name_prefix="gang-create") as pool:
+        futures = [pool.submit(t) for t in tasks]
+        done, not_done = wait(futures, return_when=FIRST_EXCEPTION)
+        first_error: Optional[BaseException] = None
+        for f in done:
+            err = f.exception()
+            if err is not None:
+                first_error = err
+                break
+        if first_error is None:
+            return
+        for f in not_done:
+            f.cancel()
+        # pool.__exit__ joins the still-running tasks; cancelled ones never
+        # start, so nothing is created behind the caller's back after this.
+    raise first_error
 
 
 # --- Naming (ref: replicas.go:570-583) --------------------------------------
@@ -145,6 +182,25 @@ def coordinator_address(job_name: str, runtime_id: str, spec: TPUJobSpec) -> Tup
     )
 
 
+class EnvContext:
+    """Job-wide topology computed ONCE per sync and threaded through every
+    replica's env build. Without it, each of the N pod specs rebuilt the
+    full process table and rescanned it linearly for its own process id —
+    an O(N²) env-build per gang sync that dominated pod-spec construction
+    at megascale replica counts."""
+
+    __slots__ = ("table", "coord", "process_index", "workers")
+
+    def __init__(self, job_name: str, runtime_id: str, spec: TPUJobSpec):
+        self.table = process_table(job_name, runtime_id, spec)
+        self.coord = coordinator_address(job_name, runtime_id, spec)
+        self.process_index = {
+            (role, i): gi for gi, (role, i, _dns, _p) in enumerate(self.table)
+        }
+        self.workers = [entry for entry in self.table
+                        if entry[0] == TPUReplicaType.WORKER]
+
+
 def build_replica_env(
     job_name: str,
     runtime_id: str,
@@ -152,6 +208,7 @@ def build_replica_env(
     replica_type: str,
     index: int,
     attempt: int = 0,
+    ctx: Optional[EnvContext] = None,
 ) -> Dict[str, str]:
     """The env contract injected into the ``tpu`` container — the TPU-native
     replacement for the six ``DMLC_*`` vars (ref: replicas.go:235-260).
@@ -160,18 +217,17 @@ def build_replica_env(
     Multi-slice (spec.num_slices > 1): workers partition into equal slices;
     ``TPU_WORKER_*`` becomes slice-local and ``MEGASCALE_*`` carries the
     cross-slice DCN discovery info.
+
+    ``ctx`` carries the precomputed job topology; sync loops build it once
+    and pass it per replica. Omitting it computes a fresh one (single-pod
+    call sites).
     """
-    table = process_table(job_name, runtime_id, spec)
-    coord_dns, coord_port = coordinator_address(job_name, runtime_id, spec)
-
-    # Global process id: position in the stable table.
-    process_id = next(
-        gi for gi, (role, i, _dns, _p) in enumerate(table)
-        if role == replica_type and i == index
-    )
-
-    workers = [(role, i, dns, port) for role, i, dns, port in table
-               if role == TPUReplicaType.WORKER]
+    if ctx is None:
+        ctx = EnvContext(job_name, runtime_id, spec)
+    table = ctx.table
+    coord_dns, coord_port = ctx.coord
+    process_id = ctx.process_index[(replica_type, index)]
+    workers = ctx.workers
 
     env = {
         "TPUJOB_NAME": job_name,
@@ -309,42 +365,94 @@ class TPUReplicaSet:
         }
 
     @traced
-    def create_service_with_index(self, index: int) -> Dict[str, Any]:
-        """ref: replicas.go:132-159."""
+    def create_service_with_index(self, index: int,
+                                  emit_event: bool = True
+                                  ) -> Optional[Dict[str, Any]]:
+        """ref: replicas.go:132-159. A 409 AlreadyExists is benign — the
+        snapshot this create was decided from can lag the apiserver, and
+        Service names are deterministic, so the duplicate create means the
+        child is already there (returns None in that case)."""
         svc = self.service_spec_with_index(index)
-        created = self.clientset.services.create(self.job.namespace, svc)
-        if self.recorder:
+        try:
+            created = self.clientset.services.create(self.job.namespace, svc)
+        except errors.ApiError as e:
+            if errors.is_already_exists(e):
+                log.debug("service %s already exists (stale cache); skipping",
+                          svc["metadata"]["name"])
+                return None
+            raise
+        if self.recorder and emit_event:
             self.recorder.event(
                 self.job, "Normal", "SuccessfulCreate",
                 f"Created service: {svc['metadata']['name']}",
             )
         return created
 
+    def missing_service_indices(self,
+                                snapshot: Optional[ReplicaSnapshot] = None
+                                ) -> List[int]:
+        """Indices with no Service in the snapshot (zero RPCs; the reference
+        issued one GET per index, replicas.go:538-568)."""
+        snap = snapshot or self._fallback_snapshot()
+        return [index for index in range(self.spec.replicas)
+                if not snap.has_service(self.gen_name(index))]
+
     @traced
-    def sync_services(self) -> None:
-        """Create-if-absent per index (ref: replicas.go:538-568)."""
-        for index in range(self.spec.replicas):
-            name = self.gen_name(index)
-            try:
-                self.clientset.services.get(self.job.namespace, name)
-            except errors.ApiError as e:
-                if errors.is_not_found(e):
-                    self.create_service_with_index(index)
-                else:
-                    raise
+    def sync_services(self, snapshot: Optional[ReplicaSnapshot] = None) -> None:
+        """Create-if-absent per index, classified against the snapshot and
+        created across the bounded pool; one aggregated SuccessfulCreate
+        event per sync (ref: replicas.go:538-568, minus the N GETs)."""
+        missing = self.missing_service_indices(snapshot)
+        created: List[int] = []  # list.append is atomic; pool-safe
+
+        def create_one(i: int) -> None:
+            if self.create_service_with_index(i, emit_event=False) is not None:
+                created.append(i)
+
+        run_creates([lambda i=i: create_one(i) for i in missing],
+                    self._create_parallelism())
+        # Count what was actually created, not what the (possibly stale)
+        # snapshot thought was missing — N benign 409s must not produce a
+        # "Created N service(s)" event.
+        if created and self.recorder:
+            self.recorder.event(
+                self.job, "Normal", "SuccessfulCreate",
+                f"Created {len(created)} {self.replica_type.lower()} "
+                f"service(s)",
+            )
+
+    def _create_parallelism(self) -> int:
+        config = getattr(self.job, "config", None)
+        return int(getattr(config, "create_parallelism",
+                           DEFAULT_CREATE_PARALLELISM)
+                   or DEFAULT_CREATE_PARALLELISM)
+
+    def _fallback_snapshot(self) -> ReplicaSnapshot:
+        """Snapshot for informer-less use (standalone replica-set calls):
+        one pod LIST + one service LIST under this replica set's selector —
+        constant read cost, where the per-index loops were O(N) RPCs."""
+        return ReplicaSnapshot.from_clientset(
+            self.clientset, self.job.namespace,
+            labels_mod.to_selector(self.labels()),
+        )
 
     # -- pods (ref: replicas.go:162-276, 481-535) -----------------------------
 
-    def pod_spec_with_index(self, index: int, attempt: int = 0) -> Dict[str, Any]:
+    def pod_spec_with_index(self, index: int, attempt: int = 0,
+                            env_ctx: Optional[EnvContext] = None
+                            ) -> Dict[str, Any]:
         """Build the pod manifest for one replica index
         (ref: CreatePodWithIndex, replicas.go:162-276)."""
         job_spec: TPUJobSpec = self.job.job_spec
+        # ONE deepcopy of the user template; metadata/spec below are views
+        # into that private copy (they were redundantly deep-copied a second
+        # time from the already-copied template).
         template = copy.deepcopy(self.spec.template) or {}
         pod: Dict[str, Any] = {
             "apiVersion": "v1",
             "kind": "Pod",
-            "metadata": copy.deepcopy(template.get("metadata") or {}),
-            "spec": copy.deepcopy(template.get("spec") or {}),
+            "metadata": template.get("metadata") or {},
+            "spec": template.get("spec") or {},
         }
         md = pod["metadata"]
         md["name"] = gen_pod_name(
@@ -371,7 +479,7 @@ class TPUReplicaSet:
 
         env = build_replica_env(
             self.job.name, job_spec.runtime_id, job_spec,
-            self.replica_type, index, attempt,
+            self.replica_type, index, attempt, ctx=env_ctx,
         )
         # Identity + telemetry sink (payload/heartbeat.py): the namespace
         # and — when the operator advertises one — the status-server URL
@@ -400,17 +508,24 @@ class TPUReplicaSet:
         return pod
 
     @traced
-    def create_pod_with_index(self, index: int, attempt: int = 0) -> Dict[str, Any]:
-        pod = self.pod_spec_with_index(index, attempt)
+    def create_pod_with_index(self, index: int, attempt: int = 0,
+                              env_ctx: Optional[EnvContext] = None,
+                              emit_event: bool = True) -> Dict[str, Any]:
+        pod = self.pod_spec_with_index(index, attempt, env_ctx=env_ctx)
         created = self.clientset.pods.create(self.job.namespace, pod)
-        if self.recorder:
+        if self.recorder and emit_event:
             self.recorder.event(
                 self.job, "Normal", "SuccessfulCreate",
                 f"Created pod: {pod['metadata']['name']}",
             )
         return created
 
-    def pods_for_index(self, index: int, attempt: Optional[int] = None) -> List[dict]:
+    def pods_for_index(self, index: int, attempt: Optional[int] = None,
+                       snapshot: Optional[ReplicaSnapshot] = None) -> List[dict]:
+        """This replica index's pods. From the snapshot when one is given
+        (zero RPCs); a direct label-selected LIST otherwise."""
+        if snapshot is not None:
+            return snapshot.pods_for(self.replica_type, index, attempt)
         sel_labels = self.index_labels(index)
         sel_labels.pop("attempt", None)
         selector = labels_mod.to_selector(sel_labels)
@@ -418,10 +533,13 @@ class TPUReplicaSet:
             selector += f",attempt={attempt}"
         return self.clientset.pods.list(self.job.namespace, label_selector=selector)
 
-    def missing_pod_indices(self, attempt: int = 0) -> List[int]:
+    def missing_pod_indices(self, attempt: int = 0,
+                            snapshot: Optional[ReplicaSnapshot] = None
+                            ) -> List[int]:
         """Indices that need a pod created for this generation — the single
         home of the live-pod filter shared by ``sync_pods`` and the
-        TrainingJob's gang creation.
+        TrainingJob's gang creation. Classified against the snapshot (the
+        reference issued one pod LIST per index, replicas.go:481-535).
 
         Per-pod mode (the reference behavior): fully-failed pods are filtered
         out (ref: replicas.go:497 ``status.phase != Failed``) so a fresh pod
@@ -430,10 +548,11 @@ class TPUReplicaSet:
         the group restart decision belongs to the TrainingJob, which bumps
         the attempt and deletes the whole generation.
         """
+        snap = snapshot or self._fallback_snapshot()
         per_pod = self.job.job_spec.restart_policy != RestartPolicy.WHOLE_GROUP
         missing = []
         for index in range(self.spec.replicas):
-            pods = self.pods_for_index(index, attempt)
+            pods = snap.pods_for(self.replica_type, index, attempt)
             live = [
                 p for p in pods
                 if (p.get("status") or {}).get("phase") != "Failed"
@@ -447,10 +566,29 @@ class TPUReplicaSet:
         return missing
 
     @traced
-    def sync_pods(self, attempt: int = 0) -> None:
-        """Create-if-absent per index (ref: SyncPods, replicas.go:481-535)."""
-        for index in self.missing_pod_indices(attempt):
-            self.create_pod_with_index(index, attempt)
+    def sync_pods(self, attempt: int = 0,
+                  snapshot: Optional[ReplicaSnapshot] = None) -> None:
+        """Create-if-absent per index (ref: SyncPods, replicas.go:481-535),
+        creates fanned across the bounded pool with one aggregated event.
+        Gang semantics (all-or-none with rollback) live in the TrainingJob;
+        this standalone path is plain create-if-absent."""
+        missing = self.missing_pod_indices(attempt, snapshot)
+        if not missing:
+            return
+        env_ctx = EnvContext(self.job.name, self.job.job_spec.runtime_id,
+                             self.job.job_spec)
+        run_creates(
+            [lambda i=i: self.create_pod_with_index(
+                i, attempt, env_ctx=env_ctx, emit_event=False)
+             for i in missing],
+            self._create_parallelism(),
+        )
+        if self.recorder:
+            self.recorder.event(
+                self.job, "Normal", "SuccessfulCreate",
+                f"Created {len(missing)} {self.replica_type.lower()} "
+                f"pod(s) for attempt {attempt}",
+            )
 
     # -- delete (ref: replicas.go:279-342) ------------------------------------
 
@@ -540,7 +678,9 @@ class TPUReplicaSet:
             return ReplicaState.STARTING
         return ReplicaState.UNKNOWN
 
-    def retryable_failure_info(self, attempt: int) -> Optional[Tuple[str, str]]:
+    def retryable_failure_info(self, attempt: int,
+                               snapshot: Optional[ReplicaSnapshot] = None
+                               ) -> Optional[Tuple[str, str]]:
         """(FailureKind, reason) of this generation's retryable failure, or
         None — the whole-group restart trigger, feeding the per-kind retry
         budgets and the ``status.failures`` ledger. Covers both a retryable
@@ -555,8 +695,9 @@ class TPUReplicaSet:
         the 4x preemption budget — otherwise a crash-looper whose crashes
         collaterally kill siblings would sidestep its own cap."""
         first_preemption: Optional[Tuple[str, str]] = None
+        snap = snapshot or self._fallback_snapshot()
         for index in range(self.spec.replicas):
-            for pod in self.pods_for_index(index, attempt):
+            for pod in snap.pods_for(self.replica_type, index, attempt):
                 info = policy.classify_pod_failure(pod, DEFAULT_CONTAINER_NAME)
                 if info is None:
                     continue
@@ -566,17 +707,25 @@ class TPUReplicaSet:
                     first_preemption = info
         return first_preemption
 
-    def get_single_replica_status(self, index: int, attempt: Optional[int] = None) -> str:
+    def get_single_replica_status(self, index: int,
+                                  attempt: Optional[int] = None,
+                                  snapshot: Optional[ReplicaSnapshot] = None
+                                  ) -> str:
         """ref: GetSingleReplicaStatus (replicas.go:400-434), minus the
         dead Get-by-name path (see module docstring)."""
-        return self.replica_state_from_pod_list(self.pods_for_index(index, attempt))
+        return self.replica_state_from_pod_list(
+            self.pods_for_index(index, attempt, snapshot))
 
     @traced
-    def get_status(self, attempt: Optional[int] = None) -> TPUReplicaStatus:
-        """Roll up per-index states (ref: GetStatus, replicas.go:436-478)."""
+    def get_status(self, attempt: Optional[int] = None,
+                   snapshot: Optional[ReplicaSnapshot] = None
+                   ) -> TPUReplicaStatus:
+        """Roll up per-index states (ref: GetStatus, replicas.go:436-478),
+        classified against one snapshot instead of N pod LISTs."""
+        snap = snapshot or self._fallback_snapshot()
         counts: Dict[str, int] = {}
         for index in range(self.spec.replicas):
-            st = self.get_single_replica_status(index, attempt)
+            st = self.get_single_replica_status(index, attempt, snap)
             counts[st] = counts.get(st, 0) + 1
 
         n = self.spec.replicas
