@@ -70,6 +70,11 @@ def parse_args(argv=None):
                         "reconcile latency, parallel-vs-sequential gang "
                         "creation against the in-process apiserver; exits "
                         "nonzero if the zero-read budget regresses")
+    p.add_argument("--checkpoint", action="store_true",
+                   help="run ONLY the checkpoint durability micro-rows "
+                        "(CPU-hostable): verified-save + restore latency vs "
+                        "state size, and the corrupt-latest fallback-scan "
+                        "cost")
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
     return p.parse_args(argv)
@@ -924,6 +929,130 @@ def bench_control_plane(quick: bool) -> list:
     ]
 
 
+# --- checkpoint durability micro-rows ------------------------------------------
+
+def _ckpt_state(size_mb: float):
+    import jax.numpy as jnp
+
+    n = max(1, int(size_mb * (1 << 20)) // 4)
+    return {"step": jnp.int32(0), "w": jnp.arange(n, dtype=jnp.float32)}
+
+
+def bench_checkpoint_save_restore(size_mb: float, quick: bool) -> list:
+    """Verified-save and restore latency at one state size. Save cost is
+    save + commit + verification (manifest write with per-file sha256) —
+    the full durable path, not just the async submit; restore is the
+    fresh-process resume path (manager init amortized out)."""
+    import shutil
+    import tempfile
+
+    from tpu_operator.payload import checkpoint as ckpt_mod
+
+    windows = 2 if quick else 5
+    state = _ckpt_state(size_mb)
+    save_times, verify_times, restore_times = [], [], []
+    for w in range(windows):
+        d = tempfile.mkdtemp(prefix="bench-ckpt-")
+        try:
+            ck = ckpt_mod.Checkpointer(d, save_every=1)
+            t0 = time.perf_counter()
+            ck.maybe_save(w + 1, state)
+            t_submit = time.perf_counter()
+            ck.flush()  # commit + verify + manifest
+            save_times.append((time.perf_counter() - t0) * 1e3)
+            verify_times.append((time.perf_counter() - t_submit) * 1e3)
+            ck.close()
+
+            reader = ckpt_mod.Checkpointer(d, save_every=1)
+            t0 = time.perf_counter()
+            _restored, start = reader.restore(state)
+            restore_times.append((time.perf_counter() - t0) * 1e3)
+            reader.close()
+            assert start == w + 1
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    for t in (save_times, verify_times, restore_times):
+        t.sort()
+    mid = len(save_times) // 2
+    return [
+        {
+            "metric": "checkpoint_save_verified_ms",
+            "value": round(save_times[mid], 1),
+            "unit": "ms",
+            "state_mb": size_mb,
+            "flush_ms": round(verify_times[mid], 1),
+            "windows": windows,
+        },
+        {
+            "metric": "checkpoint_restore_ms",
+            "value": round(restore_times[mid], 1),
+            "unit": "ms",
+            "state_mb": size_mb,
+            "windows": windows,
+        },
+    ]
+
+
+def bench_checkpoint_fallback_scan(quick: bool) -> dict:
+    """Cost of the corrupt-latest walk-back: K newest steps are corrupted,
+    restore must quarantine each and land on the newest valid one. This is
+    the recovery-path tax a restart pays when storage went bad — it bounds
+    how much worse a dirty resume is than a clean one."""
+    import shutil
+    import tempfile
+
+    from tpu_operator.payload import checkpoint as ckpt_mod
+
+    windows = 2 if quick else 5
+    corrupt = 3
+    size_mb = 0.25 if quick else 1.0
+    state = _ckpt_state(size_mb)
+    times = []
+    for _w in range(windows):
+        d = tempfile.mkdtemp(prefix="bench-ckpt-fb-")
+        try:
+            ck = ckpt_mod.Checkpointer(d, save_every=1, max_to_keep=corrupt + 2)
+            for s in range(1, corrupt + 2):
+                ck.maybe_save(s, state)
+            ck.close()
+            for s in range(2, corrupt + 2):  # corrupt the newest `corrupt`
+                step_dir = os.path.join(d, str(s))
+                victim = sorted(
+                    os.path.join(root, fn)
+                    for root, _dirs, files in os.walk(step_dir)
+                    for fn in files if fn != ckpt_mod.MANIFEST_NAME)[-1]
+                with open(victim, "r+b") as f:
+                    f.write(b"\xde\xad\xbe\xef")
+            reader = ckpt_mod.Checkpointer(d, save_every=1)
+            t0 = time.perf_counter()
+            _restored, start = reader.restore(state)
+            times.append((time.perf_counter() - t0) * 1e3)
+            reader.close()
+            assert start == 1, start
+            assert reader.restore_fallbacks == corrupt
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+    times.sort()
+    return {
+        "metric": "checkpoint_fallback_scan_ms",
+        "value": round(times[len(times) // 2], 1),
+        "unit": "ms",
+        "corrupt_steps_walked": corrupt,
+        "state_mb": size_mb,
+        "windows": windows,
+    }
+
+
+def bench_checkpoint(quick: bool) -> list:
+    """The --checkpoint micro-section: save/restore latency vs state size
+    plus the fallback-scan cost. CPU-hostable (orbax I/O is host-side)."""
+    rows = []
+    for size_mb in ((0.25,) if quick else (1.0, 16.0)):
+        rows.extend(bench_checkpoint_save_restore(size_mb, quick))
+    rows.append(bench_checkpoint_fallback_scan(quick))
+    return rows
+
+
 def _control_plane_ok(rows: list) -> bool:
     """The CI contract (hack/verify.sh runs --control-plane --quick):
     steady-state reconcile must stay zero-read and the parallel gang must
@@ -950,6 +1079,13 @@ def main(argv=None) -> int:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_control_plane(args.quick)]
         return 0 if _control_plane_ok(rows) else 1
+    if args.checkpoint:
+        # Orbax I/O is host-side: pin CPU so the rows measure the durable
+        # path, not a tunnel's device→host transfer artifacts.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        for row in bench_checkpoint(args.quick):
+            _emit(row)
+        return 0
     if args.quick:
         # Force CPU even when a TPU plugin pinned the platform at boot
         # (backend clients initialize lazily, so this override wins).
@@ -968,6 +1104,8 @@ def main(argv=None) -> int:
         rows.extend(cp_rows)
         if not _control_plane_ok(cp_rows):
             return 1
+        for row in bench_checkpoint(args.quick):
+            rows.append(_emit(row))
         rows.append(_emit(bench_matmul(args.quick)))
         for row in bench_attention(args.quick):
             rows.append(_emit(row))

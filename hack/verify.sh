@@ -26,6 +26,15 @@ python -m pytest tests/test_metrics_conformance.py -x -q
 # checkpointed, twice-preempted job to DONE through the Backoff phase with
 # no leaked pods — the whole time-aware recovery stack under fire.
 python -m pytest tests/test_chaos_soak.py -x -q
+# Standalone durability gate: the checkpoint chaos test (a worker SIGKILLed
+# mid-save, the latest checkpoint corrupted, seeded RNG, real subprocess
+# payloads over the in-process apiserver) must resume from the last
+# VERIFIED step — never step 0 — and reach DONE, with lastCheckpointStep
+# in job status and the restore-fallback counter incremented.
+python -m pytest tests/test_checkpoint_chaos.py -x -q
+# The measured form of the durable path: verified-save/restore latency and
+# the corrupt-latest fallback-scan cost must at least run clean.
+python bench.py --checkpoint --quick
 # Standalone control-plane budget gate: steady-state reconcile must issue
 # ZERO read RPCs (all reads served by the informer indexes) and the first
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
@@ -37,6 +46,7 @@ python -m pytest tests/test_api_budget.py -x -q
 python bench.py --control-plane --quick
 python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_chaos_soak.py \
+  --ignore=tests/test_checkpoint_chaos.py \
   --ignore=tests/test_api_budget.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

@@ -1,0 +1,106 @@
+"""Subprocess payload for the checkpoint durability chaos test
+(tests/test_checkpoint_chaos.py).
+
+Runs ONE single-process CPU training attempt of the tiny linear-regression
+payload through the operator's real env contract — the parent passes this
+worker exactly the env the operator injected into the pod spec
+(TPU_CHECKPOINT_DIR, TPUJOB_NAME/NAMESPACE/ATTEMPT, TPUJOB_STATUS_URL) —
+so checkpoint restore, interval saves, and checkpoint-carrying heartbeats
+all exercise their production paths.
+
+Two modes, selected by CHAOS_MODE:
+
+- ``killed`` (attempt 0): train to CHAOS_KILL_STEP with verified interval
+  saves, post a final heartbeat carrying the durable step, kick off one
+  more *async* save (the one the kill lands in the middle of), write the
+  sentinel file, and spin until the parent SIGKILLs us — the canonical
+  preempted-mid-save death.
+- ``finish`` (attempt >= 1): restore (the parent corrupted the latest
+  checkpoint, so this walks back to the last verified step), train to
+  CHAOS_TOTAL_STEPS, post the final durability stats, exit 0.
+
+The restore/resume step is asserted by the parent from this process's log
+("restored checkpoint step N").
+"""
+
+import os
+import sys
+import time
+
+
+def main() -> None:
+    os.environ.setdefault("JAX_PLATFORMS", "cpu")
+    os.environ.pop("XLA_FLAGS", None)
+    os.environ["PALLAS_AXON_POOL_IPS"] = ""
+    sys.path.insert(0, os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+    import jax
+
+    jax.config.update("jax_platforms", "cpu")
+
+    import logging
+
+    logging.basicConfig(level=logging.INFO, stream=sys.stdout,
+                        format="%(asctime)s %(levelname)s %(message)s")
+    logging.getLogger("absl").setLevel(logging.WARNING)
+
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_operator.payload import (bootstrap, checkpoint, data as data_mod,
+                                      heartbeat as heartbeat_mod, models,
+                                      train)
+
+    mode = os.environ["CHAOS_MODE"]
+    kill_step = int(os.environ.get("CHAOS_KILL_STEP", "6"))
+    total_steps = int(os.environ.get("CHAOS_TOTAL_STEPS", "10"))
+    sentinel = os.environ.get("CHAOS_SENTINEL", "")
+
+    def run(info: bootstrap.ProcessInfo) -> None:
+        mesh = train.make_mesh(1)
+        model = models.LinearRegressor()
+        tx = optax.sgd(0.1)
+        sample = jnp.zeros((8, 8), jnp.float32)
+        state = train.create_train_state(model, jax.random.key(0), sample, tx)
+        state = train.place_state(mesh, state)
+        step = train.make_regression_train_step(model, tx, mesh, state)
+        batches = data_mod.synthetic_linear(0, 8, 8)
+
+        ckpt = checkpoint.from_env_or_args(save_every=2)
+        assert ckpt is not None, "operator did not inject TPU_CHECKPOINT_DIR"
+
+        steps = kill_step if mode == "killed" else total_steps
+        state, _metrics = train.train_loop(mesh, step, state, batches,
+                                           steps=steps, checkpointer=ckpt)
+
+        # Final heartbeat with the attempt's durability stats — the chaos
+        # loop is too fast for the in-loop interval reporter to be the one
+        # carrying the final word, so post it explicitly the same way.
+        reporter = heartbeat_mod.from_env()
+        if reporter is not None:
+            reporter.report(steps, None, checkpoint=ckpt.stats())
+
+        if mode == "killed":
+            # One more async save for the kill to land inside (its litter —
+            # a torn tmp dir or an unverified commit — is then replaced by
+            # the parent's *seeded* corrupt-latest so the outcome stays
+            # deterministic), then hand control to the parent.
+            try:
+                ckpt.manager.save(
+                    kill_step + 2,
+                    args=ckpt._ocp.args.StandardSave(state), force=True)
+            except Exception:  # noqa: BLE001 — racing our own SIGKILL
+                pass
+            if sentinel:
+                with open(sentinel, "w") as f:
+                    f.write(str(kill_step))
+            while True:  # parent SIGKILLs us here, "mid-save"
+                time.sleep(0.1)
+        ckpt.close()
+
+    sys.exit(bootstrap.run_payload(run))
+
+
+if __name__ == "__main__":
+    main()

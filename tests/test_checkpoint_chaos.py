@@ -1,0 +1,275 @@
+"""Checkpoint durability chaos: kill -9 mid-save + corrupted latest
+checkpoint → the job resumes from the last VERIFIED step — never step 0,
+never permanent Failed — and reaches DONE, with the durable step visible in
+job status and the restore-fallback counter incremented.
+
+The operator (informers → workqueue → reconcile) runs in-process against
+the HTTP test apiserver; the payload is a REAL subprocess
+(tests/checkpoint_chaos_worker.py) driven by exactly the env the operator
+injected into the pod spec, posting heartbeats through the real status
+server. The test plays kubelet:
+
+1. attempt 0's pod goes Running; the worker trains 6 steps with verified
+   interval saves, reports ``lastCheckpointStep=6``, kicks off one more
+   async save and is SIGKILLed while it is (or was about to be) writing;
+2. the chaos (seeded) then makes the on-disk state maximally hostile:
+   whatever the killed save left behind is replaced with a *corrupt*
+   latest step 8 (copy of step 6 with flipped bytes under an honest
+   manifest) plus an orphaned tmp dir from a second phantom killed save;
+3. the pod is marked Failed with exit 137 → classified preemption → the
+   ledger records the restart with ``resumeStep`` = the durable step 6;
+4. attempt 1's worker restores: quarantines the corrupt 8, walks back to
+   6, finishes the remaining steps, exits 0 → job DONE.
+
+Runs standalone as a hack/verify.sh gate (marked slow: two subprocess JAX
+payloads make it too heavy for the tier-1 sweep).
+"""
+
+import os
+import random
+import re
+import shutil
+import signal
+import subprocess
+import sys
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1.types import ControllerConfig
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import checkpoint as ckpt_mod
+from tpu_operator.testing.apiserver import ApiServerHarness
+
+pytestmark = pytest.mark.slow  # standalone verify.sh gate
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+WORKER = os.path.join(REPO, "tests", "checkpoint_chaos_worker.py")
+
+KILL_STEP = 6
+TOTAL_STEPS = 10
+
+
+def wait_for(pred, timeout=60.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def chaos_job(ckdir):
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "ckdur", "namespace": "default"},
+        "spec": {
+            "replicaSpecs": [{
+                "replicas": 1, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+                "template": {"spec": {"containers": [{"name": "tpu"}]}},
+            }],
+            "maxRestarts": 2,
+            "checkpointDir": ckdir,
+            # Instant re-gang: backoff pacing has its own soak test.
+            "restartBackoff": {"baseSeconds": 0},
+        },
+    }
+
+
+def pod_env(pod):
+    """The operator's injected env contract, straight off the pod spec —
+    the worker consumes exactly what a real container would."""
+    (container,) = [c for c in pod["spec"]["containers"]
+                    if c["name"] == "tpu"]
+    return {e["name"]: e["value"] for e in container.get("env", [])}
+
+
+def launch_worker(pod, mode, sentinel=""):
+    env = dict(os.environ)
+    env.pop("XLA_FLAGS", None)
+    env["PALLAS_AXON_POOL_IPS"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(pod_env(pod))
+    env.update({
+        "CHAOS_MODE": mode,
+        "CHAOS_KILL_STEP": str(KILL_STEP),
+        "CHAOS_TOTAL_STEPS": str(TOTAL_STEPS),
+        "CHAOS_SENTINEL": sentinel,
+        # Fast heartbeat cadence so the in-loop reporter fires too.
+        "TPUJOB_HEARTBEAT_INTERVAL": "0.2",
+    })
+    return subprocess.Popen(
+        [sys.executable, WORKER], stdout=subprocess.PIPE,
+        stderr=subprocess.STDOUT, text=True, env=env, cwd=REPO)
+
+
+def corrupt_latest(ckdir, rng):
+    """Seeded post-kill hostility: replace whatever the killed save left
+    with a deterministic corrupt latest (step 8 = copy of the verified 6
+    with flipped bytes, so its manifest honestly mismatches) plus an
+    orphaned tmp dir from a second phantom killed save."""
+    for entry in os.listdir(ckdir):
+        if entry.split(".")[0] == str(KILL_STEP + 2):
+            path = os.path.join(ckdir, entry)
+            shutil.rmtree(path, ignore_errors=True)
+    good = os.path.join(ckdir, str(KILL_STEP))
+    bad = os.path.join(ckdir, str(KILL_STEP + 2))
+    shutil.copytree(good, bad)
+    victims = sorted(
+        os.path.join(root, fn)
+        for root, _dirs, files in os.walk(bad) for fn in files
+        if fn != ckpt_mod.MANIFEST_NAME and os.path.getsize(
+            os.path.join(root, fn)) > 0)
+    victim = rng.choice(victims)
+    size = os.path.getsize(victim)
+    with open(victim, "r+b") as f:
+        f.seek(rng.randrange(size))
+        f.write(b"\xde\xad")
+    orphan = os.path.join(ckdir, f"{KILL_STEP + 4}.orbax-checkpoint-tmp-7")
+    os.makedirs(os.path.join(orphan, "default"))
+    with open(os.path.join(orphan, "default", "data"), "wb") as f:
+        f.write(b"half-written by a killed save")
+
+
+def test_kill9_midsave_and_corrupt_latest_resumes_from_verified_step(
+        tmp_path):
+    rng = random.Random(20260803)
+    ckdir = str(tmp_path / "ckpt")
+    sentinel = str(tmp_path / "ready0")
+
+    harness = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=harness.url, timeout=5.0))
+    server = StatusServer(0)
+    server.start()
+    config = ControllerConfig(status_url=f"http://127.0.0.1:{server.port}")
+    controller = Controller(
+        cs, SharedInformerFactory(cs, "default", resync_period=1.0),
+        config=config, namespace="default",
+        heartbeat_persist_interval=0.0)
+    server.metrics = controller.metrics
+    server.set_controller(controller)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True, name="ckdur-controller")
+    runner.start()
+
+    procs = []
+
+    def get_pod(attempt):
+        for p in cs.pods.list("default"):
+            if (p["metadata"].get("labels") or {}).get("attempt") \
+                    == str(attempt):
+                return p
+        return None
+
+    def mark_running(pod):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        cs.pods.update_status("default", pod)
+
+    def mark_terminated(pod, exit_code):
+        pod["status"] = {
+            "phase": "Failed" if exit_code else "Succeeded",
+            "containerStatuses": [{
+                "name": "tpu",
+                "state": {"terminated": {"exitCode": exit_code}}}],
+        }
+        cs.pods.update_status("default", pod)
+
+    def job_status():
+        try:
+            return cs.tpujobs.get("default", "ckdur").get("status") or {}
+        except Exception:  # noqa: BLE001 — polling
+            return {}
+
+    try:
+        cs.tpujobs.create("default", chaos_job(ckdir))
+
+        # --- attempt 0: train, verify saves, die by SIGKILL mid-save ------
+        assert wait_for(lambda: get_pod(0) is not None), "no attempt-0 pod"
+        pod0 = get_pod(0)
+        mark_running(pod0)
+        proc0 = launch_worker(pod0, "killed", sentinel=sentinel)
+        procs.append(proc0)
+        assert wait_for(lambda: os.path.exists(sentinel), timeout=120.0), \
+            proc0.communicate()[0] if proc0.poll() is not None else \
+            "worker 0 never reached the kill point"
+        proc0.send_signal(signal.SIGKILL)
+        proc0.wait(timeout=30)
+
+        # the durable step was reported before death
+        assert wait_for(lambda: (job_status().get("checkpoint") or {})
+                        .get("lastCheckpointStep") == KILL_STEP), \
+            job_status()
+
+        # --- seeded chaos: corrupt the latest checkpoint ------------------
+        corrupt_latest(ckdir, rng)
+
+        mark_terminated(get_pod(0), 137)  # kubelet reports the SIGKILL
+
+        # preemption-classified group restart with the resume step recorded
+        assert wait_for(lambda: job_status().get("attempt", 0) >= 1), \
+            job_status()
+        failures = job_status().get("failures") or []
+        assert failures and failures[0]["kind"] == "preemption", failures
+        assert failures[0]["resumeStep"] == KILL_STEP, failures
+
+        # --- attempt 1: restore past the corruption, finish ---------------
+        assert wait_for(lambda: get_pod(1) is not None), "no attempt-1 pod"
+        pod1 = get_pod(1)
+        mark_running(pod1)
+        proc1 = launch_worker(pod1, "finish")
+        procs.append(proc1)
+        out1, _ = proc1.communicate(timeout=180)
+        assert proc1.returncode == 0, f"exit {proc1.returncode}:\n{out1}"
+
+        # resumed from the last VERIFIED step — never step 0
+        m = re.search(r"restored checkpoint step (\d+)", out1)
+        assert m, out1
+        assert int(m.group(1)) == KILL_STEP, out1
+        assert "restarting from step 0" not in out1
+
+        mark_terminated(get_pod(1), 0)
+        assert wait_for(lambda: job_status().get("phase") == "Done",
+                        timeout=60.0), job_status()
+
+        status = job_status()
+        assert status["state"] == "Succeeded"
+        assert status["attempt"] == 1
+
+        # durable state visible in job status: final step, fallback counted
+        ck = status.get("checkpoint") or {}
+        assert ck.get("lastCheckpointStep") == TOTAL_STEPS, status
+        assert ck.get("restoreFallbacks", 0) >= 1, status
+
+        # the corrupt latest was quarantined, not deleted; the orphan swept
+        entries = os.listdir(ckdir)
+        assert any(e.startswith(f"{KILL_STEP + 2}"
+                                f"{ckpt_mod.QUARANTINE_SUFFIX}")
+                   for e in entries), entries
+        assert any(e.endswith(ckpt_mod.ORPHAN_SUFFIX) for e in entries), \
+            entries
+
+        # and the operator exports it
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{server.port}/metrics", timeout=5) as r:
+            body = r.read().decode()
+        assert re.search(
+            r'tpu_operator_job_checkpoint_restore_fallbacks_total'
+            r'\{name="ckdur",namespace="default"\} [1-9]', body), body
+        assert ('tpu_operator_job_last_checkpoint_step'
+                f'{{name="ckdur",namespace="default"}} {TOTAL_STEPS}'
+                in body), body
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+        stop.set()
+        runner.join(timeout=10.0)
+        server.stop()
+        harness.stop()

@@ -1,0 +1,574 @@
+"""Checkpoint durability matrix (payload/checkpoint.py).
+
+The hardening arc over the plain orbax wrapper: verified saves (commit
+marker + manifest), quarantine-and-fall-back restore, save-failure
+tolerance (skip/count/escalate), gang-consistent resume, and the
+end-of-run save dedup — plus the operator-side plumbing: heartbeat fields,
+``status.checkpoint`` delta accounting, ledger ``resumeStep``, and strict
+schema round-trips.
+
+These tests use raw pytrees (no model build) so the matrix stays fast; the
+train-loop integration rides in tests/test_checkpoint.py and the full
+kill -9 + corrupt-latest e2e in tests/test_checkpoint_chaos.py.
+"""
+
+import json
+import os
+import shutil
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from tpu_operator.payload import checkpoint
+from tpu_operator.payload.bootstrap import EXIT_RETRYABLE
+
+
+def tiny_state(step=0):
+    return {"step": jnp.int32(step), "w": jnp.arange(64, dtype=jnp.float32)}
+
+
+def make_ck(path, **kw):
+    kw.setdefault("save_every", 2)
+    return checkpoint.Checkpointer(str(path), **kw)
+
+
+def corrupt_a_file(step_dir, keep_size=False):
+    """Flip bytes in one data file of a step dir (not the manifest)."""
+    victims = []
+    for root, _dirs, files in os.walk(step_dir):
+        for fn in files:
+            if fn != checkpoint.MANIFEST_NAME:
+                victims.append(os.path.join(root, fn))
+    victim = sorted(victims)[-1]
+    if keep_size:
+        size = os.path.getsize(victim)
+        with open(victim, "r+b") as f:
+            f.write(b"\xde\xad\xbe\xef" * max(1, min(size, 16) // 4))
+    else:
+        with open(victim, "ab") as f:
+            f.write(b"TORN")
+    return victim
+
+
+# --- verified saves ----------------------------------------------------------
+
+def test_verified_save_writes_manifest_and_tracks_step(tmp_path):
+    ck = make_ck(tmp_path / "ck")
+    assert ck.maybe_save(1, tiny_state(1))
+    assert ck.maybe_save(2, tiny_state(2))
+    ck.close()
+    assert ck.last_verified_step() == 2
+    manifest = tmp_path / "ck" / "2" / checkpoint.MANIFEST_NAME
+    assert manifest.exists()
+    doc = json.loads(manifest.read_text())
+    assert doc["step"] == 2
+    assert doc["files"] and all(
+        {"path", "size", "sha256"} <= set(e) for e in doc["files"])
+    assert ck.stats() == {"saveFailures": 0, "restoreFallbacks": 0,
+                          "lastCheckpointStep": 2}
+
+
+def test_last_verified_lags_latest_until_commit_checked(tmp_path):
+    ck = make_ck(tmp_path / "ck")
+    assert ck.maybe_save(1, tiny_state(1))
+    # The async save may already be on disk, but it has not been VERIFIED
+    # yet — last_verified must not advertise it as durable.
+    assert ck.last_verified_step() is None
+    ck.close()  # flush + verify
+    assert ck.last_verified_step() == 1
+
+
+# --- end-of-run save dedup (satellite) ---------------------------------------
+
+def test_save_dedups_in_flight_interval_save_of_same_step(tmp_path):
+    """The old code compared only latest_step(), which misses an in-flight
+    async interval save of the same step and issued a redundant force=True
+    rewrite. save() must synchronize and skip."""
+    ck = make_ck(tmp_path / "ck")
+    assert ck.maybe_save(2, tiny_state(2))  # async interval save in flight
+    calls = []
+    real_save = ck.manager.save
+
+    def spying_save(*a, **kw):
+        calls.append(a)
+        return real_save(*a, **kw)
+
+    ck.manager.save = spying_save
+    assert ck.save(2, tiny_state(2)) is False  # dedup: no manager.save call
+    assert calls == []
+    assert ck.last_verified_step() == 2  # the sync verified the pending one
+    ck.close()
+
+
+def test_save_still_writes_new_final_step(tmp_path):
+    ck = make_ck(tmp_path / "ck")
+    assert ck.maybe_save(2, tiny_state(2))
+    assert ck.save(3, tiny_state(3)) is True  # genuinely new step
+    ck.close()
+    assert ck.latest_step() == 3
+    assert ck.last_verified_step() == 3
+
+
+# --- save-failure tolerance --------------------------------------------------
+
+def test_interval_save_failure_is_skipped_and_counted(tmp_path):
+    ck = make_ck(tmp_path / "ck", fail_after=3)
+
+    def exploding(*_a, **_kw):
+        raise OSError(28, "No space left on device")
+
+    ck.manager.save = exploding
+    assert ck.maybe_save(2, tiny_state(2)) is False  # skipped, not raised
+    assert ck.save_failures == 1
+    assert ck.consecutive_save_failures == 1
+    assert ck.stats()["saveFailures"] == 1
+    ck.manager = make_ck(tmp_path / "ck").manager  # healthy again
+    assert ck.maybe_save(4, tiny_state(4)) is True
+    ck._finalize_pending(block=True)
+    # a verified commit resets the escalation streak, not the total
+    assert ck.consecutive_save_failures == 0
+    assert ck.save_failures == 1
+    ck.close()
+
+
+def test_consecutive_save_failures_escalate_retryable(tmp_path):
+    ck = make_ck(tmp_path / "ck", fail_after=3)
+
+    def exploding(*_a, **_kw):
+        raise OSError("flaky volume")
+
+    ck.manager.save = exploding
+    assert ck.maybe_save(2, tiny_state(2)) is False
+    assert ck.maybe_save(4, tiny_state(4)) is False
+    with pytest.raises(SystemExit) as exc:
+        ck.maybe_save(6, tiny_state(6))
+    assert exc.value.code == EXIT_RETRYABLE
+
+
+def test_drain_save_failure_still_exits_retryable(tmp_path):
+    """Satellite: an I/O failure during the preemption drain save must not
+    escape train_loop as a permanent exit — the drain still exits 143 and
+    the restart resumes from the last verified save."""
+    import jax
+    import optax
+
+    from tpu_operator.payload import bootstrap, data as data_mod, models, train
+
+    mesh = train.make_mesh(1)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((8, 8), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+
+    ck = make_ck(tmp_path / "ck", save_every=1000)
+
+    def exploding_save(_step, _state):
+        raise RuntimeError("checkpoint volume vanished mid-drain")
+
+    ck.save = exploding_save
+
+    def drain_after_step_3(i, _metrics):
+        if i == 3:
+            bootstrap.request_drain()
+
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train.train_loop(mesh, step, state,
+                             data_mod.synthetic_linear(0, 8, 8), 50,
+                             checkpointer=ck, log_every=1,
+                             log_fn=drain_after_step_3)
+        assert exc.value.code == EXIT_RETRYABLE
+    finally:
+        bootstrap.reset_drain()
+        ck.save = lambda *_a, **_kw: False
+        ck.close()
+
+
+def test_final_save_failure_exits_retryable_not_done(tmp_path):
+    """A run must not report DONE with its end state silently unpersisted:
+    when the end-of-run save fails (tolerance swallows the I/O error, so no
+    escalation fires) and the final step never becomes durable, train_loop
+    exits retryable — the restarted attempt resumes from the last verified
+    step and re-earns a durable finish."""
+    import jax
+    import optax
+
+    from tpu_operator.payload import data as data_mod, models, train
+
+    mesh = train.make_mesh(1)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((8, 8), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+
+    ck = make_ck(tmp_path / "ck", save_every=1000, fail_after=100)
+
+    def exploding(*_a, **_kw):
+        raise OSError(28, "No space left on device")
+
+    ck.manager.save = exploding
+    try:
+        with pytest.raises(SystemExit) as exc:
+            train.train_loop(mesh, step, state,
+                             data_mod.synthetic_linear(0, 8, 8), 5,
+                             checkpointer=ck)
+        assert exc.value.code == EXIT_RETRYABLE
+        assert ck.save_failures >= 1
+        assert ck.last_verified_step() is None
+    finally:
+        ck.manager.save = lambda *_a, **_kw: False
+        ck.close()
+
+
+def test_restore_failure_on_intact_bytes_raises_not_quarantines(tmp_path):
+    """A restore that raises on a checkpoint whose bytes still verify
+    against their manifest is NOT corruption (model-shape change, orbax
+    drift): it must surface as a visible error, not quarantine healthy,
+    resumable checkpoints one by one and silently restart from step 0."""
+    save_steps(tmp_path / "ck", [2, 4])
+
+    ck = make_ck(tmp_path / "ck")
+
+    def incompatible(*_a, **_kw):
+        raise ValueError("shape mismatch: restored (8,) vs abstract (16,)")
+
+    ck.manager.restore = incompatible
+    with pytest.raises(ValueError, match="shape mismatch"):
+        ck.restore(tiny_state(0))
+    ck.close()
+    # Nothing was quarantined: both steps survive, resumable after rollback.
+    assert (tmp_path / "ck" / "4").is_dir()
+    assert (tmp_path / "ck" / "2").is_dir()
+    assert ck.restore_fallbacks == 0
+
+
+# --- restore fallback matrix -------------------------------------------------
+
+def save_steps(path, steps):
+    ck = make_ck(path, save_every=1)
+    for s in steps:
+        assert ck.maybe_save(s, tiny_state(s))
+    ck.close()
+    return ck
+
+
+def test_restore_empty_dir_is_identity(tmp_path):
+    ck = make_ck(tmp_path / "empty")
+    state = tiny_state(0)
+    same, start = ck.restore(state)
+    ck.close()
+    assert start == 0
+    assert same is state
+    assert ck.restore_fallbacks == 0
+
+
+def test_corrupt_latest_falls_back_to_older_verified_step(tmp_path):
+    save_steps(tmp_path / "ck", [1, 2, 3])
+    corrupt_a_file(str(tmp_path / "ck" / "3"), keep_size=True)  # checksum
+
+    ck = make_ck(tmp_path / "ck")
+    restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    assert start == 2
+    assert int(restored["step"]) == 2
+    assert ck.restore_fallbacks == 1
+    assert ck.last_verified_step() == 2
+    # the corrupt step was quarantined, not deleted
+    quarantined = [d for d in os.listdir(tmp_path / "ck")
+                   if d.startswith("3" + checkpoint.QUARANTINE_SUFFIX)]
+    assert quarantined
+
+
+def test_torn_latest_size_mismatch_falls_back(tmp_path):
+    save_steps(tmp_path / "ck", [1, 2])
+    corrupt_a_file(str(tmp_path / "ck" / "2"), keep_size=False)  # size
+
+    ck = make_ck(tmp_path / "ck")
+    _restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    assert start == 1
+    assert ck.restore_fallbacks == 1
+
+
+def test_orphaned_tmp_dir_from_killed_save_is_swept(tmp_path):
+    save_steps(tmp_path / "ck", [1, 2])
+    # the litter a kill -9 mid-save leaves behind
+    tmp_dir = tmp_path / "ck" / "4.orbax-checkpoint-tmp-123"
+    (tmp_dir / "default").mkdir(parents=True)
+    (tmp_dir / "default" / "data").write_bytes(b"half-written")
+
+    ck = make_ck(tmp_path / "ck")
+    _restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    assert start == 2  # the tmp dir never shadows the real latest
+    assert ck.restore_fallbacks == 0
+    swept = [d for d in os.listdir(tmp_path / "ck")
+             if d.endswith(checkpoint.ORPHAN_SUFFIX)]
+    assert swept
+
+
+def test_all_corrupt_reaches_step_zero(tmp_path):
+    save_steps(tmp_path / "ck", [1, 2])
+    for step in ("1", "2"):
+        corrupt_a_file(str(tmp_path / "ck" / step), keep_size=True)
+
+    ck = make_ck(tmp_path / "ck")
+    state = tiny_state(0)
+    same, start = ck.restore(state)
+    ck.close()
+    assert start == 0
+    assert same is state
+    assert ck.restore_fallbacks == 2
+    assert ck.stats()["restoreFallbacks"] == 2
+
+
+def test_unmanifested_corrupt_step_quarantined_on_restore_failure(tmp_path):
+    """A legacy checkpoint (no manifest) passes static verification; when
+    the actual restore then raises, it must still be quarantined and the
+    walk continue."""
+    save_steps(tmp_path / "ck", [1, 2])
+    os.remove(tmp_path / "ck" / "2" / checkpoint.MANIFEST_NAME)
+    # gut the payload data so orbax's restore itself fails
+    default = tmp_path / "ck" / "2" / "default"
+    shutil.rmtree(default)
+    default.mkdir()
+
+    ck = make_ck(tmp_path / "ck")
+    _restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    assert start == 1
+    assert ck.restore_fallbacks == 1
+
+
+def test_gang_disagreement_restores_min_step(tmp_path):
+    """Injected per-process newest steps (this process saw 4, a lagging
+    peer only 2): the group must restore the MIN so no member restores
+    state another member does not hold."""
+    save_steps(tmp_path / "ck", [2, 4])
+
+    seen = []
+
+    def lagging_peer_agree(candidate):
+        seen.append(candidate)
+        return min(candidate, 2) if candidate is not None else None
+
+    ck = make_ck(tmp_path / "ck", agree_fn=lagging_peer_agree)
+    restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    # Agree round saw the local newest (4); the post-restore confirm round
+    # saw the agreed step (2) — both collectives run on every process so
+    # the gang's collective sequences stay paired.
+    assert seen == [4, 2]
+    assert start == 2    # group agreed on the lagging peer's 2
+    assert int(restored["step"]) == 2
+    assert ck.last_verified_step() == 2
+
+
+def test_peer_restore_failure_retries_walk_collectively(tmp_path):
+    """A peer whose restore of the agreed step failed reports None in the
+    confirm round: this process must discard its own (successful) restore
+    and re-agree, landing on the older step the whole group can hold —
+    never proceeding alone into mismatched collectives."""
+    save_steps(tmp_path / "ck", [2, 4])
+
+    calls = []
+
+    def peer_restore_fails_once(candidate):
+        calls.append(candidate)
+        if len(calls) == 1:
+            return candidate        # agree: everyone's newest is 4
+        if len(calls) == 2:
+            return None             # confirm: a peer's restore of 4 failed
+        if len(calls) == 3:
+            return min(candidate, 2)  # re-agree: that peer fell back to 2
+        return candidate            # confirm: everyone restored 2
+
+    ck = make_ck(tmp_path / "ck", agree_fn=peer_restore_fails_once)
+    restored, start = ck.restore(tiny_state(0))
+    ck.close()
+    assert calls == [4, 4, 4, 2]
+    assert start == 2
+    assert int(restored["step"]) == 2
+    # The failure was the peer's, not ours: our step 4 stays unquarantined.
+    assert ck.restore_fallbacks == 0
+    assert (tmp_path / "ck" / "4").is_dir()
+
+
+def test_gang_agree_single_process_is_identity():
+    assert checkpoint.gang_agree_step(7) == 7
+    assert checkpoint.gang_agree_step(None) is None
+
+
+# --- heartbeat / operator plumbing -------------------------------------------
+
+def test_heartbeat_carries_checkpoint_fields():
+    from tpu_operator.payload import heartbeat as heartbeat_mod
+
+    posts = []
+    r = heartbeat_mod.HeartbeatReporter(
+        "http://x:1", "job", poster=lambda _u, b: posts.append(b),
+        clock=lambda: 0.0)
+    assert r.report(5, {"loss": 1.0},
+                    checkpoint={"lastCheckpointStep": 4, "saveFailures": 1,
+                                "restoreFallbacks": 2})
+    body = posts[0]
+    assert body["lastCheckpointStep"] == 4
+    assert body["checkpointSaveFailures"] == 1
+    assert body["checkpointRestoreFallbacks"] == 2
+    # stats without a verified step yet: the step field is simply absent
+    assert r.report(6, None, checkpoint={"saveFailures": 0,
+                                         "restoreFallbacks": 0})
+    assert "lastCheckpointStep" not in posts[1]
+
+
+def test_statusserver_accepts_and_gauges_checkpoint_fields():
+    from tpu_operator.controller.statusserver import StatusServer
+
+    server = StatusServer(0)
+    try:
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "lastCheckpointStep": -1})
+        assert not ok and "negative" in msg
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "checkpointSaveFailures": "nan"})
+        assert not ok
+    finally:
+        server.server.server_close()
+
+
+def test_controller_folds_checkpoint_into_status_and_metrics():
+    from tpu_operator.apis.tpujob.v1alpha1.types import TPUJob
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.client.informer import SharedInformerFactory
+    from tpu_operator.controller.controller import Controller
+    from tpu_operator.trainer.training import TrainingJob
+
+    def job_dict(name):
+        return {
+            "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+            "metadata": {"name": name, "namespace": "default"},
+            "spec": {"replicaSpecs": [{
+                "replicas": 1, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+                "template": {"spec": {"containers": [{"name": "tpu"}]}}}]},
+        }
+
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=3600.0)
+    job = TPUJob.from_dict(job_dict("ck"))
+    tj = TrainingJob(cs, None, job)
+    controller.jobs["default/ck"] = tj
+
+    hb1 = {"time": "2026-08-03T00:00:00.000000Z", "step": 4, "attempt": 0,
+           "lastCheckpointStep": 4, "checkpointSaveFailures": 1,
+           "checkpointRestoreFallbacks": 0}
+    assert controller.record_heartbeat("default", "ck", hb1)
+    ck = tj.job.status.checkpoint
+    assert ck["lastCheckpointStep"] == 4
+    assert ck["saveFailures"] == 1
+    assert ck["restoreFallbacks"] == 0
+
+    # same attempt, counters advance: only the DELTA is added
+    hb2 = {"time": "2026-08-03T00:00:10.000000Z", "step": 8, "attempt": 0,
+           "lastCheckpointStep": 8, "checkpointSaveFailures": 3,
+           "checkpointRestoreFallbacks": 1}
+    assert controller.record_heartbeat("default", "ck", hb2)
+    ck = tj.job.status.checkpoint
+    assert ck["saveFailures"] == 3
+    assert ck["restoreFallbacks"] == 1
+
+    # new attempt: the payload's per-attempt counters reset; totals keep
+    # accumulating instead of double-counting or going backwards
+    hb3 = {"time": "2026-08-03T00:00:20.000000Z", "step": 8, "attempt": 1,
+           "lastCheckpointStep": 8, "checkpointSaveFailures": 2,
+           "checkpointRestoreFallbacks": 1}
+    assert controller.record_heartbeat("default", "ck", hb3)
+    ck = tj.job.status.checkpoint
+    assert ck["saveFailures"] == 5       # 3 + 2 (fresh attempt baseline)
+    assert ck["restoreFallbacks"] == 2   # 1 + 1
+    assert ck["attempt"] == 1
+
+    snap = controller.metrics.snapshot()
+    assert snap["job_checkpoint_save_failures_total"] == 5
+    assert snap["job_checkpoint_restore_fallbacks_total"] == 2
+
+    # a liveness-only heartbeat must not erase the checkpoint fields from
+    # lastHeartbeat (merge) nor disturb status.checkpoint
+    hb4 = {"time": "2026-08-03T00:00:30.000000Z", "attempt": 1}
+    assert controller.record_heartbeat("default", "ck", hb4)
+    assert tj.job.status.last_heartbeat["lastCheckpointStep"] == 8
+    assert tj.job.status.checkpoint["saveFailures"] == 5
+
+
+def test_failure_ledger_records_resume_step():
+    from tpu_operator.apis.tpujob.v1alpha1.types import FailureKind, TPUJob
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.trainer.training import TrainingJob
+
+    job = TPUJob.from_dict({
+        "metadata": {"name": "r", "namespace": "default"},
+        "spec": {"replicaSpecs": []},
+    })
+    job.status.checkpoint = {"lastCheckpointStep": 42}
+    tj = TrainingJob(FakeClientset(), None, job)
+    tj._record_failure(0, FailureKind.PREEMPTION, "slice preempted")
+    (rec,) = job.status.failures
+    assert rec.resume_step == 42
+    assert rec.to_dict()["resumeStep"] == 42
+
+    # no checkpoint state known: the record says so (cold restart)
+    job2 = TPUJob.from_dict({
+        "metadata": {"name": "r2", "namespace": "default"},
+        "spec": {"replicaSpecs": []},
+    })
+    tj2 = TrainingJob(FakeClientset(), None, job2)
+    tj2._record_failure(0, FailureKind.APPLICATION, "crash")
+    (rec2,) = job2.status.failures
+    assert rec2.resume_step is None
+    assert "resumeStep" not in rec2.to_dict()
+
+
+def test_status_checkpoint_round_trips_strict_schema():
+    from tpu_operator.apis.tpujob.v1alpha1 import schema
+    from tpu_operator.apis.tpujob.v1alpha1.types import TPUJobStatus
+
+    status = TPUJobStatus.from_dict({
+        "phase": "Running", "state": "Running", "attempt": 1,
+        "checkpoint": {"lastCheckpointStep": 8, "saveFailures": 2,
+                       "restoreFallbacks": 1, "attempt": 1,
+                       "attemptSaveFailures": 2,
+                       "attemptRestoreFallbacks": 1,
+                       "time": "2026-08-03T00:00:00.000000Z"},
+        "lastHeartbeat": {"step": 9, "lastCheckpointStep": 8,
+                          "checkpointSaveFailures": 2,
+                          "checkpointRestoreFallbacks": 1,
+                          "time": "2026-08-03T00:00:00.000000Z"},
+        "failures": [{"attempt": 0, "kind": "preemption", "reason": "x",
+                      "time": "2026-08-03T00:00:00.000000Z",
+                      "resumeStep": 6}],
+    })
+    body = {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "s"},
+        "spec": {"replicaSpecs": []},
+        "status": status.to_dict(),
+    }
+    ok, msg = schema.validate_tpujob_strict(body)
+    assert ok, msg
+    back = TPUJobStatus.from_dict(status.to_dict())
+    assert back.checkpoint == status.checkpoint
+    assert back.failures[0].resume_step == 6
+
+
+def test_from_env_or_args_passes_fail_after(tmp_path):
+    ck = checkpoint.from_env_or_args(
+        "", env={"TPU_CHECKPOINT_DIR": str(tmp_path / "ck")}, fail_after=7)
+    assert ck is not None
+    assert ck.fail_after == 7
+    ck.close()

@@ -146,6 +146,22 @@ def status_schema() -> Dict[str, Any]:
             "tokensPerSec": _num(minimum=0),
             "loss": _num(),
             "time": _str(),
+            # Checkpoint durability fields (payload/checkpoint.py stats).
+            "lastCheckpointStep": _int(minimum=0),
+            "checkpointSaveFailures": _int(minimum=0),
+            "checkpointRestoreFallbacks": _int(minimum=0),
+        }),
+        # Checkpoint durability roll-up: the last VERIFIED (durable) step,
+        # lifetime save-failure / restore-fallback totals, and the
+        # per-attempt baselines the controller's delta accounting persists.
+        "checkpoint": _obj({
+            "lastCheckpointStep": _int(minimum=0),
+            "saveFailures": _int(minimum=0),
+            "restoreFallbacks": _int(minimum=0),
+            "attempt": _int(minimum=0),
+            "attemptSaveFailures": _int(minimum=0),
+            "attemptRestoreFallbacks": _int(minimum=0),
+            "time": _str(),
         }),
         # Most recent phase *change* (stall-watchdog baseline; RFC3339).
         "lastTransitionTime": _str(),
@@ -157,6 +173,9 @@ def status_schema() -> Dict[str, Any]:
             "kind": _str(enum=list(types.FailureKind.ALL)),
             "reason": _str(),
             "time": _str(),
+            # Last durable checkpoint step known when the restart was
+            # recorded — what the next attempt resumed from.
+            "resumeStep": _int(minimum=0),
         })),
         # Lifetime failure counters by kind (retry budgets charge these).
         "restartCounts": {

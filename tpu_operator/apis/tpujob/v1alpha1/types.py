@@ -431,10 +431,17 @@ class FailureRecord:
     kind: str = FailureKind.APPLICATION
     reason: str = ""
     time: str = ""
+    # Last durable checkpoint step known when the restart was recorded —
+    # the step the next attempt resumes from (None: job never reported
+    # checkpoint state; the postmortem then knows the restart was cold).
+    resume_step: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"attempt": self.attempt, "kind": self.kind,
-                "reason": self.reason, "time": self.time}
+        d = {"attempt": self.attempt, "kind": self.kind,
+             "reason": self.reason, "time": self.time}
+        if self.resume_step is not None:
+            d["resumeStep"] = self.resume_step
+        return d
 
     @classmethod
     def from_dict(cls, d: Dict[str, Any]) -> "FailureRecord":
@@ -443,6 +450,8 @@ class FailureRecord:
             kind=str(d.get("kind", FailureKind.APPLICATION)),
             reason=str(d.get("reason", "")),
             time=str(d.get("time", "")),
+            resume_step=(int(d["resumeStep"])
+                         if d.get("resumeStep") is not None else None),
         )
 
 
@@ -464,6 +473,13 @@ class TPUJobStatus:
     # status server: {step, stepTimeSeconds, tokensPerSec, loss, time, ...}.
     # ``kubectl get -o yaml`` shows a hung slice as a stale timestamp here.
     last_heartbeat: Optional[Dict[str, Any]] = None
+    # Checkpoint durability state, folded in from heartbeat fields by the
+    # controller: lastCheckpointStep (newest VERIFIED step — the step a
+    # restart actually resumes from, distinct from whatever is merely
+    # latest on disk), lifetime saveFailures/restoreFallbacks totals, and
+    # the per-attempt baselines the delta accounting persists
+    # (attempt/attemptSaveFailures/attemptRestoreFallbacks).
+    checkpoint: Optional[Dict[str, Any]] = None
     # Time-aware recovery state:
     # RFC3339 stamp of the most recent phase *change* (unlike phaseTimeline,
     # which keeps only the first entry into each phase) — the stall
@@ -495,6 +511,8 @@ class TPUJobStatus:
             d["phaseTimeline"] = dict(self.phase_timeline)
         if self.last_heartbeat:
             d["lastHeartbeat"] = dict(self.last_heartbeat)
+        if self.checkpoint:
+            d["checkpoint"] = dict(self.checkpoint)
         if self.last_transition_time:
             d["lastTransitionTime"] = self.last_transition_time
         if self.backoff_until:
@@ -524,6 +542,8 @@ class TPUJobStatus:
             },
             last_heartbeat=(dict(d["lastHeartbeat"])
                             if d.get("lastHeartbeat") else None),
+            checkpoint=(dict(d["checkpoint"])
+                        if d.get("checkpoint") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),
             backoff_until=str(d.get("backoffUntil", "")),
             failures=[FailureRecord.from_dict(f)

@@ -164,11 +164,20 @@ def cmd_describe(cs, opts) -> int:
               f"{rs.get('replicas', 0)} × port {rs.get('tpuPort', '')}")
     if status.get("backoffUntil"):
         print(f"Backoff:    re-gang parked until {status['backoffUntil']}")
+    ck = status.get("checkpoint") or {}
+    if ck:
+        durable = ck.get("lastCheckpointStep")
+        print(f"Durable:    last verified checkpoint step "
+              f"{'-' if durable is None else durable} "
+              f"(save failures {ck.get('saveFailures', 0)}, "
+              f"restore fallbacks {ck.get('restoreFallbacks', 0)})")
     if status.get("failures"):
         print("Failures:")
         for f in status["failures"][-10:]:
+            resume = (f" resume@{f['resumeStep']}"
+                      if f.get("resumeStep") is not None else "")
             print(f"  attempt {f.get('attempt', 0)}\t{f.get('kind', '')}\t"
-                  f"{f.get('reason', '')}\t{f.get('time', '')}")
+                  f"{f.get('reason', '')}\t{f.get('time', '')}{resume}")
     if status.get("replicaStatuses"):
         print("Replica statuses:")
         for rstat in status["replicaStatuses"]:

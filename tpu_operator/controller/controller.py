@@ -300,10 +300,15 @@ class Controller:
                 prev_gen = now_attempt if prev_attempt is None else prev_attempt
                 if hb_gen == prev_gen:
                     for field in ("step", "processId", "stepTimeSeconds",
-                                  "tokensPerSec", "loss"):
+                                  "tokensPerSec", "loss",
+                                  "lastCheckpointStep",
+                                  "checkpointSaveFailures",
+                                  "checkpointRestoreFallbacks"):
                         if field not in merged and field in prev:
                             merged[field] = prev[field]
             tj.job.status.last_heartbeat = merged
+            self._apply_checkpoint_heartbeat(tj, namespace, name, heartbeat,
+                                             hb_attempt)
             # Compare against the last *persisted* stamp, not the last
             # received one — a steady sub-interval cadence would otherwise
             # keep resetting the baseline and never persist again.
@@ -317,6 +322,54 @@ class Controller:
         if persist:
             self.queue.add(key)
         return True
+
+    def _apply_checkpoint_heartbeat(self, tj: TrainingJob, namespace: str,
+                                    name: str, heartbeat: Dict[str, Any],
+                                    hb_attempt: Optional[int]) -> None:
+        """Fold a heartbeat's durability fields into ``status.checkpoint``
+        (called under _jobs_lock). The payload's counters are per-attempt
+        (they reset on whole-group restart); status keeps lifetime totals
+        by accumulating deltas, with the per-attempt baseline persisted IN
+        status so an operator restart doesn't re-add the current attempt's
+        count. The same deltas tick the labeled
+        ``job_checkpoint_{save_failures,restore_fallbacks}_total``
+        counters. ``lastCheckpointStep`` is taken as reported — it may
+        legitimately move backwards when a restore fell back past a
+        quarantined step."""
+        relevant = [heartbeat.get(k) for k in
+                    ("lastCheckpointStep", "checkpointSaveFailures",
+                     "checkpointRestoreFallbacks")]
+        if all(v is None for v in relevant):
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        ck = dict(tj.job.status.checkpoint or {})
+        same_attempt = ck.get("attempt") == gen
+        if heartbeat.get("lastCheckpointStep") is not None:
+            ck["lastCheckpointStep"] = int(heartbeat["lastCheckpointStep"])
+        for src, baseline_key, total_key, metric in (
+                ("checkpointSaveFailures", "attemptSaveFailures",
+                 "saveFailures", "job_checkpoint_save_failures_total"),
+                ("checkpointRestoreFallbacks", "attemptRestoreFallbacks",
+                 "restoreFallbacks",
+                 "job_checkpoint_restore_fallbacks_total")):
+            reported = heartbeat.get(src)
+            if reported is None:
+                continue
+            reported = int(reported)
+            baseline = int(ck.get(baseline_key, 0)) if same_attempt else 0
+            # A reported count below the baseline means the payload's
+            # counters reset (unexpected mid-attempt); count it all.
+            delta = reported if reported < baseline else reported - baseline
+            ck[total_key] = int(ck.get(total_key, 0)) + delta
+            if delta > 0:
+                self.metrics.inc(metric, delta,
+                                 labels={"namespace": namespace,
+                                         "name": name})
+            ck[baseline_key] = reported
+        ck["attempt"] = int(gen)
+        if heartbeat.get("time"):
+            ck["time"] = str(heartbeat["time"])
+        tj.job.status.checkpoint = ck
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

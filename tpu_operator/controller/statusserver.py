@@ -175,6 +175,12 @@ class Metrics:
         self.register("events_pruned_total", "counter",
                       "Event-dedup cache entries evicted (LRU bound or "
                       "object deletion).")
+        self.register("job_checkpoint_save_failures_total", "counter",
+                      "Checkpoint interval-save failures reported by "
+                      "payload heartbeats (delta-accumulated per job).")
+        self.register("job_checkpoint_restore_fallbacks_total", "counter",
+                      "Corrupt/torn checkpoints quarantined while a payload "
+                      "walked back to an older valid step on restore.")
         self.register("reconcile_duration_seconds", "histogram",
                       "Wall time of one reconcile pass.", RECONCILE_BUCKETS)
         self.register("workqueue_queue_duration_seconds", "histogram",
@@ -505,7 +511,10 @@ class StatusServer:
         hb: Dict[str, Any] = {"time": now_rfc3339()}
         for field, cast in (("step", int), ("attempt", int),
                             ("processId", int), ("stepTimeSeconds", float),
-                            ("tokensPerSec", float), ("loss", float)):
+                            ("tokensPerSec", float), ("loss", float),
+                            ("lastCheckpointStep", int),
+                            ("checkpointSaveFailures", int),
+                            ("checkpointRestoreFallbacks", int)):
             if body.get(field) is not None:
                 try:
                     value = cast(body[field])
@@ -636,6 +645,9 @@ class StatusServer:
                 # and, while parked in Backoff, the re-gang release time.
                 "failures": status.get("failures", []),
                 "backoffUntil": status.get("backoffUntil", ""),
+                # Durability state: which step is actually safe to restart
+                # from, and how the payload's checkpoint storage is faring.
+                "checkpoint": status.get("checkpoint"),
                 # The in-memory heartbeat is fresher than the informer-cached
                 # status copy (which lags by a reconcile + watch round-trip);
                 # the internal receivedAt bookkeeping stays out of the API.
@@ -694,6 +706,9 @@ class StatusServer:
                     ("job_tokens_per_second", "tokensPerSec",
                      "Last reported training throughput in tokens/sec."),
                     ("job_loss", "loss", "Last reported training loss."),
+                    ("job_last_checkpoint_step", "lastCheckpointStep",
+                     "Last verified (durable) checkpoint step reported by "
+                     "the payload."),
                 )
                 for metric, field, help_text in gauges:
                     rows = [((ns, name), hb[field])

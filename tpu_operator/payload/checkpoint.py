@@ -1,4 +1,4 @@
-"""Checkpoint/resume for whole-group restart, built on orbax.
+"""Durable checkpoint/resume for whole-group restart, built on orbax.
 
 The reference operator had no checkpoint layer at all — persistence was the
 user container's job via PodTemplate volumes (SURVEY.md §5; reference
@@ -14,42 +14,159 @@ state. This module makes resume a first-class part of the payload contract:
 - payloads call :func:`from_env_or_args` to get a :class:`Checkpointer`
   (or ``None`` when unconfigured — checkpointing stays opt-in, exactly as
   in the reference's data-plane contract);
-- ``train.train_loop`` restores the latest step on entry and saves every
-  ``save_every`` steps plus once at the end.
+- ``train.train_loop`` restores the newest *verified* step on entry and
+  saves every ``save_every`` steps plus once at the end.
+
+Durability model (the CheckFreq/Gemini hardening arc: decouple save
+failures from the step loop, treat checkpoint validity as a first-class
+recovery input):
+
+- **Verified saves.** After an async save commits, the checkpoint is
+  validated — orbax's commit marker (a finalized, non-tmp step directory)
+  plus a manifest sidecar recording every file's size and sha256 — and the
+  *last verified step* is tracked separately from latest-on-disk. A save
+  that never finalizes (kill -9, preemption mid-write) is never advertised
+  as durable.
+- **Restore fallback.** ``restore`` walks from the newest step backwards:
+  a step that fails verification (or raises during restore) is
+  *quarantined* — renamed to ``<step>.corrupt-N`` so orbax stops seeing it
+  but the bytes survive for postmortem — and the walk continues to the
+  newest older valid step, reaching step 0 only when nothing survives.
+  Orphaned tmp directories from killed saves are swept aside on restore.
+- **Save-failure tolerance.** An I/O error on an interval save (disk full,
+  flaky volume) does not crash the step loop: it is counted, logged, and
+  reported via the heartbeat; only ``fail_after`` *consecutive* failures
+  escalate to a retryable exit (143) so the operator restarts the group
+  onto (hopefully) healthier storage instead of the job dying permanently.
+- **Gang-consistent resume.** In multi-process jobs the restore step is
+  agreed via a tiny allgather-min of each process's newest locally-valid
+  step (the same pattern as train_loop's drain latch), so shared-fs lag or
+  per-pod checkpoint dirs can never make the group restore divergent
+  state.
+
+The counters (``save_failures``, ``restore_fallbacks``, last verified
+step) flow out through the heartbeat (payload/heartbeat.py →
+``status.checkpoint`` / ``job_checkpoint_*`` metrics), so the operator's
+restart decisions and the human's ``tpujobctl describe`` both see which
+step is actually durable.
 
 TPU notes: saves go through orbax's async path (device→host copy happens
 at save(); the filesystem write overlaps subsequent steps, keeping the MXU
-busy), and restore is sharding-aware — each process reads only the shards
-it owns, so a resumed TP/DP-sharded state never materialises unsharded on
-one host.
+busy), and the verification read-back + sha256 runs on a background thread
+once the commit lands — the step loop never pays the hash; it only joins
+the worker at the next save boundary (where orbax would block for the
+previous write anyway) or on an explicit flush. Restore is sharding-aware —
+each process reads only the shards it owns, so a resumed TP/DP-sharded
+state never materialises unsharded on one host.
 """
 
 from __future__ import annotations
 
+import hashlib
+import json
 import logging
 import os
-from typing import Any, Optional, Tuple
+import threading
+from typing import Any, Callable, Dict, Optional, Tuple
+
+from tpu_operator.payload.bootstrap import EXIT_RETRYABLE
 
 log = logging.getLogger(__name__)
 
 ENV_VAR = "TPU_CHECKPOINT_DIR"
 
+# Manifest sidecar written into a step directory after its save verifies.
+# Lives inside the step dir so orbax's max_to_keep GC and our quarantine
+# rename both carry it along with the data it describes.
+MANIFEST_NAME = "manifest.tpuop.json"
+
+# Quarantined step dirs: ``<step>.corrupt-<n>``. Non-numeric, so orbax's
+# step scan ignores them; the bytes stay on disk for postmortem.
+QUARANTINE_SUFFIX = ".corrupt"
+
+# Orphaned tmp dirs from a killed save are renamed aside with this suffix.
+ORPHAN_SUFFIX = ".orphaned"
+
+# Consecutive interval-save failures tolerated before escalating to a
+# retryable exit (CheckFreq-style: transient I/O blips are skipped and
+# counted; a persistently failing volume hands the problem to the
+# operator's whole-group restart instead of silently training undurable).
+DEFAULT_FAIL_AFTER = 3
+
+
+def gang_agree_step(candidate: Optional[int]) -> Optional[int]:
+    """Group consensus on the restore step: allgather-min of each process's
+    newest locally-valid step (None → -1 sentinel). Single-process jobs
+    return the candidate unchanged. Same tiny-collective pattern as the
+    drain latch in train.train_loop — one scalar allgather, noise next to
+    restore itself. The MIN is the only safe choice: every process can
+    restore a step ≤ its own newest valid one, so the group lands on state
+    all members actually hold (shared-fs propagation lag or per-pod dirs
+    would otherwise leave the group restoring divergent steps — a silent
+    training-state fork)."""
+    import jax
+
+    if jax.process_count() <= 1:
+        return candidate
+    import numpy as np
+    from jax.experimental import multihost_utils
+
+    local = np.int64(candidate if candidate is not None else -1)
+    agreed = int(multihost_utils.process_allgather(local).min())
+    return None if agreed < 0 else agreed
+
+
+class CheckpointError(Exception):
+    """A checkpoint operation failed (carried in logs/counters; only
+    escalation raises out of the step loop)."""
+
+
+def _sha256_file(path: str) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as f:
+        for chunk in iter(lambda: f.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
+
 
 class Checkpointer:
-    """Thin orbax CheckpointManager wrapper bound to one train state shape.
+    """Orbax CheckpointManager wrapper with verified saves, quarantine-and-
+    fall-back restore, and save-failure tolerance, bound to one train state
+    shape.
 
     Steps are the single source of truth: the saved pytree carries its own
     ``step`` leaf, and orbax names checkpoints by step, so resume needs no
-    sidecar metadata.
+    sidecar metadata beyond the integrity manifest.
     """
 
     def __init__(self, directory: str, save_every: int = 100,
-                 max_to_keep: int = 3):
+                 max_to_keep: int = 3,
+                 fail_after: int = DEFAULT_FAIL_AFTER,
+                 agree_fn: Optional[Callable[[Optional[int]],
+                                             Optional[int]]] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.save_every = max(1, int(save_every))
+        self.fail_after = max(1, int(fail_after))
+        # Injectable for tests; default is the real allgather-min.
+        self._agree = agree_fn or gang_agree_step
+        # Durability bookkeeping, reported via stats()/the heartbeat.
+        self.save_failures = 0              # total failed saves, this attempt
+        self.consecutive_save_failures = 0  # escalation counter
+        self.restore_fallbacks = 0          # quarantined steps during restore
+        self._last_verified: Optional[int] = None  # newest verified commit
+        self._pending: Optional[int] = None        # async save awaiting verify
+        # Background verification: the read-back + sha256 of a committed
+        # save runs on this worker so the step loop never pays the hash;
+        # its (step, error-or-None) outcome is applied by _reap_verify on
+        # the step-loop thread (where escalation is allowed to raise).
+        self._verify_thread: Optional[threading.Thread] = None
+        self._verify_outcome: Optional[Tuple[int, Optional[Exception]]] = None
+        # Steps already condemned this process (quarantine attempted): never
+        # reconsidered, so a failing rename cannot loop the restore walk.
+        self._condemned: set = set()
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -59,49 +176,444 @@ class Checkpointer:
             ),
         )
 
+    # -- introspection ---------------------------------------------------------
+
     def latest_step(self) -> Optional[int]:
+        """Newest step on disk — NOT necessarily durable; restart decisions
+        should prefer :meth:`last_verified_step`."""
         return self.manager.latest_step()
 
-    def restore(self, state: Any) -> Tuple[Any, int]:
-        """(state, start_step): the latest checkpoint restored onto the
-        live state's shardings, or the input state untouched at step 0."""
-        import jax
+    def last_verified_step(self) -> Optional[int]:
+        """Newest step whose commit was verified (marker + manifest) by this
+        process — the step a restart is guaranteed to resume from."""
+        return self._last_verified
 
-        latest = self.manager.latest_step()
-        if latest is None:
-            return state, 0
-        abstract = jax.tree_util.tree_map(
-            lambda x: jax.ShapeDtypeStruct(
-                x.shape, x.dtype,
-                sharding=getattr(x, "sharding", None),
-            ) if hasattr(x, "shape") else x,
-            state,
-        )
-        restored = self.manager.restore(
-            latest, args=self._ocp.args.StandardRestore(abstract))
-        log.info("restored checkpoint step %d from %s", latest, self.directory)
-        return restored, int(latest)
+    def stats(self) -> Dict[str, int]:
+        """Durability counters for the heartbeat body
+        (→ ``status.checkpoint`` and the ``job_checkpoint_*`` metrics)."""
+        out: Dict[str, int] = {
+            "saveFailures": int(self.save_failures),
+            "restoreFallbacks": int(self.restore_fallbacks),
+        }
+        if self._last_verified is not None:
+            out["lastCheckpointStep"] = int(self._last_verified)
+        return out
+
+    # -- save path -------------------------------------------------------------
 
     def maybe_save(self, step: int, state: Any) -> bool:
         """Save if the interval policy says so (orbax decides). Async: the
-        write completes in the background; wait_until_finished() blocks."""
-        return bool(self.manager.save(int(step), args=self._ocp.args.StandardSave(state)))
+        write completes in the background; the *previous* pending save is
+        verified here first — blocking only when a new save is due, where
+        orbax would block for it anyway. I/O failures never propagate: they
+        are counted and skipped, escalating to SystemExit(143) only after
+        ``fail_after`` consecutive failures."""
+        step = int(step)
+        try:
+            due = bool(self.manager.should_save(step))
+        except Exception:  # noqa: BLE001 — conservative: try the save
+            due = True
+        if not due:
+            self._finalize_pending(block=False)
+            return False
+        self._finalize_pending(block=True)
+        return self._save(step, state, force=False)
 
     def save(self, step: int, state: Any) -> bool:
-        """Unconditional save (end-of-run final state); no-op if that step
-        was already written by the interval policy."""
-        if self.manager.latest_step() == int(step):
+        """Unconditional save (end-of-run final state, drain); no-op if that
+        step was already written by the interval policy. The
+        synchronize-first order matters: comparing only ``latest_step()``
+        misses an async interval save of the same step still in flight and
+        would issue a redundant force rewrite of state that is already
+        committing — so the pending save is finalized (committed AND
+        verified) before deciding, and a pending save that *failed* to
+        commit is retried here rather than dedup'd away."""
+        step = int(step)
+        self._finalize_pending(block=True)
+        if self._last_verified == step or self.manager.latest_step() == step:
             return False
-        return bool(self.manager.save(
-            int(step), args=self._ocp.args.StandardSave(state), force=True))
+        return self._save(step, state, force=True)
+
+    def flush(self) -> None:
+        """Block until the in-flight async save (if any) has committed AND
+        verified — after this, :meth:`last_verified_step` reflects it."""
+        self._finalize_pending(block=True)
+
+    def _save(self, step: int, state: Any, force: bool) -> bool:
+        try:
+            saved = bool(self.manager.save(
+                step, args=self._ocp.args.StandardSave(state), force=force))
+        except Exception as e:  # noqa: BLE001 — tolerance: skip, count, report
+            self._record_save_failure(step, e)
+            return False
+        if saved:
+            self._pending = step
+        return saved
+
+    def _finalize_pending(self, block: bool) -> None:
+        """Drive the pending async save towards verified: once the commit
+        lands, hand the read-back + sha256 to the background verify worker,
+        and apply any finished worker's outcome (advance the last-verified
+        step, or count the failure). ``block=True`` joins everything —
+        after it returns, the pending save is either verified or counted
+        as failed; ``block=False`` never waits."""
+        self._reap_verify(block)
+        if self._pending is None:
+            return
+        if not block:
+            try:
+                if self.manager.is_saving_in_progress():
+                    return
+            except Exception:  # noqa: BLE001 — treat as still in progress
+                return
+        step, self._pending = self._pending, None
+        try:
+            self.manager.wait_until_finished()
+            check = getattr(self.manager, "check_for_errors", None)
+            if check is not None:
+                check()
+        except Exception as e:  # noqa: BLE001 — async write failed
+            self._record_save_failure(step, e)
+            return
+        self._verify_thread = threading.Thread(
+            target=self._verify_worker, args=(step,),
+            name="ckpt-verify", daemon=True)
+        self._verify_thread.start()
+        if block:
+            self._reap_verify(block=True)
+
+    def _verify_worker(self, step: int) -> None:
+        """Background half of verification: commit-marker check, manifest
+        hash + write. Only records the outcome — counters and escalation
+        belong to the step-loop thread via _reap_verify."""
+        try:
+            ok, why = self._verify_commit(step)
+            if not ok:
+                raise CheckpointError(why)
+            try:
+                self._write_manifest(step)
+            except Exception as e:  # noqa: BLE001 — manifest is best-effort
+                # The commit itself is good; a failed manifest write only
+                # downgrades this step to legacy (restore-attempt)
+                # verification.
+                log.warning("checkpoint step %d: manifest write failed: %s",
+                            step, e)
+            self._verify_outcome = (step, None)
+        except Exception as e:  # noqa: BLE001 — applied by _reap_verify
+            self._verify_outcome = (step, e)
+
+    def _reap_verify(self, block: bool) -> None:
+        """Apply the verify worker's outcome on the calling (step-loop)
+        thread, so a fail_after escalation raises where SystemExit actually
+        exits the process instead of dying with a daemon thread."""
+        t = self._verify_thread
+        if t is None:
+            return
+        if block:
+            t.join()
+        elif t.is_alive():
+            return
+        self._verify_thread = None
+        outcome, self._verify_outcome = self._verify_outcome, None
+        if outcome is None:  # worker died before recording: count it
+            self._record_save_failure(-1, CheckpointError(
+                "verification worker died without an outcome"))
+            return
+        step, err = outcome
+        if err is not None:
+            self._record_save_failure(step, err)
+            return
+        self._last_verified = step
+        self.consecutive_save_failures = 0
+        log.info("checkpoint step %d verified in %s", step, self.directory)
+
+    def _record_save_failure(self, step: int, err: Exception) -> None:
+        self.save_failures += 1
+        self.consecutive_save_failures += 1
+        log.warning(
+            "checkpoint save of step %d failed (%d consecutive, %d total, "
+            "last durable step %s): %s", step,
+            self.consecutive_save_failures, self.save_failures,
+            self._last_verified, err)
+        if self.consecutive_save_failures >= self.fail_after:
+            log.error(
+                "checkpoint storage failing persistently (%d consecutive "
+                "save failures); exiting retryable so the operator restarts "
+                "the group", self.consecutive_save_failures)
+            raise SystemExit(EXIT_RETRYABLE)
+
+    # -- verification / manifest -----------------------------------------------
+
+    def _step_dir(self, step: int) -> str:
+        return os.path.join(self.directory, str(step))
+
+    def _verify_commit(self, step: int) -> Tuple[bool, str]:
+        """Did the save of ``step`` commit? Orbax's marker is the atomic
+        rename to a finalized (non-tmp) step directory."""
+        path = self._step_dir(step)
+        if not os.path.isdir(path):
+            return False, "step directory missing after save"
+        try:
+            from orbax.checkpoint import utils as ocp_utils
+
+            finalized = bool(ocp_utils.is_checkpoint_finalized(path))
+        except Exception as e:  # noqa: BLE001 — probe itself failed
+            # Indeterminate, NOT a failed commit: the probe breaking
+            # (orbax API drift across versions, a transient stat error on
+            # flaky storage) says nothing about the checkpoint — the step
+            # dir exists at its final (post-rename) path and the manifest
+            # checksums still guard integrity. Failing here would convert
+            # every healthy save into the fail_after escalation loop.
+            log.warning("commit-marker probe unavailable for step %d "
+                        "(passing tentatively): %s", step, e)
+            return True, "commit marker unprobeable"
+        if not finalized:
+            return False, "orbax commit marker missing (tmp checkpoint)"
+        return True, ""
+
+    def _write_manifest(self, step: int) -> None:
+        """Record every committed file's size + sha256 in an atomically-
+        replaced sidecar, so later verification can tell torn/corrupt bytes
+        from a healthy checkpoint without attempting a full restore.
+        Process 0 writes (single writer on a shared filesystem); per-pod
+        checkpoint dirs simply fall back to legacy verification."""
+        try:
+            import jax
+
+            if jax.process_count() > 1 and jax.process_index() != 0:
+                return
+        except Exception:  # noqa: BLE001 — no jax runtime: single process
+            pass
+        root = self._step_dir(step)
+        files = []
+        for dirpath, _dirnames, filenames in os.walk(root):
+            for fn in sorted(filenames):
+                if fn == MANIFEST_NAME or fn.endswith(".tmp"):
+                    continue
+                p = os.path.join(dirpath, fn)
+                files.append({
+                    "path": os.path.relpath(p, root),
+                    "size": os.path.getsize(p),
+                    "sha256": _sha256_file(p),
+                })
+        doc = {"step": int(step), "files": files}
+        tmp = os.path.join(root, MANIFEST_NAME + ".tmp")
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(doc, f)
+            f.flush()
+            os.fsync(f.fileno())
+        os.replace(tmp, os.path.join(root, MANIFEST_NAME))
+
+    def _has_intact_manifest(self, step: int) -> bool:
+        """True when the step carries a manifest AND its bytes still match
+        it — i.e. a restore failure on this step cannot be blamed on torn
+        or corrupt data. Legacy unmanifested steps return False (their
+        bytes are unprovable, so restore failures keep the quarantine
+        path)."""
+        if not os.path.exists(
+                os.path.join(self._step_dir(step), MANIFEST_NAME)):
+            return False
+        ok, _why = self._verify_step(step)
+        return ok
+
+    def _verify_step(self, step: int) -> Tuple[bool, str]:
+        """Full integrity check of an on-disk step: commit marker, then the
+        manifest (when present — a step without one, e.g. written before
+        this subsystem existed, passes tentatively and relies on restore's
+        own failure handling)."""
+        ok, why = self._verify_commit(step)
+        if not ok:
+            return False, why
+        mpath = os.path.join(self._step_dir(step), MANIFEST_NAME)
+        if not os.path.exists(mpath):
+            return True, "unmanifested (legacy) checkpoint"
+        try:
+            with open(mpath, encoding="utf-8") as f:
+                doc = json.load(f)
+        except (OSError, ValueError) as e:
+            return False, f"manifest unreadable: {e}"
+        root = self._step_dir(step)
+        for entry in doc.get("files", []):
+            p = os.path.join(root, entry.get("path", ""))
+            if not os.path.isfile(p):
+                return False, f"missing file {entry.get('path')}"
+            if os.path.getsize(p) != entry.get("size"):
+                return False, (f"size mismatch on {entry.get('path')}: "
+                               f"{os.path.getsize(p)} != {entry.get('size')}")
+            if _sha256_file(p) != entry.get("sha256"):
+                return False, f"checksum mismatch on {entry.get('path')}"
+        return True, ""
+
+    # -- restore path ----------------------------------------------------------
+
+    def _quarantine(self, step: int, why: str) -> None:
+        """Move a failed step aside under a non-numeric name: orbax stops
+        seeing it, the walk-back continues, and the bytes survive for
+        postmortem. Races with a peer process quarantining the same step on
+        a shared filesystem resolve to whoever renames first."""
+        self._condemned.add(int(step))
+        src = self._step_dir(step)
+        n = 0
+        dst = f"{src}{QUARANTINE_SUFFIX}-{n}"
+        while os.path.exists(dst):
+            n += 1
+            dst = f"{src}{QUARANTINE_SUFFIX}-{n}"
+        try:
+            os.rename(src, dst)
+            log.error("quarantined checkpoint step %d -> %s (%s)",
+                      step, os.path.basename(dst), why)
+        except OSError as e:
+            # A peer already moved it (or it vanished): same outcome.
+            log.warning("quarantine of step %d raced/failed (%s); "
+                        "continuing fallback: %s", step, why, e)
+        try:
+            self.manager.reload()
+        except Exception as e:  # noqa: BLE001 — stale cache worst case
+            log.warning("checkpoint manager reload after quarantine: %s", e)
+
+    def _sweep_orphaned_tmp(self) -> None:
+        """Rename aside tmp directories a killed save (kill -9, preemption
+        mid-write) left behind, so they are visibly inert instead of
+        silently ignored."""
+        try:
+            from orbax.checkpoint import utils as ocp_utils
+
+            tmps = list(ocp_utils.tmp_checkpoints(self.directory))
+        except Exception:  # noqa: BLE001 — best-effort hygiene
+            return
+        for name in tmps:
+            src = os.path.join(self.directory, str(name))
+            try:
+                os.rename(src, src + ORPHAN_SUFFIX)
+                log.warning("swept orphaned tmp checkpoint %s (killed save)",
+                            name)
+            except OSError:
+                pass  # peer swept it / already gone
+
+    def _newest_intact_step(self) -> Optional[int]:
+        """Newest step passing full verification; anything newer that fails
+        is quarantined and counted as a restore fallback."""
+        try:
+            steps = sorted(self.manager.all_steps(), reverse=True)
+        except Exception as e:  # noqa: BLE001 — unreadable dir = nothing
+            log.warning("listing checkpoint steps failed: %s", e)
+            return None
+        for step in steps:
+            if int(step) in self._condemned:
+                continue  # quarantine raced/failed earlier; never re-walk it
+            ok, why = self._verify_step(step)
+            if ok:
+                return int(step)
+            self.restore_fallbacks += 1
+            self._quarantine(int(step), why)
+        return None
+
+    def restore(self, state: Any) -> Tuple[Any, int]:
+        """(state, start_step): the newest *valid* checkpoint agreed across
+        the gang, restored onto the live state's shardings, or the input
+        state untouched at step 0 when nothing survives.
+
+        The walk: verify newest → quarantine failures → gang-agree the min
+        of everyone's newest valid step → restore it → gang-confirm the
+        restore; a restore that still raises anywhere in the group
+        (corruption the manifest missed, or a legacy unmanifested step)
+        quarantines that step on the failing process(es) and the whole walk
+        repeats *collectively*. The confirm round is what keeps the gang's
+        collectives matched: without it, a process whose local restore
+        failed would loop back into the allgather while its peers proceed
+        into training collectives — a mismatched collective, i.e. a hang."""
+        import jax
+
+        self._sweep_orphaned_tmp()
+        while True:
+            candidate = self._newest_intact_step()
+            agreed = self._agree(candidate)
+            if agreed is None:
+                # Collective min: every process sees the same None and
+                # returns here together — no confirm round needed.
+                if self.restore_fallbacks:
+                    log.error(
+                        "no valid checkpoint survives in %s (%d quarantined); "
+                        "restarting from step 0", self.directory,
+                        self.restore_fallbacks)
+                return state, 0
+            abstract = jax.tree_util.tree_map(
+                lambda x: jax.ShapeDtypeStruct(
+                    x.shape, x.dtype,
+                    sharding=getattr(x, "sharding", None),
+                ) if hasattr(x, "shape") else x,
+                state,
+            )
+            restored, err = None, None
+            try:
+                restored = self.manager.restore(
+                    agreed, args=self._ocp.args.StandardRestore(abstract))
+            except Exception as e:  # noqa: BLE001 — gang-confirmed below
+                err = e
+            # Every process reaches this second collective each iteration,
+            # success or failure, so the rounds stay paired group-wide.
+            confirmed = self._agree(agreed if err is None else None)
+            if err is not None:
+                if self._has_intact_manifest(int(agreed)):
+                    # The bytes re-verify against their manifest, so this
+                    # is NOT corruption — a shape/dtype mismatch after a
+                    # model change, orbax version drift, OOM. Quarantining
+                    # would mangle every resumable checkpoint in turn and
+                    # silently restart from step 0; surface it as the
+                    # permanent, visible error it is instead.
+                    log.error(
+                        "restore of step %d failed but its bytes verify "
+                        "intact — not corruption; refusing to quarantine",
+                        agreed)
+                    raise err
+                self.restore_fallbacks += 1
+                self._quarantine(int(agreed), f"restore failed: {err}")
+                continue
+            if confirmed != agreed:
+                # A peer's restore of this step failed (it quarantined its
+                # copy); discard ours and re-agree so the group lands on a
+                # common older step instead of forking state.
+                log.warning(
+                    "restore of step %d succeeded locally but failed on a "
+                    "peer; retrying the walk collectively", agreed)
+                continue
+            self._last_verified = int(agreed)
+            if candidate is not None and agreed != candidate:
+                log.warning(
+                    "gang agreed on step %d (local newest valid was %d)",
+                    agreed, candidate)
+            if self.restore_fallbacks:
+                log.warning(
+                    "restored checkpoint step %d from %s after %d "
+                    "fallback(s)", agreed, self.directory,
+                    self.restore_fallbacks)
+            else:
+                log.info("restored checkpoint step %d from %s", agreed,
+                         self.directory)
+            return restored, int(agreed)
+
+    # -- lifecycle -------------------------------------------------------------
 
     def close(self) -> None:
-        self.manager.wait_until_finished()
-        self.manager.close()
+        """Flush and verify the in-flight save, then close. Best-effort:
+        escalation (SystemExit) belongs to the step loop, not to teardown —
+        a completed run must not be converted to a retryable exit by its
+        final flush."""
+        try:
+            self._finalize_pending(block=True)
+        except SystemExit:
+            pass
+        except Exception as e:  # noqa: BLE001
+            log.warning("checkpoint flush on close failed: %s", e)
+        try:
+            self.manager.close()
+        except Exception as e:  # noqa: BLE001
+            log.warning("checkpoint manager close failed: %s", e)
 
 
 def from_env_or_args(checkpoint_dir: str = "", save_every: int = 100,
                      max_to_keep: int = 3,
+                     fail_after: int = DEFAULT_FAIL_AFTER,
                      env: Optional[dict] = None) -> Optional[Checkpointer]:
     """Build a Checkpointer from an explicit flag, falling back to the
     operator-injected TPU_CHECKPOINT_DIR; None when neither is set."""
@@ -110,4 +622,4 @@ def from_env_or_args(checkpoint_dir: str = "", save_every: int = 100,
     if not directory:
         return None
     return Checkpointer(directory, save_every=save_every,
-                        max_to_keep=max_to_keep)
+                        max_to_keep=max_to_keep, fail_after=fail_after)

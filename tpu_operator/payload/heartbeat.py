@@ -74,10 +74,18 @@ class HeartbeatReporter:
         now = self._clock()
         return self._last_post is None or now - self._last_post >= self.interval
 
-    def report(self, step: int, metrics: Optional[Dict[str, Any]] = None) -> bool:
+    def report(self, step: int, metrics: Optional[Dict[str, Any]] = None,
+               checkpoint: Optional[Dict[str, Any]] = None) -> bool:
         """Post one heartbeat; returns True when the post succeeded. Step
         time is averaged over the steps since the previous post, so it is
-        meaningful at any reporting interval."""
+        meaningful at any reporting interval.
+
+        ``checkpoint`` is the payload's durability state
+        (``Checkpointer.stats()``): last verified step, save failures,
+        restore fallbacks — surfaced as ``lastCheckpointStep`` /
+        ``checkpointSaveFailures`` / ``checkpointRestoreFallbacks`` so the
+        operator's restart decisions and ``status.checkpoint`` see which
+        step is actually durable."""
         now = self._clock()
         body: Dict[str, Any] = {
             "namespace": self.namespace,
@@ -92,6 +100,15 @@ class HeartbeatReporter:
             body["stepTimeSeconds"] = round(per_step, 6)
             if self.tokens_per_batch > 0 and per_step > 0:
                 body["tokensPerSec"] = round(self.tokens_per_batch / per_step, 3)
+        if checkpoint:
+            if checkpoint.get("lastCheckpointStep") is not None:
+                body["lastCheckpointStep"] = int(
+                    checkpoint["lastCheckpointStep"])
+            for src, dst in (("saveFailures", "checkpointSaveFailures"),
+                             ("restoreFallbacks",
+                              "checkpointRestoreFallbacks")):
+                if checkpoint.get(src) is not None:
+                    body[dst] = int(checkpoint[src])
         loss = (metrics or {}).get("loss")
         if loss is not None:
             try:
@@ -115,10 +132,11 @@ class HeartbeatReporter:
             return False
 
     def maybe_report(self, step: int,
-                     metrics: Optional[Dict[str, Any]] = None) -> bool:
+                     metrics: Optional[Dict[str, Any]] = None,
+                     checkpoint: Optional[Dict[str, Any]] = None) -> bool:
         if not self.due(step):
             return False
-        return self.report(step, metrics)
+        return self.report(step, metrics, checkpoint=checkpoint)
 
 
 def from_env(env: Optional[Dict[str, str]] = None,

@@ -553,8 +553,10 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     the LM payload passes P("data", "seq")).
 
     With a ``checkpointer`` (payload/checkpoint.py), the loop first restores
-    the latest checkpoint — so a whole-group restart (TPUJOB_ATTEMPT > 0)
-    resumes where the previous attempt left off instead of step 0 — then
+    the newest *verified* checkpoint (corrupt/torn steps are quarantined and
+    walked past; multi-process jobs agree on the step via allgather-min) —
+    so a whole-group restart (TPUJOB_ATTEMPT > 0) resumes where the previous
+    attempt left off instead of step 0 — then
     saves on the checkpointer's interval policy plus once at the end. The
     checkpointer stays owned by the caller, who must ``close()`` it (flushes
     the async save) when done with it.
@@ -623,9 +625,19 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 # jobs every peer (signaled or not) reaches this branch at
                 # the same i (consensus above), saves collectively, and
                 # exits retryable so the operator restarts the whole group.
+                # The save is guarded: an I/O failure during the preemption
+                # drain must not escape as a permanent exit (1) — the
+                # restart simply resumes from the last verified save.
                 if checkpointer is not None and i > start:
-                    checkpointer.save(i, state)
-                    log.info("drain: checkpointed step %d, exiting retryable", i)
+                    try:
+                        checkpointer.save(i, state)
+                        log.info("drain: checkpointed step %d, "
+                                 "exiting retryable", i)
+                    except Exception:  # noqa: BLE001 — 143 regardless
+                        log.exception(
+                            "drain: checkpoint save of step %d failed; "
+                            "exiting retryable anyway (resume falls back "
+                            "to the last verified step)", i)
                 else:
                     log.info("drain: exiting retryable at step %d", i)
                 raise SystemExit(bootstrap_mod.EXIT_RETRYABLE)
@@ -647,7 +659,10 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             if log_every and log_fn and (i + 1) % log_every == 0:
                 log_fn(i + 1, jax.device_get(metrics))
             if heartbeat is not None and heartbeat.due(i + 1):
-                heartbeat.report(i + 1, jax.device_get(metrics))
+                heartbeat.report(
+                    i + 1, jax.device_get(metrics),
+                    checkpoint=(checkpointer.stats()
+                                if checkpointer is not None else None))
     finally:
         bootstrap_mod.exit_step_loop()
         if tracing:
@@ -660,7 +675,21 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 pass
             jax.profiler.stop_trace()
     if checkpointer is not None and steps > start:
+        # The final save is load-bearing: a run must not report DONE with
+        # its end state silently unpersisted (an interval-save failure is
+        # tolerable — the next interval retries — but there is no next
+        # interval here). Flush forces verification; if the final step
+        # still is not durable, exit retryable so the restarted attempt
+        # resumes from the last verified step and re-earns a durable
+        # finish instead of the trained weights being quietly lost.
         checkpointer.save(steps, state)
+        checkpointer.flush()
+        if checkpointer.last_verified_step() != steps:
+            log.error(
+                "final checkpoint of step %d is not durable (last verified "
+                "step: %s); exiting retryable so the restart re-earns it",
+                steps, checkpointer.last_verified_step())
+            raise SystemExit(bootstrap_mod.EXIT_RETRYABLE)
     return state, (jax.device_get(metrics) if metrics else {})
 
 

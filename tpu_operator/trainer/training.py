@@ -766,8 +766,24 @@ class TrainingJob:
         ledger = status.failures
         if any(f.attempt == attempt and f.kind == kind for f in ledger):
             return
+        # The last durable step known right now is what the next attempt
+        # resumes from — stamped into the record so the postmortem trail
+        # (and `tpujobctl describe`) shows each restart's actual resume
+        # point instead of leaving "did it go back to 0?" to guesswork.
+        resume = None
+        ck = status.checkpoint or {}
+        hb = status.last_heartbeat or {}
+        for source in (ck.get("lastCheckpointStep"),
+                       hb.get("lastCheckpointStep")):
+            if source is not None:
+                try:
+                    resume = int(source)
+                except (TypeError, ValueError):
+                    resume = None
+                break
         ledger.append(FailureRecord(attempt=attempt, kind=kind,
-                                    reason=reason, time=_now()))
+                                    reason=reason, time=_now(),
+                                    resume_step=resume))
         if len(ledger) > FAILURE_LEDGER_CAP:
             del ledger[:len(ledger) - FAILURE_LEDGER_CAP]
         status.restart_counts[kind] = status.restart_counts.get(kind, 0) + 1
