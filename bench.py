@@ -75,6 +75,14 @@ def parse_args(argv=None):
                         "(CPU-hostable): verified-save + restore latency vs "
                         "state size, and the corrupt-latest fallback-scan "
                         "cost")
+    p.add_argument("--startup", action="store_true",
+                   help="run ONLY the warm-restart startup rows: cold vs "
+                        "warm time-to-first-step on the transformer payload "
+                        "(fresh subprocess each, shared persistent "
+                        "compilation cache + checkpoint dir); exits nonzero "
+                        "if the warm restart stops beating cold or the "
+                        "cache stops hitting")
+    p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
     return p.parse_args(argv)
@@ -1053,6 +1061,145 @@ def bench_checkpoint(quick: bool) -> list:
     return rows
 
 
+# --- warm-restart startup rows --------------------------------------------------
+
+def startup_worker_main(cfg_json: str) -> int:
+    """Subprocess half of the startup bench: ONE fresh attempt of the
+    transformer payload — build, (restore), overlapped AOT compile, first
+    step — against the cache/checkpoint dirs the driver passes in. TTFS is
+    measured from post-import to first-step completion (what the
+    operator's startup breakdown covers; interpreter+import cost is
+    identical cold and warm and would only dilute the ratio). Prints one
+    JSON line."""
+    cfg = json.loads(cfg_json)
+    # Must land in the environment BEFORE jax is imported: the persistent
+    # cache dir is read at config init, the platform at backend init.
+    os.environ["JAX_PLATFORMS"] = cfg.get("platform", "cpu")
+    os.environ["JAX_COMPILATION_CACHE_DIR"] = cfg["cache_dir"]
+
+    from tpu_operator.payload import bootstrap
+    from tpu_operator.payload import checkpoint as ckpt_mod
+    from tpu_operator.payload import startup as startup_mod
+    from tpu_operator.payload import train, transformer
+
+    bootstrap.enable_compilation_cache()
+    t0 = time.perf_counter()
+    targs = transformer.parse_args(cfg["argv"])
+    mesh, _model, state, step, batches = transformer.build(targs)
+    ck = ckpt_mod.Checkpointer(cfg["ckpt_dir"], save_every=10_000) \
+        if cfg.get("ckpt_dir") else None
+    tracker = startup_mod.new_tracker()
+    spec = transformer.lm_token_spec(mesh)
+    try:
+        state, _metrics = train.train_loop(
+            mesh, step, state, batches, cfg["steps"], spec=spec,
+            checkpointer=ck, heartbeat=None, startup=tracker)
+    finally:
+        if ck is not None:
+            ck.close()
+    ttfs = (tracker.first_step_done_at or time.perf_counter()) - t0
+    # Steady-state guard rows: the fast path must not trade steady step
+    # time for TTFS (same executable either way — this proves it).
+    state, steps_per_sec = train.throughput(
+        mesh, step, state, batches, steps=cfg.get("steady_steps", 3),
+        warmup=1, spec=spec)
+    print(json.dumps({
+        "ttfs_s": round(ttfs, 4),
+        "steady_step_ms": round(1e3 / steps_per_sec, 2),
+        "breakdown": tracker.breakdown(),
+    }), flush=True)
+    return 0
+
+
+def _run_startup_worker(cfg: dict) -> dict:
+    import subprocess
+
+    out = subprocess.run(
+        [sys.executable, os.path.abspath(__file__),
+         "--startup-worker", json.dumps(cfg)],
+        capture_output=True, text=True, timeout=900)
+    if out.returncode != 0:
+        raise RuntimeError(
+            f"startup worker failed (rc {out.returncode}):\n{out.stderr}")
+    return json.loads(out.stdout.strip().splitlines()[-1])
+
+
+def bench_startup(quick: bool) -> list:
+    """Cold vs warm restart of the transformer payload, each in a FRESH
+    process (in-process jit caches would fake the warm path): the cold
+    attempt populates the persistent compilation cache and leaves a final
+    checkpoint; the warm attempt restores it and — via the overlapped
+    prologue + cache hit — must reach its first step ≥ 2x faster. The
+    delta IS the restart tax the operator's preemption budgets pay on
+    every one of their maxRestarts*4 restarts."""
+    import shutil
+    import tempfile
+
+    if quick:
+        argv = ["--dim", "128", "--layers", "2", "--heads", "4",
+                "--batch", "4", "--seq-len", "128", "--vocab", "1024"]
+    else:
+        # Deep-and-narrow on purpose: XLA compile time scales with graph
+        # size (layers — measured 44 s cold vs 3.8 s cached for this
+        # config), step time with FLOPs — this is the CPU-hostable config
+        # whose TTFS is compile-dominated the way flagship payloads are on
+        # a real TPU, so the warm/cold ratio measures the cache, not the
+        # host's matmul throughput.
+        argv = ["--dim", "64", "--layers", "16", "--heads", "4",
+                "--batch", "2", "--seq-len", "64", "--vocab", "512"]
+    cache_dir = tempfile.mkdtemp(prefix="bench-xla-cache-")
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-startup-ckpt-")
+    base = {"argv": argv, "cache_dir": cache_dir, "ckpt_dir": ckpt_dir,
+            "steady_steps": 5 if quick else 10}
+    try:
+        cold = _run_startup_worker({**base, "steps": 2})
+        warm = _run_startup_worker({**base, "steps": 4})
+    finally:
+        shutil.rmtree(cache_dir, ignore_errors=True)
+        shutil.rmtree(ckpt_dir, ignore_errors=True)
+    speedup = cold["ttfs_s"] / warm["ttfs_s"] if warm["ttfs_s"] else 0.0
+    rows = [
+        {"metric": "startup_ttfs_cold_s", "value": cold["ttfs_s"],
+         "unit": "s", "steady_step_ms": cold["steady_step_ms"],
+         **{f"cold_{k}": v for k, v in cold["breakdown"].items()}},
+        {"metric": "startup_ttfs_warm_s", "value": warm["ttfs_s"],
+         "unit": "s", "speedup_vs_cold": round(speedup, 2),
+         "steady_step_ms": warm["steady_step_ms"],
+         **{f"warm_{k}": v for k, v in warm["breakdown"].items()}},
+    ]
+    return rows
+
+
+def _startup_ok(rows: list, quick: bool) -> bool:
+    """The CI contract (hack/verify.sh runs --startup --quick): the warm
+    attempt must hit the persistent compilation cache, beat cold TTFS by
+    the budget factor, and hold steady-state step time."""
+    ok = True
+    cold = next(r for r in rows if r["metric"] == "startup_ttfs_cold_s")
+    warm = next(r for r in rows if r["metric"] == "startup_ttfs_warm_s")
+    if not warm.get("warm_cacheHit"):
+        print("FAIL: warm restart did not hit the persistent compilation "
+              f"cache ({warm})", file=sys.stderr)
+        ok = False
+    # Tiny --quick shapes leave less compile time to win back (and share
+    # CI CPU with noisy neighbors — observed 1.35-3.9x run to run), so the
+    # gate budget is looser than the ≥2x the real config must show.
+    budget = 1.2 if quick else 2.0
+    if warm.get("speedup_vs_cold", 0) < budget:
+        print(f"FAIL: warm TTFS only {warm.get('speedup_vs_cold')}x faster "
+              f"than cold (budget: {budget}x)", file=sys.stderr)
+        ok = False
+    # Coarse guard only: it exists to catch the AOT path poisoning steady
+    # state (same executable → same step time), not to benchmark it — the
+    # shared CI box jitters single-digit steps by 2-3x.
+    if warm["steady_step_ms"] > cold["steady_step_ms"] * 3.0 + 50.0:
+        print(f"FAIL: steady-state step regressed warm "
+              f"({warm['steady_step_ms']} ms vs cold "
+              f"{cold['steady_step_ms']} ms)", file=sys.stderr)
+        ok = False
+    return ok
+
+
 def _control_plane_ok(rows: list) -> bool:
     """The CI contract (hack/verify.sh runs --control-plane --quick):
     steady-state reconcile must stay zero-read and the parallel gang must
@@ -1075,6 +1222,11 @@ def _control_plane_ok(rows: list) -> bool:
 
 def main(argv=None) -> int:
     args = parse_args(argv)
+    if args.startup_worker:
+        return startup_worker_main(args.startup_worker)
+    if args.startup:
+        rows = [_emit(row) for row in bench_startup(args.quick)]
+        return 0 if _startup_ok(rows, args.quick) else 1
     if args.control_plane:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_control_plane(args.quick)]
@@ -1105,6 +1257,8 @@ def main(argv=None) -> int:
         if not _control_plane_ok(cp_rows):
             return 1
         for row in bench_checkpoint(args.quick):
+            rows.append(_emit(row))
+        for row in bench_startup(args.quick):
             rows.append(_emit(row))
         rows.append(_emit(bench_matmul(args.quick)))
         for row in bench_attention(args.quick):

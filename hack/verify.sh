@@ -35,6 +35,14 @@ python -m pytest tests/test_checkpoint_chaos.py -x -q
 # The measured form of the durable path: verified-save/restore latency and
 # the corrupt-latest fallback-scan cost must at least run clean.
 python bench.py --checkpoint --quick
+# Standalone warm-restart gate: the compilationCache spec wiring, the
+# overlapped restore+compile prologue (PR 4 restore semantics preserved),
+# startup-stage heartbeats, and the status.startup/metrics fold.
+python -m pytest tests/test_startup_path.py -x -q
+# And its measured form: a warm restart (persistent compilation cache hit
+# + overlapped prologue) must beat cold time-to-first-step by the budget
+# factor, with steady-state step time held — exits nonzero otherwise.
+python bench.py --startup --quick
 # Standalone control-plane budget gate: steady-state reconcile must issue
 # ZERO read RPCs (all reads served by the informer indexes) and the first
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
@@ -47,6 +55,7 @@ python bench.py --control-plane --quick
 python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_chaos_soak.py \
   --ignore=tests/test_checkpoint_chaos.py \
-  --ignore=tests/test_api_budget.py
+  --ignore=tests/test_api_budget.py \
+  --ignore=tests/test_startup_path.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

@@ -1,0 +1,793 @@
+"""Warm-restart fast path: ``spec.compilationCache`` wiring end to end,
+the overlapped restore+compile prologue (PR 4 restore semantics preserved
+exactly), best-effort cache enablement, the DNS backoff, and the
+startup-phase breakdown flowing heartbeat → statusserver → controller →
+``status.startup`` + ``/metrics``.
+"""
+
+import socket
+import threading
+import time
+import urllib.request
+
+import pytest
+
+from tpu_operator.apis.tpujob import validation
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.controller.statusserver import Metrics, StatusServer
+from tpu_operator.payload import bootstrap
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.payload import startup as startup_mod
+from tpu_operator.trainer import replicas as replicas_mod
+from tpu_operator.trainer.training import TrainingJob
+from tests.test_types import make_template
+
+
+# --- spec field: types/schema/defaults/validation ----------------------------
+
+def cache_spec(**kw):
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=2, template=make_template())],
+        compilation_cache=t.CompilationCacheSpec(**kw),
+    )
+    return set_defaults(spec)
+
+
+def test_compilation_cache_roundtrip():
+    spec = cache_spec(path="/mnt/xla", medium="emptyDir")
+    wire = spec.to_dict()
+    assert wire["compilationCache"] == {
+        "enabled": True, "path": "/mnt/xla", "medium": "emptyDir"}
+    back = t.TPUJobSpec.from_dict(wire)
+    assert back.compilation_cache == spec.compilation_cache
+    # absent block stays absent (opt-in)
+    bare = t.TPUJobSpec.from_dict({"replicaSpecs": []})
+    assert bare.compilation_cache is None
+    assert "compilationCache" not in bare.to_dict()
+
+
+def test_compilation_cache_defaults_fill_empty_block():
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=1, template=make_template())],
+        compilation_cache=t.CompilationCacheSpec(path="", medium=""),
+    )
+    set_defaults(spec)
+    assert spec.compilation_cache.path == t.DEFAULT_CACHE_PATH
+    assert spec.compilation_cache.medium == t.CacheMedium.HOSTPATH
+    assert spec.compilation_cache.enabled
+    validation.validate_tpujob_spec(spec)
+
+
+@pytest.mark.parametrize("kw, needle", [
+    ({"medium": "persistentVolume"}, "medium"),
+    ({"path": "relative/path"}, "path"),
+    ({"path": ""}, "path"),
+])
+def test_compilation_cache_validation_rejects(kw, needle):
+    spec = cache_spec(**kw)
+    # set_defaults fills empty path; force the invalid value back
+    for key, value in kw.items():
+        setattr(spec.compilation_cache, key, value)
+    with pytest.raises(validation.ValidationError, match=needle):
+        validation.validate_tpujob_spec(spec)
+
+
+def test_compilation_cache_disabled_block_is_inert():
+    spec = cache_spec(enabled=False)
+    spec.compilation_cache.medium = "bogus"  # disabled → not validated
+    validation.validate_tpujob_spec(spec)
+
+
+def job_body(cache=None):
+    body = {
+        "apiVersion": t.CRD_API_VERSION, "kind": t.CRD_KIND,
+        "metadata": {"name": "warm"},
+        "spec": {"replicaSpecs": [{
+            "replicas": 1, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu",
+                                                  "image": "x"}]}}}]},
+    }
+    if cache is not None:
+        body["spec"]["compilationCache"] = cache
+    return body
+
+
+def test_schema_strict_compilation_cache():
+    ok, msg = schema_mod.validate_tpujob_strict(
+        job_body({"enabled": True, "path": "/var/cache/tpujob/xla",
+                  "medium": "hostPath"}))
+    assert ok, msg
+    ok, msg = schema_mod.validate_tpujob_strict(
+        job_body({"medium": "nfs"}))
+    assert not ok and "medium" in msg
+    ok, msg = schema_mod.validate_tpujob_strict(
+        job_body({"hostPath": "/x"}))
+    assert not ok and "unknown field" in msg
+
+
+def test_schema_status_startup_and_heartbeat_stage():
+    body = job_body()
+    body["status"] = {
+        "phase": "Running", "state": "Running",
+        "startup": {"rendezvousSeconds": 0.1, "restoreSeconds": 1.5,
+                    "compileSeconds": 30.2, "firstStepSeconds": 0.4,
+                    "cacheHit": True, "attempt": 2, "time": "2026-01-01T00:00:00Z"},
+        "lastHeartbeat": {"startupStage": "COMPILE",
+                          "startup": {"compileSeconds": 30.2}},
+    }
+    ok, msg = schema_mod.validate_tpujob_strict(body)
+    assert ok, msg
+    body["status"]["lastHeartbeat"]["startupStage"] = "WAITING"
+    ok, msg = schema_mod.validate_tpujob_strict(body)
+    assert not ok and "startupStage" in msg
+
+
+# --- operator injection: env + volume ----------------------------------------
+
+def build_pod(cache=None):
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(replicas=2, template=make_template())],
+        runtime_id="wr01", compilation_cache=cache,
+    )
+    set_defaults(spec)
+    job = t.TPUJob(metadata={"name": "warm", "namespace": "default",
+                             "uid": "u1"}, spec=spec)
+    tj = TrainingJob(None, None, job)
+    rs = replicas_mod.TPUReplicaSet(None, None, tj, spec.replica_specs[0])
+    return rs.pod_spec_with_index(0)
+
+
+def tpu_container(pod):
+    return next(c for c in pod["spec"]["containers"] if c["name"] == "tpu")
+
+
+def test_cache_env_and_hostpath_volume_injected():
+    pod = build_pod(t.CompilationCacheSpec())
+    env = {e["name"]: e["value"] for e in tpu_container(pod)["env"]}
+    assert env["JAX_COMPILATION_CACHE_DIR"] == t.DEFAULT_CACHE_PATH
+    assert env["TPUJOB_CACHE_ENABLED"] == "1"
+    assert env["TPUJOB_CACHE_PATH"] == t.DEFAULT_CACHE_PATH
+    assert env["TPUJOB_CACHE_MEDIUM"] == "hostPath"
+    vols = {v["name"]: v for v in pod["spec"]["volumes"]}
+    vol = vols[replicas_mod.CACHE_VOLUME_NAME]
+    assert vol["hostPath"] == {"path": t.DEFAULT_CACHE_PATH,
+                               "type": "DirectoryOrCreate"}
+    mounts = {m["name"]: m for m in tpu_container(pod)["volumeMounts"]}
+    assert mounts[replicas_mod.CACHE_VOLUME_NAME]["mountPath"] == \
+        t.DEFAULT_CACHE_PATH
+
+
+def test_cache_emptydir_fallback():
+    pod = build_pod(t.CompilationCacheSpec(path="/xla-cache",
+                                           medium="emptyDir"))
+    vol = next(v for v in pod["spec"]["volumes"]
+               if v["name"] == replicas_mod.CACHE_VOLUME_NAME)
+    assert vol == {"name": replicas_mod.CACHE_VOLUME_NAME, "emptyDir": {}}
+    mounts = tpu_container(pod)["volumeMounts"]
+    assert mounts[0]["mountPath"] == "/xla-cache"
+
+
+def test_no_cache_spec_injects_nothing():
+    pod = build_pod(None)
+    env_names = {e["name"] for e in tpu_container(pod)["env"]}
+    assert "JAX_COMPILATION_CACHE_DIR" not in env_names
+    assert not any(v.get("name") == replicas_mod.CACHE_VOLUME_NAME
+                   for v in pod["spec"].get("volumes", []))
+
+
+def test_disabled_cache_spec_injects_nothing():
+    pod = build_pod(t.CompilationCacheSpec(enabled=False))
+    env_names = {e["name"] for e in tpu_container(pod)["env"]}
+    assert "JAX_COMPILATION_CACHE_DIR" not in env_names
+
+
+# --- bootstrap: best-effort enablement + DNS backoff --------------------------
+
+def test_enable_compilation_cache_sets_config(tmp_path):
+    import jax
+
+    cache = tmp_path / "xla"
+    env = {"JAX_COMPILATION_CACHE_DIR": str(cache)}
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        assert bootstrap.enable_compilation_cache(env) == str(cache)
+        assert jax.config.jax_compilation_cache_dir == str(cache)
+        assert jax.config.jax_persistent_cache_min_entry_size_bytes == 0
+        assert cache.is_dir()
+        assert startup_mod.cache_dir() == str(cache)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+
+
+def test_enable_compilation_cache_unusable_dir_proceeds_cold(tmp_path):
+    # The "corrupt cache dir" case: the path exists but is a FILE — mkdir
+    # and the write probe both fail. Must log-and-return, never raise.
+    clobber = tmp_path / "not-a-dir"
+    clobber.write_text("junk")
+    env = {"JAX_COMPILATION_CACHE_DIR": str(clobber)}
+    assert bootstrap.enable_compilation_cache(env) == ""
+
+
+def test_enable_compilation_cache_respects_disable(tmp_path):
+    env = {"JAX_COMPILATION_CACHE_DIR": str(tmp_path),
+           "TPUJOB_CACHE_ENABLED": "0"}
+    assert bootstrap.enable_compilation_cache(env) == ""
+    assert bootstrap.enable_compilation_cache({}) == ""
+
+
+def test_wait_for_coordinator_tight_then_backed_off(monkeypatch):
+    failures = [8]
+    def fake_getaddrinfo(_host, _port):
+        if failures[0] > 0:
+            failures[0] -= 1
+            raise socket.gaierror("not yet")
+        return []
+    monkeypatch.setattr(socket, "getaddrinfo", fake_getaddrinfo)
+    sleeps = []
+    now = [0.0]
+    def fake_sleep(dt):
+        sleeps.append(dt)
+        now[0] += dt
+    bootstrap.wait_for_coordinator("coord:8476", timeout=300.0, interval=2.0,
+                                   sleep=fake_sleep, clock=lambda: now[0])
+    # 8 failed polls → 8 sleeps: 0.05, 0.1, ..., capped at the interval.
+    assert sleeps == [0.05, 0.1, 0.2, 0.4, 0.8, 1.6, 2.0, 2.0]
+
+
+def test_wait_for_coordinator_warm_service_is_instant(monkeypatch):
+    monkeypatch.setattr(socket, "getaddrinfo", lambda _h, _p: [])
+    sleeps = []
+    bootstrap.wait_for_coordinator("coord:8476", sleep=sleeps.append)
+    assert sleeps == []
+
+
+def test_wait_for_coordinator_times_out(monkeypatch):
+    def nope(_h, _p):
+        raise socket.gaierror("never")
+    monkeypatch.setattr(socket, "getaddrinfo", nope)
+    now = [0.0]
+    def fake_sleep(dt):
+        now[0] += dt
+    with pytest.raises(TimeoutError):
+        bootstrap.wait_for_coordinator("coord:8476", timeout=10.0,
+                                       sleep=fake_sleep,
+                                       clock=lambda: now[0])
+
+
+# --- the overlapped prologue ---------------------------------------------------
+
+def tiny_build(lr=0.1):
+    import jax
+    import optax
+
+    from tpu_operator.payload import models, train
+
+    mesh = train.make_mesh(num_devices=2)
+    model = models.LinearRegressor()
+    tx = optax.sgd(lr)
+    sample = jax.numpy.zeros((8, 4), jax.numpy.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    shardings = train.state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+    step = train.make_regression_train_step(model, tx, mesh, state, shardings)
+    return mesh, state, step
+
+
+def counting_linear_stream(counter):
+    from tpu_operator.payload import data as data_mod
+
+    def stream():
+        for batch in data_mod.synthetic_linear(0, 8, 4):
+            counter.append(1)
+            yield batch
+    return stream()
+
+
+def test_overlap_prologue_trains_and_uses_aot(tmp_path):
+    import jax
+
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    counter = []
+    tracker = startup_mod.StartupTracker()
+    out, _metrics = train.train_loop(
+        mesh, step, state, counting_linear_stream(counter), steps=3,
+        heartbeat=None, startup=tracker, prefetch=0)
+    assert int(jax.device_get(out.step)) == 3
+    assert len(counter) == 3  # batch 0 peeked for AOT shapes, then consumed
+    b = tracker.breakdown()
+    assert b["compileSeconds"] > 0  # the AOT path actually ran
+    assert b["firstStepSeconds"] > 0
+
+
+def test_overlap_resume_restores_and_fast_forwards(tmp_path):
+    import jax
+
+    from tpu_operator.payload import checkpoint as ckpt_mod
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    # Attempt 0: run 3 steps, leave a verified checkpoint at 3.
+    ck = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=1000)
+    counter = []
+    state0, _ = train.train_loop(mesh, step, state,
+                                 counting_linear_stream(counter), steps=3,
+                                 checkpointer=ck, heartbeat=None, prefetch=0)
+    ck.close()
+    assert len(counter) == 3
+
+    # Attempt 1: fresh init state; the overlapped prologue must restore
+    # step 3 (restore result WINS over the AOT-compiled init state) and
+    # fast-forward the stream so batches 0-2 are drawn-but-discarded.
+    mesh2, fresh, step2 = tiny_build()
+    ck2 = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=1000)
+    counter2 = []
+    tracker = startup_mod.StartupTracker()
+    out, _ = train.train_loop(mesh2, step2, fresh,
+                              counting_linear_stream(counter2), steps=5,
+                              checkpointer=ck2, heartbeat=None,
+                              startup=tracker, prefetch=0)
+    ck2.close()
+    assert int(jax.device_get(out.step)) == 5
+    assert len(counter2) == 5  # 3 fast-forwarded + 2 trained
+    assert tracker.breakdown()["restoreSeconds"] > 0
+    # The restored trajectory must equal the uninterrupted one: params of
+    # a 5-step run from scratch vs 3+2 across the restore.
+    mesh3, fresh3, step3 = tiny_build()
+    ref, _ = train.train_loop(mesh3, step3, fresh3,
+                              counting_linear_stream([]), steps=5,
+                              heartbeat=None)
+    for a, b in zip(jax.tree_util.tree_leaves(out.params),
+                    jax.tree_util.tree_leaves(ref.params)):
+        assert jax.numpy.allclose(a, b, atol=1e-6)
+
+
+def test_overlap_failed_restore_falls_back_per_pr4(tmp_path):
+    import os
+
+    import jax
+
+    from tpu_operator.payload import checkpoint as ckpt_mod
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    ck = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=2,
+                               max_to_keep=5)
+    state0, _ = train.train_loop(mesh, step, state,
+                                 counting_linear_stream([]), steps=4,
+                                 checkpointer=ck, heartbeat=None)
+    ck.close()
+    # Corrupt the newest checkpoint (step 4): flip bytes in its largest
+    # file so the manifest checksum fails and the walk quarantines it.
+    step_dir = str(tmp_path / "ck" / "4")
+    victim = max(
+        (os.path.join(root, fn) for root, _d, files in os.walk(step_dir)
+         for fn in files if fn != ckpt_mod.MANIFEST_NAME),
+        key=os.path.getsize)
+    with open(victim, "r+b") as f:
+        f.write(b"\xde\xad\xbe\xef")
+
+    mesh2, fresh, step2 = tiny_build()
+    ck2 = ckpt_mod.Checkpointer(str(tmp_path / "ck"), save_every=1000)
+    counter = []
+    out, _ = train.train_loop(mesh2, step2, fresh,
+                              counting_linear_stream(counter), steps=5,
+                              checkpointer=ck2, heartbeat=None, prefetch=0)
+    # PR 4 semantics through the overlapped path: corrupt 4 quarantined,
+    # resume from verified 2, train 3 more.
+    assert ck2.restore_fallbacks == 1
+    assert int(jax.device_get(out.step)) == 5
+    assert len(counter) == 5
+    ck2.close()
+
+
+def test_cache_dir_corruption_still_cold_starts(tmp_path):
+    """Best-effort end to end: a payload whose cache dir is a corrupt
+    non-directory still trains (cold) — enablement returns "" and the
+    loop runs exactly as without a cache."""
+    import jax
+
+    from tpu_operator.payload import train
+
+    clobber = tmp_path / "cache"
+    clobber.write_text("junk")
+    assert bootstrap.enable_compilation_cache(
+        {"JAX_COMPILATION_CACHE_DIR": str(clobber)}) == ""
+    mesh, state, step = tiny_build()
+    out, _ = train.train_loop(mesh, step, state, counting_linear_stream([]),
+                              steps=2, heartbeat=None)
+    assert int(jax.device_get(out.step)) == 2
+
+
+def test_aot_mismatch_falls_back_to_jit_dispatch():
+    """A step jitted WITHOUT explicit in_shardings lowers from the host
+    batch's (absent) sharding; the AOT executable then rejects the
+    device-placed sharded batch at call time. The first step must fall
+    back to ordinary jit dispatch instead of failing the attempt."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+    from jax.sharding import PartitionSpec as P
+
+    from tpu_operator.payload import models, train
+
+    mesh = train.make_mesh(num_devices=2)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((8, 4), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+
+    def step(state, x, y):
+        def loss_fn(params):
+            pred = model.apply({"params": params}, x, train=True)
+            return jnp.mean((pred - y) ** 2)
+        loss, grads = jax.value_and_grad(loss_fn)(state.params)
+        updates, new_opt = tx.update(grads, state.opt_state, state.params)
+        return train.TrainState(
+            step=state.step + 1,
+            params=optax.apply_updates(state.params, updates),
+            batch_stats=state.batch_stats, opt_state=new_opt,
+        ), {"loss": loss}
+
+    bare_jit = jax.jit(step)  # no in_shardings: the mismatch case
+    out, metrics = train.train_loop(
+        mesh, bare_jit, state, counting_linear_stream([]), steps=2,
+        heartbeat=None, spec=P("data"))
+    assert int(jax.device_get(out.step)) == 2
+    assert "loss" in metrics
+
+
+def test_serial_prologue_unchanged(tmp_path):
+    """overlap=False is the PR-4 serial path, byte for byte."""
+    import jax
+
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    counter = []
+    out, _ = train.train_loop(mesh, step, state,
+                              counting_linear_stream(counter), steps=2,
+                              heartbeat=None, overlap=False, prefetch=0)
+    assert int(jax.device_get(out.step)) == 2
+    assert len(counter) == 2
+
+
+# --- heartbeats: startupStage liveness + the breakdown post -------------------
+
+def make_reporter(posts, interval=0.05):
+    return heartbeat_mod.HeartbeatReporter(
+        "http://x", "warm", interval=interval,
+        poster=lambda _url, body: posts.append(body))
+
+
+def test_report_startup_posts_stage_only():
+    posts = []
+    rep = make_reporter(posts)
+    assert rep.report_startup("COMPILE")
+    assert posts[-1]["startupStage"] == "COMPILE"
+    assert "step" not in posts[-1]
+    # startup posts must not starve the first real step report
+    assert rep.due(1)
+
+
+def test_report_carries_startup_breakdown():
+    posts = []
+    rep = make_reporter(posts)
+    rep.report(1, {"loss": 0.5},
+               startup={"compileSeconds": 2.0, "cacheHit": True})
+    assert posts[-1]["startup"] == {"compileSeconds": 2.0, "cacheHit": True}
+
+
+def test_train_loop_posts_startup_stage_and_breakdown():
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    posts = []
+    rep = make_reporter(posts, interval=0.02)
+    tracker = startup_mod.StartupTracker()
+    # Slow the compile artificially so the ticker provably fires during it.
+    real_compile = train.aot_compile_step
+
+    def slow_compile(*a, **kw):
+        time.sleep(0.15)
+        return real_compile(*a, **kw)
+
+    try:
+        train.aot_compile_step = slow_compile
+        train.train_loop(mesh, step, state, counting_linear_stream([]),
+                         steps=2, heartbeat=rep, startup=tracker)
+    finally:
+        train.aot_compile_step = real_compile
+    stages = [p["startupStage"] for p in posts if "startupStage" in p]
+    assert "COMPILE" in stages
+    breakdowns = [p["startup"] for p in posts if "startup" in p]
+    assert breakdowns and breakdowns[0]["compileSeconds"] > 0
+    assert breakdowns[0]["firstStepSeconds"] > 0
+
+
+# --- statusserver validation ---------------------------------------------------
+
+def test_statusserver_sanitizes_startup_fields():
+    server = StatusServer(0, metrics=Metrics())
+    server.start()
+    try:
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startupStage": "WAITING"})
+        assert not ok and "startupStage" in msg
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startup": "zzz"})
+        assert not ok and "startup" in msg
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startup": {"compileSeconds": -1}})
+        assert not ok
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startup": {"compileSeconds": float("nan")}})
+        assert not ok
+        # valid fields on a standby: rejected as standby, not as bad body
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startupStage": "COMPILE",
+             "startup": {"compileSeconds": 1.5, "cacheHit": True,
+                         "ignored": "dropped"}})
+        assert not ok and msg.startswith("standby")
+    finally:
+        server.stop()
+
+
+def test_statusserver_rejects_unrecordable_breakdown_retryably():
+    """The breakdown is a one-shot per attempt: if the controller cannot
+    record it yet (fresh leader, TrainingJob not built), a 200 would make
+    the payload drop it forever — the server must fail retryably instead,
+    while ordinary beats keep the old ACK-and-stash behavior."""
+    class Store:
+        @staticmethod
+        def get(_ns, _name):
+            return {"metadata": {"name": "x", "namespace": "default"}}
+
+    class Informer:
+        store = Store()
+
+    class NotReadyController:
+        job_informer = Informer()
+
+        @staticmethod
+        def record_heartbeat(_ns, _name, _hb):
+            return False  # job known to the cache, TrainingJob not built
+
+    server = StatusServer(0, metrics=Metrics())
+    server.start()
+    server.set_controller(NotReadyController())
+    try:
+        ok, msg = server.record_heartbeat(
+            {"name": "x", "startup": {"compileSeconds": 3.0}})
+        assert not ok and msg.endswith("retry")
+        ok, _ = server.record_heartbeat({"name": "x", "step": 1})
+        assert ok  # plain beats: gauges stash, status catches up later
+    finally:
+        server.stop()
+
+
+# --- stall watchdog: startup beats are liveness --------------------------------
+
+def test_startup_heartbeat_defers_stall():
+    from tests.test_time_recovery import (
+        FakeNow, all_running, make_job, new_tj)
+    from tpu_operator.trainer import training as training_mod
+
+    clock = FakeNow()
+    orig = training_mod._now
+    training_mod._now = clock
+    try:
+        job = make_job(stall_timeout_seconds=60,
+                       restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+        cs, tj = new_tj(job, metrics=Metrics())
+        tj.reconcile()
+        all_running(cs)
+        tj.reconcile()
+        assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+        # 50 s in: a COMPILE-stage liveness beat lands (operator-stamped
+        # time, exactly what the statusserver stores for startup posts).
+        clock.advance(50.0)
+        tj.job.status.last_heartbeat = {"time": training_mod._now(),
+                                        "startupStage": "COMPILE",
+                                        "attempt": 0}
+        # 59 s after the beat (109 s after Running): still alive.
+        clock.advance(59.0)
+        tj.reconcile()
+        assert tj.job.status.attempt == 0
+        assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+        # 2 more: the startup stage stopped progressing → stall fires.
+        clock.advance(2.0)
+        tj.reconcile()
+        assert tj.job.status.attempt == 1
+        assert tj.job.status.failures[-1].kind == "stall"
+    finally:
+        training_mod._now = orig
+
+
+# --- e2e: breakdown → status.startup + /metrics (strict schema) ----------------
+
+@pytest.fixture()
+def e2e():
+    from tpu_operator.client.informer import SharedInformerFactory
+    from tpu_operator.client.rest import Clientset, RestConfig
+    from tpu_operator.controller.controller import Controller
+    from tpu_operator.testing.apiserver import ApiServerHarness
+
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    # resync_period: NOT 0 here — an object created inside the informer's
+    # LIST→WATCH establishment gap is otherwise invisible forever (the
+    # pre-existing flake class documented for test_telemetry_e2e); a 1 s
+    # re-list heals the miss, and since PR 3 the resync loop no longer
+    # re-dispatches unchanged resourceVersions, so it costs nothing here.
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=1.0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def wait_for(pred, timeout=45.0, interval=0.05):
+    deadline = time.monotonic() + timeout
+    while time.monotonic() < deadline:
+        if pred():
+            return True
+        time.sleep(interval)
+    return pred()
+
+
+def test_startup_breakdown_e2e(e2e):
+    api, cs, controller, server = e2e
+    cs.tpujobs.create("default", {
+        "apiVersion": t.CRD_API_VERSION, "kind": t.CRD_KIND,
+        "metadata": {"name": "warm", "namespace": "default"},
+        "spec": {
+            "compilationCache": {"enabled": True, "path": "/xla",
+                                 "medium": "hostPath"},
+            "replicaSpecs": [{
+                "replicas": 1, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+                "template": {"spec": {"containers": [
+                    {"name": "tpu", "image": "x"}]}}}]},
+    })
+    # Poll with a reconcile nudge: this harness class has a pre-existing
+    # LIST/WATCH establishment race (documented for test_telemetry_e2e on
+    # the baseline tree) where the create event can be missed with
+    # resync_period=0; re-adding the key is dedup'd by the workqueue and
+    # keeps this test about the startup plumbing, not the watch race.
+    def pods_exist():
+        if api.clientset.pods.list("default"):
+            return True
+        controller.queue.add("default/warm")
+        return False
+
+    assert wait_for(pods_exist)
+    # The injected pod carries the cache contract + volume.
+    pod = api.clientset.pods.list("default")[0]
+    env = {e["name"]: e.get("value") for c in pod["spec"]["containers"]
+           for e in c.get("env", [])}
+    assert env["JAX_COMPILATION_CACHE_DIR"] == "/xla"
+    assert any(v.get("name") == replicas_mod.CACHE_VOLUME_NAME
+               for v in pod["spec"]["volumes"])
+    for p in api.clientset.pods.list("default"):
+        p["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", p)
+    def job_running():
+        if (cs.tpujobs.get("default", "warm").get("status", {})
+                .get("phase") == "Running"):
+            return True
+        controller.queue.add("default/warm")  # same nudge as above
+        return False
+
+    assert wait_for(job_running)
+
+    # The payload's reporter, exactly as train_loop drives it: liveness
+    # beats during compile, then the post-first-step breakdown.
+    reporter = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "warm", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "0", "TPUJOB_ATTEMPT": "0"})
+    assert reporter.report_startup("COMPILE")
+    breakdown = {"rendezvousSeconds": 0.2, "restoreSeconds": 1.1,
+                 "compileSeconds": 33.0, "firstStepSeconds": 0.7,
+                 "cacheHit": True}
+    assert reporter.report(1, {"loss": 2.5}, startup=breakdown)
+
+    def persisted_startup():
+        return (cs.tpujobs.get("default", "warm").get("status", {})
+                .get("startup") or {})
+    assert wait_for(lambda: persisted_startup().get("compileSeconds") == 33.0)
+    su = persisted_startup()
+    assert su["cacheHit"] is True and su["attempt"] == 0
+    # Strict schema proof: the write above passed the apiserver's strict
+    # structural admission with status.startup + lastHeartbeat.startup.
+    hb = cs.tpujobs.get("default", "warm")["status"]["lastHeartbeat"]
+    assert hb["startup"]["restoreSeconds"] == 1.1
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5) as r:
+        body = r.read().decode()
+    assert 'tpu_operator_job_startup_seconds_bucket{le="60",stage="compile"} 1' in body
+    assert ('tpu_operator_compilation_cache_hits_total'
+            '{name="warm",namespace="default"} 1') in body
+    # One breakdown per attempt: a re-post must not double-observe.
+    assert reporter.report(2, {"loss": 2.4}, startup=breakdown)
+    time.sleep(0.2)
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}/metrics", timeout=5) as r:
+        body2 = r.read().decode()
+    assert ('tpu_operator_compilation_cache_hits_total'
+            '{name="warm",namespace="default"} 1') in body2
+
+
+# --- tpujobctl describe --------------------------------------------------------
+
+def test_ctl_describe_prints_startup(capsys):
+    import argparse
+
+    from tpu_operator.cmd import ctl
+
+    job = {
+        "metadata": {"name": "warm", "namespace": "default"},
+        "spec": {"replicaSpecs": []},
+        "status": {"phase": "Running", "state": "Running", "attempt": 1,
+                   "startup": {"rendezvousSeconds": 0.21,
+                               "restoreSeconds": 1.18,
+                               "compileSeconds": 33.0,
+                               "firstStepSeconds": 0.66,
+                               "cacheHit": True, "attempt": 1}},
+    }
+
+    class Stub:
+        class tpujobs:
+            @staticmethod
+            def get(_ns, _name):
+                return job
+
+        class events:
+            @staticmethod
+            def list(_ns):
+                return []
+
+    opts = argparse.Namespace(namespace="default", name="warm")
+    assert ctl.cmd_describe(Stub, opts) == 0
+    out = capsys.readouterr().out
+    assert "Startup:" in out
+    assert "compile 33.00s" in out
+    assert "warm (compilation cache hit)" in out
+
+
+# --- throughput satellite ------------------------------------------------------
+
+def test_throughput_uses_device_prefetch(monkeypatch):
+    from tpu_operator.payload import data as data_mod
+    from tpu_operator.payload import train
+
+    mesh, state, step = tiny_build()
+    used = []
+    real = data_mod.device_prefetch
+
+    def spy(*a, **kw):
+        used.append(kw.get("depth"))
+        return real(*a, **kw)
+
+    monkeypatch.setattr(data_mod, "device_prefetch", spy)
+    _state, steps_per_sec = train.throughput(
+        mesh, step, state, counting_linear_stream([]), steps=3, warmup=1)
+    assert steps_per_sec > 0
+    assert used == [2]  # the shipped pipelined path, default depth

@@ -12,8 +12,10 @@ untestable without a full TrainingJob).
 from __future__ import annotations
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_CACHE_PATH,
     DEFAULT_TPU_PORT,
     DEFAULT_TPU_REPLICAS,
+    CacheMedium,
     RestartBackoffSpec,
     RestartPolicy,
     TerminationPolicySpec,
@@ -69,4 +71,14 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
     # opts a job out of backoff entirely.
     if spec.restart_backoff is None:
         spec.restart_backoff = RestartBackoffSpec()
+
+    # Warm-restart compilation cache: the block stays opt-in (None = off),
+    # but a present block fills its unset fields — ``compilationCache: {}``
+    # means "the default cache": enabled, hostPath, the standard path.
+    if spec.compilation_cache is not None:
+        cache = spec.compilation_cache
+        if not cache.path:
+            cache.path = DEFAULT_CACHE_PATH
+        if not cache.medium:
+            cache.medium = CacheMedium.HOSTPATH
     return spec

@@ -30,6 +30,10 @@ from __future__ import annotations
 from typing import Any, Dict, List, Tuple
 
 from tpu_operator.apis.tpujob.v1alpha1 import types
+# Stage names of the warm-restart startup breakdown. payload/startup.py is
+# the canonical home (the payload emits them); it is stdlib-only, so the
+# schema importing it drags nothing heavy into the control plane.
+from tpu_operator.payload.startup import STAGES as STARTUP_STAGES
 
 
 def _str(**kw) -> Dict[str, Any]:
@@ -105,7 +109,28 @@ def spec_schema() -> Dict[str, Any]:
             "baseSeconds": _int(minimum=0),
             "maxSeconds": _int(minimum=0),
         }),
+        # Warm-restart fast path: persistent XLA compilation cache.
+        "compilationCache": _obj({
+            "enabled": {"type": "boolean"},
+            "path": _str(),
+            "medium": _str(enum=list(types.CacheMedium.ALL)),
+        }),
     }, required=["replicaSpecs"])
+
+
+def startup_breakdown_schema() -> Dict[str, Any]:
+    """The startup-phase breakdown object: shared by
+    ``status.lastHeartbeat.startup`` (as posted) and ``status.startup``
+    (as folded in by the controller, which adds attempt/time)."""
+    return _obj({
+        "rendezvousSeconds": _num(minimum=0),
+        "restoreSeconds": _num(minimum=0),
+        "compileSeconds": _num(minimum=0),
+        "firstStepSeconds": _num(minimum=0),
+        "cacheHit": {"type": "boolean"},
+        "attempt": _int(minimum=0),
+        "time": _str(),
+    })
 
 
 def status_schema() -> Dict[str, Any]:
@@ -150,6 +175,11 @@ def status_schema() -> Dict[str, Any]:
             "lastCheckpointStep": _int(minimum=0),
             "checkpointSaveFailures": _int(minimum=0),
             "checkpointRestoreFallbacks": _int(minimum=0),
+            # Warm-restart startup telemetry: pre-first-step liveness beats
+            # carry the in-flight stage; the post-first-step beat carries
+            # the full breakdown (folded into status.startup).
+            "startupStage": _str(enum=list(STARTUP_STAGES)),
+            "startup": startup_breakdown_schema(),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -163,6 +193,10 @@ def status_schema() -> Dict[str, Any]:
             "attemptRestoreFallbacks": _int(minimum=0),
             "time": _str(),
         }),
+        # Warm-restart observability: the per-attempt startup-phase
+        # breakdown (rendezvous/restore/compile/first-step seconds and
+        # whether the XLA compile hit the persistent cache).
+        "startup": startup_breakdown_schema(),
         # Most recent phase *change* (stall-watchdog baseline; RFC3339).
         "lastTransitionTime": _str(),
         # Gang-create release time while phase is Backoff (RFC3339).

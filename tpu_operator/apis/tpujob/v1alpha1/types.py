@@ -154,6 +154,28 @@ DEFAULT_RESTART_BACKOFF_BASE = 10
 DEFAULT_RESTART_BACKOFF_MAX = 360
 
 
+# --- Warm-restart fast path (persistent compilation cache) -------------------
+
+class CacheMedium:
+    """Backing store of the persistent XLA compilation cache volume.
+
+    HOSTPATH survives whole-group restarts that land on the same node (the
+    common case for slice preemption: pods are recreated onto the same
+    reserved topology) — restart N+1 deserializes the executables attempt N
+    compiled. EMPTYDIR is the fallback for clusters that forbid hostPath:
+    the cache then only serves compiles *within* one pod lifetime (grad
+    accumulation microbatch recompiles, eval fns), not across restarts.
+    """
+
+    HOSTPATH = "hostPath"
+    EMPTYDIR = "emptyDir"
+
+    ALL = (HOSTPATH, EMPTYDIR)
+
+
+DEFAULT_CACHE_PATH = "/var/cache/tpujob/xla"
+
+
 # --- Restart / gang policy (TPU-native addition) ----------------------------
 
 class RestartPolicy:
@@ -242,6 +264,40 @@ class RestartBackoffSpec:
             return 0.0
         return float(min(self.base_seconds * (2 ** (n - 1)),
                          self.max_seconds))
+
+
+@dataclass
+class CompilationCacheSpec:
+    """Persistent XLA compilation-cache wiring (``spec.compilationCache``).
+
+    When present and enabled, the operator mounts a cache volume (medium
+    hostPath or emptyDir) at ``path`` in the ``tpu`` container and injects
+    ``JAX_COMPILATION_CACHE_DIR`` + ``TPUJOB_CACHE_*``, so a restarted
+    attempt deserializes the executables the previous attempt compiled
+    instead of paying full XLA recompilation — the dominant cost of
+    time-to-first-step on real payloads. Strictly best-effort on the
+    payload side (bootstrap.enable_compilation_cache): a corrupt or
+    unwritable cache dir logs and proceeds cold, never fails the attempt.
+    """
+
+    enabled: bool = True
+    path: str = DEFAULT_CACHE_PATH
+    medium: str = CacheMedium.HOSTPATH
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled, "path": self.path,
+                "medium": self.medium}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["CompilationCacheSpec"]:
+        if d is None:
+            return None
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            path=str(d.get("path", DEFAULT_CACHE_PATH)),
+            medium=str(d.get("medium", CacheMedium.HOSTPATH)),
+        )
 
 
 @dataclass
@@ -334,6 +390,9 @@ class TPUJobSpec:
     # deletes the TPUJob (children follow via OwnerReferences / explicit
     # teardown) — batch/v1 ttlSecondsAfterFinished.
     ttl_seconds_after_finished: Optional[int] = None
+    # Warm-restart fast path: persistent XLA compilation cache volume + env
+    # (None = off; restarts pay full recompilation, the pre-PR-5 behavior).
+    compilation_cache: Optional[CompilationCacheSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -366,6 +425,8 @@ class TPUJobSpec:
             d["restartBackoff"] = self.restart_backoff.to_dict()
         if self.ttl_seconds_after_finished is not None:
             d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
+        if self.compilation_cache is not None:
+            d["compilationCache"] = self.compilation_cache.to_dict()
         return d
 
     @classmethod
@@ -390,6 +451,8 @@ class TPUJobSpec:
             restart_backoff=RestartBackoffSpec.from_dict(
                 d.get("restartBackoff")),
             ttl_seconds_after_finished=opt_int("ttlSecondsAfterFinished"),
+            compilation_cache=CompilationCacheSpec.from_dict(
+                d.get("compilationCache")),
         )
 
 
@@ -480,6 +543,14 @@ class TPUJobStatus:
     # the per-attempt baselines the delta accounting persists
     # (attempt/attemptSaveFailures/attemptRestoreFallbacks).
     checkpoint: Optional[Dict[str, Any]] = None
+    # Warm-restart observability, folded in from the heartbeat's one-shot
+    # post after the first step of each attempt: the startup-phase
+    # breakdown {rendezvousSeconds, restoreSeconds, compileSeconds,
+    # firstStepSeconds, cacheHit, attempt, time}. ``cacheHit`` is whether
+    # the XLA compile was served from the persistent compilation cache —
+    # the number that proves (or disproves) the warm-restart fast path on
+    # a live job.
+    startup: Optional[Dict[str, Any]] = None
     # Time-aware recovery state:
     # RFC3339 stamp of the most recent phase *change* (unlike phaseTimeline,
     # which keeps only the first entry into each phase) — the stall
@@ -513,6 +584,8 @@ class TPUJobStatus:
             d["lastHeartbeat"] = dict(self.last_heartbeat)
         if self.checkpoint:
             d["checkpoint"] = dict(self.checkpoint)
+        if self.startup:
+            d["startup"] = dict(self.startup)
         if self.last_transition_time:
             d["lastTransitionTime"] = self.last_transition_time
         if self.backoff_until:
@@ -544,6 +617,7 @@ class TPUJobStatus:
                             if d.get("lastHeartbeat") else None),
             checkpoint=(dict(d["checkpoint"])
                         if d.get("checkpoint") else None),
+            startup=(dict(d["startup"]) if d.get("startup") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),
             backoff_until=str(d.get("backoffUntil", "")),
             failures=[FailureRecord.from_dict(f)

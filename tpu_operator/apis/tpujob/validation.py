@@ -20,6 +20,7 @@ from typing import List
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
+    CacheMedium,
     RestartPolicy,
     TPUJobSpec,
     TPUReplicaType,
@@ -103,6 +104,22 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
         if bo.max_seconds < bo.base_seconds:
             raise ValidationError(
                 "restartBackoff.maxSeconds must be >= baseSeconds"
+            )
+
+    # Warm-restart compilation cache (validated only when enabled: a
+    # disabled block is inert, whatever its other fields say).
+    cache = spec.compilation_cache
+    if cache is not None and cache.enabled:
+        if cache.medium not in CacheMedium.ALL:
+            raise ValidationError(
+                f"compilationCache.medium {cache.medium!r} is not in "
+                f"{list(CacheMedium.ALL)}"
+            )
+        if not cache.path or not cache.path.startswith("/"):
+            raise ValidationError(
+                "compilationCache.path must be an absolute path "
+                "(it is both the container mount point and, for medium "
+                "hostPath, the node directory)"
             )
 
 

@@ -171,6 +171,20 @@ def cmd_describe(cs, opts) -> int:
               f"{'-' if durable is None else durable} "
               f"(save failures {ck.get('saveFailures', 0)}, "
               f"restore fallbacks {ck.get('restoreFallbacks', 0)})")
+    su = status.get("startup") or {}
+    if su:
+        stages = " ".join(
+            f"{label} {su[key]:.2f}s"
+            for label, key in (("rendezvous", "rendezvousSeconds"),
+                               ("restore", "restoreSeconds"),
+                               ("compile", "compileSeconds"),
+                               ("first-step", "firstStepSeconds"))
+            if su.get(key) is not None) or "-"
+        cache = su.get("cacheHit")
+        cache_s = ("warm (compilation cache hit)" if cache
+                   else "cold" if cache is not None else "unknown")
+        print(f"Startup:    attempt {su.get('attempt', 0)}: {stages} "
+              f"[{cache_s}]")
     if status.get("failures"):
         print("Failures:")
         for f in status["failures"][-10:]:

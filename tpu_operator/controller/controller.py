@@ -309,12 +309,20 @@ class Controller:
             tj.job.status.last_heartbeat = merged
             self._apply_checkpoint_heartbeat(tj, namespace, name, heartbeat,
                                              hb_attempt)
+            self._apply_startup_heartbeat(tj, namespace, name, heartbeat,
+                                          hb_attempt)
             # Compare against the last *persisted* stamp, not the last
             # received one — a steady sub-interval cadence would otherwise
-            # keep resetting the baseline and never persist again.
+            # keep resetting the baseline and never persist again. A
+            # startup-breakdown beat is always persisted immediately: it is
+            # a one-shot per attempt, and coalescing would park it in
+            # memory until the next natural reconcile (up to a resync
+            # period) — observed as status.startup missing while the
+            # payload already trains.
             last = self._hb_persisted.get(key)
             persist = (prev is None
                        or prev.get("attempt") != heartbeat.get("attempt")
+                       or "startup" in heartbeat
                        or last is None
                        or new_t - last >= self.heartbeat_persist_interval)
             if persist:
@@ -370,6 +378,45 @@ class Controller:
         if heartbeat.get("time"):
             ck["time"] = str(heartbeat["time"])
         tj.job.status.checkpoint = ck
+
+    def _apply_startup_heartbeat(self, tj: TrainingJob, namespace: str,
+                                 name: str, heartbeat: Dict[str, Any],
+                                 hb_attempt: Optional[int]) -> None:
+        """Fold a heartbeat's startup breakdown into ``status.startup``
+        (called under _jobs_lock). The breakdown is posted once per attempt
+        (right after the first step); the per-stage durations feed the
+        ``job_startup_seconds{stage}`` histograms and a cache-hit ticks
+        ``compilation_cache_hits_total`` — guarded per attempt, so the
+        payload retrying a failed post cannot double-observe."""
+        from tpu_operator.payload.startup import STAGE_FIELDS
+
+        su = heartbeat.get("startup")
+        if not isinstance(su, dict) or not su:
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        cur = tj.job.status.startup or {}
+        already = cur.get("attempt") == gen
+        new: Dict[str, Any] = {}
+        for field in STAGE_FIELDS.values():
+            if su.get(field) is not None:
+                new[field] = float(su[field])
+        if su.get("cacheHit") is not None:
+            new["cacheHit"] = bool(su["cacheHit"])
+        if not new:
+            return
+        new["attempt"] = int(gen)
+        if heartbeat.get("time"):
+            new["time"] = str(heartbeat["time"])
+        tj.job.status.startup = new
+        if already:
+            return
+        for stage, field in STAGE_FIELDS.items():
+            if field in new:
+                self.metrics.observe("job_startup_seconds", new[field],
+                                     labels={"stage": stage.lower()})
+        if new.get("cacheHit"):
+            self.metrics.inc("compilation_cache_hits_total",
+                             labels={"namespace": namespace, "name": name})
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

@@ -44,6 +44,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpu_operator.payload.startup import STAGE_FIELDS, STAGES as STARTUP_STAGES
 from tpu_operator.util import tracing
 from tpu_operator.util.util import now_rfc3339, parse_rfc3339
 
@@ -65,6 +66,10 @@ RUNTIME_BUCKETS = (10.0, 60.0, 300.0, 600.0, 1800.0, 3600.0, 10800.0,
 # Restart-backoff delays: exponential from the 10 s default base up to the
 # 360 s default cap (plus headroom for custom maxSeconds).
 BACKOFF_BUCKETS = (1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 360.0, 600.0)
+# Startup stages span ms (warm rendezvous) to minutes (cold XLA compile of
+# a flagship payload) — log-spaced across both regimes.
+STARTUP_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
+                   600.0)
 
 LabelsT = Optional[Dict[str, str]]
 
@@ -181,6 +186,10 @@ class Metrics:
         self.register("job_checkpoint_restore_fallbacks_total", "counter",
                       "Corrupt/torn checkpoints quarantined while a payload "
                       "walked back to an older valid step on restore.")
+        self.register("compilation_cache_hits_total", "counter",
+                      "Attempts whose XLA compile was served from the "
+                      "persistent compilation cache (warm restart), per "
+                      "startup breakdown reports.")
         self.register("reconcile_duration_seconds", "histogram",
                       "Wall time of one reconcile pass.", RECONCILE_BUCKETS)
         self.register("workqueue_queue_duration_seconds", "histogram",
@@ -199,6 +208,11 @@ class Metrics:
         self.register("group_restart_backoff_seconds", "histogram",
                       "Backoff delay applied between whole-group restarts.",
                       BACKOFF_BUCKETS)
+        self.register("job_startup_seconds", "histogram",
+                      "Per-attempt startup stage durations "
+                      "(label stage: rendezvous/restore/compile/"
+                      "first_step), from payload startup breakdowns.",
+                      STARTUP_BUCKETS)
 
     # -- registry --------------------------------------------------------------
 
@@ -460,8 +474,11 @@ class StatusServer:
                         self._send(200, json.dumps({"ok": True}),
                                    "application/json")
                     else:
+                        # "; retry"-suffixed rejections are transient
+                        # (standby instance, job not yet reconciled) →
+                        # 503; everything else is a bad body → 400.
                         self._send(
-                            503 if message.startswith("standby") else 400,
+                            503 if message.endswith("retry") else 400,
                             message)
                 except Exception as e:  # noqa: BLE001 — never kill the thread
                     log.warning("status endpoint %s failed: %s", path, e)
@@ -532,6 +549,37 @@ class StatusServer:
                 if field != "loss" and value < 0:
                     return False, f"bad heartbeat: negative {field}"
                 hb[field] = value
+        # Warm-restart startup telemetry. Both fields are sanitized down to
+        # exactly the CRD schema's shape before they can reach status — an
+        # unknown key or bad value persisted there would fail strict
+        # admission and wedge every later status write for the job.
+        stage = body.get("startupStage")
+        if stage is not None:
+            if stage not in STARTUP_STAGES:
+                return False, f"bad heartbeat: unknown startupStage {stage!r}"
+            hb["startupStage"] = str(stage)
+        su = body.get("startup")
+        if su is not None:
+            if not isinstance(su, dict):
+                return False, "bad heartbeat: startup must be an object"
+            clean: Dict[str, Any] = {}
+            for field in STAGE_FIELDS.values():
+                if su.get(field) is None:
+                    continue
+                try:
+                    value = float(su[field])
+                except (TypeError, ValueError):
+                    return False, f"bad heartbeat: non-numeric startup.{field}"
+                if not math.isfinite(value) or value < 0:
+                    return False, f"bad heartbeat: bad startup.{field}"
+                clean[field] = value
+            if su.get("cacheHit") is not None:
+                clean["cacheHit"] = bool(su["cacheHit"])
+            # An empty breakdown carries nothing: storing it would defeat
+            # heartbeat coalescing (the controller force-persists any beat
+            # with a "startup" key) and 503 no-op beats on a fresh leader.
+            if clean:
+                hb["startup"] = clean
         c = self.controller
         if c is None:
             # A standby cannot persist the heartbeat (no in-memory job) nor
@@ -555,8 +603,19 @@ class StatusServer:
             # terminating pod from a previous generation): the gauges must
             # not advertise liveness the stall watchdog ignores, so skip
             # the stash — but still 200 the dying pod.
-            if c.record_heartbeat(namespace, name, hb) is None:
+            recorded = c.record_heartbeat(namespace, name, hb)
+            if recorded is None:
                 return True, ""
+            if recorded is False and "startup" in hb:
+                # The startup breakdown is a ONE-SHOT per attempt: the
+                # payload stops resending it after the first 200 (unlike
+                # the checkpoint fields, which ride on every beat). ACKing
+                # it before the TrainingJob exists — a fresh leader whose
+                # first reconcile hasn't run — would silently lose the
+                # attempt's status.startup and its histogram/cache-hit
+                # observations. Fail retryably instead; the payload
+                # re-attaches it to the next due beat.
+                return False, "not ready: job not yet reconciled; retry"
         with self._heartbeats_lock:
             self._heartbeats[(namespace, name)] = {
                 **hb, "receivedAt": time.time()}

@@ -27,6 +27,8 @@ import threading
 import time
 from typing import Callable, Optional
 
+from tpu_operator.payload import startup as startup_mod
+
 log = logging.getLogger(__name__)
 
 
@@ -64,46 +66,118 @@ def process_info_from_env(env: Optional[dict] = None) -> ProcessInfo:
     )
 
 
+# First DNS poll delay; doubles up to the ``interval`` cap.
+INITIAL_DNS_POLL = 0.05
+
+
 def wait_for_coordinator(address: str, timeout: float = 300.0,
-                         interval: float = 2.0) -> None:
+                         interval: float = 2.0,
+                         sleep: Callable[[float], None] = time.sleep,
+                         clock: Callable[[], float] = time.monotonic) -> None:
     """Block until the coordinator's DNS name resolves (the Service exists
     before any pod by construction — trainer/training.py creates services
-    first — but cluster DNS propagation still takes seconds)."""
+    first — but cluster DNS propagation still takes seconds).
+
+    Polls tightly at first (50 ms) with capped exponential backoff up to
+    ``interval``: on a warm restart the Service — and usually its DNS
+    record — already exists, so the common case costs milliseconds instead
+    of a full coarse poll period, while a genuinely cold cluster degrades
+    to the old 2 s cadence. ``sleep``/``clock`` are injectable for tests.
+    """
     host = address.rsplit(":", 1)[0]
-    deadline = time.monotonic() + timeout
+    deadline = clock() + timeout
+    delay = min(INITIAL_DNS_POLL, interval) if interval > 0 else 0.0
     while True:
         try:
             socket.getaddrinfo(host, None)
             return
         except socket.gaierror:
-            if time.monotonic() >= deadline:
+            now = clock()
+            if now >= deadline:
                 raise TimeoutError(
                     f"coordinator DNS {host!r} did not resolve in {timeout:.0f}s"
                 )
-            log.info("waiting for coordinator DNS %s ...", host)
-            time.sleep(interval)
+            # The tight early polls would spam INFO; log them at debug and
+            # only surface the wait once it is actually taking a while.
+            if delay >= interval:
+                log.info("waiting for coordinator DNS %s ...", host)
+            else:
+                log.debug("waiting for coordinator DNS %s ...", host)
+            sleep(min(delay, max(0.0, deadline - now)))
+            delay = min(delay * 2 if delay > 0 else interval, interval)
 
 
 def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
     """Form the process group. Single-process jobs skip jax.distributed
     entirely (a v4-8 single-worker job needs no coordinator —
-    BASELINE config 2 degenerates to plain jax)."""
+    BASELINE config 2 degenerates to plain jax). The DNS wait + rendezvous
+    time is recorded as the RENDEZVOUS stage of the startup breakdown."""
     info = info or process_info_from_env()
     if info.num_processes <= 1:
         log.info("single-process job; skipping jax.distributed")
+        startup_mod.record_rendezvous(0.0)
         return info
     import jax
 
+    t0 = time.perf_counter()
     wait_for_coordinator(info.coordinator_address)
     jax.distributed.initialize(
         coordinator_address=info.coordinator_address,
         num_processes=info.num_processes,
         process_id=info.process_id,
     )
+    startup_mod.record_rendezvous(time.perf_counter() - t0)
     log.info("process %d/%d joined group at %s (%d devices visible)",
              info.process_id, info.num_processes, info.coordinator_address,
              jax.device_count())
     return info
+
+
+def enable_compilation_cache(env: Optional[dict] = None) -> str:
+    """Point JAX's persistent compilation cache at the operator-mounted
+    volume (JAX_COMPILATION_CACHE_DIR / TPUJOB_CACHE_*, injected by
+    trainer/replicas.py when ``spec.compilationCache`` is enabled) and
+    force min-entry-size/min-compile-time to 0 so every executable — not
+    just the slow ones JAX's defaults admit — is reusable on the next
+    attempt. Returns the cache dir, or "" when caching is off or the dir
+    is unusable.
+
+    Strictly best-effort: a corrupt, read-only, or otherwise unwritable
+    cache dir logs a warning and the attempt proceeds with a cold compile
+    — a broken cache volume must degrade warm restarts, never fail them.
+    """
+    e = env if env is not None else os.environ
+    path = e.get("JAX_COMPILATION_CACHE_DIR", "")
+    if not path:
+        return ""
+    if e.get("TPUJOB_CACHE_ENABLED", "1").lower() in ("0", "false"):
+        return ""
+    try:
+        os.makedirs(path, exist_ok=True)
+        probe = os.path.join(path, f".tpujob-cache-probe-{os.getpid()}")
+        with open(probe, "w", encoding="utf-8") as f:
+            f.write("ok")
+        os.remove(probe)
+    except OSError as err:
+        log.warning("compilation cache dir %s unusable (%s); proceeding "
+                    "with cold compilation", path, err)
+        return ""
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir", path)
+        # Defaults skip small/fast compiles; a warm restart wants every
+        # executable back, so persist all of them.
+        jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
+        jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    except Exception as err:  # noqa: BLE001 — config drift must not kill us
+        log.warning("enabling the persistent compilation cache failed (%s); "
+                    "proceeding with cold compilation", err)
+        return ""
+    startup_mod.set_cache_dir(path)
+    log.info("persistent compilation cache at %s (medium %s)",
+             path, e.get("TPUJOB_CACHE_MEDIUM", "unset"))
+    return path
 
 
 EXIT_RETRYABLE = 143  # 128 + SIGTERM: the retryable band (training.go:172-208)
@@ -159,6 +233,7 @@ def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
     signal.signal(signal.SIGTERM, _sigterm)
     try:
         info = initialize()
+        enable_compilation_cache()
         # jax.distributed.initialize installs its own C++ SIGTERM handler
         # (the preemption notifier, preemption_notifier.cc) which *replaces*
         # the drain handler above. Left in place, SIGTERM would never set

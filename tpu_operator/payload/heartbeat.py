@@ -75,7 +75,8 @@ class HeartbeatReporter:
         return self._last_post is None or now - self._last_post >= self.interval
 
     def report(self, step: int, metrics: Optional[Dict[str, Any]] = None,
-               checkpoint: Optional[Dict[str, Any]] = None) -> bool:
+               checkpoint: Optional[Dict[str, Any]] = None,
+               startup: Optional[Dict[str, Any]] = None) -> bool:
         """Post one heartbeat; returns True when the post succeeded. Step
         time is averaged over the steps since the previous post, so it is
         meaningful at any reporting interval.
@@ -85,7 +86,12 @@ class HeartbeatReporter:
         restore fallbacks — surfaced as ``lastCheckpointStep`` /
         ``checkpointSaveFailures`` / ``checkpointRestoreFallbacks`` so the
         operator's restart decisions and ``status.checkpoint`` see which
-        step is actually durable."""
+        step is actually durable.
+
+        ``startup`` is the attempt's startup-phase breakdown
+        (``StartupTracker.breakdown()``), attached once after the first
+        step — the operator folds it into ``status.startup`` and the
+        ``job_startup_seconds`` histograms."""
         now = self._clock()
         body: Dict[str, Any] = {
             "namespace": self.namespace,
@@ -94,6 +100,8 @@ class HeartbeatReporter:
             "processId": self.process_id,
             "attempt": self.attempt,
         }
+        if startup:
+            body["startup"] = dict(startup)
         if self._last_post is not None and self._last_step is not None \
                 and step > self._last_step:
             per_step = (now - self._last_post) / (step - self._last_step)
@@ -121,15 +129,36 @@ class HeartbeatReporter:
             except (TypeError, ValueError):
                 pass
         self._last_post, self._last_step = now, int(step)
+        return self._post(body)
+
+    def _post(self, body: Dict[str, Any]) -> bool:
+        """Best-effort POST shared by every report flavor: never raises,
+        logs the first failure of a streak rather than a stream."""
         try:
             self._poster(self.url, body)
             self._failed_once = False
             return True
         except Exception as e:  # noqa: BLE001 — heartbeats never kill training
-            if not self._failed_once:  # log the first failure, not a stream
+            if not self._failed_once:
                 log.warning("heartbeat post to %s failed: %s", self.url, e)
                 self._failed_once = True
             return False
+
+    def report_startup(self, stage: str) -> bool:
+        """Post a pre-first-step liveness beat carrying only the in-flight
+        ``startupStage`` (RENDEZVOUS/RESTORE/COMPILE/FIRST_STEP): the stall
+        watchdog's baseline is the operator's receipt stamp, so these keep
+        a long compile from reading as a hang. Deliberately does NOT touch
+        the step-cadence bookkeeping (``_last_post``): the first real step
+        report must fire immediately, and step-time averaging must not
+        span the startup window."""
+        return self._post({
+            "namespace": self.namespace,
+            "name": self.job_name,
+            "processId": self.process_id,
+            "attempt": self.attempt,
+            "startupStage": str(stage),
+        })
 
     def maybe_report(self, step: int,
                      metrics: Optional[Dict[str, Any]] = None,

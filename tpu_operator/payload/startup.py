@@ -1,0 +1,164 @@
+"""Warm-restart startup instrumentation: the per-attempt phase tracker.
+
+PR 2 made restarts frequent by design (preemption budgets, backoff) and
+PR 4 made them durable (verified checkpoint resume) — which moves the
+goodput bottleneck under churn to **time-to-first-step (TTFS)**: every
+attempt pays DNS wait → jax.distributed rendezvous → checkpoint restore →
+XLA compilation → first step before a single useful FLOP. This module is
+the measurement half of the warm-restart fast path (train.py's overlapped
+prologue and bootstrap's persistent compilation cache are the mechanism):
+
+- :class:`StartupTracker` times each startup stage (RENDEZVOUS / RESTORE /
+  COMPILE / FIRST_STEP); stages may overlap (restore and AOT compile run
+  concurrently in the fast path), so each is timed independently and
+  ``current_stage`` reports the innermost in-flight one for the
+  pre-first-step liveness heartbeats.
+- The resulting ``breakdown()`` dict is the wire format the heartbeat
+  carries (``startup: {rendezvousSeconds, restoreSeconds, compileSeconds,
+  firstStepSeconds, cacheHit}``) into ``status.startup`` and the
+  ``job_startup_seconds{stage}`` histograms.
+- ``cache_hit_count`` is the persistent-compilation-cache hit signal,
+  fed by JAX's own monitoring events.
+
+Stdlib-only on purpose: the controller (statusserver heartbeat validation,
+schema enums) imports the stage names from here, and this module must not
+drag jax into the control plane.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+# Startup stages, in nominal order. COMPILE and RESTORE overlap in the
+# fast path; FIRST_STEP is the first optimizer step after the prologue
+# (its duration includes any residual compile the AOT path didn't cover).
+RENDEZVOUS = "RENDEZVOUS"
+RESTORE = "RESTORE"
+COMPILE = "COMPILE"
+FIRST_STEP = "FIRST_STEP"
+
+STAGES = (RENDEZVOUS, RESTORE, COMPILE, FIRST_STEP)
+
+# Heartbeat/status field name per stage (the ``status.startup`` keys).
+STAGE_FIELDS = {
+    RENDEZVOUS: "rendezvousSeconds",
+    RESTORE: "restoreSeconds",
+    COMPILE: "compileSeconds",
+    FIRST_STEP: "firstStepSeconds",
+}
+
+# Rendezvous happens in bootstrap.initialize, before any tracker exists
+# (the payload's train_loop builds one much later) — recorded at module
+# level and seeded into every new tracker of this process.
+_rendezvous_seconds: Optional[float] = None
+# The persistent compilation cache dir bootstrap enabled ("" = cold).
+_cache_dir: str = ""
+
+
+def record_rendezvous(seconds: float) -> None:
+    global _rendezvous_seconds
+    _rendezvous_seconds = float(seconds)
+
+
+def set_cache_dir(path: str) -> None:
+    global _cache_dir
+    _cache_dir = str(path or "")
+
+
+def cache_dir() -> str:
+    return _cache_dir
+
+
+# Persistent-cache hit counting via jax.monitoring (the same event stream
+# jax's own telemetry uses). Registered lazily from the payload side —
+# importing this module must never import jax.
+_cache_hits = 0
+_listener_registered = False
+
+
+def ensure_cache_listener() -> bool:
+    """Idempotently subscribe to JAX's compilation-cache events; returns
+    False when the monitoring API is unavailable (config drift)."""
+    global _listener_registered
+    if _listener_registered:
+        return True
+    try:
+        from jax import monitoring
+
+        def _on_event(event: str, **_kw: Any) -> None:
+            global _cache_hits
+            if event == "/jax/compilation_cache/cache_hits":
+                _cache_hits += 1
+
+        monitoring.register_event_listener(_on_event)
+        _listener_registered = True
+        return True
+    except Exception:  # noqa: BLE001 — best-effort telemetry
+        return False
+
+
+def cache_hit_count() -> int:
+    """Persistent compilation-cache hits observed so far this process
+    (0 until :func:`ensure_cache_listener` ran and a compile hit)."""
+    return _cache_hits
+
+
+class StartupTracker:
+    """Times the startup stages of one attempt. Thread-safe: the fast path
+    runs RESTORE (main thread) and COMPILE (worker thread) concurrently."""
+
+    def __init__(self, clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._active: List[str] = []  # innermost last
+        self.durations: Dict[str, float] = {}
+        self.cache_hit: Optional[bool] = None
+        # Absolute clock() stamp of first-step completion (TTFS fences).
+        self.first_step_done_at: Optional[float] = None
+        if _rendezvous_seconds is not None:
+            self.durations[RENDEZVOUS] = _rendezvous_seconds
+
+    @contextlib.contextmanager
+    def stage(self, name: str):
+        t0 = self._clock()
+        with self._lock:
+            self._active.append(name)
+        try:
+            yield
+        finally:
+            dt = self._clock() - t0
+            with self._lock:
+                if name in self._active:
+                    self._active.remove(name)
+                # Keep the max across re-entries (a retried restore walk
+                # re-enters the stage; the attempt paid the longest one).
+                self.durations[name] = max(self.durations.get(name, 0.0), dt)
+                if name == FIRST_STEP:
+                    self.first_step_done_at = self._clock()
+
+    def current_stage(self) -> Optional[str]:
+        """The innermost in-flight stage — what a pre-first-step liveness
+        heartbeat reports as ``startupStage``."""
+        with self._lock:
+            return self._active[-1] if self._active else None
+
+    def breakdown(self) -> Dict[str, Any]:
+        """The wire-format startup breakdown (only stages actually timed)."""
+        with self._lock:
+            out: Dict[str, Any] = {
+                STAGE_FIELDS[name]: round(self.durations[name], 6)
+                for name in STAGES if name in self.durations
+            }
+            if self.cache_hit is not None:
+                out["cacheHit"] = bool(self.cache_hit)
+        return out
+
+
+def new_tracker(clock: Callable[[], float] = time.perf_counter
+                ) -> StartupTracker:
+    """Fresh per-attempt tracker, pre-seeded with this process's
+    rendezvous time (bootstrap.initialize records it)."""
+    return StartupTracker(clock=clock)

@@ -18,7 +18,9 @@ because its compute lived in user images):
 
 from __future__ import annotations
 
+import itertools
 import logging
+import threading
 import time
 from typing import Any, Callable, Optional, Tuple
 
@@ -32,6 +34,7 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 from tpu_operator.payload import bootstrap as bootstrap_mod
 from tpu_operator.payload import data as data_mod
 from tpu_operator.payload import models as models_mod
+from tpu_operator.payload import startup as startup_mod
 
 log = logging.getLogger(__name__)
 
@@ -535,6 +538,137 @@ def _infer_tokens_per_batch(batch_args: tuple) -> int:
     return 0
 
 
+def _abstractify(x: Any) -> Any:
+    if hasattr(x, "shape") and hasattr(x, "dtype"):
+        return jax.ShapeDtypeStruct(x.shape, x.dtype,
+                                    sharding=getattr(x, "sharding", None))
+    return x
+
+
+def _detach_restored(state: TrainState) -> TrainState:
+    """Copy orbax-restored leaves into fresh backend buffers before the
+    (donating) train step consumes them. Restored arrays can be backed by
+    the restore machinery's own allocations; donating those into a
+    persistent-cache-deserialized executable corrupts the heap on the
+    jaxlib CPU build this environment pins (glibc abort on the second
+    step, reproduced with restore + cache hit + donation and with any one
+    of the three removed it disappears). The copy is bandwidth-cheap next
+    to the restore's host I/O and runs once per attempt. Non-addressable
+    (multi-host) leaves pass through untouched — copying them would need
+    an identity program per sharding, and the corruption has only been
+    observed on the single-process CPU path."""
+    return jax.tree_util.tree_map(
+        lambda x: jnp.array(x)
+        if isinstance(x, jax.Array) and x.is_fully_addressable else x,
+        state)
+
+
+def aot_compile_step(train_step: Callable, state: TrainState,
+                     batch_args: tuple) -> Optional[Callable]:
+    """AOT-compile a jitted train step for the live state's shapes and one
+    batch's shapes via ``lower(...).compile()`` — the compile then runs off
+    the critical path (the overlapped prologue calls this on a worker
+    thread while checkpoint restore does host I/O), and the returned
+    executable is invoked directly, skipping trace-time on the first step.
+    Returns None when the step has no ``lower`` (not a jit'd callable)."""
+    lower = getattr(train_step, "lower", None)
+    if lower is None:
+        return None
+    abstract_state = jax.tree_util.tree_map(_abstractify, state)
+    abstract_batch = tuple(_abstractify(a) for a in batch_args)
+    return lower(abstract_state, *abstract_batch).compile()
+
+
+def _overlapped_prologue(train_step: Callable, state: TrainState, batches,
+                         checkpointer, tracker: startup_mod.StartupTracker
+                         ) -> tuple:
+    """The warm-restart fast path's attempt prologue: checkpoint restore
+    (host I/O + a little device placement) and the AOT compile of the train
+    step (compiler-bound, or a persistent-cache deserialize on a warm
+    restart) run **concurrently** instead of serially — restore lands into
+    the already-compiled step. Returns (state, start, stream, compiled).
+
+    Semantics are identical to the serial prologue by construction:
+
+    - batch 0 is peeked only to give the AOT lowering its shapes; the
+      returned stream re-chains it in order, so a fresh start trains on it
+      and a resume discards it exactly as the serial fast-forward would;
+    - restore keeps PR 4's verified-restore + gang-consistent semantics
+      untouched — it is the same ``checkpointer.restore`` call, whose
+      collectives stay on this (the main) thread; only the XLA compile
+      moves to a worker;
+    - any compile failure falls back to ordinary jit dispatch (first step
+      pays trace+compile, as before) — the fast path never adds a way for
+      an attempt to fail.
+    """
+    it = iter(batches)
+    try:
+        first = next(it)
+    except StopIteration:
+        first = None
+    result: dict = {}
+    # Snapshot the state binding BEFORE the thread starts: the main thread
+    # rebinds ``state`` mid-restore (and again in _detach_restored), and a
+    # closure reads its variable at call time — the lowering must see the
+    # init state's leaves deterministically, never a racy mix.
+    compile_state = state
+
+    def compile_worker() -> None:
+        listening = startup_mod.ensure_cache_listener()
+        before = startup_mod.cache_hit_count()
+        try:
+            with tracker.stage(startup_mod.COMPILE):
+                compiled = aot_compile_step(train_step, compile_state, first)
+        except Exception as e:  # noqa: BLE001 — fall back to jit dispatch
+            log.warning("AOT compile of the train step failed; first step "
+                        "will trace+compile as before: %s", e)
+            return
+        if compiled is None:
+            return
+        # Warm vs cold via JAX's own monitoring events: a persistent-cache
+        # hit during the compile window means the executable (or the bulk
+        # of this attempt's programs) was deserialized, not rebuilt.
+        if listening:
+            tracker.cache_hit = startup_mod.cache_hit_count() > before
+        result["compiled"] = compiled
+
+    worker = None
+    if first is not None:
+        worker = threading.Thread(target=compile_worker, daemon=True,
+                                  name="aot-compile")
+        worker.start()
+    start = 0
+    try:
+        if checkpointer is not None:
+            with tracker.stage(startup_mod.RESTORE):
+                state, start = checkpointer.restore(state)
+            if start > 0:
+                state = _detach_restored(state)
+    finally:
+        # Join on every exit — a restore failure propagating with the
+        # compile thread mid-flight would race it against teardown.
+        if worker is not None:
+            worker.join()
+    stream = itertools.chain([first], it) if first is not None else it
+    for _ in range(start):
+        next(stream)
+    return state, start, stream, result.get("compiled")
+
+
+def _startup_heartbeat_ticker(tracker: startup_mod.StartupTracker,
+                              heartbeat, stop: threading.Event) -> None:
+    """Pre-first-step liveness: until the first step lands there are no
+    step heartbeats, so a long compile or restore on a big payload is
+    indistinguishable from a hang — the stall watchdog (PR 2) would
+    restart the group into a loop that never escapes compilation. Posting
+    the in-flight ``startupStage`` on the heartbeat cadence keeps the
+    watchdog's baseline fresh while startup makes progress."""
+    while not stop.wait(max(0.01, getattr(heartbeat, "interval", 10.0))):
+        stage = tracker.current_stage()
+        if stage is not None:
+            heartbeat.report_startup(stage)
+
+
 def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                batches, steps: int,
                log_every: int = 0,
@@ -543,7 +677,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                profile_dir: str = "",
                profile_range: Tuple[int, int] = (10, 20),
                prefetch: int = 2,
-               heartbeat="auto") -> Tuple[TrainState, dict]:
+               heartbeat="auto", startup=None,
+               overlap: bool = True) -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
     interval — and the batch transfers run ``prefetch`` deep ahead of the
@@ -578,19 +713,51 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     and this is process 0 — or pass a HeartbeatReporter / None explicitly.
     The post is rate-limited inside the reporter and fetches metrics only
     when actually due, so it stays off the steady-state step path.
+
+    ``overlap`` (default on) runs the attempt prologue's independent costs
+    concurrently — checkpoint restore, AOT compile of the train step, and
+    the first batches' host generation + H2D prefetch — instead of
+    serially (the warm-restart fast path; see ``_overlapped_prologue``).
+    ``startup`` is an injectable :class:`startup.StartupTracker`; the
+    default fresh tracker times each stage, the breakdown is posted on the
+    first heartbeat after the first step (→ ``status.startup``), and
+    pre-first-step liveness beats carry the in-flight ``startupStage`` so
+    a long compile never reads as a stall.
     """
     if heartbeat == "auto":
         from tpu_operator.payload import heartbeat as heartbeat_mod
         heartbeat = heartbeat_mod.from_env()
+    tracker = startup if startup is not None else startup_mod.new_tracker()
+    ticker_stop = threading.Event()
+    if heartbeat is not None:
+        threading.Thread(target=_startup_heartbeat_ticker,
+                         args=(tracker, heartbeat, ticker_stop),
+                         daemon=True, name="startup-heartbeat").start()
     start = 0
-    if checkpointer is not None:
-        state, start = checkpointer.restore(state)
-        for _ in range(start):
-            next(batches)
+    step_fn = train_step
+    try:
+        if overlap:
+            state, start, batches, compiled = _overlapped_prologue(
+                train_step, state, batches, checkpointer, tracker)
+            if compiled is not None:
+                step_fn = compiled
+        elif checkpointer is not None:
+            with tracker.stage(startup_mod.RESTORE):
+                state, start = checkpointer.restore(state)
+            if start > 0:
+                state = _detach_restored(state)
+            for _ in range(start):
+                next(batches)
+    except BaseException:
+        ticker_stop.set()
+        raise
     # Prefetch wraps the stream only after the resume fast-forward above,
     # so a restarted attempt still sees exactly the batches it would have.
+    # The fill's H2D transfers are async, so they overlap whatever compile
+    # work the first step still has to do.
     dev_batches = data_mod.device_prefetch(mesh, batches, spec=spec,
                                            depth=max(0, prefetch))
+    pending_startup: Optional[dict] = None
     metrics = {}
     tracing = profiled = False
     trace_from, trace_to = start + profile_range[0], start + profile_range[1]
@@ -649,7 +816,37 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             if heartbeat is not None and i == start \
                     and getattr(heartbeat, "tokens_per_batch", 0) == 0:
                 heartbeat.tokens_per_batch = _infer_tokens_per_batch(batch_args)
-            state, metrics = train_step(state, *batch_args)
+            if i == start:
+                # Time the first step to completion (one extra fence, paid
+                # once per attempt): with the AOT fast path it is pure
+                # execution; without, it carries the residual trace+compile
+                # — either way it is the last leg of TTFS.
+                with tracker.stage(startup_mod.FIRST_STEP):
+                    try:
+                        state, metrics = step_fn(state, *batch_args)
+                    except (TypeError, ValueError):
+                        if step_fn is train_step:
+                            raise
+                        # The AOT executable can reject inputs the jit
+                        # path would accept — e.g. a step jitted WITHOUT
+                        # explicit in_shardings lowers from the host
+                        # batch's (absent) sharding and then refuses the
+                        # device-placed one. Only argument-validation
+                        # errors (TypeError/ValueError) are retried: they
+                        # fire before execution or donation, so the state
+                        # is intact. Runtime failures (XlaRuntimeError,
+                        # OOM) may already have consumed the donated
+                        # buffers and must propagate as the real error.
+                        log.warning(
+                            "AOT-compiled step rejected its inputs; "
+                            "falling back to jit dispatch", exc_info=True)
+                        step_fn = train_step
+                        state, metrics = step_fn(state, *batch_args)
+                    jax.device_get(metrics)
+                ticker_stop.set()
+                pending_startup = tracker.breakdown()
+            else:
+                state, metrics = step_fn(state, *batch_args)
             if tracing and (i + 1) >= trace_to:
                 jax.device_get(metrics)  # drain async work into the trace
                 jax.profiler.stop_trace()
@@ -658,12 +855,21 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 checkpointer.maybe_save(i + 1, state)
             if log_every and log_fn and (i + 1) % log_every == 0:
                 log_fn(i + 1, jax.device_get(metrics))
-            if heartbeat is not None and heartbeat.due(i + 1):
-                heartbeat.report(
-                    i + 1, jax.device_get(metrics),
-                    checkpoint=(checkpointer.stats()
-                                if checkpointer is not None else None))
+            # The first step's report is forced (not just when due): it
+            # carries the startup breakdown the operator folds into
+            # status.startup; thereafter the breakdown rides along on due
+            # beats until one post succeeds.
+            if heartbeat is not None and (heartbeat.due(i + 1)
+                                          or (i == start
+                                              and pending_startup)):
+                if heartbeat.report(
+                        i + 1, jax.device_get(metrics),
+                        checkpoint=(checkpointer.stats()
+                                    if checkpointer is not None else None),
+                        startup=pending_startup):
+                    pending_startup = None
     finally:
+        ticker_stop.set()
         bootstrap_mod.exit_step_loop()
         if tracing:
             # Close the trace on EVERY exit path — normal completion with the
@@ -694,21 +900,27 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
 
 
 def throughput(mesh: Mesh, train_step: Callable, state: TrainState, batches,
-               steps: int, warmup: int = 3) -> Tuple[TrainState, float]:
-    """steps/sec over `steps` timed iterations (post-warmup). The fences are
-    ``device_get`` of the last metrics — a value fetch completes only after
-    the whole dependent step chain has executed, which holds on every
-    backend (``block_until_ready`` was observed returning early on the
-    tunneled axon TPU platform and must not be trusted for timing)."""
+               steps: int, warmup: int = 3, spec: P = None,
+               prefetch: int = 2) -> Tuple[TrainState, float]:
+    """steps/sec over `steps` timed iterations (post-warmup), fed through
+    the SAME pipelined input path the shipped loop uses —
+    ``data.device_prefetch`` (depth ``prefetch``) — rather than a
+    bench-only per-step ``put_global_batch``: the measured number then
+    includes host batch generation and H2D transfer overlapped behind
+    compute exactly as production runs them (pre-staged device batches
+    pass through untouched, so HBM-cycled benches are unchanged).
+    The fences are ``device_get`` of the last metrics — a value fetch
+    completes only after the whole dependent step chain has executed,
+    which holds on every backend (``block_until_ready`` was observed
+    returning early on the tunneled axon TPU platform and must not be
+    trusted for timing)."""
+    dev_batches = data_mod.device_prefetch(mesh, batches, spec=spec,
+                                           depth=max(0, prefetch))
     for _ in range(warmup):
-        host = next(batches)
-        dev = data_mod.put_global_batch(mesh, *host)
-        state, metrics = train_step(state, *dev)
+        state, metrics = train_step(state, *next(dev_batches))
     jax.device_get(metrics["loss"])
     start = time.perf_counter()
     for _ in range(steps):
-        host = next(batches)
-        dev = data_mod.put_global_batch(mesh, *host)
-        state, metrics = train_step(state, *dev)
+        state, metrics = train_step(state, *next(dev_batches))
     jax.device_get(metrics["loss"])
     return state, steps / (time.perf_counter() - start)

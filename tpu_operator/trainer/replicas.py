@@ -46,6 +46,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from tpu_operator.apis.tpujob import helper
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
+    CacheMedium,
     FailureKind,
     RestartPolicy,
     ReplicaState,
@@ -72,6 +73,10 @@ _MAX_DNS_LABEL = 63
 # Bound on concurrent child-create RPCs per sync (--create-parallelism):
 # a 256-pod gang costs ~N/16 round trips instead of N sequential ones.
 DEFAULT_CREATE_PARALLELISM = 16
+
+# Volume name of the persistent XLA compilation cache mount
+# (spec.compilationCache); a user template already defining it wins.
+CACHE_VOLUME_NAME = "tpujob-compilation-cache"
 
 
 def run_creates(tasks: List[Callable[[], Any]], parallelism: int) -> None:
@@ -246,6 +251,16 @@ def build_replica_env(
         env["TPU_CHECKPOINT_DIR"] = spec.checkpoint_dir
     if spec.profile_dir:
         env["TPU_PROFILE_DIR"] = spec.profile_dir
+    cache = spec.compilation_cache
+    if cache is not None and cache.enabled:
+        # Warm-restart fast path: JAX reads JAX_COMPILATION_CACHE_DIR
+        # natively; the TPUJOB_CACHE_* mirror lets the payload bootstrap
+        # distinguish operator-wired caching (and log/force the min-entry
+        # knobs) from an ambient developer env var.
+        env["JAX_COMPILATION_CACHE_DIR"] = cache.path
+        env["TPUJOB_CACHE_ENABLED"] = "1"
+        env["TPUJOB_CACHE_PATH"] = cache.path
+        env["TPUJOB_CACHE_MEDIUM"] = cache.medium
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)
@@ -505,7 +520,36 @@ class TPUReplicaSet:
             raise ValueError(
                 f"pod template has no container named {DEFAULT_CONTAINER_NAME!r}"
             )
+        self._inject_cache_volume(pod_spec, job_spec)
         return pod
+
+    @staticmethod
+    def _inject_cache_volume(pod_spec: Dict[str, Any],
+                             job_spec: TPUJobSpec) -> None:
+        """Mount the persistent compilation-cache volume into the ``tpu``
+        container (spec.compilationCache). Medium hostPath points at the
+        same path on the node, so a whole-group restart landing on the same
+        node deserializes attempt N-1's executables; emptyDir is the
+        no-hostPath fallback (cache lives and dies with the pod). A user
+        template that already defines the volume or mount name wins."""
+        cache = job_spec.compilation_cache
+        if cache is None or not cache.enabled:
+            return
+        volumes = pod_spec.setdefault("volumes", [])
+        if not any(v.get("name") == CACHE_VOLUME_NAME for v in volumes):
+            if cache.medium == CacheMedium.HOSTPATH:
+                source: Dict[str, Any] = {"hostPath": {
+                    "path": cache.path, "type": "DirectoryOrCreate"}}
+            else:
+                source = {"emptyDir": {}}
+            volumes.append({"name": CACHE_VOLUME_NAME, **source})
+        for container in pod_spec.get("containers") or []:
+            if container.get("name") != DEFAULT_CONTAINER_NAME:
+                continue
+            mounts = container.setdefault("volumeMounts", [])
+            if not any(m.get("name") == CACHE_VOLUME_NAME for m in mounts):
+                mounts.append({"name": CACHE_VOLUME_NAME,
+                               "mountPath": cache.path})
 
     @traced
     def create_pod_with_index(self, index: int, attempt: int = 0,
