@@ -1,0 +1,90 @@
+#!/usr/bin/env python3
+"""Contract-analysis gate: run the tpu_operator/analysis rule suite.
+
+Usage:
+    python hack/analyze.py                 # all rules, repo root
+    python hack/analyze.py --rules env-contract,exceptions
+    python hack/analyze.py --root /some/tree --allowlist /dev/null
+    python hack/analyze.py --list-rules
+    python hack/analyze.py -v              # also show suppressed findings
+
+Exit status: 0 when clean; 1 on any unsuppressed finding OR any stale
+allowlist entry (a suppression matching nothing must be deleted — it
+would otherwise hide a future regression of something already fixed).
+
+Run from hack/verify.sh before the test pyramid: these checks are cheaper
+than any test and catch the cross-file drift tests structurally cannot
+(a spec field added to types.py with no schema entry breaks no unit test —
+it breaks users).
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tpu_operator.analysis.driver import RULES, run_analysis  # noqa: E402
+
+
+def main() -> int:
+    p = argparse.ArgumentParser(description=__doc__)
+    p.add_argument("--root", default=REPO,
+                   help="tree to analyze (default: this repo)")
+    p.add_argument("--rules", default="",
+                   help="comma-separated rule ids (default: all)")
+    p.add_argument("--allowlist", default=None,
+                   help="allowlist file (default: "
+                        "<root>/hack/analyze_allowlist.txt)")
+    p.add_argument("--list-rules", action="store_true")
+    p.add_argument("-v", "--verbose", action="store_true",
+                   help="also print allowlist-suppressed findings")
+    args = p.parse_args()
+
+    if args.list_rules:
+        for rule_id, mod in RULES.items():
+            first = (mod.__doc__ or "").strip().splitlines()[0]
+            print(f"{rule_id:16s} {first}")
+        return 0
+
+    rules = [r.strip() for r in args.rules.split(",") if r.strip()] or None
+    allowlist = Path(args.allowlist) if args.allowlist else None
+    try:
+        active, suppressed, stale = run_analysis(
+            Path(args.root), rules=rules, allowlist_path=allowlist)
+    except ValueError as e:
+        print(f"analyze: {e}", file=sys.stderr)
+        return 2
+
+    if args.verbose and suppressed:
+        print(f"analyze: {len(suppressed)} finding(s) suppressed by "
+              f"allowlist:")
+        for f in suppressed:
+            print(f"  [suppressed] {f.render()}")
+    failed = False
+    if active:
+        failed = True
+        print(f"analyze: FAIL — {len(active)} finding(s):")
+        for f in active:
+            print(f"  {f.render()}")
+    if stale:
+        failed = True
+        print(f"analyze: FAIL — {len(stale)} stale allowlist entr"
+              f"{'y' if len(stale) == 1 else 'ies'} (matched nothing; "
+              f"delete them):")
+        for rule, key in sorted(stale):
+            print(f"  {rule}  {key}")
+    if failed:
+        return 1
+    ran = rules or list(RULES)
+    print(f"analyze: OK ({len(ran)} rules, "
+          f"{len(suppressed)} allowlisted finding(s))")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,168 +1,39 @@
 #!/usr/bin/env python3
-"""Image-completeness gate: every import reachable from the shipped images
-must resolve from their pinned requirements.
+"""Image-completeness gate — thin shim over the shared analysis driver.
 
-Reference parity: the reference's image build compiled its one binary with
-all deps installed (build/images/mx_operator/Dockerfile:22-28), so a missing
-dependency failed at *build* time. The Python images have no compile step,
-so a payload module importing something the image never installs (the
-round-1 orbax bug: payload/checkpoint.py imported orbax.checkpoint while the
-Dockerfile installed only jax/flax/optax/pyyaml) only explodes at *job
-startup*. This script closes that hole statically + dynamically:
+The implementation moved to ``tpu_operator/analysis/payload_image.py`` so
+all contract checks share one runner, finding format, and allowlist
+(``python hack/analyze.py`` runs it alongside the other five rules; this
+entry point remains for muscle memory and older scripts)::
 
-1. Static: walk every module shipped in each image, parse its imports with
-   ``ast``, and assert each top-level import is stdlib, in-repo, or covered
-   by that image's requirements.txt.
-2. Dynamic: import every payload module in the dev environment, so a broken
-   module body (not just a missing dep) fails CI.
-
-Run from hack/verify.sh. Exits non-zero with a per-module report on failure.
+    python hack/check_payload_image.py
+    # == python hack/analyze.py --rules payload-image
 """
 
 from __future__ import annotations
 
-import ast
-import importlib
-import pathlib
-import re
+import os
 import sys
 
-REPO = pathlib.Path(__file__).resolve().parent.parent
-PKG = REPO / "tpu_operator"
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
 
-# requirement-name -> import names it provides. Keep in lockstep with
-# build/images/*/requirements.txt.
-REQUIREMENT_PROVIDES = {
-    "jax": {"jax", "jaxlib"},
-    "flax": {"flax"},
-    "optax": {"optax"},
-    "orbax-checkpoint": {"orbax"},
-    "numpy": {"numpy"},
-    "pyyaml": {"yaml"},
-}
+from pathlib import Path  # noqa: E402
 
-# Imports allowed to be missing from the image because the code gates them
-# behind a feature flag AND degrades cleanly (must be justified here).
-OPTIONAL_IMPORTS: dict[str, set[str]] = {
-    # none currently — checkpoint.py's orbax import is mandatory by design:
-    # a checkpointDir job that cannot checkpoint must die loudly at startup,
-    # so orbax ships in the image instead of being optional.
-}
-
-
-def parse_requirements(path: pathlib.Path) -> set[str]:
-    provided: set[str] = set()
-    for line in path.read_text().splitlines():
-        line = line.split("#", 1)[0].strip()
-        if not line:
-            continue
-        name = re.split(r"[\[=<>!~;]", line, 1)[0].strip().lower()
-        provided |= REQUIREMENT_PROVIDES.get(name, {name.replace("-", "_")})
-    return provided
-
-
-def module_imports(path: pathlib.Path) -> set[str]:
-    tree = ast.parse(path.read_text(), filename=str(path))
-    tops: set[str] = set()
-    for node in ast.walk(tree):
-        if isinstance(node, ast.Import):
-            tops |= {alias.name.split(".")[0] for alias in node.names}
-        elif isinstance(node, ast.ImportFrom) and node.level == 0 and node.module:
-            tops.add(node.module.split(".")[0])
-    return tops
-
-
-def check_image(label: str, files: list[pathlib.Path], reqs: pathlib.Path) -> list[str]:
-    provided = parse_requirements(reqs)
-    failures = []
-    for f in sorted(files):
-        rel = f.relative_to(REPO)
-        for top in sorted(module_imports(f)):
-            if top in sys.stdlib_module_names or top == "tpu_operator":
-                continue
-            if top in provided or top in OPTIONAL_IMPORTS.get(str(rel), set()):
-                continue
-            failures.append(
-                f"{label}: {rel} imports '{top}' which {reqs.name} does not install"
-            )
-    return failures
-
-
-def check_pyproject_lockstep() -> list[str]:
-    """The pin list lives in three places (pyproject 'payload' extra + the
-    two image requirements.txt); assert the pyproject extra stays in
-    lockstep with the payload image so `pip install .[payload]` cannot
-    silently diverge from the shipped image."""
-    import tomllib
-
-    with open(REPO / "pyproject.toml", "rb") as f:
-        proj = tomllib.load(f)
-
-    def pins(lines: list[str]) -> dict[str, str]:
-        out = {}
-        for line in lines:
-            line = line.split("#", 1)[0].strip()
-            if not line:
-                continue
-            name = re.split(r"[\[=<>!~;]", line, 1)[0].strip().lower()
-            ver = line.split("==", 1)[1].strip() if "==" in line else ""
-            out[name.replace("-", "_")] = ver
-        return out
-
-    img = pins((REPO / "build/images/tpu_payload/requirements.txt")
-               .read_text().splitlines())
-    extra = pins(proj["project"]["optional-dependencies"]["payload"])
-    failures = []
-    for name, ver in extra.items():
-        if img.get(name) != ver:
-            failures.append(
-                f"pin drift: pyproject payload extra has {name}=={ver} but "
-                f"payload image requirements.txt has {img.get(name, 'nothing')}")
-    for name, ver in img.items():
-        if name not in extra:
-            failures.append(
-                f"pin drift: payload image requirements.txt has {name}=={ver} "
-                f"but the pyproject payload extra omits it")
-    return failures
+from tpu_operator.analysis.driver import run_analysis  # noqa: E402
 
 
 def main() -> int:
-    payload_files = list((PKG / "payload").glob("*.py"))
-    # The operator image ships the whole package but only the control plane
-    # runs in it; payload modules execute in the payload image.
-    operator_files = [
-        f for f in PKG.rglob("*.py") if "payload" not in f.parts
-    ]
-
-    failures = check_image(
-        "payload-image", payload_files,
-        REPO / "build/images/tpu_payload/requirements.txt",
-    )
-    failures += check_image(
-        "operator-image", operator_files,
-        REPO / "build/images/tpu_operator/requirements.txt",
-    )
-    failures += check_pyproject_lockstep()
-
-    # Dynamic tier: the dev env has the payload deps, so a module that cannot
-    # even import (syntax error, bad module-level code, renamed dep) fails
-    # here rather than at job startup.
-    sys.path.insert(0, str(REPO))
-    for f in sorted(payload_files):
-        mod = "tpu_operator.payload." + f.stem if f.stem != "__init__" \
-            else "tpu_operator.payload"
-        try:
-            importlib.import_module(mod)
-        except Exception as exc:  # noqa: BLE001 — report all import failures
-            failures.append(f"import {mod}: {type(exc).__name__}: {exc}")
-
-    if failures:
+    active, _suppressed, stale = run_analysis(
+        Path(REPO), rules=["payload-image"])
+    if active or stale:
         print("check_payload_image: FAIL")
-        for line in failures:
-            print(f"  {line}")
+        for finding in active:
+            print(f"  {finding.render()}")
+        for rule, key in sorted(stale):
+            print(f"  stale allowlist entry (delete it): {rule}  {key}")
         return 1
-    print(f"check_payload_image: OK "
-          f"({len(payload_files)} payload + {len(operator_files)} operator modules)")
+    print("check_payload_image: OK (via tpu_operator/analysis)")
     return 0
 
 

@@ -9,12 +9,24 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-python hack/check_payload_image.py
+# Contract-analysis gate, first and fail-fast: spec-drift across
+# types/schema/defaults/validation/CRD, the env-var contract between
+# trainer/replicas.py and the payload, the heartbeat-key chain, lock
+# discipline (# guarded-by annotations), exception policy, and the
+# payload-image import check (folded in from check_payload_image.py).
+# Cheaper than any test and catches the cross-file drift tests can't.
+python hack/analyze.py
+# Lint gate (pinned in the pyproject `dev` extra). Skipped with a warning
+# when ruff is not installed — the stdlib-only analyzer above always runs.
+if command -v ruff >/dev/null 2>&1; then
+  ruff check tpu_operator/ tests/ hack/ bench.py
+else
+  echo "verify: WARNING — ruff not installed (pip install -e .[dev]); lint skipped"
+fi
 python hack/gen_lock.py --check
-# Manifests-in-sync gate: examples/crd.yml and the Helm chart CRD are
-# GENERATED from tpu_operator/apis/tpujob/v1alpha1/schema.py; any schema
-# edit must ship the regenerated YAML (and repackaged chart) or CI fails.
-python hack/gen_crd.py --check
+# Manifests-in-sync: the CRD-YAML drift check (`gen_crd.py --check`) is
+# owned by the analyzer's spec-drift rule above — not repeated here; the
+# chart package check has no analyzer home yet.
 python hack/package_chart.py --check
 # Standalone observability gate: every /metrics line must parse as valid
 # Prometheus exposition format (HELP/TYPE, label escaping, bucket

@@ -1,0 +1,573 @@
+"""Static-analysis suite tests: each rule against a fixture tree with a
+seeded violation (exact file:line findings asserted), a clean run on the
+real tree, the add-a-spec-field drift demo, and regression tests for the
+defects the analyzers surfaced in this repo (dead env vars, the silently
+swallowed event-aggregation failure, clientset RPCs under the recorder
+lock)."""
+
+import logging
+import textwrap
+import threading
+import types as _types
+from pathlib import Path
+
+import pytest
+
+from tpu_operator.analysis import concurrency, env_contract, \
+    exception_policy, payload_image, spec_drift, status_contract
+from tpu_operator.analysis.driver import RULES, run_analysis
+
+REPO = Path(__file__).resolve().parent.parent
+
+
+def write(root: Path, relpath: str, body: str) -> Path:
+    path = root / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+def keyed(findings):
+    return {f.key: f for f in findings}
+
+
+# --- fixture trees: one seeded violation per rule ----------------------------
+
+def test_spec_drift_fixture(tmp_path):
+    write(tmp_path, spec_drift.TYPES, """\
+        class TPUJobSpec:
+            @classmethod
+            def from_dict(cls, d):
+                return cls(
+                    old_field=d.get("oldField"),
+                    new_field=d.get("newField"),
+                )
+        """)
+    write(tmp_path, spec_drift.SCHEMA, """\
+        def _obj(properties, required=()):
+            return {"type": "object", "properties": properties}
+
+
+        def spec_schema():
+            return _obj({
+                "oldField": {"type": "string"},
+                "ghostField": {"type": "string"},
+            })
+        """)
+    write(tmp_path, spec_drift.DEFAULTS, "# handles old_field only\n")
+    write(tmp_path, spec_drift.VALIDATION, "# checks old_field only\n")
+    found = keyed(spec_drift.run(tmp_path))
+    # newField: parsed by from_dict, missing from schema AND both handlers
+    assert found["schema:newField"].line == 6
+    assert found["schema:newField"].path == spec_drift.TYPES
+    assert "defaults:newField" in found
+    assert "validation:newField" in found
+    # ghostField: schema property with no wire key behind it
+    assert found["types:ghostField"].path == spec_drift.SCHEMA
+    assert found["types:ghostField"].line == 8
+    # oldField is fully covered — no findings about it
+    assert not any(k.endswith(":oldField") for k in found)
+
+
+def test_spec_drift_catches_field_added_to_real_types(tmp_path):
+    """Acceptance demo: adding a field to the REAL types.py without touching
+    schema/defaults/validation reproducibly fails the spec-drift rule."""
+    for relpath in (spec_drift.TYPES, spec_drift.SCHEMA,
+                    spec_drift.DEFAULTS, spec_drift.VALIDATION):
+        dst = tmp_path / relpath
+        dst.parent.mkdir(parents=True, exist_ok=True)
+        dst.write_text((REPO / relpath).read_text())
+    # Faithful copy: only the repo's standing (allowlisted) findings.
+    before = set(keyed(spec_drift.run(tmp_path)))
+    assert not any("shinyNewField" in k for k in before)
+
+    types_path = tmp_path / spec_drift.TYPES
+    src = types_path.read_text()
+    marker = "            suspend=bool(d.get(\"suspend\", False)),"
+    assert marker in src
+    types_path.write_text(src.replace(
+        marker, marker + "\n            shiny=bool(d.get(\"shinyNewField\", False)),"))
+    found = set(keyed(spec_drift.run(tmp_path)))
+    assert found - before == {"schema:shinyNewField",
+                              "defaults:shinyNewField",
+                              "validation:shinyNewField"}
+
+
+def test_env_contract_fixture(tmp_path):
+    write(tmp_path, env_contract.INJECTOR, """\
+        def build_replica_env():
+            env = {
+                "TPUJOB_DEAD": "1",
+                "TPUJOB_USED": "1",
+            }
+            env["TPUJOB_SUBSCRIPTED"] = "x"
+            return env
+        """)
+    write(tmp_path, "tpu_operator/payload/consumer.py", """\
+        import os
+
+
+        def read():
+            return (os.environ.get("TPUJOB_USED"),
+                    os.environ.get("TPUJOB_SUBSCRIPTED"),
+                    os.environ.get("TPUJOB_ORPHAN_READ"))
+        """)
+    found = keyed(env_contract.run(tmp_path))
+    dead = found["injected-unread:TPUJOB_DEAD"]
+    assert (dead.path, dead.line) == (env_contract.INJECTOR, 3)
+    orphan = found["read-uninjected:TPUJOB_ORPHAN_READ"]
+    assert (orphan.path, orphan.line) == \
+        ("tpu_operator/payload/consumer.py", 7)
+    assert len(found) == 2  # the used/subscripted vars are clean
+
+
+def test_env_contract_docstring_mention_is_not_a_read(tmp_path):
+    write(tmp_path, env_contract.INJECTOR, """\
+        def build_replica_env():
+            env = {"TPUJOB_ONLY_IN_DOCSTRING": "1"}
+            return env
+        """)
+    write(tmp_path, "tpu_operator/payload/consumer.py", '''\
+        """This module documents TPUJOB_ONLY_IN_DOCSTRING but never reads it."""
+        ''')
+    found = keyed(env_contract.run(tmp_path))
+    assert "injected-unread:TPUJOB_ONLY_IN_DOCSTRING" in found
+
+
+def test_status_contract_fixture(tmp_path):
+    write(tmp_path, status_contract.HEARTBEAT, """\
+        def report():
+            body = {
+                "namespace": "x",
+                "name": "y",
+                "step": 1,
+                "mystery": 2,
+            }
+            return body
+        """)
+    write(tmp_path, status_contract.STATUSSERVER, """\
+        def record_heartbeat(body):
+            hb = {"time": "t"}
+            hb["step"] = body.get("step")
+            hb["ghost"] = 1
+            return hb
+        """)
+    write(tmp_path, status_contract.SCHEMA, """\
+        def _obj(properties):
+            return {"type": "object", "properties": properties}
+
+
+        def status_schema():
+            return _obj({
+                "lastHeartbeat": _obj({
+                    "step": {"type": "integer"},
+                    "time": {"type": "string"},
+                }),
+            })
+        """)
+    found = keyed(status_contract.run(tmp_path))
+    mystery = found["posted-unsanitized:mystery"]
+    assert (mystery.path, mystery.line) == (status_contract.HEARTBEAT, 6)
+    ghost = found["sanitized-unschema:ghost"]
+    assert (ghost.path, ghost.line) == (status_contract.STATUSSERVER, 4)
+    # namespace/name are the routing envelope, step/time are clean
+    assert len(found) == 2
+
+
+def test_status_contract_metric_hygiene_fixture(tmp_path):
+    write(tmp_path, status_contract.STATUSSERVER, """\
+        class Metrics:
+            def __init__(self):
+                self.register("documented_total", "counter", "h")
+                self.register("mystery_total", "counter", "h")
+
+
+        class User:
+            def tick(self):
+                self.metrics.inc("typo_total")
+        """)
+    write(tmp_path, "docs/design.md", "only documented_total is here\n")
+    write(tmp_path, "tests/test_x.py", "covers documented_total\n")
+    found = keyed(status_contract.run(tmp_path))
+    assert found["metric-undocumented:mystery_total"].line == 4
+    assert "metric-untested:mystery_total" in found
+    unreg = found["metric-unregistered:typo_total"]
+    assert (unreg.path, unreg.line) == (status_contract.STATUSSERVER, 9)
+    assert "metric-undocumented:documented_total" not in found
+
+
+def test_concurrency_guarded_by_fixture(tmp_path):
+    write(tmp_path, "tpu_operator/client/box.py", """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._items = {}  # guarded-by: _lock
+
+            def good(self):
+                with self._lock:
+                    return len(self._items)
+
+            def good_locked(self):
+                return self._items.get("y")
+
+            def bad(self):
+                return self._items.get("x")
+        """)
+    found = keyed(concurrency.run(tmp_path))
+    bad = found["guarded-by:tpu_operator/client/box.py:Box._items:bad"]
+    assert bad.line == 17
+    assert len(found) == 1  # with-block and *_locked accesses are clean
+
+
+def test_concurrency_thread_and_blocking_fixtures(tmp_path):
+    write(tmp_path, "tpu_operator/controller/runner.py", """\
+        import threading
+
+
+        def spawn():
+            t = threading.Thread(target=print)
+            t.start()
+            return t
+        """)
+    write(tmp_path, "tpu_operator/controller/locky.py", """\
+        import threading
+        import time
+
+        LOCK = threading.Lock()
+
+
+        def hold():
+            with LOCK:
+                time.sleep(1)
+        """)
+    found = keyed(concurrency.run(tmp_path))
+    thread = found["thread:tpu_operator/controller/runner.py:spawn"]
+    assert thread.line == 5
+    blocking = found[
+        "lock-blocking:tpu_operator/controller/locky.py:hold:time.sleep"]
+    assert blocking.line == 9
+    assert len(found) == 2
+
+
+def test_concurrency_annotation_on_continuation_line(tmp_path):
+    """A guarded-by comment on a wrapped assignment's continuation line
+    (the events.py _seen shape) must register — notes are matched against
+    the statement's full lineno..end_lineno range."""
+    write(tmp_path, "tpu_operator/client/wrapped.py", """\
+        import threading
+
+
+        class Box:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self._seen = dict(
+                    a=1)  # guarded-by: _lock
+
+            def bad(self):
+                return self._seen.get("x")
+        """)
+    found = keyed(concurrency.run(tmp_path))
+    assert "guarded-by:tpu_operator/client/wrapped.py:Box._seen:bad" in found
+
+
+def test_concurrency_join_noise_does_not_mask_unjoined_thread(tmp_path):
+    """str.join / os.path.join elsewhere in the file must not satisfy the
+    thread-join check — only a .join() on the thread's own binding does."""
+    write(tmp_path, "tpu_operator/controller/noisy.py", """\
+        import os
+        import threading
+
+
+        def leak():
+            path = os.path.join("a", ",".join(["b", "c"]))
+            t = threading.Thread(target=print, args=(path,))
+            t.start()
+            return t
+        """)
+    found = keyed(concurrency.run(tmp_path))
+    assert "thread:tpu_operator/controller/noisy.py:leak" in found
+
+
+def test_concurrency_daemon_and_joined_threads_are_clean(tmp_path):
+    write(tmp_path, "tpu_operator/controller/ok.py", """\
+        import threading
+
+
+        def spawn_daemon():
+            threading.Thread(target=print, daemon=True).start()
+
+
+        def spawn_joined():
+            t = threading.Thread(target=print)
+            t.start()
+            t.join()
+        """)
+    assert concurrency.run(tmp_path) == []
+
+
+def test_exception_policy_fixture(tmp_path):
+    write(tmp_path, "tpu_operator/controller/recon.py", """\
+        import logging
+
+        log = logging.getLogger(__name__)
+
+
+        def silent():
+            try:
+                work()
+            except ValueError:
+                pass
+
+
+        def broad():
+            try:
+                work()
+            except Exception:
+                x = 1
+            return x
+
+
+        def bare():
+            try:
+                work()
+            except:
+                log.warning("caught")
+
+
+        def fine():
+            try:
+                work()
+            except Exception as e:
+                log.warning("boom: %s", e)
+
+
+        def literal_exit():
+            raise SystemExit(143)
+        """)
+    found = keyed(exception_policy.run(tmp_path))
+    path = "tpu_operator/controller/recon.py"
+    assert found[f"silent-except:{path}:silent"].line == 9
+    assert found[f"broad-except:{path}:broad"].line == 16
+    assert found[f"bare-except:{path}:bare"].line == 24
+    assert found[f"exit-code:{path}:literal_exit"].line == 36
+    assert not any(":fine" in k for k in found)
+    assert len(found) == 4
+
+
+def test_payload_image_fixture(tmp_path):
+    write(tmp_path, "tpu_operator/payload/mod.py", """\
+        import os
+        import missingdep
+        """)
+    write(tmp_path, "build/images/tpu_payload/requirements.txt",
+          "numpy==2.0.2\n")
+    write(tmp_path, "pyproject.toml", """\
+        [project.optional-dependencies]
+        payload = [
+            "numpy==1.0.0",
+        ]
+        """)
+    found = keyed(payload_image.run(tmp_path))
+    imp = found["import:tpu_operator/payload/mod.py:missingdep"]
+    assert imp.line == 2
+    assert "pin-drift:numpy" in found  # 1.0.0 extra vs 2.0.2 image
+
+
+# --- the real tree is clean --------------------------------------------------
+
+def test_real_tree_is_clean_under_allowlist():
+    active, suppressed, stale = run_analysis(REPO)
+    assert active == [], "\n".join(f.render() for f in active)
+    assert stale == set(), f"stale allowlist entries: {stale}"
+    # the allowlist is genuinely load-bearing, not decorative
+    assert suppressed, "expected at least one allowlisted finding"
+
+
+def test_cli_exit_codes_and_finding_format(tmp_path):
+    """hack/analyze.py exits nonzero with file:line findings on a seeded
+    violation tree and 0 on an empty-but-valid one."""
+    import subprocess
+    import sys
+
+    write(tmp_path, "tpu_operator/controller/recon.py", """\
+        def reconcile():
+            try:
+                work()
+            except ValueError:
+                pass
+        """)
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "hack/analyze.py"),
+         "--root", str(tmp_path), "--allowlist", "/dev/null"],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "tpu_operator/controller/recon.py:4: [exceptions]" in proc.stdout
+
+    # the same tree with the violation allowlisted (and the entry in use)
+    allow = tmp_path / "allow.txt"
+    allow.write_text("exceptions  silent-except:tpu_operator/controller/"
+                     "recon.py:reconcile  # test\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "hack/analyze.py"),
+         "--root", str(tmp_path), "--allowlist", str(allow)],
+        capture_output=True, text=True)
+    assert proc.returncode == 0, proc.stdout
+
+    # a stale allowlist entry alone fails the gate
+    allow.write_text("exceptions  silent-except:nowhere.py:gone  # stale\n"
+                     "exceptions  silent-except:tpu_operator/controller/"
+                     "recon.py:reconcile  # test\n")
+    proc = subprocess.run(
+        [sys.executable, str(REPO / "hack/analyze.py"),
+         "--root", str(tmp_path), "--allowlist", str(allow)],
+        capture_output=True, text=True)
+    assert proc.returncode == 1
+    assert "stale" in proc.stdout
+
+
+def test_driver_rejects_unknown_rule():
+    with pytest.raises(ValueError):
+        run_analysis(REPO, rules=["no-such-rule"])
+
+
+def test_every_rule_registered():
+    assert set(RULES) == {"spec-drift", "env-contract", "status-contract",
+                          "concurrency", "exceptions", "payload-image"}
+
+
+# --- regression tests for the defects the analyzers surfaced -----------------
+
+def test_env_contract_no_dead_coordinator_port():
+    """JAX_COORDINATOR_PORT was injected for five PRs and read by nothing;
+    the port rides inside JAX_COORDINATOR_ADDRESS."""
+    from tpu_operator.apis.tpujob.v1alpha1.types import TPUJobSpec
+    from tpu_operator.trainer.replicas import build_replica_env
+
+    spec = TPUJobSpec.from_dict({"replicaSpecs": [{
+        "replicas": 2, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+        "template": {"spec": {"containers": [{"name": "tpu"}]}}}]})
+    env = build_replica_env("job", "rid", spec, "WORKER", 0)
+    assert "JAX_COORDINATOR_PORT" not in env
+    assert env["JAX_COORDINATOR_ADDRESS"].endswith(":8476")
+
+
+def test_process_info_carries_operator_identity():
+    """TPUJOB_RUNTIME_ID / TPUJOB_REPLICA_INDEX were injected-but-unread;
+    ProcessInfo now surfaces them for log/artifact correlation."""
+    from tpu_operator.payload.bootstrap import process_info_from_env
+
+    info = process_info_from_env({
+        "JAX_COORDINATOR_ADDRESS": "c:1", "JAX_PROCESS_ID": "1",
+        "JAX_NUM_PROCESSES": "2", "TPUJOB_RUNTIME_ID": "ab12",
+        "TPUJOB_REPLICA_INDEX": "1",
+    })
+    assert info.runtime_id == "ab12"
+    assert info.replica_index == 1
+
+
+def test_cache_path_mirror_is_honored(tmp_path):
+    """TPUJOB_CACHE_PATH was an injected-but-unread mirror; the bootstrap
+    now falls back to it when the ambient JAX var is stripped."""
+    import jax
+
+    from tpu_operator.payload import bootstrap, startup as startup_mod
+
+    prev = jax.config.jax_compilation_cache_dir
+    try:
+        got = bootstrap.enable_compilation_cache(
+            {"TPUJOB_CACHE_PATH": str(tmp_path)})
+        assert got == str(tmp_path)
+        assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+        # explicit JAX var still wins over the mirror
+        other = tmp_path / "other"
+        got = bootstrap.enable_compilation_cache(
+            {"JAX_COMPILATION_CACHE_DIR": str(other),
+             "TPUJOB_CACHE_PATH": str(tmp_path)})
+        assert got == str(other)
+    finally:
+        jax.config.update("jax_compilation_cache_dir", prev)
+        startup_mod.set_cache_dir("")
+
+
+class _RecorderClientset:
+    """Stub clientset that records calls and asserts the recorder's dedup
+    lock is NOT held during any RPC (the lock-blocking fix)."""
+
+    class _Events:
+        def __init__(self, outer):
+            self.outer = outer
+            self.fail_update = False
+            self.created = []
+            self.updated = []
+
+        def _assert_unlocked(self):
+            assert self.outer.lock.acquire(blocking=False), \
+                "clientset RPC issued while the recorder lock is held"
+            self.outer.lock.release()
+
+        def get(self, namespace, name):
+            self._assert_unlocked()
+            from tpu_operator.client import errors
+            if self.fail_update:
+                raise errors.ApiError(409, "Conflict", "conflict")
+            return {"metadata": {"name": name, "namespace": namespace},
+                    "count": 1}
+
+        def update(self, namespace, ev):
+            self._assert_unlocked()
+            self.updated.append(ev)
+            return ev
+
+        def create(self, namespace, ev):
+            self._assert_unlocked()
+            self.created.append(ev)
+            return ev
+
+    def __init__(self, lock):
+        self.lock = lock
+        self.events = self._Events(self)
+
+
+def _job_obj(name="j1"):
+    return _types.SimpleNamespace(
+        name=name, namespace="default",
+        metadata={"uid": "u1", "apiVersion": "tpuoperator.dev/v1alpha1"})
+
+
+def test_event_recording_rpcs_run_outside_the_dedup_lock():
+    from tpu_operator.controller.events import EventRecorder
+
+    recorder = EventRecorder.__new__(EventRecorder)
+    lock = threading.Lock()
+    cs = _RecorderClientset(lock)
+    recorder.__init__(cs)
+    recorder._lock = lock  # the stub asserts against this exact lock
+    job = _job_obj()
+    recorder.event(job, "Normal", "Tick", "msg")       # create path
+    recorder.event(job, "Normal", "Tick", "msg")       # aggregation path
+    assert len(cs.events.created) == 1
+    assert len(cs.events.updated) == 1
+
+
+def test_event_aggregation_failure_logs_and_falls_back(caplog):
+    """The aggregation-update ApiError used to be swallowed with a bare
+    ``pass``; it must log and still create a fresh event."""
+    from tpu_operator.controller.events import EventRecorder
+
+    lock = threading.Lock()
+    cs = _RecorderClientset(lock)
+    recorder = EventRecorder.__new__(EventRecorder)
+    recorder.__init__(cs)
+    recorder._lock = lock
+    job = _job_obj()
+    recorder.event(job, "Normal", "Tick", "msg")
+    cs.events.fail_update = True
+    with caplog.at_level(logging.DEBUG,
+                         logger="tpu_operator.controller.events"):
+        recorder.event(job, "Normal", "Tick", "msg")
+    assert len(cs.events.created) == 2, \
+        "aggregation failure must fall back to a fresh create"
+    assert any("aggregation" in r.message for r in caplog.records)

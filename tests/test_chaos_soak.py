@@ -21,7 +21,6 @@ import random
 import threading
 import time
 
-import pytest
 
 from tpu_operator.client.errors import ApiError
 from tpu_operator.client.informer import SharedInformerFactory

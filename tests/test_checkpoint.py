@@ -107,7 +107,6 @@ def test_resume_fast_forwards_data_stream(tmp_path):
     consumed = []
 
     def counting_stream():
-        import itertools
         for i, b in enumerate(tiny_build()[1][4]):
             consumed.append(i)
             yield b

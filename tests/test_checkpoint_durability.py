@@ -16,7 +16,6 @@ import json
 import os
 import shutil
 
-import numpy as np
 import pytest
 
 import jax.numpy as jnp

@@ -12,7 +12,7 @@ import numpy as np
 import pytest
 
 from tpu_operator.payload import transformer
-from tpu_operator.payload import data as data_mod, train
+from tpu_operator.payload import data as data_mod
 
 
 def _argv(extra=()):

@@ -235,8 +235,13 @@ def test_full_metrics_surface_is_conformant():
                          f"{p}workqueue_queue_duration_seconds",
                          f"{p}workqueue_work_duration_seconds",
                          f"{p}job_time_to_running_seconds",
+                         f"{p}job_time_to_scheduled_seconds",
                          f"{p}job_runtime_seconds",
                          f"{p}reconcile_total",
+                         f"{p}reconcile_errors_total",
+                         f"{p}gc_deleted_total",
+                         f"{p}api_requests_total",
+                         f"{p}leader_elections_won_total",
                          f"{p}workqueue_adds_total",
                          f"{p}workqueue_depth",
                          f"{p}workqueue_unfinished_work_seconds",
@@ -244,6 +249,22 @@ def test_full_metrics_surface_is_conformant():
                          f"{p}jobs"):
             assert required in families, f"missing family {required}"
             assert families[required]["samples"], f"empty family {required}"
+        # the heartbeat posted above carries step time / throughput / loss —
+        # each must surface as its per-job gauge, job-labeled
+        for gauge, value in ((f"{p}job_step_time_seconds", 0.25),
+                             (f"{p}job_tokens_per_second", 1024.5),
+                             (f"{p}job_loss", 2.5)):
+            assert families[gauge]["samples"] == [
+                (gauge, {"namespace": "default", "name": "conf"}, value)
+            ], f"heartbeat gauge {gauge} missing or wrong"
+        # set_controller above won the (fake) election; the controller's
+        # clientset ledger ticked real API requests during the reconcile
+        won = [v for _n, _l, v
+               in families[f"{p}leader_elections_won_total"]["samples"]]
+        assert won and won[0] >= 1
+        api = sum(v for _n, _l, v
+                  in families[f"{p}api_requests_total"]["samples"])
+        assert api >= 1
         for fam, expected_type in (
                 (f"{p}reconcile_duration_seconds", "histogram"),
                 (f"{p}workqueue_queue_duration_seconds", "histogram"),

@@ -8,7 +8,6 @@ curve, not a subtly different optimizer.
 """
 
 import numpy as np
-import pytest
 
 import jax
 import jax.numpy as jnp

@@ -16,7 +16,6 @@ step function would silently reuse the old path.
 import os
 
 import numpy as np
-import pytest
 
 import jax
 from jax.sharding import PartitionSpec as P

@@ -10,11 +10,10 @@ import numpy as np
 import pytest
 
 import jax
-import jax.numpy as jnp
 
 from tpu_operator.payload import bootstrap
 from tpu_operator.payload import data as data_mod
-from tpu_operator.payload import models, train
+from tpu_operator.payload import train
 
 
 @pytest.fixture(scope="module")
@@ -246,8 +245,6 @@ def test_npz_classification_validates_eagerly(tmp_path):
 
 
 def test_device_prefetch_preserves_order_and_bounds_lookahead():
-    import itertools
-
     import jax
 
     from tpu_operator.payload import data as data_mod, train

@@ -24,7 +24,6 @@ import sys
 import time
 
 import numpy as np
-import pytest
 
 REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
 WORKER = os.path.join(REPO, "tests", "realdata_worker.py")

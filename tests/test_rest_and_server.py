@@ -8,7 +8,6 @@ import time
 
 import pytest
 
-from tpu_operator.apis.tpujob.v1alpha1 import types as t
 from tpu_operator.client import errors
 from tpu_operator.client.rest import Clientset, RestConfig
 from tpu_operator.controller.chaos import ChaosMonkey

@@ -1,0 +1,32 @@
+"""Static-analysis suite for the operator's hand-maintained contracts.
+
+The reference tf-operator kept its API artifacts consistent with ~1,770 LoC
+of generated client plumbing (SURVEY.md §0); this reproduction hand-edits
+five artifacts per spec field plus two runtime contracts (env injection and
+the heartbeat body). Kubernetes-operator practice says those contracts
+should be machine-checked, not reviewer-checked — this package is that
+machine check, stdlib-only so it runs anywhere the control plane does.
+
+Rules (each a module exporting ``run(root) -> List[Finding]``):
+
+- ``spec_drift``       — types.py ⊆ schema.py/defaults.py/validation.py
+                         and the generated CRDs are byte-identical.
+- ``env_contract``     — injected env vars are read by the payload and
+                         payload env reads are injected (or allowlisted).
+- ``status_contract``  — heartbeat keys posted ⊆ sanitized ⊆ status schema;
+                         metric names are documented and tested.
+- ``concurrency``      — ``# guarded-by:`` lock annotations, threads that
+                         are never joined, blocking calls under a lock.
+- ``exception_policy`` — no broad/silent excepts on controller paths;
+                         retryable exit codes only via named constants.
+- ``payload_image``    — every import shipped in an image resolves from its
+                         pinned requirements (folded in from the former
+                         hack/check_payload_image.py).
+
+``driver.run_analysis`` runs them all against one root with one allowlist
+(hack/analyze_allowlist.txt); ``hack/analyze.py`` is the CLI, gated in
+hack/verify.sh.
+"""
+
+from tpu_operator.analysis.base import Allowlist, Finding  # noqa: F401
+from tpu_operator.analysis.driver import RULES, run_analysis  # noqa: F401

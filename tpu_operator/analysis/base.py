@@ -1,0 +1,198 @@
+"""Shared plumbing for the analysis rules: findings, allowlists, AST helpers.
+
+Every rule reports :class:`Finding` objects carrying a stable ``rule`` id
+and a stable ``key`` (what the finding is *about*, independent of line
+numbers), so allowlist entries survive unrelated edits. The allowlist file
+format is one suppression per line::
+
+    <rule-id>  <key>        # justification (required by convention)
+
+Rules operate on a *root directory* (parsed with ``ast``, never imported),
+which is what lets the self-tests run each rule against fixture trees with
+seeded violations.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from dataclasses import dataclass, field
+from pathlib import Path
+from typing import Dict, Iterable, List, Optional, Set, Tuple
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One analyzer hit: ``path:line: [rule] message`` with a stable key."""
+
+    rule: str
+    path: str       # repo-relative, forward slashes
+    line: int
+    message: str
+    key: str        # stable allowlist handle (no line numbers)
+
+    def render(self) -> str:
+        return f"{self.path}:{self.line}: [{self.rule}] {self.message} " \
+               f"(key: {self.key})"
+
+
+@dataclass
+class Allowlist:
+    """Per-rule suppression set parsed from hack/analyze_allowlist.txt."""
+
+    entries: Set[Tuple[str, str]] = field(default_factory=set)
+
+    @classmethod
+    def load(cls, path: Path) -> "Allowlist":
+        entries: Set[Tuple[str, str]] = set()
+        if path.is_file():
+            for raw in path.read_text(encoding="utf-8").splitlines():
+                line = raw.split("#", 1)[0].strip()
+                if not line:
+                    continue
+                parts = line.split(None, 1)
+                if len(parts) == 2:
+                    entries.add((parts[0], parts[1].strip()))
+        return cls(entries)
+
+    def allows(self, finding: Finding) -> bool:
+        return (finding.rule, finding.key) in self.entries
+
+    def unused(self, findings: Iterable[Finding]) -> Set[Tuple[str, str]]:
+        hit = {(f.rule, f.key) for f in findings}
+        return {e for e in self.entries if e not in hit}
+
+
+# --- source / AST helpers ----------------------------------------------------
+
+ENV_NAME_RE = re.compile(r"^(TPUJOB|JAX|TPU|MEGASCALE|DMLC)[A-Z0-9]*_[A-Z0-9_]+$")
+
+
+def rel(root: Path, path: Path) -> str:
+    return path.relative_to(root).as_posix()
+
+
+def parse_file(path: Path) -> Optional[ast.Module]:
+    try:
+        return ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
+    except (OSError, SyntaxError):
+        return None
+
+
+def iter_py_files(root: Path, *parts: str) -> List[Path]:
+    """All .py files under ``root/parts...`` (a file path is returned
+    as-is), sorted for deterministic findings."""
+    base = root.joinpath(*parts)
+    if base.is_file():
+        return [base]
+    if not base.is_dir():
+        return []
+    return sorted(p for p in base.rglob("*.py") if "__pycache__" not in p.parts)
+
+
+def attach_parents(tree: ast.AST) -> None:
+    """Annotate every node with ``.parent`` (rules walk ancestor chains)."""
+    for node in ast.walk(tree):
+        for child in ast.iter_child_nodes(node):
+            child.parent = node  # type: ignore[attr-defined]
+
+
+def ancestors(node: ast.AST) -> Iterable[ast.AST]:
+    cur = getattr(node, "parent", None)
+    while cur is not None:
+        yield cur
+        cur = getattr(cur, "parent", None)
+
+
+def enclosing_function(node: ast.AST) -> Optional[ast.AST]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc
+    return None
+
+
+def self_attr(node: ast.AST) -> Optional[str]:
+    """``self.X`` → ``"X"``, else None."""
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def dotted_name(node: ast.AST) -> str:
+    """Best-effort dotted rendering of an expression (``self.clientset.pods``
+    → ``"self.clientset.pods"``); unknown parts render as ``?``."""
+    if isinstance(node, ast.Attribute):
+        return f"{dotted_name(node.value)}.{node.attr}"
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Call):
+        return dotted_name(node.func) + "()"
+    return "?"
+
+
+def str_const(node: ast.AST) -> Optional[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return node.value
+    return None
+
+
+def module_str_constants(tree: ast.Module) -> Dict[str, str]:
+    """Module-level ``NAME = "literal"`` assignments (used to resolve
+    ``e.get(ENV_VAR)``-style indirection)."""
+    consts: Dict[str, str] = {}
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name):
+            value = str_const(stmt.value)
+            if value is not None:
+                consts[stmt.targets[0].id] = value
+    return consts
+
+
+def comment_annotations(path: Path, tag: str) -> Dict[int, str]:
+    """Map line number → value for ``# <tag>: <value>`` trailing comments
+    (ast drops comments, so annotations come from the token stream)."""
+    # Matches anywhere in a comment token so the tag can share a line with
+    # prose ("# heap of (...); guarded-by: _cond").
+    pattern = re.compile(rf"{re.escape(tag)}:\s*(\S+)")
+    out: Dict[int, str] = {}
+    try:
+        tokens = tokenize.generate_tokens(
+            io.StringIO(path.read_text(encoding="utf-8")).readline)
+        for tok in tokens:
+            if tok.type == tokenize.COMMENT:
+                m = pattern.search(tok.string)
+                if m:
+                    out[tok.start[0]] = m.group(1)
+    except (OSError, tokenize.TokenError, SyntaxError):
+        pass
+    return out
+
+
+def non_docstring_strings(tree: ast.Module) -> List[Tuple[str, int]]:
+    """Every string constant with its line, excluding doc-position strings
+    (an env var named in a docstring is documentation, not a read)."""
+    doc_nodes: Set[int] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.Module, ast.ClassDef, ast.FunctionDef,
+                             ast.AsyncFunctionDef)):
+            body = node.body
+            if body and isinstance(body[0], ast.Expr) \
+                    and str_const(body[0].value) is not None:
+                doc_nodes.add(id(body[0].value))
+    out: List[Tuple[str, int]] = []
+    for node in ast.walk(tree):
+        if id(node) in doc_nodes:
+            continue
+        value = str_const(node)
+        if value is not None:
+            out.append((value, node.lineno))
+    return out
+
+
+def camel_to_snake(name: str) -> str:
+    return re.sub(r"(?<!^)(?=[A-Z])", "_", name).lower()

@@ -1,0 +1,250 @@
+"""Rule ``concurrency``: lock discipline in the threaded control plane.
+
+Three checks over the informer/workqueue/controller/checkpoint layer (the
+code that actually runs multi-threaded: reflector threads, reconcile
+workers, HTTP handler threads, the checkpoint verify worker):
+
+1. **guarded-by annotations.** A shared mutable attribute declares its lock
+   at its ``__init__`` assignment::
+
+       self._items: Dict[str, Any] = {}  # guarded-by: _lock
+
+   Every other access to ``self._items`` inside the class must then sit
+   lexically inside ``with self._lock:`` — or in a method whose name ends
+   in ``_locked`` (the existing call-with-lock-held convention). This is
+   Java's @GuardedBy, AST-flavored: annotations are cheap to write and the
+   checker catches the access someone adds in review without the lock.
+
+2. **Threads started but never joined.** A ``threading.Thread`` that is
+   neither ``daemon=True`` nor ``.join()``-ed in the same file leaks a
+   non-daemon thread that can hang interpreter shutdown.
+
+3. **Blocking calls under a lock.** Inside a ``with <lock>:`` block
+   (anything lock/cond-shaped), calls to ``time.sleep``/``sleep``,
+   ``subprocess.*``, ``socket.*``, ``urlopen``, or a clientset RPC
+   (``*.clientset.*``) are flagged — they serialize every other thread on
+   the lock behind I/O. Calls on the lock object itself (``cond.wait``)
+   are exempt: they release it.
+
+Keys: ``guarded-by:<file>:<Class>.<attr>:<method>``,
+``thread:<file>:<func>``, ``lock-blocking:<file>:<func>:<callee>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional
+
+from tpu_operator.analysis.base import Finding, ancestors, attach_parents, \
+    comment_annotations, dotted_name, iter_py_files, parse_file, rel, \
+    self_attr
+
+RULE = "concurrency"
+
+# The threaded control-plane surface this rule watches.
+SCAN = (
+    ("tpu_operator", "client"),
+    ("tpu_operator", "controller"),
+    ("tpu_operator", "trainer"),
+    ("tpu_operator", "payload", "checkpoint.py"),
+    ("tpu_operator", "payload", "train.py"),
+)
+
+_BLOCKING_ATTRS = {"sleep", "_sleep", "urlopen", "getaddrinfo",
+                   "create_connection", "check_call", "check_output"}
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.")
+
+
+def _lockish(expr: ast.AST) -> Optional[str]:
+    """Dotted name of a with-item that looks like a lock acquisition."""
+    name = dotted_name(expr)
+    leaf = name.rsplit(".", 1)[-1].lower()
+    if "lock" in leaf or "cond" in leaf or "mutex" in leaf:
+        return name
+    return None
+
+
+def _enclosing_with_locks(node: ast.AST) -> List[str]:
+    """Dotted names of every lock-shaped ``with`` the node sits inside."""
+    locks: List[str] = []
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                lock = _lockish(item.context_expr)
+                if lock:
+                    locks.append(lock)
+    return locks
+
+
+def _method_of(node: ast.AST) -> Optional[ast.FunctionDef]:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc  # nearest function
+    return None
+
+
+def _check_guarded(tree: ast.Module, path_rel: str,
+                   notes: Dict[int, str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next((m for m in cls.body if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            continue
+        guarded: Dict[str, str] = {}
+        for stmt in ast.walk(init):
+            target = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+            elif isinstance(stmt, ast.AnnAssign):
+                target = stmt.target
+            attr = self_attr(target) if target is not None else None
+            # A multi-line assignment can carry the annotation on any of
+            # its physical lines (black-wrapped dict literals put the
+            # comment on the continuation line).
+            lock = None
+            if hasattr(stmt, "lineno"):
+                end = getattr(stmt, "end_lineno", None) or stmt.lineno
+                for line in range(stmt.lineno, end + 1):
+                    lock = notes.get(line)
+                    if lock:
+                        break
+            if attr and lock:
+                guarded[attr] = lock.removeprefix("self.")
+        if not guarded:
+            continue
+        for method in cls.body:
+            if not isinstance(method, ast.FunctionDef) \
+                    or method.name == "__init__" \
+                    or method.name.endswith("_locked"):
+                continue
+            for node in ast.walk(method):
+                attr = self_attr(node)
+                if attr is None or attr not in guarded:
+                    continue
+                # Only accesses from *this* method frame count; a nested
+                # function/class (HTTP handler closures) has its own rules.
+                if _method_of(node) is not method:
+                    continue
+                lock = guarded[attr]
+                held = {h.removeprefix("self.")
+                        for h in _enclosing_with_locks(node)}
+                if lock not in held:
+                    findings.append(Finding(
+                        RULE, path_rel, node.lineno,
+                        f"{cls.name}.{attr} is guarded-by {lock} but "
+                        f"{method.name}() accesses it outside "
+                        f"`with self.{lock}:` (rename the method *_locked "
+                        f"if the caller holds it)",
+                        key=f"guarded-by:{path_rel}:{cls.name}.{attr}:"
+                            f"{method.name}"))
+    return findings
+
+
+def _target_leaf(node: ast.AST) -> Optional[str]:
+    """Leaf name a value is bound to (``t`` or ``self._worker``), walking
+    up through the immediate Assign/AnnAssign parent."""
+    parent = getattr(node, "parent", None)
+    target = None
+    if isinstance(parent, ast.Assign) and len(parent.targets) == 1:
+        target = parent.targets[0]
+    elif isinstance(parent, ast.AnnAssign):
+        target = parent.target
+    if isinstance(target, ast.Name):
+        return target.id
+    leaf = self_attr(target) if target is not None else None
+    return leaf
+
+
+def _check_threads(tree: ast.Module, path_rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    # Receiver leaf names something calls .join() on — matched against the
+    # Thread's binding name, NOT a whole-file substring test (which
+    # ','.join / os.path.join would satisfy vacuously).
+    joined: set = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "join":
+            recv = node.func.value
+            if isinstance(recv, ast.Name):
+                joined.add(recv.id)
+            else:
+                leaf = self_attr(recv)
+                if leaf:
+                    joined.add(leaf)
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        name = dotted_name(node.func)
+        if name not in ("threading.Thread", "Thread"):
+            continue
+        daemon = next((kw.value for kw in node.keywords
+                       if kw.arg == "daemon"), None)
+        if isinstance(daemon, ast.Constant) and daemon.value is True:
+            continue
+        bound = _target_leaf(node)
+        if bound is not None and bound in joined:
+            continue
+        fn = _method_of(node)
+        fn_name = fn.name if fn is not None else "<module>"
+        findings.append(Finding(
+            RULE, path_rel, node.lineno,
+            f"thread created in {fn_name}() is neither daemon=True nor "
+            f"joined (no .join() on its binding in this file) — it can "
+            f"hang interpreter shutdown",
+            key=f"thread:{path_rel}:{fn_name}"))
+    return findings
+
+
+def _check_blocking(tree: ast.Module, path_rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call):
+            continue
+        held = _enclosing_with_locks(node)
+        if not held:
+            continue
+        callee = dotted_name(node.func)
+        leaf = callee.rsplit(".", 1)[-1]
+        blocking = (
+            leaf in _BLOCKING_ATTRS
+            or callee == "time.sleep"
+            or any(callee.startswith(p) for p in _BLOCKING_PREFIXES)
+            or ".clientset." in f".{callee}."
+        )
+        if not blocking:
+            continue
+        # Calls on the lock object itself release it (cond.wait/notify).
+        if any(callee.startswith(f"{lock}.") for lock in held):
+            continue
+        fn = _method_of(node)
+        fn_name = fn.name if fn is not None else "<module>"
+        findings.append(Finding(
+            RULE, path_rel, node.lineno,
+            f"blocking call {callee}() inside `with {held[0]}:` — every "
+            f"thread contending on the lock serializes behind this I/O",
+            key=f"lock-blocking:{path_rel}:{fn_name}:{callee}"))
+    return findings
+
+
+def run(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    seen = set()
+    for parts in SCAN:
+        for path in iter_py_files(root, *parts):
+            if path in seen:
+                continue
+            seen.add(path)
+            tree = parse_file(path)
+            if tree is None:
+                continue
+            attach_parents(tree)
+            path_rel = rel(root, path)
+            notes = comment_annotations(path, "guarded-by")
+            findings += _check_guarded(tree, path_rel, notes)
+            findings += _check_threads(tree, path_rel)
+            findings += _check_blocking(tree, path_rel)
+    return findings

@@ -1,0 +1,62 @@
+"""Analysis driver: run every rule against one root with one allowlist.
+
+``run_analysis`` returns (findings, suppressed, stale_allowlist_entries);
+``hack/analyze.py`` is the CLI wrapper gated in hack/verify.sh. Exit policy
+(enforced by the CLI): any unsuppressed finding fails; a stale allowlist
+entry (suppressing nothing) also fails, so suppressions cannot outlive the
+code they excuse.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+from typing import Iterable, List, Optional, Set, Tuple
+
+from tpu_operator.analysis import concurrency, env_contract, \
+    exception_policy, payload_image, spec_drift, status_contract
+from tpu_operator.analysis.base import Allowlist, Finding
+
+# Stable rule-id -> module order; findings print grouped in this order.
+RULES = {
+    spec_drift.RULE: spec_drift,
+    env_contract.RULE: env_contract,
+    status_contract.RULE: status_contract,
+    concurrency.RULE: concurrency,
+    exception_policy.RULE: exception_policy,
+    payload_image.RULE: payload_image,
+}
+
+DEFAULT_ALLOWLIST = "hack/analyze_allowlist.txt"
+
+
+def run_analysis(
+    root: Path,
+    rules: Optional[Iterable[str]] = None,
+    allowlist_path: Optional[Path] = None,
+) -> Tuple[List[Finding], List[Finding], Set[Tuple[str, str]]]:
+    """Run ``rules`` (default: all) against ``root``.
+
+    Returns (active findings, allowlist-suppressed findings, stale
+    allowlist entries that matched nothing this run — only computed for
+    the rules that actually ran).
+    """
+    root = Path(root).resolve()
+    selected = list(rules) if rules is not None else list(RULES)
+    unknown = [r for r in selected if r not in RULES]
+    if unknown:
+        raise ValueError(f"unknown rule(s): {unknown}; "
+                         f"available: {sorted(RULES)}")
+    allowlist = Allowlist.load(
+        allowlist_path if allowlist_path is not None
+        else root / DEFAULT_ALLOWLIST)
+
+    all_findings: List[Finding] = []
+    for rule_id in RULES:
+        if rule_id in selected:
+            all_findings.extend(RULES[rule_id].run(root))
+
+    active = [f for f in all_findings if not allowlist.allows(f)]
+    suppressed = [f for f in all_findings if allowlist.allows(f)]
+    stale = {(rule, key) for rule, key in allowlist.unused(all_findings)
+             if rule in selected}
+    return active, suppressed, stale

@@ -1,0 +1,111 @@
+"""Rule ``env-contract``: operator env injection ↔ payload env reads.
+
+``trainer/replicas.py`` injects the pod env contract (``TPUJOB_*``,
+``JAX_*``, ``TPU_*``, ``MEGASCALE_*``); ``tpu_operator/payload/`` consumes
+it. Drift here is silent at review time and fatal (or dead weight) at job
+runtime, so both directions are checked:
+
+- **injected-unread**: an env var the operator injects that no payload
+  module references. Either dead plumbing (delete it) or an external
+  contract (libtpu/XLA reads it, not our code) — the latter goes on the
+  allowlist with a justification.
+- **read-uninjected**: an env var the payload reads that the operator never
+  injects. Either a missing injection or a user-provided knob (template
+  env, developer override) — again allowlist with justification.
+
+Injections are collected from dict literals assigned to a name ``env`` and
+``env["X"] = ...`` stores in replicas.py. Reads are any full-literal
+occurrence of an env-shaped name in payload code outside docstrings (this
+deliberately counts ``ENV_VAR = "TPU_CHECKPOINT_DIR"`` constants and
+``e.get(ENV_VAR)`` indirection as reads).
+
+Keys: ``injected-unread:<NAME>``, ``read-uninjected:<NAME>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List
+
+from tpu_operator.analysis.base import ENV_NAME_RE, Finding, \
+    iter_py_files, non_docstring_strings, parse_file, rel, str_const
+
+RULE = "env-contract"
+
+INJECTOR = "tpu_operator/trainer/replicas.py"
+PAYLOAD_DIR = ("tpu_operator", "payload")
+
+# Env dict variable names on the injection side.
+_ENV_NAMES = {"env"}
+
+
+def _injected(tree: ast.Module) -> Dict[str, int]:
+    """Env names injected by replicas.py: keys of dict literals assigned to
+    ``env`` plus ``env["X"] = ...`` subscript stores."""
+    out: Dict[str, int] = {}
+
+    def record(node: ast.AST) -> None:
+        value = str_const(node)
+        if value is not None and ENV_NAME_RE.match(value):
+            out.setdefault(value, node.lineno)
+
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            targets = node.targets
+            if len(targets) == 1 and isinstance(targets[0], ast.Name) \
+                    and targets[0].id in _ENV_NAMES \
+                    and isinstance(node.value, ast.Dict):
+                for k in node.value.keys:
+                    if k is not None:
+                        record(k)
+            if len(targets) == 1 and isinstance(targets[0], ast.Subscript) \
+                    and isinstance(targets[0].value, ast.Name) \
+                    and targets[0].value.id in _ENV_NAMES:
+                record(targets[0].slice)
+    return out
+
+
+def _payload_reads(root: Path) -> Dict[str, str]:
+    """Env names referenced by payload code (name → ``file:line`` of first
+    reference), docstrings excluded."""
+    reads: Dict[str, str] = {}
+    for path in iter_py_files(root, *PAYLOAD_DIR):
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        for value, line in non_docstring_strings(tree):
+            if ENV_NAME_RE.match(value):
+                reads.setdefault(value, f"{rel(root, path)}:{line}")
+    return reads
+
+
+def run(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    injector_path = root / INJECTOR
+    tree = parse_file(injector_path)
+    if tree is None:
+        return findings
+    injected = _injected(tree)
+    reads = _payload_reads(root)
+
+    for name, line in sorted(injected.items()):
+        if name not in reads:
+            findings.append(Finding(
+                RULE, rel(root, injector_path), line,
+                f"env var {name} is injected into the pod but never read "
+                f"by tpu_operator/payload/ — dead plumbing, or an external "
+                f"contract that belongs on the allowlist",
+                key=f"injected-unread:{name}"))
+
+    for name, where in sorted(reads.items()):
+        if name in injected:
+            continue
+        path_str, _, line_str = where.rpartition(":")
+        findings.append(Finding(
+            RULE, path_str, int(line_str),
+            f"payload reads env var {name} which trainer/replicas.py never "
+            f"injects — missing injection, or a user/developer knob that "
+            f"belongs on the allowlist",
+            key=f"read-uninjected:{name}"))
+    return findings

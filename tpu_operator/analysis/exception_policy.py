@@ -1,0 +1,147 @@
+"""Rule ``exceptions``: error-handling policy on controller paths.
+
+The reconcile engine's failure contract is "log-and-requeue or re-raise":
+an exception swallowed silently on a controller path is a stuck job with no
+trail (the review-caught drift bugs of PRs 1-5 were all of this shape).
+Checked over ``tpu_operator/{controller,trainer,client,cmd}``:
+
+- **bare-except** — ``except:`` catches SystemExit/KeyboardInterrupt too;
+  always flagged.
+- **silent-except** — a handler whose body is a lone ``pass`` (any
+  exception type): the swallow leaves no log line. Justified teardown
+  paths go on the allowlist.
+- **broad-except** — ``except Exception/BaseException`` whose body neither
+  re-raises nor calls a logger: the error is converted to silence.
+- **exit-code** — retryable exit codes (137/143) written as literals
+  instead of the named constants (``bootstrap.EXIT_RETRYABLE``,
+  ``policy.PREEMPTION_EXIT_CODES``); checked across all of
+  ``tpu_operator/`` since the payload side owns the contract's other end.
+
+Keys: ``bare-except:<file>:<func>``, ``silent-except:<file>:<func>``,
+``broad-except:<file>:<func>``, ``exit-code:<file>:<func>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import List, Optional
+
+from tpu_operator.analysis.base import Finding, ancestors, attach_parents, \
+    dotted_name, iter_py_files, parse_file, rel
+
+RULE = "exceptions"
+
+SCOPE = (
+    ("tpu_operator", "controller"),
+    ("tpu_operator", "trainer"),
+    ("tpu_operator", "client"),
+    ("tpu_operator", "cmd"),
+)
+
+RETRYABLE_EXIT_CODES = {137, 143}
+
+_BROAD = {"Exception", "BaseException"}
+_LOGGER_METHODS = {"debug", "info", "warning", "error", "exception",
+                   "critical", "log"}
+
+
+def _func_name(node: ast.AST) -> str:
+    for anc in ancestors(node):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            return anc.name
+    return "<module>"
+
+
+def _is_broad(type_node: Optional[ast.AST]) -> bool:
+    if type_node is None:
+        return False  # bare handled separately
+    names = []
+    if isinstance(type_node, ast.Tuple):
+        names = [dotted_name(e) for e in type_node.elts]
+    else:
+        names = [dotted_name(type_node)]
+    return any(n.rsplit(".", 1)[-1] in _BROAD for n in names)
+
+
+def _handles(handler: ast.ExceptHandler, what: str) -> bool:
+    for node in ast.walk(handler):
+        if what == "raise" and isinstance(node, ast.Raise):
+            return True
+        if what == "log" and isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _LOGGER_METHODS:
+            receiver = dotted_name(node.func.value).lower()
+            if "log" in receiver:
+                return True
+    return False
+
+
+def _check_handlers(tree: ast.Module, path_rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.ExceptHandler):
+            continue
+        fn = _func_name(node)
+        pass_only = (len(node.body) == 1
+                     and isinstance(node.body[0], ast.Pass))
+        if node.type is None:
+            findings.append(Finding(
+                RULE, path_rel, node.lineno,
+                f"bare `except:` in {fn}() catches SystemExit/"
+                f"KeyboardInterrupt — name the exception",
+                key=f"bare-except:{path_rel}:{fn}"))
+        elif pass_only:
+            findings.append(Finding(
+                RULE, path_rel, node.lineno,
+                f"exception swallowed silently (pass-only handler) in "
+                f"{fn}() — log it, re-raise, or allowlist with a "
+                f"justification", key=f"silent-except:{path_rel}:{fn}"))
+        elif _is_broad(node.type) and not _handles(node, "raise") \
+                and not _handles(node, "log"):
+            findings.append(Finding(
+                RULE, path_rel, node.lineno,
+                f"broad `except {ast.unparse(node.type)}` in {fn}() "
+                f"neither logs nor re-raises — failures on this path "
+                f"vanish", key=f"broad-except:{path_rel}:{fn}"))
+    return findings
+
+
+def _check_exit_codes(tree: ast.Module, path_rel: str) -> List[Finding]:
+    findings: List[Finding] = []
+    for node in ast.walk(tree):
+        if not isinstance(node, ast.Call) or not node.args:
+            continue
+        callee = dotted_name(node.func)
+        if callee not in ("SystemExit", "sys.exit", "os._exit", "exit"):
+            continue
+        arg = node.args[0]
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, int) \
+                and arg.value in RETRYABLE_EXIT_CODES:
+            fn = _func_name(node)
+            findings.append(Finding(
+                RULE, path_rel, node.lineno,
+                f"retryable exit code {arg.value} written as a literal in "
+                f"{fn}() — use the named constant (EXIT_RETRYABLE / "
+                f"PREEMPTION_EXIT_CODES) so the operator contract stays "
+                f"greppable", key=f"exit-code:{path_rel}:{fn}"))
+    return findings
+
+
+def run(root: Path) -> List[Finding]:
+    """One parse per file: exit-code literals are checked across all of
+    tpu_operator/, handler policy only on the controller-path SCOPE."""
+    findings: List[Finding] = []
+    scope_prefixes = tuple("/".join(parts) + "/" for parts in SCOPE)
+    for path in iter_py_files(root, "tpu_operator"):
+        if "analysis" in path.parts:
+            continue
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        attach_parents(tree)
+        path_rel = rel(root, path)
+        if path_rel.startswith(scope_prefixes):
+            findings += _check_handlers(tree, path_rel)
+        findings += _check_exit_codes(tree, path_rel)
+    return findings

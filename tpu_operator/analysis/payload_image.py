@@ -1,0 +1,196 @@
+"""Rule ``payload-image``: shipped imports resolve from pinned requirements.
+
+Folded in from the former standalone ``hack/check_payload_image.py`` so all
+contract checks share one runner, finding format, and allowlist (the shim
+at hack/check_payload_image.py now delegates here). Three tiers:
+
+1. Static: every top-level import reachable from each image's module set is
+   stdlib, in-repo, or provided by that image's requirements.txt.
+2. Lockstep: the pyproject ``payload`` extra matches the payload image's
+   requirements.txt pin-for-pin.
+3. Dynamic (live repo only): every payload module actually imports in the
+   dev environment, so a broken module body fails CI rather than job
+   startup.
+
+Keys: ``import:<file>:<module>``, ``pin-drift:<name>``,
+``module-import:<module>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import importlib
+import re
+import sys
+from pathlib import Path
+from typing import Dict, List, Set
+
+from tpu_operator.analysis.base import Finding, parse_file, rel
+
+RULE = "payload-image"
+
+# requirement-name -> import names it provides. Keep in lockstep with
+# build/images/*/requirements.txt.
+REQUIREMENT_PROVIDES = {
+    "jax": {"jax", "jaxlib"},
+    "flax": {"flax"},
+    "optax": {"optax"},
+    "orbax-checkpoint": {"orbax"},
+    "numpy": {"numpy"},
+    "pyyaml": {"yaml"},
+}
+
+
+def parse_requirements(path: Path) -> Set[str]:
+    provided: Set[str] = set()
+    for line in path.read_text(encoding="utf-8").splitlines():
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        name = re.split(r"[\[=<>!~;]", line, 1)[0].strip().lower()
+        provided |= REQUIREMENT_PROVIDES.get(name, {name.replace("-", "_")})
+    return provided
+
+
+def _module_imports(path: Path) -> Dict[str, int]:
+    tree = parse_file(path)
+    if tree is None:
+        # Unparseable file: the dynamic import tier (live repo) reports it
+        # as a module-import finding; a seeded-bad fixture file must not
+        # crash the whole analysis run.
+        return {}
+    tops: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for alias in node.names:
+                tops.setdefault(alias.name.split(".")[0], node.lineno)
+        elif isinstance(node, ast.ImportFrom) and node.level == 0 \
+                and node.module:
+            tops.setdefault(node.module.split(".")[0], node.lineno)
+    return tops
+
+
+def _check_image(root: Path, label: str, files: List[Path],
+                 reqs: Path) -> List[Finding]:
+    if not reqs.is_file():
+        return []
+    provided = parse_requirements(reqs)
+    findings = []
+    for f in sorted(files):
+        for top, line in sorted(_module_imports(f).items()):
+            if top in sys.stdlib_module_names or top == "tpu_operator":
+                continue
+            if top in provided:
+                continue
+            findings.append(Finding(
+                RULE, rel(root, f), line,
+                f"{label}: imports {top!r} which {reqs.name} does not "
+                f"install — explodes at job startup, not build time",
+                key=f"import:{rel(root, f)}:{top}"))
+    return findings
+
+
+def _pins(lines: List[str]) -> Dict[str, str]:
+    out = {}
+    for line in lines:
+        line = line.split("#", 1)[0].strip()
+        if not line:
+            continue
+        name = re.split(r"[\[=<>!~;]", line, 1)[0].strip().lower()
+        ver = line.split("==", 1)[1].strip() if "==" in line else ""
+        out[name.replace("-", "_")] = ver
+    return out
+
+
+def _payload_extra_lines(pyproject: Path) -> List[str]:
+    """The pyproject ``payload`` extra, via tomllib when available (3.11+)
+    with a regex fallback for older interpreters."""
+    try:
+        import tomllib
+        with open(pyproject, "rb") as f:
+            proj = tomllib.load(f)
+        return list(proj["project"]["optional-dependencies"]["payload"])
+    except ImportError:
+        # Non-greedy up to a closing bracket at column 0 — a `]` inside an
+        # extras marker ("jax[tpu]==...") must not end the capture.
+        m = re.search(r"^payload\s*=\s*\[(.*?)^\]",
+                      pyproject.read_text(encoding="utf-8"),
+                      re.DOTALL | re.MULTILINE)
+        if not m:
+            return []
+        return [part.strip().strip("\"'")
+                for part in m.group(1).split(",") if part.strip()]
+    except KeyError:
+        return []
+
+
+def _check_lockstep(root: Path) -> List[Finding]:
+    """pyproject 'payload' extra ↔ payload image requirements.txt."""
+    pyproject = root / "pyproject.toml"
+    req_path = root / "build/images/tpu_payload/requirements.txt"
+    if not pyproject.is_file() or not req_path.is_file():
+        return []
+    extra_lines = _payload_extra_lines(pyproject)
+    if not extra_lines:
+        return []
+    img = _pins(req_path.read_text(encoding="utf-8").splitlines())
+    extra = _pins(extra_lines)
+    findings = []
+    for name, ver in sorted(extra.items()):
+        if img.get(name) != ver:
+            findings.append(Finding(
+                RULE, rel(root, pyproject), 1,
+                f"pin drift: pyproject payload extra has {name}=={ver} but "
+                f"the payload image requirements.txt has "
+                f"{img.get(name, 'nothing')}", key=f"pin-drift:{name}"))
+    for name, ver in sorted(img.items()):
+        if name not in extra:
+            findings.append(Finding(
+                RULE, rel(root, req_path), 1,
+                f"pin drift: payload image requirements.txt has "
+                f"{name}=={ver} but the pyproject payload extra omits it",
+                key=f"pin-drift:{name}"))
+    return findings
+
+
+def _check_dynamic(root: Path, payload_files: List[Path]) -> List[Finding]:
+    findings = []
+    for f in sorted(payload_files):
+        mod = "tpu_operator.payload." + f.stem if f.stem != "__init__" \
+            else "tpu_operator.payload"
+        try:
+            importlib.import_module(mod)
+        except Exception as exc:  # noqa: BLE001 — report all import failures
+            findings.append(Finding(
+                RULE, rel(root, f), 1,
+                f"import {mod}: {type(exc).__name__}: {exc}",
+                key=f"module-import:{mod}"))
+    return findings
+
+
+def run(root: Path) -> List[Finding]:
+    pkg = root / "tpu_operator"
+    if not pkg.is_dir():
+        return []
+    payload_files = sorted((pkg / "payload").glob("*.py"))
+    # The analysis package is CI tooling: it ships in the sdist but the
+    # operator binary never imports it, so its (gated) dev-only imports
+    # don't bind the image requirements.
+    operator_files = [
+        f for f in sorted(pkg.rglob("*.py"))
+        if "payload" not in f.parts and "analysis" not in f.parts
+        and "__pycache__" not in f.parts
+    ]
+    findings = _check_image(
+        root, "payload-image", payload_files,
+        root / "build/images/tpu_payload/requirements.txt")
+    findings += _check_image(
+        root, "operator-image", operator_files,
+        root / "build/images/tpu_operator/requirements.txt")
+    findings += _check_lockstep(root)
+    # Dynamic tier only against the live repo (importing fixture-tree
+    # modules under the installed package name would be nonsense).
+    if (root / "tpu_operator/analysis/payload_image.py").resolve() \
+            == Path(__file__).resolve():
+        findings += _check_dynamic(root, payload_files)
+    return findings

@@ -1,0 +1,178 @@
+"""Rule ``spec-drift``: the five hand-maintained spec artifacts agree.
+
+A TPUJob spec field exists in five places: the ``types.py`` dataclass wire
+format (``from_dict``), the ``schema.py`` structural schema, ``defaults.py``,
+``validation.py``, and the generated CRD YAML (examples + chart). The
+reference generated most of this; we hand-edit it, so this rule makes the
+cross-file contract machine-checked:
+
+- every wire key parsed by ``TPUJobSpec.from_dict`` / ``TPUReplicaSpec
+  .from_dict`` appears in ``spec_schema()`` / ``replica_spec_schema()``
+  (and vice versa — a schema key with no dataclass backing is also drift);
+- every wire key's snake_case attribute is mentioned by ``defaults.py`` and
+  ``validation.py``, or carries an explicit allowlist entry documenting why
+  it needs no defaulting/validation;
+- ``hack/gen_crd.py --check`` passes (the CRD YAML on disk is byte-identical
+  to what the schema renders).
+
+Keys: ``schema:<key>``, ``types:<key>``, ``defaults:<key>``,
+``validation:<key>``, ``crd:drift``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+import subprocess
+import sys
+from pathlib import Path
+from typing import Dict, List, Optional, Tuple
+
+from tpu_operator.analysis.base import Finding, parse_file, rel, str_const, \
+    camel_to_snake
+
+RULE = "spec-drift"
+
+TYPES = "tpu_operator/apis/tpujob/v1alpha1/types.py"
+SCHEMA = "tpu_operator/apis/tpujob/v1alpha1/schema.py"
+DEFAULTS = "tpu_operator/apis/tpujob/v1alpha1/defaults.py"
+VALIDATION = "tpu_operator/apis/tpujob/validation.py"
+
+# (dataclass in types.py, schema builder in schema.py)
+PAIRS = (
+    ("TPUJobSpec", "spec_schema"),
+    ("TPUReplicaSpec", "replica_spec_schema"),
+)
+
+_WIRE_KEY_RE = re.compile(r"^[a-z][a-zA-Z0-9]*$")
+
+
+def _from_dict_keys(tree: ast.Module, cls_name: str) -> Dict[str, int]:
+    """Wire keys consumed by ``<cls>.from_dict``: string literals used as
+    ``d.get(...)`` args, ``d[...]`` subscripts, ``"k" in d`` membership
+    tests, or first args of helpers defined inside from_dict (the
+    ``opt_int("activeDeadlineSeconds")`` pattern)."""
+    fn: Optional[ast.FunctionDef] = None
+    for node in ast.walk(tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls_name:
+            for item in node.body:
+                if isinstance(item, ast.FunctionDef) \
+                        and item.name == "from_dict":
+                    fn = item
+    if fn is None:
+        return {}
+    local_helpers = {n.name for n in ast.walk(fn)
+                     if isinstance(n, ast.FunctionDef) and n is not fn}
+    keys: Dict[str, int] = {}
+
+    def record(node: ast.AST) -> None:
+        value = str_const(node)
+        if value is not None and _WIRE_KEY_RE.match(value):
+            keys.setdefault(value, node.lineno)
+
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Call):
+            is_get = (isinstance(node.func, ast.Attribute)
+                      and node.func.attr == "get")
+            is_helper = (isinstance(node.func, ast.Name)
+                         and node.func.id in local_helpers)
+            if (is_get or is_helper) and node.args:
+                record(node.args[0])
+        elif isinstance(node, ast.Subscript):
+            record(node.slice)
+        elif isinstance(node, ast.Compare):
+            if any(isinstance(op, (ast.In, ast.NotIn)) for op in node.ops):
+                record(node.left)
+    return keys
+
+
+def _schema_keys(tree: ast.Module, fn_name: str) -> Dict[str, int]:
+    """Top-level property keys of the ``_obj({...})`` a schema builder
+    returns."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == fn_name:
+            for stmt in ast.walk(node):
+                if isinstance(stmt, ast.Return) \
+                        and isinstance(stmt.value, ast.Call) \
+                        and isinstance(stmt.value.func, ast.Name) \
+                        and stmt.value.func.id == "_obj" \
+                        and stmt.value.args \
+                        and isinstance(stmt.value.args[0], ast.Dict):
+                    out: Dict[str, int] = {}
+                    for k in stmt.value.args[0].keys:
+                        value = str_const(k) if k is not None else None
+                        if value is not None:
+                            out.setdefault(value, k.lineno)
+                    return out
+    return {}
+
+
+def _mention_lines(path: Path) -> Tuple[str, bool]:
+    try:
+        return path.read_text(encoding="utf-8"), True
+    except OSError:
+        return "", False
+
+
+def run(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    types_path = root / TYPES
+    schema_path = root / SCHEMA
+    types_tree = parse_file(types_path)
+    schema_tree = parse_file(schema_path)
+    if types_tree is None or schema_tree is None:
+        return findings  # nothing to check in this tree
+
+    defaults_src, have_defaults = _mention_lines(root / DEFAULTS)
+    validation_src, have_validation = _mention_lines(root / VALIDATION)
+
+    for cls_name, schema_fn in PAIRS:
+        wire = _from_dict_keys(types_tree, cls_name)
+        schema = _schema_keys(schema_tree, schema_fn)
+        if not wire or not schema:
+            continue
+        for key, line in sorted(wire.items()):
+            if key not in schema:
+                findings.append(Finding(
+                    RULE, rel(root, types_path), line,
+                    f"{cls_name} wire key {key!r} is missing from "
+                    f"schema.{schema_fn}() — the strict schema would "
+                    f"reject (or a pruning apiserver silently drop) it",
+                    key=f"schema:{key}"))
+            snake = camel_to_snake(key)
+            for src, ok, label in (
+                    (defaults_src, have_defaults, "defaults"),
+                    (validation_src, have_validation, "validation")):
+                if not ok:
+                    continue
+                if not re.search(rf"\b{re.escape(snake)}\b", src):
+                    findings.append(Finding(
+                        RULE, rel(root, types_path), line,
+                        f"{cls_name} field {key!r} ({snake}) is handled by "
+                        f"neither {label}.py nor an allowlist entry "
+                        f"documenting why it needs no {label}",
+                        key=f"{label}:{key}"))
+        for key, line in sorted(schema.items()):
+            if key not in wire:
+                findings.append(Finding(
+                    RULE, rel(root, schema_path), line,
+                    f"schema.{schema_fn}() property {key!r} has no "
+                    f"backing wire key in {cls_name}.from_dict — the "
+                    f"apiserver accepts a field the operator ignores",
+                    key=f"types:{key}"))
+
+    gen_crd = root / "hack" / "gen_crd.py"
+    if gen_crd.is_file():
+        proc = subprocess.run(
+            [sys.executable, str(gen_crd), "--check"],
+            cwd=root, capture_output=True, text=True)
+        if proc.returncode != 0:
+            output = (proc.stdout or proc.stderr).strip()
+            first_line = output.splitlines()[0] if output else \
+                f"exit {proc.returncode}, no output"
+            findings.append(Finding(
+                RULE, rel(root, gen_crd), 1,
+                "generated CRD YAML drifted from schema.py — run "
+                f"`python hack/gen_crd.py` ({first_line})",
+                key="crd:drift"))
+    return findings

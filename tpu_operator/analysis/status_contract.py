@@ -1,0 +1,276 @@
+"""Rule ``status-contract``: heartbeat body ↔ sanitizer ↔ status schema,
+and metric-name hygiene.
+
+The heartbeat chain has three hand-maintained layers: the payload POSTs a
+body (``payload/heartbeat.py``), the status server sanitizes it down to the
+CRD shape (``controller/statusserver.py record_heartbeat``), and the strict
+status schema admits it (``schema.py status_schema``). A key present
+upstream but missing downstream is *silently dropped* telemetry (the
+lost-one-shot class of bug); the rule enforces
+
+    posted-keys − envelope  ⊆  sanitized-keys  ⊆  schema lastHeartbeat keys
+
+(``namespace``/``name`` are the routing envelope the server consumes, never
+status payload). Metric hygiene, same spirit:
+
+- every registered/emitted metric name appears in ``docs/`` and in at least
+  one file under ``tests/`` (an undocumented metric is invisible to
+  operators; an untested one silently breaks);
+- every ``inc``/``observe``/``set_gauge`` call site with a literal name
+  refers to a registered or emitted metric (counters auto-register, so a
+  typo'd call site otherwise creates a parallel, forever-zero family).
+
+Keys: ``posted-unsanitized:<key>``, ``sanitized-unschema:<key>``,
+``metric-undocumented:<name>``, ``metric-untested:<name>``,
+``metric-unregistered:<name>``.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from tpu_operator.analysis.base import Finding, attach_parents, ancestors, \
+    dotted_name, iter_py_files, parse_file, rel, str_const
+
+RULE = "status-contract"
+
+HEARTBEAT = "tpu_operator/payload/heartbeat.py"
+STATUSSERVER = "tpu_operator/controller/statusserver.py"
+SCHEMA = "tpu_operator/apis/tpujob/v1alpha1/schema.py"
+
+# Routing envelope: consumed by the server to find the job, never persisted.
+ENVELOPE = {"namespace", "name"}
+
+_METRIC_NAME_RE = re.compile(r"^[a-z][a-z0-9_]*$")
+
+
+def _resolve_loop_name(node: ast.Name) -> Optional[Set[str]]:
+    """A subscript index that is a Name bound by an enclosing literal
+    ``for``-loop: resolve the set of string values it takes. Handles both
+    ``for k in ("a", "b")`` and ``for a, b in (("x", "y"), ...)``."""
+    for anc in ancestors(node):
+        if not isinstance(anc, ast.For):
+            continue
+        target, it = anc.target, anc.iter
+        if not isinstance(it, (ast.Tuple, ast.List)):
+            continue
+        if isinstance(target, ast.Name) and target.id == node.id:
+            values = {str_const(e) for e in it.elts}
+            return {v for v in values if v is not None} or None
+        if isinstance(target, ast.Tuple):
+            for pos, el in enumerate(target.elts):
+                if isinstance(el, ast.Name) and el.id == node.id:
+                    values = set()
+                    for e in it.elts:
+                        if isinstance(e, (ast.Tuple, ast.List)) \
+                                and len(e.elts) > pos:
+                            v = str_const(e.elts[pos])
+                            if v is not None:
+                                values.add(v)
+                    return values or None
+    return None
+
+
+def _dict_keys_of(tree: ast.Module, var: str) -> Dict[str, int]:
+    """String keys flowing into dict variable ``var``: literal keys of
+    ``var = {...}`` / ``var: T = {...}`` assignments and ``var[...] = ...``
+    stores (loop-bound index names resolved against literal tuples)."""
+    attach_parents(tree)
+    out: Dict[str, int] = {}
+
+    def record(value: Optional[str], line: int) -> None:
+        if value is not None:
+            out.setdefault(value, line)
+
+    for node in ast.walk(tree):
+        value_node = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+            value_node = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            target = node.target
+            value_node = node.value
+        else:
+            continue
+        if isinstance(target, ast.Name) and target.id == var \
+                and isinstance(value_node, ast.Dict):
+            for k in value_node.keys:
+                if k is not None:
+                    record(str_const(k), k.lineno)
+        if isinstance(target, ast.Subscript) \
+                and isinstance(target.value, ast.Name) \
+                and target.value.id == var:
+            idx = target.slice
+            const = str_const(idx)
+            if const is not None:
+                record(const, idx.lineno)
+            elif isinstance(idx, ast.Name):
+                for v in _resolve_loop_name(idx) or ():
+                    record(v, idx.lineno)
+    return out
+
+
+def _schema_heartbeat_keys(tree: ast.Module) -> Set[str]:
+    """Property keys of the ``lastHeartbeat`` object in status_schema()."""
+    for node in ast.walk(tree):
+        if isinstance(node, ast.FunctionDef) and node.name == "status_schema":
+            for d in ast.walk(node):
+                if not isinstance(d, ast.Dict):
+                    continue
+                for k, v in zip(d.keys, d.values):
+                    if k is not None and str_const(k) == "lastHeartbeat" \
+                            and isinstance(v, ast.Call) and v.args \
+                            and isinstance(v.args[0], ast.Dict):
+                        return {str_const(kk) for kk in v.args[0].keys
+                                if kk is not None and str_const(kk)}
+    return set()
+
+
+# --- metric hygiene ----------------------------------------------------------
+
+def _registered_metrics(tree: ast.Module) -> Dict[str, int]:
+    """First args of ``.register(name, mtype, ...)`` calls; a Name first
+    arg bound by a literal for-loop (``for name in (...): register(name``)
+    resolves to every value it takes."""
+    attach_parents(tree)
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Call) and isinstance(node.func, ast.Attribute) \
+                and node.func.attr == "register" and len(node.args) >= 2:
+            mtype = str_const(node.args[1])
+            if mtype not in ("counter", "gauge", "histogram"):
+                continue
+            name = str_const(node.args[0])
+            if name:
+                out.setdefault(name, node.lineno)
+            elif isinstance(node.args[0], ast.Name):
+                for value in _resolve_loop_name(node.args[0]) or ():
+                    out.setdefault(value, node.lineno)
+    return out
+
+
+def _emitted_metrics(tree: ast.Module) -> Dict[str, int]:
+    """Gauge names emitted ad hoc by ``render_metrics``: ``emit(name, ...)``
+    first args, ``METRIC_PREFIX + "name"`` concatenations, and loop-table
+    metric names (lowercase, underscore-bearing string literals)."""
+    out: Dict[str, int] = {}
+    for node in ast.walk(tree):
+        if not (isinstance(node, ast.FunctionDef)
+                and node.name == "render_metrics"):
+            continue
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Call) and isinstance(sub.func, ast.Name) \
+                    and sub.func.id == "emit" and sub.args:
+                name = str_const(sub.args[0])
+                if name:
+                    out.setdefault(name, sub.lineno)
+            if isinstance(sub, ast.BinOp) and isinstance(sub.op, ast.Add) \
+                    and isinstance(sub.left, ast.Name) \
+                    and sub.left.id == "METRIC_PREFIX":
+                name = str_const(sub.right)
+                if name:
+                    out.setdefault(name, sub.lineno)
+            value = str_const(sub)
+            if value and "_" in value and _METRIC_NAME_RE.match(value):
+                out.setdefault(value, sub.lineno)
+    return out
+
+
+def _metric_call_sites(root: Path) -> Dict[str, List[str]]:
+    """Literal metric names at ``*.inc/observe/set_gauge`` call sites on
+    metrics-ish receivers, across the control plane."""
+    sites: Dict[str, List[str]] = {}
+    for path in iter_py_files(root, "tpu_operator"):
+        if "analysis" in path.parts:
+            continue
+        tree = parse_file(path)
+        if tree is None:
+            continue
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Call) \
+                    and isinstance(node.func, ast.Attribute) \
+                    and node.func.attr in ("inc", "observe", "set_gauge") \
+                    and node.args \
+                    and "metrics" in dotted_name(node.func.value).lower():
+                name = str_const(node.args[0])
+                if name:
+                    sites.setdefault(name, []).append(
+                        f"{rel(root, path)}:{node.lineno}")
+    return sites
+
+
+def _grep_tree(base: Path, suffixes: tuple) -> str:
+    chunks: List[str] = []
+    if base.is_dir():
+        for path in sorted(base.rglob("*")):
+            if path.suffix in suffixes and path.is_file():
+                try:
+                    chunks.append(path.read_text(encoding="utf-8"))
+                except OSError:
+                    continue
+    return "\n".join(chunks)
+
+
+def run(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    hb_path, ss_path = root / HEARTBEAT, root / STATUSSERVER
+    hb_tree, ss_tree = parse_file(hb_path), parse_file(ss_path)
+    schema_tree = parse_file(root / SCHEMA)
+
+    if hb_tree is not None and ss_tree is not None:
+        posted = _dict_keys_of(hb_tree, "body")
+        ss_keys = _dict_keys_of(ss_tree, "hb")
+        sanitized = set(ss_keys)
+        for key, line in sorted(posted.items()):
+            if key in ENVELOPE or key in sanitized:
+                continue
+            findings.append(Finding(
+                RULE, rel(root, hb_path), line,
+                f"heartbeat body key {key!r} is posted but "
+                f"statusserver.record_heartbeat silently drops it "
+                f"(not sanitized into the status copy)",
+                key=f"posted-unsanitized:{key}"))
+        if schema_tree is not None:
+            schema_keys = _schema_heartbeat_keys(schema_tree)
+            if schema_keys:
+                for key, line in sorted(ss_keys.items()):
+                    if key not in schema_keys:
+                        findings.append(Finding(
+                            RULE, rel(root, ss_path), line,
+                            f"sanitized heartbeat key {key!r} is not in the "
+                            f"status schema's lastHeartbeat object — strict "
+                            f"admission would wedge every later status "
+                            f"write",
+                            key=f"sanitized-unschema:{key}"))
+
+    # -- metric hygiene -------------------------------------------------------
+    if ss_tree is not None:
+        registered = _registered_metrics(ss_tree)
+        emitted = _emitted_metrics(ss_tree)
+        known = {**emitted, **registered}
+        docs_text = _grep_tree(root / "docs", (".md",))
+        tests_text = _grep_tree(root / "tests", (".py",))
+        for name, line in sorted(known.items()):
+            if docs_text and name not in docs_text:
+                findings.append(Finding(
+                    RULE, rel(root, ss_path), line,
+                    f"metric {name!r} is exposed but never documented "
+                    f"under docs/", key=f"metric-undocumented:{name}"))
+            if tests_text and name not in tests_text:
+                findings.append(Finding(
+                    RULE, rel(root, ss_path), line,
+                    f"metric {name!r} is exposed but no test under tests/ "
+                    f"references it", key=f"metric-untested:{name}"))
+        for name, where in sorted(_metric_call_sites(root).items()):
+            if name not in known:
+                path_str, _, line_str = where[0].rpartition(":")
+                findings.append(Finding(
+                    RULE, path_str, int(line_str),
+                    f"metric call site uses unregistered name {name!r} "
+                    f"(counters auto-create, so a typo here splits the "
+                    f"series silently)",
+                    key=f"metric-unregistered:{name}"))
+    return findings

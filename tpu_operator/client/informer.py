@@ -81,10 +81,10 @@ class Store:
 
     def __init__(self) -> None:
         self._lock = threading.RLock()
-        self._items: Dict[str, Dict[str, Any]] = {}
-        self._indexers: Dict[str, IndexFunc] = {}
+        self._items: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._indexers: Dict[str, IndexFunc] = {}  # guarded-by: _lock
         # index name -> index value -> {object key: object}
-        self._indices: Dict[str, Dict[str, Dict[str, Dict[str, Any]]]] = {}
+        self._indices: Dict[str, Dict[str, Dict[str, Dict[str, Any]]]] = {}  # guarded-by: _lock
 
     def get(self, namespace: str, name: str) -> Optional[Dict[str, Any]]:
         with self._lock:
@@ -193,7 +193,7 @@ class Informer:
         self._handlers: List[Tuple[Optional[Handler], Optional[Handler], Optional[Handler]]] = []
         self._synced = threading.Event()
         self._threads: List[threading.Thread] = []
-        self._watch = None
+        self._watch = None  # guarded-by: _lock
         self._lock = threading.Lock()
 
     def add_event_handler(self, on_add: Optional[Handler] = None,

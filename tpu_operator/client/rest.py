@@ -29,7 +29,6 @@ import json
 import random
 import socket
 import ssl
-import threading
 import time
 import urllib.parse
 from dataclasses import dataclass, field

@@ -51,16 +51,16 @@ class RateLimitingQueue:
         self._clock = clock
         self._metrics = metrics
         self._cond = threading.Condition()
-        self._queue: List[Any] = []
-        self._dirty: Set[Any] = set()
-        self._processing: Set[Any] = set()
-        self._failures: Dict[Any, int] = {}
-        self._delayed: List[tuple] = []  # heap of (ready_at, seq, item)
+        self._queue: List[Any] = []  # guarded-by: _cond
+        self._dirty: Set[Any] = set()  # guarded-by: _cond
+        self._processing: Set[Any] = set()  # guarded-by: _cond
+        self._failures: Dict[Any, int] = {}  # guarded-by: _cond
+        self._delayed: List[tuple] = []  # heap of (ready_at, seq, item); guarded-by: _cond
         self._seq = 0
-        self._shutdown = False
+        self._shutdown = False  # guarded-by: _cond
         # telemetry state: when items entered the queue / started processing
-        self._added_at: Dict[Any, float] = {}
-        self._processing_since: Dict[Any, float] = {}
+        self._added_at: Dict[Any, float] = {}  # guarded-by: _cond
+        self._processing_since: Dict[Any, float] = {}  # guarded-by: _cond
 
     # -- core queue -----------------------------------------------------------
 

@@ -98,11 +98,11 @@ class Controller:
         self.recorder = EventRecorder(clientset, metrics=self.metrics)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
-        self.jobs: Dict[str, TrainingJob] = {}
+        self.jobs: Dict[str, TrainingJob] = {}  # guarded-by: _jobs_lock
         self._jobs_lock = threading.Lock()
         # key -> heartbeat "time" of the last persist-enqueued heartbeat
         # (guarded by _jobs_lock; see record_heartbeat's coalescing).
-        self._hb_persisted: Dict[str, float] = {}
+        self._hb_persisted: Dict[str, float] = {}  # guarded-by: _jobs_lock
 
         self.job_informer = self.factory.informer_for("tpujobs")
         self.job_informer.add_event_handler(

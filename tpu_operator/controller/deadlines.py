@@ -44,7 +44,7 @@ class DeadlineManager:
         # key -> pending wakeup epoch (best-effort view; the queue owns the
         # actual timers, which are never cancelled — a stale wakeup just
         # causes one cheap no-op reconcile).
-        self._scheduled: Dict[str, float] = {}
+        self._scheduled: Dict[str, float] = {}  # guarded-by: _lock
 
     def sync(self, key: str, due: Optional[float]) -> None:
         """Ensure a reconcile of ``key`` runs at epoch ``due``.

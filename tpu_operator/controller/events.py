@@ -50,7 +50,7 @@ class EventRecorder:
         self._lock = threading.Lock()
         # LRU: (ns, name, reason, message) -> (event_name, count)
         self._seen: "collections.OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
-            collections.OrderedDict())
+            collections.OrderedDict())  # guarded-by: _lock
 
     def forget_object(self, namespace: str, name: str) -> int:
         """Drop dedup entries for a deleted object (the controller calls this
@@ -83,45 +83,59 @@ class EventRecorder:
             "uid": obj.metadata.get("uid", ""),
         }
         key = (namespace, obj.name, reason, message)
+        # The apiserver round trips run OUTSIDE the dedup lock: with it
+        # held, a slow apiserver serialized every reconcile worker that
+        # wanted to record ANY event behind one thread's RPC. The race this
+        # opens (two threads recording the same key concurrently) costs at
+        # worst one extra Event object, which aggregation folds from then
+        # on — strictly better than a control-plane-wide convoy.
         with self._lock:
             prior = self._seen.get(key)
             if prior:
                 self._seen.move_to_end(key)
-                name, count = prior
-                try:
-                    ev = self.clientset.events.get(namespace, name)
-                    ev["count"] = count + 1
-                    ev["lastTimestamp"] = _now()
-                    self.clientset.events.update(namespace, ev)
+        if prior:
+            name, count = prior
+            try:
+                ev = self.clientset.events.get(namespace, name)
+                ev["count"] = count + 1
+                ev["lastTimestamp"] = _now()
+                self.clientset.events.update(namespace, ev)
+                with self._lock:
                     self._seen[key] = (name, count + 1)
-                    if self.metrics is not None:
-                        self.metrics.inc("events_emitted_total")
-                        self.metrics.inc("events_aggregated_total")
-                    return
-                except errors.ApiError:
-                    pass  # fall through to create fresh
-            name = f"{obj.name}.{rand_string(10)}"
-            event = {
-                "apiVersion": "v1",
-                "kind": "Event",
-                "metadata": {"name": name, "namespace": namespace},
-                "involvedObject": involved,
-                "reason": reason,
-                "message": message,
-                "type": event_type,
-                "count": 1,
-                "firstTimestamp": _now(),
-                "lastTimestamp": _now(),
-                "source": {"component": self.component},
-            }
-            self.clientset.events.create(namespace, event)
+                if self.metrics is not None:
+                    self.metrics.inc("events_emitted_total")
+                    self.metrics.inc("events_aggregated_total")
+                return
+            except errors.ApiError as e:
+                # Fall through to create fresh — but say so: a silently
+                # swallowed aggregation failure looked exactly like
+                # first-time recording, hiding e.g. a permissions change
+                # that 403s every update.
+                log.debug("event aggregation of %s failed (%s); "
+                          "creating fresh", name, e)
+        name = f"{obj.name}.{rand_string(10)}"
+        event = {
+            "apiVersion": "v1",
+            "kind": "Event",
+            "metadata": {"name": name, "namespace": namespace},
+            "involvedObject": involved,
+            "reason": reason,
+            "message": message,
+            "type": event_type,
+            "count": 1,
+            "firstTimestamp": _now(),
+            "lastTimestamp": _now(),
+            "source": {"component": self.component},
+        }
+        self.clientset.events.create(namespace, event)
+        evicted = 0
+        with self._lock:
             self._seen[key] = (name, 1)
             self._seen.move_to_end(key)
-            evicted = 0
             while len(self._seen) > self._seen_cap:
                 self._seen.popitem(last=False)
                 evicted += 1
-            if self.metrics is not None:
-                self.metrics.inc("events_emitted_total")
-                if evicted:
-                    self.metrics.inc("events_pruned_total", evicted)
+        if self.metrics is not None:
+            self.metrics.inc("events_emitted_total")
+            if evicted:
+                self.metrics.inc("events_pruned_total", evicted)

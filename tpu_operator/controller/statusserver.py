@@ -143,7 +143,7 @@ class Metrics:
 
     def __init__(self) -> None:
         self._lock = threading.Lock()
-        self._families: Dict[str, _Family] = {}
+        self._families: Dict[str, _Family] = {}  # guarded-by: _lock
         for name in ("reconcile_total", "reconcile_errors_total",
                      "gc_deleted_total", "leader_elections_won_total"):
             self.register(name, "counter",
@@ -234,7 +234,7 @@ class Metrics:
             fam.series[key] = s
         return s
 
-    def _family(self, name: str, mtype: str,
+    def _family_locked(self, name: str, mtype: str,
                 buckets: Optional[Tuple[float, ...]] = None) -> _Family:
         fam = self._families.get(name)
         if fam is None:
@@ -248,13 +248,13 @@ class Metrics:
 
     def inc(self, name: str, amount: float = 1, labels: LabelsT = None) -> None:
         with self._lock:
-            fam = self._family(name, "counter")
+            fam = self._family_locked(name, "counter")
             key = _series_key(labels)
             fam.series[key] = self._series_locked(fam, key) + amount
 
     def set_gauge(self, name: str, value: float, labels: LabelsT = None) -> None:
         with self._lock:
-            fam = self._family(name, "gauge")
+            fam = self._family_locked(name, "gauge")
             fam.series[_series_key(labels)] = float(value)
 
     def observe(self, name: str, value: float, labels: LabelsT = None) -> None:
@@ -376,11 +376,11 @@ class StatusServer:
                  metrics: Optional[Metrics] = None, host: str = "") -> None:
         self.metrics = metrics if metrics is not None else Metrics()
         self._controller_lock = threading.Lock()
-        self._controller = controller
+        self._controller = controller  # guarded-by: _controller_lock
         self._leading = threading.Event()
         self._heartbeats_lock = threading.Lock()
         # (namespace, name) -> last heartbeat dict (+ receivedAt epoch)
-        self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = {}
+        self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded-by: _heartbeats_lock
         outer = self
 
         class Handler(BaseHTTPRequestHandler):

@@ -46,6 +46,12 @@ class ProcessInfo:
     attempt: int = 0
     num_slices: int = 1
     slice_id: int = 0
+    # Operator-stamped identity, carried so payload logs/artifacts can be
+    # correlated with the exact child-resource generation that produced
+    # them (child names embed the runtime id; the replica index is this
+    # process's stable slot, unlike the pod's random-suffixed name).
+    runtime_id: str = ""
+    replica_index: int = 0
 
 
 def process_info_from_env(env: Optional[dict] = None) -> ProcessInfo:
@@ -63,6 +69,8 @@ def process_info_from_env(env: Optional[dict] = None) -> ProcessInfo:
         attempt=int(e.get("TPUJOB_ATTEMPT", "0")),
         num_slices=int(e.get("MEGASCALE_NUM_SLICES", "1")),
         slice_id=int(e.get("MEGASCALE_SLICE_ID", "0")),
+        runtime_id=e.get("TPUJOB_RUNTIME_ID", ""),
+        replica_index=int(e.get("TPUJOB_REPLICA_INDEX", "0")),
     )
 
 
@@ -147,7 +155,13 @@ def enable_compilation_cache(env: Optional[dict] = None) -> str:
     — a broken cache volume must degrade warm restarts, never fail them.
     """
     e = env if env is not None else os.environ
-    path = e.get("JAX_COMPILATION_CACHE_DIR", "")
+    # TPUJOB_CACHE_PATH is the operator's own mirror of the mount point:
+    # honoring it as a fallback means a template that strips or overrides
+    # the ambient JAX var still gets the operator-wired cache (the mirror
+    # was injected-but-unread dead weight before the env-contract
+    # analyzer flagged it).
+    path = e.get("JAX_COMPILATION_CACHE_DIR", "") \
+        or e.get("TPUJOB_CACHE_PATH", "")
     if not path:
         return ""
     if e.get("TPUJOB_CACHE_ENABLED", "1").lower() in ("0", "false"):

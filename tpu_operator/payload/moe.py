@@ -29,7 +29,7 @@ import argparse
 import logging
 import os
 import math
-from typing import Any, Callable, Optional
+from typing import Optional
 
 from tpu_operator.payload import bootstrap
 from tpu_operator.payload import optimizers

@@ -28,7 +28,7 @@ from __future__ import annotations
 import argparse
 import logging
 import os
-from typing import Any, Optional
+from typing import Optional
 
 from tpu_operator.payload import bootstrap
 from tpu_operator.payload import optimizers

@@ -240,8 +240,10 @@ def build_replica_env(
         "TPUJOB_REPLICA_TYPE": replica_type.lower(),
         "TPUJOB_REPLICA_INDEX": str(index),
         "TPUJOB_ATTEMPT": str(attempt),
+        # The coordinator port rides inside the address — a separate
+        # JAX_COORDINATOR_PORT var was injected for years but read by
+        # nothing (payload or JAX; found by the env-contract analyzer).
         "JAX_COORDINATOR_ADDRESS": f"{coord_dns}:{coord_port}",
-        "JAX_COORDINATOR_PORT": str(coord_port),
         "JAX_PROCESS_ID": str(process_id),
         "JAX_NUM_PROCESSES": str(len(table)),
     }

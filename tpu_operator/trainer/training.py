@@ -90,7 +90,6 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJob,
     TPUJobPhase,
     TPUJobSpec,
-    TPUReplicaType,
 )
 from tpu_operator.client import errors
 from tpu_operator.trainer import labels as labels_mod

@@ -16,7 +16,7 @@ Resolution order (first match wins):
 from __future__ import annotations
 
 import os
-from typing import Any, Dict, Optional
+from typing import Any, Dict
 
 from tpu_operator.client.rest import RestConfig
 
