@@ -70,6 +70,14 @@ def parse_args(argv=None):
                         "reconcile latency, parallel-vs-sequential gang "
                         "creation against the in-process apiserver; exits "
                         "nonzero if the zero-read budget regresses")
+    p.add_argument("--fleet", action="store_true",
+                   help="run ONLY the fleet-scheduler rows (no JAX/TPU "
+                        "needed): ~5k TPUJobs driven through the "
+                        "slice-inventory admission queue over the "
+                        "in-process apiserver with sharded reconcile "
+                        "workers; exits nonzero if p99 reconcile latency, "
+                        "the status-write budget, or the zero-read steady "
+                        "state regresses (--quick: a few hundred jobs)")
     p.add_argument("--checkpoint", action="store_true",
                    help="run ONLY the checkpoint durability micro-rows "
                         "(CPU-hostable): verified-save + restore latency vs "
@@ -937,6 +945,291 @@ def bench_control_plane(quick: bool) -> list:
     ]
 
 
+# --- fleet scheduler (admission queue at ~5k jobs) ------------------------------
+
+FLEET_SLICE_KEY = "cloud-tpus.google.com/v4:2x2x2"
+
+
+def _fleet_job(name: str, queue: str, priority: int = 0) -> dict:
+    """One single-worker TPUJob demanding one v4 2x2x2 slice."""
+    from tpu_operator.apis.tpujob.v1alpha1 import types as t
+
+    return t.TPUJob(
+        metadata={"name": name, "namespace": "default"},
+        spec=t.TPUJobSpec(
+            replica_specs=[t.TPUReplicaSpec(
+                replicas=1,
+                template={"spec": {"containers": [
+                    {"name": "tpu", "image": "img:latest",
+                     "resources": {
+                         "limits": {"cloud-tpus.google.com/v4": 4}}}],
+                    "restartPolicy": "Never"}},
+                tpu_replica_type=t.TPUReplicaType.WORKER)],
+            runtime_id="flt1",
+            tpu_topology="2x2x2",
+            restart_backoff=t.RestartBackoffSpec(base_seconds=0),
+            scheduling=t.SchedulingSpec(priority=priority, queue=queue),
+        ),
+    ).to_dict()
+
+
+def _fleet_reads(metrics) -> float:
+    """get+list RPCs issued by the operator's clientset, summed over
+    resources (watch is the standing stream, not a steady-state read)."""
+    kinds = ("TPUJob", "Pod", "Service", "Event", "Endpoints",
+             "ConfigMap", "Lease")
+    return sum(metrics.counter_value("api_requests_total",
+                                     {"verb": verb, "resource": kind})
+               for verb in ("get", "list") for kind in kinds)
+
+
+def _fleet_status_puts(metrics) -> float:
+    return sum(metrics.counter_value("api_requests_total",
+                                     {"verb": verb, "resource": "TPUJob"})
+               for verb in ("update", "update_status"))
+
+
+def _hist_quantile_bound(metrics, name: str, q: float):
+    """Upper-bound the q-quantile from a histogram's fixed buckets: the
+    smallest bucket bound whose cumulative count covers q."""
+    snap = metrics.histogram_snapshot(name)
+    if not snap or not snap["count"]:
+        return None, 0
+    target = q * snap["count"]
+    for bound, cum in snap["buckets"].items():
+        if cum >= target:
+            return (float("inf") if bound == "+Inf" else float(bound),
+                    snap["count"])
+    return float("inf"), snap["count"]
+
+
+def bench_fleet(quick: bool) -> list:
+    """~5k TPUJobs through the slice-inventory admission queue: the REAL
+    operator (REST clientset, informers, sharded workqueue, fleet
+    scheduler, writeback limiter) over the in-process apiserver. A
+    kubelet-simulator thread succeeds every created pod, so jobs flow
+    queue → admit → gang → Done and release their slice to the next wave.
+    Asserted budgets (the CI contract): every job reaches Done, p99
+    reconcile latency, status-PUT count per job, and ZERO get/list RPCs
+    over a steady-state reconcile wave of the whole fleet (PR 3's
+    zero-read contract, at fleet scale)."""
+    import threading
+
+    from tpu_operator.apis.tpujob.v1alpha1.types import ControllerConfig
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.client.informer import SharedInformerFactory
+    from tpu_operator.client.rest import Clientset, RestConfig
+    from tpu_operator.controller.controller import Controller
+    from tpu_operator.testing.apiserver import ApiServerHarness
+
+    jobs = 384 if quick else 5000
+    capacity = 32 if quick else 128
+    shards = 4
+    deadline_s = 180 if quick else 900
+
+    backing = FakeClientset()
+    with ApiServerHarness(clientset=backing) as srv:
+        clientset = Clientset(RestConfig(host=srv.url, timeout=30.0))
+        config = ControllerConfig(
+            slice_inventory={FLEET_SLICE_KEY: capacity})
+        # resync long: steady state must be watch-driven, not re-list-driven.
+        factory = SharedInformerFactory(clientset, "default",
+                                        resync_period=600.0)
+        controller = Controller(clientset, factory, config, "default",
+                                shards=shards, writeback_qps=200.0)
+        clientset.rest.metrics = controller.metrics
+        metrics = controller.metrics
+
+        stop = threading.Event()
+        runner = threading.Thread(target=controller.run, args=(shards, stop),
+                                  daemon=True)
+        runner.start()
+
+        # Both simulator threads are WATCH consumers, not list pollers: at
+        # 5k retained pods a 20 Hz list poll deepcopies the world under the
+        # fake store's global lock and starves the apiserver it shares.
+        import copy as copy_mod
+
+        pod_watch = backing.pods.watch("default")
+        job_watch = backing.tpujobs.watch("default")
+        done_names: set = set()
+
+        def kubelet_sim() -> None:
+            # Succeed every pod the operator creates (status via the
+            # backing store, like a kubelet would; watch events flow back).
+            for event_type, pod in pod_watch:
+                if event_type not in ("ADDED", "MODIFIED"):
+                    continue
+                if (pod.get("status") or {}).get("phase"):
+                    continue
+                pod = copy_mod.deepcopy(pod)
+                pod["status"] = {
+                    "phase": "Succeeded",
+                    "containerStatuses": [{
+                        "name": "tpu",
+                        "state": {"terminated": {"exitCode": 0}}}]}
+                try:
+                    backing.pods.update("default", pod)
+                except Exception:
+                    continue  # raced a teardown
+
+        def done_tracker() -> None:
+            for _event_type, obj in job_watch:
+                if (obj.get("status") or {}).get("phase") == "Done":
+                    done_names.add((obj.get("metadata") or {}).get("name"))
+
+        kubelet = threading.Thread(target=kubelet_sim, daemon=True)
+        kubelet.start()
+        tracker = threading.Thread(target=done_tracker, daemon=True)
+        tracker.start()
+
+        try:
+            t0 = time.perf_counter()
+            for i in range(jobs):
+                backing.tpujobs.create(
+                    "default",
+                    _fleet_job(f"fl-{i:05d}", queue=("a", "b")[i % 2]))
+            submitted_s = time.perf_counter() - t0
+
+            end = time.monotonic() + deadline_s
+            while time.monotonic() < end and len(done_names) < jobs:
+                time.sleep(0.25)
+            done = len(done_names)
+            wall_s = time.perf_counter() - t0
+            if done < jobs:
+                phases: dict = {}
+                for j in backing.tpujobs.list("default"):
+                    p = (j.get("status") or {}).get("phase") or "None"
+                    phases[p] = phases.get(p, 0) + 1
+                counters = metrics.snapshot()
+                lost = []
+                for j in backing.tpujobs.list("default"):
+                    if (j.get("status") or {}).get("phase"):
+                        continue
+                    name = j["metadata"]["name"]
+                    key = f"default/{name}"
+                    cached = controller.job_informer.store.get("default",
+                                                               name)
+                    q = controller.queue
+                    shard = q.shard_for(key)
+                    dirty = key in q.shards[shard]._dirty
+                    lost.append(f"{name}(cached={cached is not None},"
+                                f"shard={shard},dirty={dirty})")
+                    if len(lost) >= 5:
+                        break
+                job_rpcs = {verb: metrics.counter_value(
+                    "api_requests_total",
+                    {"verb": verb, "resource": "TPUJob"})
+                    for verb in ("list", "watch", "get")}
+                raise RuntimeError(
+                    f"fleet bench stalled: {done}/{jobs} Done after "
+                    f"{deadline_s}s; phases={phases}; "
+                    f"queue_len={len(controller.queue)}; "
+                    f"reconciles={counters.get('reconcile_total')}; "
+                    f"errors={counters.get('reconcile_errors_total')}; "
+                    f"retries={counters.get('workqueue_retries_total')}; "
+                    f"lost={lost}; "
+                    f"cache_jobs={len(controller.job_informer.store.keys())}; "
+                    f"job_rpcs={job_rpcs}; "
+                    f"watchers={len(backing.tpujobs._watchers)}; "
+                    f"scheduler={controller.scheduler.summary()}")
+
+            # Steady-state read budget: requeue the WHOLE fleet and let it
+            # drain — every reconcile must be served from cache (PR 3's
+            # zero-read contract surviving 5k-job scale).
+            reads_before = _fleet_reads(metrics)
+            for i in range(jobs):
+                controller.queue.add(f"default/fl-{i:05d}")
+            drain_end = time.monotonic() + 60
+            while time.monotonic() < drain_end and len(controller.queue):
+                time.sleep(0.1)
+            time.sleep(0.5)  # in-flight items past the queue-length check
+            steady_reads = _fleet_reads(metrics) - reads_before
+        finally:
+            stop.set()
+            pod_watch.stop()
+            job_watch.stop()
+            runner.join(timeout=10.0)
+            kubelet.join(timeout=5.0)
+            tracker.join(timeout=5.0)
+
+    puts = _fleet_status_puts(metrics)
+    p99_bound, reconciles = _hist_quantile_bound(
+        metrics, "reconcile_duration_seconds", 0.99)
+    adm_p50, admissions = _hist_quantile_bound(
+        metrics, "tpujob_admission_latency_seconds", 0.50)
+    counters = metrics.snapshot()
+    return [
+        {
+            "metric": f"fleet_{jobs}_jobs_to_done_wall_s",
+            "value": round(wall_s, 1),
+            "unit": "s",
+            "jobs": jobs,
+            "slice_capacity": capacity,
+            "shards": shards,
+            "submit_s": round(submitted_s, 2),
+            "jobs_per_sec": round(jobs / wall_s, 1),
+            "transport": "in-process apiserver over HTTP (REST clientset)",
+        },
+        {
+            "metric": "fleet_reconcile_p99_ms",
+            "value": (round(p99_bound * 1e3, 1)
+                      if p99_bound not in (None, float("inf")) else None),
+            "unit": "ms",
+            "reconciles": reconciles,
+            "budget_ms": 500.0,
+            "note": "upper bound from fixed histogram buckets",
+        },
+        {
+            "metric": "fleet_status_puts_per_job",
+            "value": round(puts / jobs, 2),
+            "unit": "puts/job",
+            "total_puts": int(puts),
+            "budget_per_job": 8.0,
+        },
+        {
+            "metric": "fleet_steady_state_reads",
+            "value": int(steady_reads),
+            "unit": "reads",
+            "wave": jobs,
+            "budget": 0,
+        },
+        {
+            "metric": "fleet_admission_latency_p50_s",
+            "value": (round(adm_p50, 2)
+                      if adm_p50 not in (None, float("inf")) else None),
+            "unit": "s",
+            "admissions": admissions,
+            "preemptions": int(counters.get("tpujob_preemptions_total", 0)),
+            "note": "upper bound from fixed histogram buckets",
+        },
+    ]
+
+
+def _fleet_ok(rows: list) -> bool:
+    """The CI contract (hack/verify.sh runs --fleet --quick): the whole
+    fleet reaches Done (bench_fleet raises otherwise), p99 reconcile stays
+    under budget, status PUTs stay within the per-job budget, and the
+    steady-state reconcile wave issues zero read RPCs."""
+    ok = True
+    for row in rows:
+        if row["metric"] == "fleet_reconcile_p99_ms":
+            if row["value"] is None or row["value"] > row["budget_ms"]:
+                print(f"FAIL: fleet reconcile p99 {row['value']} ms over "
+                      f"budget {row['budget_ms']} ms", file=sys.stderr)
+                ok = False
+        if row["metric"] == "fleet_status_puts_per_job" \
+                and row["value"] > row["budget_per_job"]:
+            print(f"FAIL: {row['value']} status PUTs/job over budget "
+                  f"{row['budget_per_job']}", file=sys.stderr)
+            ok = False
+        if row["metric"] == "fleet_steady_state_reads" and row["value"] != 0:
+            print(f"FAIL: steady-state fleet wave issued {row['value']} "
+                  f"read RPCs (budget: 0)", file=sys.stderr)
+            ok = False
+    return ok
+
+
 # --- checkpoint durability micro-rows ------------------------------------------
 
 def _ckpt_state(size_mb: float):
@@ -1227,6 +1520,10 @@ def main(argv=None) -> int:
     if args.startup:
         rows = [_emit(row) for row in bench_startup(args.quick)]
         return 0 if _startup_ok(rows, args.quick) else 1
+    if args.fleet:
+        # Operator-only rows: no JAX import, runs anywhere (the CI gate).
+        rows = [_emit(row) for row in bench_fleet(args.quick)]
+        return 0 if _fleet_ok(rows) else 1
     if args.control_plane:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_control_plane(args.quick)]
@@ -1255,6 +1552,10 @@ def main(argv=None) -> int:
         cp_rows = [_emit(row) for row in bench_control_plane(args.quick)]
         rows.extend(cp_rows)
         if not _control_plane_ok(cp_rows):
+            return 1
+        fleet_rows = [_emit(row) for row in bench_fleet(args.quick)]
+        rows.extend(fleet_rows)
+        if not _fleet_ok(fleet_rows):
             return 1
         for row in bench_checkpoint(args.quick):
             rows.append(_emit(row))

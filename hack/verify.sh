@@ -55,6 +55,17 @@ python -m pytest tests/test_startup_path.py -x -q
 # + overlapped prologue) must beat cold time-to-first-step by the budget
 # factor, with steady-state step time held — exits nonzero otherwise.
 python bench.py --startup --quick
+# Standalone fleet-scheduler gate: slice-inventory admission (whole-gang
+# fit or phase Queued), fair-share + priority ordering, preemption victim
+# selection + the preemption-budget requeue, inventory release on
+# teardown/TTL, rebuild-from-cache after operator restart, shard-affinity
+# (one key never reconciles concurrently), and the writeback limiter.
+python -m pytest tests/test_fleet_scheduler.py -x -q
+# And the measured form: a few hundred jobs through the admission queue
+# over the in-process apiserver (sharded workers, kubelet sim) with p99
+# reconcile latency, the status-PUT budget, and the PR-3 zero-read steady
+# state asserted at fleet scale — exits nonzero on regression.
+python bench.py --fleet --quick
 # Standalone control-plane budget gate: steady-state reconcile must issue
 # ZERO read RPCs (all reads served by the informer indexes) and the first
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
@@ -68,6 +79,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_chaos_soak.py \
   --ignore=tests/test_checkpoint_chaos.py \
   --ignore=tests/test_api_budget.py \
-  --ignore=tests/test_startup_path.py
+  --ignore=tests/test_startup_path.py \
+  --ignore=tests/test_fleet_scheduler.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

@@ -46,6 +46,7 @@ RULE = "concurrency"
 SCAN = (
     ("tpu_operator", "client"),
     ("tpu_operator", "controller"),
+    ("tpu_operator", "scheduler"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "payload", "checkpoint.py"),
     ("tpu_operator", "payload", "train.py"),

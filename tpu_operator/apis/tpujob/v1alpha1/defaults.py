@@ -13,6 +13,7 @@ from __future__ import annotations
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CACHE_PATH,
+    DEFAULT_SCHEDULING_QUEUE,
     DEFAULT_TPU_PORT,
     DEFAULT_TPU_REPLICAS,
     CacheMedium,
@@ -71,6 +72,12 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
     # opts a job out of backoff entirely.
     if spec.restart_backoff is None:
         spec.restart_backoff = RestartBackoffSpec()
+
+    # Fleet scheduling: the block stays optional (None = priority 0 in the
+    # "default" queue — the scheduler applies the same fallback, so specs
+    # round-trip unchanged); a present block fills an unset/empty queue.
+    if spec.scheduling is not None and not spec.scheduling.queue:
+        spec.scheduling.queue = DEFAULT_SCHEDULING_QUEUE
 
     # Warm-restart compilation cache: the block stays opt-in (None = off),
     # but a present block fills its unset fields — ``compilationCache: {}``

@@ -115,6 +115,12 @@ def spec_schema() -> Dict[str, Any]:
             "path": _str(),
             "medium": _str(enum=list(types.CacheMedium.ALL)),
         }),
+        # Fleet scheduling: admission priority + fair-share queue.
+        "scheduling": _obj({
+            "priority": _int(minimum=-types.MAX_SCHEDULING_PRIORITY,
+                             maximum=types.MAX_SCHEDULING_PRIORITY),
+            "queue": _str(),
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -137,7 +143,8 @@ def status_schema() -> Dict[str, Any]:
     phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
               types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
               types.TPUJobPhase.FAILED, types.TPUJobPhase.DONE,
-              types.TPUJobPhase.SUSPENDED, types.TPUJobPhase.BACKOFF]
+              types.TPUJobPhase.SUSPENDED, types.TPUJobPhase.BACKOFF,
+              types.TPUJobPhase.QUEUED]
     states = [types.State.UNKNOWN, types.State.RUNNING,
               types.State.SUCCEEDED, types.State.FAILED]
     replica_states = [types.ReplicaState.UNKNOWN, types.ReplicaState.STARTING,
@@ -197,6 +204,14 @@ def status_schema() -> Dict[str, Any]:
         # breakdown (rendezvous/restore/compile/first-step seconds and
         # whether the XLA compile hit the persistent cache).
         "startup": startup_breakdown_schema(),
+        # Fleet-scheduling state: effective queue/priority, and — while
+        # phase is Queued — the admission-order position (0 = next).
+        "scheduling": _obj({
+            "queue": _str(),
+            "priority": _int(minimum=-types.MAX_SCHEDULING_PRIORITY,
+                             maximum=types.MAX_SCHEDULING_PRIORITY),
+            "position": _int(minimum=0),
+        }),
         # Most recent phase *change* (stall-watchdog baseline; RFC3339).
         "lastTransitionTime": _str(),
         # Gang-create release time while phase is Backoff (RFC3339).

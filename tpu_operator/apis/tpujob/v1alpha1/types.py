@@ -103,6 +103,11 @@ class TPUJobPhase:
     # so a crash-looping payload cannot burn its whole retry budget in
     # seconds (batch/v1 Job backoff semantics, whole-group flavored).
     BACKOFF = "Backoff"
+    # Fleet scheduling: the spec is valid but the cluster's slice inventory
+    # cannot fit the *whole* gang yet (or the job was just preempted by a
+    # higher-priority one). No pods exist; the admission queue promotes the
+    # job back to Creating when capacity frees.
+    QUEUED = "Queued"
 
 
 class State:
@@ -174,6 +179,16 @@ class CacheMedium:
 
 
 DEFAULT_CACHE_PATH = "/var/cache/tpujob/xla"
+
+
+# --- Fleet scheduling (admission queue + priority preemption) ----------------
+
+# Fair-share queue a job lands in when spec.scheduling names none.
+DEFAULT_SCHEDULING_QUEUE = "default"
+
+# Priority bound (|priority| <= this): wide enough for any tiering scheme,
+# finite so a typo'd priority cannot become an un-preemptable monopoly.
+MAX_SCHEDULING_PRIORITY = 1_000_000
 
 
 # --- Restart / gang policy (TPU-native addition) ----------------------------
@@ -301,6 +316,36 @@ class CompilationCacheSpec:
 
 
 @dataclass
+class SchedulingSpec:
+    """Fleet-scheduler knobs (``spec.scheduling``).
+
+    ``priority``: higher admits first; when a higher-priority job cannot
+    fit the slice inventory, the scheduler may preempt the lowest-priority
+    newest-admitted job (the victim's restart bills the preemption-kind
+    budget and the victim re-queues, it does not burn crash-loop budget).
+    ``queue``: fair-share bucket — at equal priority, admission favors the
+    queue currently holding the smallest share of the inventory, so one
+    tenant flooding the cluster cannot starve the others.
+    """
+
+    priority: int = 0
+    queue: str = DEFAULT_SCHEDULING_QUEUE
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"priority": self.priority, "queue": self.queue}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["SchedulingSpec"]:
+        if d is None:
+            return None
+        return cls(
+            priority=int(d.get("priority", 0)),
+            queue=str(d.get("queue", DEFAULT_SCHEDULING_QUEUE)),
+        )
+
+
+@dataclass
 class TPUReplicaSpec:
     """One replica set: N pods of one role (ref: types.go:93-104).
 
@@ -393,6 +438,10 @@ class TPUJobSpec:
     # Warm-restart fast path: persistent XLA compilation cache volume + env
     # (None = off; restarts pay full recompilation, the pre-PR-5 behavior).
     compilation_cache: Optional[CompilationCacheSpec] = None
+    # Fleet scheduling: admission priority + fair-share queue (None = the
+    # defaults, priority 0 in the "default" queue — kept absent so specs
+    # round-trip unchanged).
+    scheduling: Optional[SchedulingSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -427,6 +476,8 @@ class TPUJobSpec:
             d["ttlSecondsAfterFinished"] = self.ttl_seconds_after_finished
         if self.compilation_cache is not None:
             d["compilationCache"] = self.compilation_cache.to_dict()
+        if self.scheduling is not None:
+            d["scheduling"] = self.scheduling.to_dict()
         return d
 
     @classmethod
@@ -453,6 +504,7 @@ class TPUJobSpec:
             ttl_seconds_after_finished=opt_int("ttlSecondsAfterFinished"),
             compilation_cache=CompilationCacheSpec.from_dict(
                 d.get("compilationCache")),
+            scheduling=SchedulingSpec.from_dict(d.get("scheduling")),
         )
 
 
@@ -551,6 +603,12 @@ class TPUJobStatus:
     # the number that proves (or disproves) the warm-restart fast path on
     # a live job.
     startup: Optional[Dict[str, Any]] = None
+    # Fleet-scheduling state, written by the controller: the effective
+    # {queue, priority} the admission queue used and — while phase is
+    # Queued — the job's ``position`` in admission order (0 = next).
+    # Position updates are deliberately coarsened (material changes only)
+    # so a 5k-job queue draining does not turn into a status-write storm.
+    scheduling: Optional[Dict[str, Any]] = None
     # Time-aware recovery state:
     # RFC3339 stamp of the most recent phase *change* (unlike phaseTimeline,
     # which keeps only the first entry into each phase) — the stall
@@ -586,6 +644,8 @@ class TPUJobStatus:
             d["checkpoint"] = dict(self.checkpoint)
         if self.startup:
             d["startup"] = dict(self.startup)
+        if self.scheduling:
+            d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
             d["lastTransitionTime"] = self.last_transition_time
         if self.backoff_until:
@@ -618,6 +678,8 @@ class TPUJobStatus:
             checkpoint=(dict(d["checkpoint"])
                         if d.get("checkpoint") else None),
             startup=(dict(d["startup"]) if d.get("startup") else None),
+            scheduling=(dict(d["scheduling"])
+                        if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),
             backoff_until=str(d.get("backoffUntil", "")),
             failures=[FailureRecord.from_dict(f)
@@ -739,6 +801,12 @@ class ControllerConfig:
     ``create_parallelism`` (``--create-parallelism`` / config
     ``createParallelism``) bounds the concurrent child-create RPCs per gang
     sync; 1 degrades to the sequential path.
+    ``slice_inventory`` (``sliceInventory`` / ``--slice-inventory``) is the
+    static fleet-scheduler capacity model: ``"<resource>:<topology>" →
+    whole slices`` (e.g. ``"cloud-tpus.google.com/v4:2x2x2": 8``). Empty =
+    no admission control (every job admits immediately, the pre-fleet
+    behavior); a key absent from a non-empty map is treated as unmodeled
+    (unlimited) so a typo queues nothing forever.
     The reference also carried an unused ``GrpcServerFilePath`` field
     (types.go:176-177) — deliberately dropped here (SURVEY.md "quirks to
     fix, not copy").
@@ -747,6 +815,7 @@ class ControllerConfig:
     accelerators: Dict[str, TPUAcceleratorConfig] = field(default_factory=dict)
     status_url: str = ""
     create_parallelism: int = 16
+    slice_inventory: Dict[str, int] = field(default_factory=dict)
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -756,11 +825,28 @@ class ControllerConfig:
             d["statusUrl"] = self.status_url
         if self.create_parallelism != 16:
             d["createParallelism"] = self.create_parallelism
+        if self.slice_inventory:
+            d["sliceInventory"] = dict(self.slice_inventory)
         return d
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]) -> "ControllerConfig":
         d = d or {}
+        inventory: Dict[str, int] = {}
+        for k, v in (d.get("sliceInventory") or {}).items():
+            if int(v) < 1:
+                # Zero/negative capacity would silently queue every job of
+                # this shape forever — fail the admin config loudly instead.
+                raise ValueError(
+                    f"sliceInventory[{k!r}] must be >= 1, got {v!r}")
+            if ":" not in str(k):
+                # Demand keys are '<resource>:<topology>'; a colon-less
+                # key matches nothing and silently disables admission
+                # control for the shape it was meant to model.
+                raise ValueError(
+                    f"sliceInventory key {k!r} must be "
+                    f"'<resource>:<topology>' ('{k}:' for topology-less)")
+            inventory[str(k)] = int(v)
         return cls(
             accelerators={
                 str(k): TPUAcceleratorConfig.from_dict(v or {})
@@ -768,4 +854,5 @@ class ControllerConfig:
             },
             status_url=str(d.get("statusUrl", "")),
             create_parallelism=int(d.get("createParallelism", 16) or 16),
+            slice_inventory=inventory,
         )

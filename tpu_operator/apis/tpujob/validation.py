@@ -20,6 +20,7 @@ from typing import List
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
+    MAX_SCHEDULING_PRIORITY,
     CacheMedium,
     RestartPolicy,
     TPUJobSpec,
@@ -104,6 +105,22 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
         if bo.max_seconds < bo.base_seconds:
             raise ValidationError(
                 "restartBackoff.maxSeconds must be >= baseSeconds"
+            )
+
+    # Fleet scheduling: bounded priority (a typo'd priority must not become
+    # an un-preemptable monopoly) and a usable queue name (it becomes a
+    # metric label and a fair-share bucket key).
+    sched = spec.scheduling
+    if sched is not None:
+        if abs(sched.priority) > MAX_SCHEDULING_PRIORITY:
+            raise ValidationError(
+                f"scheduling.priority must be within "
+                f"±{MAX_SCHEDULING_PRIORITY}"
+            )
+        if not sched.queue or len(sched.queue) > 63:
+            raise ValidationError(
+                "scheduling.queue must be a non-empty string of at most "
+                "63 characters"
             )
 
     # Warm-restart compilation cache (validated only when enabled: a

@@ -270,7 +270,16 @@ class FakeClientset:
         # client maintains, so API-budget assertions and the control-plane
         # bench read one metric regardless of transport.
         self.metrics: Optional[Any] = None
-        self._version = 0
+        # Starts at 1, NOT 0: a real apiserver never hands out
+        # resourceVersion "0" — it is the client-side "any version"
+        # sentinel, and our own watch() honors that meaning (no replay
+        # guarantee). A pristine store listing at version 0 therefore
+        # anchored reflectors on "0", silently degrading their watch to
+        # from-now and swallowing every event raced into the
+        # list→watch-open window — at fleet burst rates that lost ~25%
+        # of submitted jobs until the next resync (caught by
+        # bench.py --fleet).
+        self._version = 1
         self._events: "collections.deque" = collections.deque(
             maxlen=self.EVENT_LOG_SIZE)
         self._evicted_through = 0  # highest RV ever dropped from _events

@@ -242,10 +242,16 @@ class Informer:
         # the client-go Reflector discipline: list, then watch anchored at
         # the list's RV, so the stream resumes exactly where the snapshot
         # ended — gap-free by construction. A 410 Gone on the anchored open
-        # (RV already compacted out of the server's watch window) falls back
-        # to a from-now watch for THIS cycle only; the snapshot was just
-        # taken, so the at-most-moments-wide gap is healed by the resync
-        # re-list like any other race.
+        # (RV already compacted out of the server's watch window) RE-LISTS
+        # for a fresh anchor and retries — client-go's Relist-on-410.
+        # Watching "from now" instead (the pre-fleet behavior) left a gap
+        # between the stale snapshot and the new stream that only the next
+        # resync healed: under a fleet-scale create burst that gap
+        # swallowed ~25% of submitted jobs for the whole resync period
+        # (caught by bench.py --fleet stalling with phase-None jobs and an
+        # empty queue). The re-list is self-throttling — each retry pays a
+        # full LIST — and every retry refreshes the snapshot, so progress
+        # is made even while the event log churns.
         #
         # Clients without list RVs (bare fakes) keep the round-2 order —
         # watch opens BEFORE the list so no event falls in a gap between
@@ -257,15 +263,32 @@ class Informer:
         lister = getattr(self._client, "list_with_version", None)
         if lister is not None:
             objs, rv = lister(self._namespace)
+        if rv == "0":
+            # "0" is the K8s "any version" sentinel, NOT a usable anchor:
+            # a watch opened at it carries no replay guarantee, so
+            # treating it as an anchor silently degraded to from-now and
+            # lost every event raced into the list→open window. Fall to
+            # the watch-before-list path below, which is gap-free for
+            # unanchored streams.
+            objs, rv = None, ""
         if rv:
-            try:
-                watch = self._client.watch(self._namespace,
-                                           resource_version=rv)
-            except errors.ApiError as e:
-                if not errors.is_expired(e):
-                    raise
-                log.info("anchored watch at RV %s got 410 Gone; watching "
-                         "from now (resync heals the window)", rv)
+            watch = None
+            while watch is None:
+                try:
+                    watch = self._client.watch(self._namespace,
+                                               resource_version=rv)
+                except errors.ApiError as e:
+                    if not errors.is_expired(e):
+                        raise
+                    log.info("anchored watch at RV %s got 410 Gone; "
+                             "re-listing for a fresh anchor", rv)
+                    if stop_event.is_set():
+                        return
+                    objs, rv = lister(self._namespace)
+                    if not rv or rv == "0":
+                        break  # no usable anchor any more
+            if watch is None:
+                objs = None
                 watch = self._client.watch(self._namespace)
         else:
             # No list RV (server omitted it, or bare fake): discard any

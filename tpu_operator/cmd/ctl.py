@@ -162,6 +162,26 @@ def cmd_describe(cs, opts) -> int:
     for rs in spec.get("replicaSpecs", []):
         print(f"  {rs.get('tpuReplicaType', 'WORKER')}: "
               f"{rs.get('replicas', 0)} × port {rs.get('tpuPort', '')}")
+    # Fleet-scheduling state: effective queue/priority, the admission-order
+    # position while parked in phase Queued, and — after a scheduler
+    # eviction — the reason from the failure ledger.
+    sched = {**(spec.get("scheduling") or {}),
+             **(status.get("scheduling") or {})}
+    queued = status.get("phase") == "Queued"
+    if sched or queued:
+        line = (f"Scheduling: queue {sched.get('queue', 'default')!r}, "
+                f"priority {sched.get('priority', 0)}")
+        if queued:
+            pos = sched.get("position")
+            line += (f" — queued at position {pos}" if pos is not None
+                     else " — queued")
+        print(line)
+    preemptions = [f for f in status.get("failures", [])
+                   if f.get("kind") == "preemption"]
+    if preemptions:
+        last = preemptions[-1]
+        print(f"Preempted:  attempt {last.get('attempt', 0)}: "
+              f"{last.get('reason', '')} ({last.get('time', '')})")
     if status.get("backoffUntil"):
         print(f"Backoff:    re-gang parked until {status['backoffUntil']}")
     ck = status.get("checkpoint") or {}

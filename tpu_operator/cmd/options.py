@@ -47,7 +47,26 @@ def build_parser() -> argparse.ArgumentParser:
                    help="namespace to operate in (default: "
                         "$TPU_OPERATOR_NAMESPACE / $MY_POD_NAMESPACE / default)")
     p.add_argument("--threadiness", type=int, default=1,
-                   help="concurrent reconcile workers (ref ran 1; >1 is safe here)")
+                   help="concurrent reconcile workers (ref ran 1; >1 is safe "
+                        "here); ignored when --reconcile-shards > 1")
+    p.add_argument("--reconcile-shards", type=int, default=1,
+                   help="split the reconcile loop into N per-shard workers "
+                        "with stable key-hash affinity (one worker owns one "
+                        "shard; a job never reconciles concurrently); 1 = "
+                        "the single shared workqueue")
+    p.add_argument("--status-writeback-qps", type=float, default=0.0,
+                   help="global cap on NON-critical status-writeback PUT/s "
+                        "(telemetry, replica roll-ups, queue positions); "
+                        "phase/attempt transitions always write. 0 = "
+                        "unlimited. At ~5k jobs a cap keeps telemetry churn "
+                        "from becoming thousands of PUT/s")
+    p.add_argument("--slice-inventory", default=None,
+                   help="static fleet-scheduler capacity, "
+                        "'<resource>:<topology>=<slices>[,...]' (e.g. "
+                        "'cloud-tpus.google.com/v4:2x2x4=8'); overrides the "
+                        "config file's sliceInventory (an explicit '' "
+                        "disables admission control even when the config "
+                        "file sets one)")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="informer resync/re-list period in seconds")
     p.add_argument("--no-leader-elect", action="store_true",

@@ -37,6 +37,39 @@ def read_controller_config(path: str) -> ControllerConfig:
     return ControllerConfig.from_dict(doc)
 
 
+def parse_slice_inventory(spec: str) -> dict:
+    """``--slice-inventory`` flag form → the config map:
+    '<resource>:<topology>=<slices>[,...]' (topology may be empty)."""
+    out = {}
+    for part in (spec or "").split(","):
+        part = part.strip()
+        if not part:
+            continue
+        key, _, count = part.rpartition("=")
+        if not key or not count:
+            raise ValueError(
+                f"bad --slice-inventory entry {part!r} "
+                f"(want '<resource>:<topology>=<slices>')")
+        if ":" not in key:
+            # Demand keys are always '<resource>:<topology>' (topology may
+            # be empty, but the colon is structural) — a colon-less key
+            # can never match any job and silently disables admission
+            # control for that shape.
+            raise ValueError(
+                f"bad --slice-inventory key {key!r}: want "
+                f"'<resource>:<topology>' (use '{key}:=N' for a "
+                f"topology-less shape)")
+        slices = int(count)
+        if slices < 1:
+            # A zero/negative capacity would queue every job of this shape
+            # forever with no error — the silent failure mode the
+            # inventory explicitly rejects for typos.
+            raise ValueError(
+                f"bad --slice-inventory entry {part!r}: slices must be >= 1")
+        out[key] = slices
+    return out
+
+
 def run(opts: Any, clientset: Optional[Any] = None,
         stop_event: Optional[threading.Event] = None) -> None:
     """ref: app.Run (server.go:54-132). ``clientset``/``stop_event`` are
@@ -49,6 +82,10 @@ def run(opts: Any, clientset: Optional[Any] = None,
         config.status_url = opts.advertise_status_url
     if getattr(opts, "create_parallelism", None) is not None:
         config.create_parallelism = opts.create_parallelism
+    if getattr(opts, "slice_inventory", None) is not None:
+        # The flag overrides the config file outright; an explicit ''
+        # parses to an empty map = admission control off.
+        config.slice_inventory = parse_slice_inventory(opts.slice_inventory)
     tracing.configure(span_buffer=getattr(opts, "trace_buffer",
                                           tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()
@@ -66,7 +103,10 @@ def run(opts: Any, clientset: Optional[Any] = None,
 
     factory = SharedInformerFactory(clientset, namespace,
                                     resync_period=opts.resync_period)
-    controller = Controller(clientset, factory, config, namespace)
+    controller = Controller(
+        clientset, factory, config, namespace,
+        shards=getattr(opts, "reconcile_shards", 1) or 1,
+        writeback_qps=getattr(opts, "status_writeback_qps", 0.0) or 0.0)
     # Late-bind the metrics registry into the chaos wrapper and the REST
     # transport (both exist before the controller's registry does).
     if isinstance(clientset, FlakyClientset):

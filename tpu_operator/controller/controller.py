@@ -39,6 +39,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 )
 from tpu_operator.client import errors
 from tpu_operator.client.informer import (
+    INDEX_OWNER_UID,
     Listers,
     SharedInformerFactory,
     add_child_indexes,
@@ -47,7 +48,15 @@ from tpu_operator.client.informer import (
 from tpu_operator.client.workqueue import RateLimitingQueue
 from tpu_operator.controller.deadlines import DeadlineManager
 from tpu_operator.controller.events import EventRecorder
-from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.scheduler.fleet import FleetScheduler
+from tpu_operator.scheduler.inventory import (
+    SliceInventory,
+    job_demand,
+    scheduling_params,
+)
+from tpu_operator.scheduler.sharding import ShardedWorkQueue
+from tpu_operator.scheduler.writeback import WritebackLimiter
+from tpu_operator.trainer.training import TrainingJob, live_pod
 from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
 
@@ -68,6 +77,8 @@ class Controller:
         clock: Callable[[], float] = time.monotonic,
         heartbeat_persist_interval: float = 30.0,
         wall_clock: Callable[[], float] = time.time,
+        shards: int = 1,
+        writeback_qps: float = 0.0,
     ):
         self.clientset = clientset
         self.factory = informer_factory
@@ -88,14 +99,32 @@ class Controller:
         # clientset call then ticks api_requests_total{verb,resource}.
         if getattr(clientset, "metrics", "absent") is None:
             clientset.metrics = self.metrics
-        self.queue = queue or RateLimitingQueue(clock=clock,
-                                               metrics=self.metrics)
+        # shards > 1: per-shard workers with key-hash affinity (one worker
+        # owns one shard; a key always reconciles on the same worker), each
+        # shard its own rate-limited queue. shards == 1 keeps the single
+        # RateLimitingQueue shape every existing consumer/test knows.
+        if queue is not None:
+            self.queue = queue
+        elif shards > 1:
+            self.queue = ShardedWorkQueue(shards, clock=clock,
+                                          metrics=self.metrics)
+        else:
+            self.queue = RateLimitingQueue(clock=clock, metrics=self.metrics)
         # Exact-time wakeups for time obligations (backoff release, stall
         # watchdog, active deadline, finished-TTL): the TrainingJob reports
         # its next obligation after every reconcile and the manager parks a
         # delayed enqueue for that moment (controller/deadlines.py).
         self.deadlines = DeadlineManager(self.queue, clock=wall_clock)
         self.recorder = EventRecorder(clientset, metrics=self.metrics)
+        # Fleet scheduler: the admission queue + slice inventory every
+        # TrainingJob consults. An empty inventory (no sliceInventory in
+        # config) admits everything — the pre-fleet behavior.
+        self.scheduler = FleetScheduler(
+            SliceInventory.from_config(self.config),
+            enqueue=self.queue.add, metrics=self.metrics, clock=wall_clock)
+        # Global non-critical status-PUT budget (0 = unlimited).
+        self.writeback = (WritebackLimiter(writeback_qps)
+                          if writeback_qps > 0 else None)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
         self.jobs: Dict[str, TrainingJob] = {}  # guarded-by: _jobs_lock
@@ -145,16 +174,31 @@ class Controller:
     def run(self, threadiness: int, stop_event: threading.Event) -> None:
         """Start informers, wait for cache sync, run workers until stopped
         (ref: controller.go:145-173; worker cadence via queue blocking rather
-        than the reference's 1 s wait.Until polling)."""
+        than the reference's 1 s wait.Until polling).
+
+        With a sharded queue the worker count IS the shard count — one
+        worker owns one shard, so key-hash affinity (never two workers on
+        one job) holds by construction and ``threadiness`` is ignored."""
         self.factory.start(stop_event)
         if not self.factory.wait_for_cache_sync():
             raise RuntimeError("timed out waiting for informer caches to sync")
-        log.info("caches synced; starting %d workers", threadiness)
-        workers = [
-            threading.Thread(target=self._worker, args=(stop_event,),
-                             daemon=True, name=f"reconcile-worker-{i}")
-            for i in range(threadiness)
-        ]
+        self._rebuild_scheduler_accounting()
+        num_shards = getattr(self.queue, "num_shards", None)
+        if num_shards is not None:
+            log.info("caches synced; starting %d shard workers", num_shards)
+            workers = [
+                threading.Thread(target=self._worker,
+                                 args=(stop_event, i),
+                                 daemon=True, name=f"reconcile-shard-{i}")
+                for i in range(num_shards)
+            ]
+        else:
+            log.info("caches synced; starting %d workers", threadiness)
+            workers = [
+                threading.Thread(target=self._worker, args=(stop_event,),
+                                 daemon=True, name=f"reconcile-worker-{i}")
+                for i in range(threadiness)
+            ]
         for w in workers:
             w.start()
         stop_event.wait()
@@ -162,13 +206,41 @@ class Controller:
         for w in workers:
             w.join(timeout=5.0)
 
-    def _worker(self, stop_event: threading.Event) -> None:
+    def _rebuild_scheduler_accounting(self) -> None:
+        """Fleet-scheduler restart rebuild, EAGER: before any worker runs,
+        re-reserve the slices of every cached job whose persisted state
+        shows held hardware (phase Running/Backoff, or Creating with gang
+        pods in the cache). The per-reconcile force-admit path covers the
+        same ground lazily, but lazily is not enough: a job created right
+        after an operator restart can reconcile BEFORE an old Running
+        job's first pass and be admitted into capacity that is physically
+        occupied (caught by the kill -9 e2e drive)."""
+        for obj in self.job_informer.store.list():
+            job = TPUJob.from_dict(obj)
+            phase = job.status.phase
+            holds = phase in (TPUJobPhase.RUNNING, TPUJobPhase.BACKOFF)
+            if not holds and phase == TPUJobPhase.CREATING:
+                holds = any(live_pod(p) for p in
+                            self.listers.pods.by_index(INDEX_OWNER_UID,
+                                                       job.uid))
+            if not holds:
+                continue
+            priority, queue = scheduling_params(job.spec)
+            self.scheduler.ensure_admitted(
+                f"{job.namespace}/{job.name}", uid=job.uid,
+                demand=job_demand(job.spec),
+                priority=priority, queue=queue,
+                holds_hardware=True)
+
+    def _worker(self, stop_event: threading.Event,
+                shard: Optional[int] = None) -> None:
         while not stop_event.is_set():
-            if not self.process_next_work_item(timeout=0.5):
+            if not self.process_next_work_item(timeout=0.5, shard=shard):
                 if self.queue.is_shutdown:  # drained and closed
                     return
 
-    def process_next_work_item(self, timeout: Optional[float] = None) -> bool:
+    def process_next_work_item(self, timeout: Optional[float] = None,
+                               shard: Optional[int] = None) -> bool:
         """One queue pop → sync → ack cycle (ref: controller.go:175-203).
         Returns False if nothing was processed.
 
@@ -176,7 +248,10 @@ class Controller:
         every nested ``@traced`` call (sync_tpujob → reconcile → ...) shares
         one trace id, visible in ``GET /api/traces``; the reconcile duration
         feeds the ``reconcile_duration_seconds`` histogram."""
-        key = self.queue.get(timeout=timeout)
+        if shard is not None:
+            key = self.queue.get(timeout=timeout, shard=shard)
+        else:
+            key = self.queue.get(timeout=timeout)
         if key is None:
             return False
         start = self._clock()
@@ -213,6 +288,9 @@ class Controller:
                 self._hb_persisted.pop(key, None)
             self.recorder.forget_object(namespace, name)
             self.deadlines.forget(key)
+            # A deleted job's slice reservation (or queue slot) frees for
+            # the next pending gang.
+            self.scheduler.release(key)
             return True
 
         job = TPUJob.from_dict(cached)
@@ -223,7 +301,9 @@ class Controller:
                 # (ref: controller.go:237-245).
                 tj = TrainingJob(self.clientset, self.recorder, job,
                                  self.config, metrics=self.metrics,
-                                 listers=self.listers)
+                                 listers=self.listers,
+                                 scheduler=self.scheduler,
+                                 writeback=self.writeback)
                 self.jobs[key] = tj
             else:
                 tj.refresh(job)

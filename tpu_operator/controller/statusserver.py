@@ -70,6 +70,10 @@ BACKOFF_BUCKETS = (1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 360.0, 600.0)
 # a flagship payload) — log-spaced across both regimes.
 STARTUP_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
                    600.0)
+# Admission latency spans a sub-second rebalance (capacity free on arrival)
+# to hours parked behind a full cluster.
+ADMISSION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
+                     3600.0, 14400.0)
 
 LabelsT = Optional[Dict[str, str]]
 
@@ -190,6 +194,18 @@ class Metrics:
                       "Attempts whose XLA compile was served from the "
                       "persistent compilation cache (warm restart), per "
                       "startup breakdown reports.")
+        self.register("tpujob_preemptions_total", "counter",
+                      "Admitted jobs evicted by the fleet scheduler so a "
+                      "higher-priority job could fit the slice inventory "
+                      "(the victim re-queues on the preemption budget).")
+        self.register("tpujob_queue_depth", "gauge",
+                      "TPUJobs parked in the admission queue (phase "
+                      "Queued), by fair-share queue.")
+        self.register("tpujob_admission_latency_seconds", "histogram",
+                      "Time from entering the admission queue to slice "
+                      "reservation (zero-wait admissions observe ~0; "
+                      "rebuild force-admissions are not observed).",
+                      ADMISSION_BUCKETS)
         self.register("reconcile_duration_seconds", "histogram",
                       "Wall time of one reconcile pass.", RECONCILE_BUCKETS)
         self.register("workqueue_queue_duration_seconds", "histogram",
@@ -285,6 +301,26 @@ class Metrics:
                 if fam.mtype == "counter":
                     out[fam.name] = sum(fam.series.values())
         return out
+
+    def remove_series(self, name: str, labels: LabelsT = None) -> None:
+        """Drop one labeled series (gauge pruning: user-keyed label values
+        — e.g. fair-share queue names — must not accumulate forever; the
+        same slow-leak class the event dedup cache bounds)."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is not None:
+                fam.series.pop(_series_key(labels), None)
+
+    def counter_value(self, name: str, labels: LabelsT = None) -> float:
+        """One labeled counter/gauge series' value (0.0 when absent) —
+        the label-exact read the budget benches assert against, where
+        snapshot() would sum away the {verb,resource} split."""
+        with self._lock:
+            fam = self._families.get(name)
+            if fam is None or fam.mtype == "histogram":
+                return 0.0
+            value = fam.series.get(_series_key(labels))
+            return float(value) if value is not None else 0.0
 
     def histogram_snapshot(self, name: str, labels: LabelsT = None
                            ) -> Optional[Dict[str, Any]]:

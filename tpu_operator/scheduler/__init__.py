@@ -1,0 +1,21 @@
+"""Fleet scheduler: slice-inventory admission, priority preemption, and
+the fleet-scale control-plane plumbing (sharded workqueues, writeback
+rate limiting) that lets ONE operator drive thousands of TPUJobs.
+
+The reference tf-operator reconciled every job independently with no
+admission control — a pod-creating free-for-all that cannot model a
+cluster's finite TPU slice inventory (SURVEY.md). This package is the
+many-jobs half of the control plane:
+
+- ``inventory``  — the capacity model: (accelerator resource, topology) →
+  whole slices, fed from static config or discovered node objects, plus
+  the per-job gang demand derivation.
+- ``fleet``      — the admission queue: gangs admit only when their WHOLE
+  demand fits, fair-share across queues, priority preemption of the
+  lowest-priority newest-admitted job, rebuilt from informer caches on
+  operator restart (no persisted scheduler state).
+- ``sharding``   — N rate-limited workqueues with stable key-hash routing,
+  so reconcile workers scale without ever processing one job concurrently.
+- ``writeback``  — a global token bucket over non-critical status PUTs, so
+  5k jobs' telemetry churn does not become 5k PUT/s.
+"""

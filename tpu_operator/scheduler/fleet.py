@@ -1,0 +1,415 @@
+"""The fleet admission queue: gang-level admission, fair share across
+queues, and priority preemption over the slice inventory.
+
+One instance lives on the controller and every TrainingJob consults it
+from its reconcile:
+
+- ``ensure_admitted`` — the reconcile-time gate before any gang create: a
+  job whose whole demand fits is admitted (capacity reserved); otherwise
+  it parks in the pending queue and the TrainingJob shows phase
+  ``Queued``. Admission order is strict priority first, then fair share
+  (the queue holding the smallest slice share goes first), then FIFO.
+  An unfittable head blocks later arrivals OF ITS OWN SLICE SHAPE on
+  purpose — a large gang is not starved by a stream of small later
+  arrivals (K8s gang schedulers: Kueue, Volcano, same call) — but never
+  blocks other shapes, whose pools are independent capacity.
+- ``pop_eviction`` — preemption delivery: when a higher-priority pending
+  job cannot fit, the rebalance marks the cheapest sufficient victim set
+  (lowest priority first, newest admitted first, same slice shape) and
+  enqueues their reconciles; each victim's reconcile pops its directive,
+  tears the gang down as a *preemption-kind* restart (the PR-2 budget —
+  eviction must not burn crash-loop budget) and re-queues.
+- ``release`` — teardown/TTL/terminal failure/suspend return the slices
+  and trigger a rebalance; newly fitting jobs are admitted and their keys
+  enqueued so their reconciles promote them out of ``Queued``.
+
+Restart-vs-release contract: ordinary whole-group restarts (crash,
+preemption-by-kubelet, stall) RETAIN their reservation through
+teardown/Backoff — the gang is coming back, and releasing would let a
+queued job steal the slot out from under every restart. Only scheduler
+eviction, suspension, and terminal/teardown paths release.
+
+Restart rebuild: no scheduler state is persisted. A job that already
+holds hardware (phase Running, or Creating with live pods in the informer
+cache) is *force-admitted* on its first post-restart reconcile — capacity
+may transiently over-commit past config, which is the truth on the ground
+and drains as jobs finish.
+
+Exported metrics (registered in controller/statusserver.py):
+``tpujob_queue_depth{queue}``, ``tpujob_admission_latency_seconds``,
+``tpujob_preemptions_total``.
+"""
+
+from __future__ import annotations
+
+import logging
+import threading
+import time
+from dataclasses import dataclass, field
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1.types import DEFAULT_SCHEDULING_QUEUE
+from tpu_operator.scheduler.inventory import SliceInventory
+
+log = logging.getLogger(__name__)
+
+# Bound on queue names tracked for gauge zeroing: spec.scheduling.queue is
+# user-supplied, and a tenant minting a queue name per run would otherwise
+# grow the tracking set AND the tpujob_queue_depth series forever (the
+# PR-1 event-dedup-cache slow-leak class). Idle queues beyond the cap are
+# dropped from tracking and their series removed from the registry.
+QUEUE_GAUGE_CAP = 256
+
+
+@dataclass
+class _Entry:
+    """One job known to the scheduler (pending or admitted)."""
+
+    key: str          # ns/name — the reconcile key
+    uid: str          # object UID: a re-created job is a new entry
+    demand_key: str   # inventory key (resource:topology)
+    slices: int       # whole slices the gang needs
+    priority: int
+    queue: str
+    seq: int          # arrival order (FIFO tie-break)
+    enqueued_at: float = 0.0   # pending: when it first queued (latency)
+    admit_seq: int = 0         # admitted: admission order (victim pick)
+    forced: bool = field(default=False)  # rebuild path (no latency sample)
+    # Demand exceeds the shape's TOTAL modeled capacity: can never fit,
+    # must never head-block the shape, and the job's status says so.
+    impossible: bool = field(default=False)
+
+
+class FleetScheduler:
+    """Admission queue + preemption over a :class:`SliceInventory`.
+
+    ``enqueue`` is the controller's workqueue add — the scheduler uses it
+    to wake the reconciles of jobs it just admitted or marked for
+    eviction. ``clock`` is the wall clock (admission latency)."""
+
+    def __init__(self, inventory: Optional[SliceInventory] = None,
+                 enqueue: Optional[Callable[[str], None]] = None,
+                 metrics: Optional[Any] = None,
+                 clock: Callable[[], float] = time.time):
+        self._enqueue = enqueue
+        self._metrics = metrics
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._inventory = inventory or SliceInventory()  # guarded-by: _lock
+        self._admitted: Dict[str, _Entry] = {}  # guarded-by: _lock
+        self._pending: Dict[str, _Entry] = {}  # guarded-by: _lock
+        # key -> (victim uid, reason): UID-scoped so a directive aimed at
+        # a deleted job can never preempt a same-name successor.
+        self._evicting: Dict[str, Tuple[str, str]] = {}  # guarded-by: _lock
+        self._known_queues: set = set()  # gauge zeroing; guarded-by: _lock
+        self._seq = 0  # guarded-by: _lock
+
+    # -- the reconcile-time gate -----------------------------------------------
+
+    def ensure_admitted(self, key: str, *, uid: str,
+                        demand: Optional[Tuple[str, int]],
+                        priority: int = 0,
+                        queue: str = DEFAULT_SCHEDULING_QUEUE,
+                        holds_hardware: Any = False) -> bool:
+        """True when ``key`` may (continue to) run its gang.
+
+        ``demand`` is ``inventory.job_demand(spec)``; None = zero-footprint
+        job, admitted unconditionally and never tracked. ``holds_hardware``
+        is the rebuild signal (bool or zero-arg callable, evaluated only
+        past the admitted fast path): the job's persisted phase/children
+        show it already owns its slices, so refuse-and-queue would be
+        fiction — reserve unconditionally instead (see module docstring).
+
+        A spec edit that changes demand while admitted keeps the original
+        reservation until the next release — resizing a live gang is the
+        elastic-parallelism item (ROADMAP), not an admission concern."""
+        if demand is None:
+            return True
+        demand_key, slices = demand
+        wake: List[str] = []
+        with self._lock:
+            ent = self._admitted.get(key)
+            if ent is not None and ent.uid == uid:
+                return True
+            if ent is not None:
+                # Same name, new UID: the old job's reservation is stale.
+                self._release_locked(ent)
+            if callable(holds_hardware):
+                holds_hardware = holds_hardware()
+            if holds_hardware:
+                self._seq += 1
+                self._inventory.reserve(demand_key, slices)
+                self._admitted[key] = _Entry(
+                    key=key, uid=uid, demand_key=demand_key, slices=slices,
+                    priority=priority, queue=queue, seq=self._seq,
+                    admit_seq=self._seq, forced=True)
+                self._pending.pop(key, None)
+                self._update_gauges_locked()
+                return True
+            pend = self._pending.get(key)
+            if (pend is None or pend.uid != uid
+                    or pend.demand_key != demand_key
+                    or pend.slices != slices
+                    or pend.priority != priority or pend.queue != queue):
+                self._seq += 1
+                self._pending[key] = _Entry(
+                    key=key, uid=uid, demand_key=demand_key, slices=slices,
+                    priority=priority, queue=queue, seq=self._seq,
+                    enqueued_at=(pend.enqueued_at
+                                 if pend is not None and pend.uid == uid
+                                 else self._clock()))
+            wake = self._rebalance_locked()
+            admitted = key in self._admitted
+        self._notify(wake, skip=key)
+        return admitted
+
+    def pop_eviction(self, key: str,
+                     uid: Optional[str] = None) -> Optional[str]:
+        """Deliver (and consume) a pending preemption directive for
+        ``key``: releases the victim's reservation and rebalances — the
+        waiting higher-priority job admits off the freed capacity.
+        Returns the human-readable reason, or None when the job is not
+        marked. ``uid`` scopes delivery: a directive recorded against a
+        different UID targeted a deleted predecessor of the same name and
+        is dropped, never applied to the successor. (None = match any —
+        test convenience.) ``tpujob_preemptions_total`` ticks at the
+        caller's actual teardown, not here: a victim whose gang already
+        succeeded consumes the directive without being evicted."""
+        with self._lock:
+            entry = self._evicting.get(key)
+            if entry is None:
+                return None
+            marked_uid, reason = entry
+            del self._evicting[key]
+            if uid is not None and marked_uid != uid:
+                # Stale directive for a dead predecessor: its reservation
+                # was already released when the old job went away; do not
+                # touch the successor's state.
+                return None
+            ent = self._admitted.pop(key, None)
+            if ent is not None:
+                self._inventory.release(ent.demand_key, ent.slices)
+            wake = self._rebalance_locked()
+        self._notify(wake, skip=key)
+        return reason
+
+    def release(self, key: str) -> None:
+        """Return ``key``'s slices (teardown/TTL/terminal/suspend/deleted)
+        and drop it from the queue entirely. Idempotent."""
+        with self._lock:
+            ent = self._admitted.pop(key, None)
+            self._evicting.pop(key, None)
+            self._pending.pop(key, None)
+            if ent is not None:
+                self._inventory.release(ent.demand_key, ent.slices)
+            wake = self._rebalance_locked()
+            self._update_gauges_locked()
+        self._notify(wake, skip=key)
+
+    # -- introspection ---------------------------------------------------------
+
+    def is_admitted(self, key: str) -> bool:
+        with self._lock:
+            return key in self._admitted
+
+    def unschedulable_reason(self, key: str) -> Optional[str]:
+        """Why a pending job can NEVER admit as specced (None = it is
+        merely waiting): surfaces 'demand exceeds total capacity' into
+        status.reason instead of an indistinguishable eternal Queued."""
+        with self._lock:
+            ent = self._pending.get(key)
+            if ent is None or not ent.impossible:
+                return None
+            total = self._inventory.capacity(ent.demand_key)
+            return (f"demand of {ent.slices} slice(s) of {ent.demand_key} "
+                    f"exceeds the inventory's total capacity ({total})")
+
+    def queue_position(self, key: str) -> Optional[int]:
+        """0-based admission-order position of a pending job (0 = next),
+        or None when it is not pending. O(pending) — called from the
+        (rare) reconciles of queued jobs, not from any hot loop."""
+        with self._lock:
+            ent = self._pending.get(key)
+            if ent is None:
+                return None
+            usage = self._queue_usage_locked()
+            me = self._order_key_locked(ent, usage)
+            return sum(1 for other in self._pending.values()
+                       if other.key != key
+                       and self._order_key_locked(other, usage) < me)
+
+    def summary(self) -> Dict[str, Any]:
+        """Bench/test view: counts + inventory snapshot."""
+        with self._lock:
+            return {
+                "admitted": len(self._admitted),
+                "pending": len(self._pending),
+                "evicting": len(self._evicting),
+                "inventory": self._inventory.snapshot(),
+            }
+
+    # -- internals (call with _lock held) --------------------------------------
+
+    def _release_locked(self, ent: _Entry) -> None:
+        self._admitted.pop(ent.key, None)
+        # A directive aimed at the entry being released is moot (and must
+        # never leak onto a same-name successor).
+        self._evicting.pop(ent.key, None)
+        self._inventory.release(ent.demand_key, ent.slices)
+
+    def _queue_usage_locked(self) -> Dict[str, int]:
+        """Slices currently admitted per fair-share queue."""
+        usage: Dict[str, int] = {}
+        for ent in self._admitted.values():
+            usage[ent.queue] = usage.get(ent.queue, 0) + ent.slices
+        return usage
+
+    def _order_key_locked(self, ent: _Entry, usage: Dict[str, int]) -> tuple:
+        """Admission order: priority desc, then the queue with the
+        smallest admitted share, then FIFO."""
+        return (-ent.priority, usage.get(ent.queue, 0), ent.seq)
+
+    def _rebalance_locked(self) -> List[str]:
+        """Admit pending jobs in order while they fit. An unfittable head
+        blocks further admission OF ITS OWN SLICE SHAPE only (and gets a
+        preemption attempt): big gangs must not be starved by small later
+        arrivals of the same shape, but a full v4 pool must never park
+        v5e jobs whose own pool has free slices. Returns the keys whose
+        reconciles must be woken (new admissions + new victims)."""
+        wake: List[str] = []
+        blocked: set = set()  # demand_keys with an unfittable head
+        while True:
+            usage = self._queue_usage_locked()
+            candidates = [e for e in self._pending.values()
+                          if e.demand_key not in blocked
+                          and not e.impossible]
+            if not candidates:
+                break
+            head = min(candidates,
+                       key=lambda e: self._order_key_locked(e, usage))
+            if not self._inventory.fits(head.demand_key, head.slices):
+                total = self._inventory.capacity(head.demand_key)
+                if total is not None and head.slices > total:
+                    # Demand exceeds the shape's TOTAL capacity: it can
+                    # NEVER fit, no victim set can change that, and head-
+                    # blocking its shape would silently starve every later
+                    # same-shape job off one typo'd numSlices. Sideline it
+                    # (the job's status.reason says why) and keep going.
+                    head.impossible = True
+                    log.warning(
+                        "fleet: %s demands %d slices of %s but the "
+                        "inventory models only %d total — unschedulable "
+                        "until capacity or the spec changes",
+                        head.key, head.slices, head.demand_key, total)
+                    wake.append(head.key)
+                    continue
+                wake.extend(self._mark_victims_locked(head))
+                blocked.add(head.demand_key)
+                continue
+            self._pending.pop(head.key)
+            self._seq += 1
+            head.admit_seq = self._seq
+            self._inventory.reserve(head.demand_key, head.slices)
+            self._admitted[head.key] = head
+            wake.append(head.key)
+            if self._metrics is not None and head.enqueued_at:
+                self._metrics.observe(
+                    "tpujob_admission_latency_seconds",
+                    max(0.0, self._clock() - head.enqueued_at))
+        self._cancel_unjustified_evictions_locked()
+        self._update_gauges_locked()
+        return wake
+
+    def _cancel_unjustified_evictions_locked(self) -> None:
+        """Rescind eviction directives that no pending job justifies any
+        more: if the blocked head that demanded the victims was admitted
+        off independently freed capacity (or deleted), tearing the
+        victims down anyway would preempt healthy gangs for nothing. An
+        eviction stays justified only while some still-pending job of the
+        same slice shape carries a strictly higher priority."""
+        for key in list(self._evicting):
+            marked_uid, _reason = self._evicting[key]
+            ent = self._admitted.get(key)
+            if ent is None:
+                continue  # released/rebuilt elsewhere; pop will no-op it
+            if ent.uid != marked_uid:
+                # The marked victim is gone; the same-name successor's
+                # admission must not inherit its death warrant.
+                del self._evicting[key]
+                continue
+            justified = any(p.demand_key == ent.demand_key
+                            and p.priority > ent.priority
+                            for p in self._pending.values())
+            if not justified:
+                del self._evicting[key]
+                log.info("fleet: cancelling eviction of %s (capacity "
+                         "freed elsewhere; no pending higher-priority "
+                         "job needs it)", key)
+
+    def _mark_victims_locked(self, head: _Entry) -> List[str]:
+        """Victim selection for a blocked higher-priority head: admitted
+        jobs of the same slice shape with strictly lower priority, lowest
+        priority first and newest admitted first, just enough of them to
+        fit the head once they drain. No sufficient set → no eviction
+        (pointlessly killing jobs that cannot free enough is worse than
+        waiting)."""
+        need = head.slices - self._inventory.free(head.demand_key)
+        # Capacity already draining from in-flight evictions counts: their
+        # reconciles will release it, and double-marking new victims for
+        # the same shortfall would cascade evictions on every rebalance.
+        need -= sum(v.slices for k, v in self._admitted.items()
+                    if k in self._evicting and v.demand_key == head.demand_key)
+        if need <= 0:
+            return []
+        candidates = sorted(
+            (v for k, v in self._admitted.items()
+             if k not in self._evicting
+             and v.demand_key == head.demand_key
+             and v.priority < head.priority),
+            key=lambda v: (v.priority, -v.admit_seq))
+        chosen: List[_Entry] = []
+        freed = 0
+        for victim in candidates:
+            chosen.append(victim)
+            freed += victim.slices
+            if freed >= need:
+                break
+        if freed < need:
+            return []
+        for victim in chosen:
+            reason = (f"preempted by higher-priority job {head.key} "
+                      f"(priority {head.priority} > {victim.priority})")
+            self._evicting[victim.key] = (victim.uid, reason)
+            log.info("fleet: marking %s for preemption (%s)",
+                     victim.key, reason)
+        return [v.key for v in chosen]
+
+    def _update_gauges_locked(self) -> None:
+        if self._metrics is None:
+            return
+        depths: Dict[str, int] = {}
+        for ent in self._pending.values():
+            depths[ent.queue] = depths.get(ent.queue, 0) + 1
+        self._known_queues.update(depths)
+        if len(self._known_queues) > QUEUE_GAUGE_CAP:
+            # Evict idle (zero-depth) queues first; their series leave the
+            # registry so /metrics stays bounded under queue-name churn.
+            for queue in sorted(self._known_queues - set(depths)):
+                if len(self._known_queues) <= QUEUE_GAUGE_CAP:
+                    break
+                self._known_queues.discard(queue)
+                self._metrics.remove_series("tpujob_queue_depth",
+                                            labels={"queue": queue})
+        for queue in self._known_queues:
+            self._metrics.set_gauge("tpujob_queue_depth",
+                                    depths.get(queue, 0),
+                                    labels={"queue": queue})
+
+    # -- wakeups (outside the lock: enqueue takes the workqueue's lock) --------
+
+    def _notify(self, keys: List[str], skip: str = "") -> None:
+        if self._enqueue is None:
+            return
+        for key in keys:
+            if key != skip:
+                self._enqueue(key)

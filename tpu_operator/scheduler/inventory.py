@@ -1,0 +1,174 @@
+"""Slice-inventory model: how much TPU hardware the cluster has, in the
+unit gangs are scheduled in — whole slices of one (accelerator resource,
+topology) shape.
+
+A TPU slice is indivisible: a v4-32 (topology 2x2x4) is acquired and
+released as a unit, and a JAX gang needs *all* of its slices live before
+any member computes (SURVEY.md §7 gang hard part). The inventory therefore
+counts slices, not chips: capacity is ``"<resource>:<topology>" → N whole
+slices`` and a job's demand is ``spec.numSlices`` slices of its shape.
+
+Two feeds:
+
+- **static config** (``ControllerConfig.slice_inventory`` /
+  ``--slice-inventory``) — the admin declares what the cluster owns;
+- **discovered node objects** (:func:`SliceInventory.from_node_objects`) —
+  nodes advertising a TPU resource in ``status.allocatable`` are grouped by
+  (resource, topology label, slice-id label) and each distinct slice id
+  counts one slice. Nodes without a slice-id label count one slice each
+  (single-host slices).
+
+Empty inventory = no admission control (every demand fits — the pre-fleet
+behavior, and what keeps every existing test/job flow unchanged). A key
+absent from a *non-empty* inventory is "unmodeled" and also always fits:
+queueing a job forever on a config typo is strictly worse than
+over-admitting it.
+
+Not thread-safe on its own: the FleetScheduler owns one instance and
+guards it with its lock.
+"""
+
+from __future__ import annotations
+
+from typing import Any, Dict, Iterable, Optional, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_SCHEDULING_QUEUE,
+    TPU_RESOURCE_PREFIX,
+    TPUJobSpec,
+)
+
+# Node labels the discovery path reads (GKE publishes the topology label on
+# TPU node pools; the slice-id label groups the hosts of one multi-host
+# slice — absent on single-host slices).
+NODE_TOPOLOGY_LABEL = "cloud.google.com/gke-tpu-topology"
+NODE_SLICE_ID_LABEL = "tpuoperator.dev/slice-id"
+
+
+def slice_key(resource: str, topology: str) -> str:
+    """Canonical inventory key: ``<resource>:<topology>`` ('' topology ok)."""
+    return f"{resource}:{topology}"
+
+
+def tpu_resource_name(template: Optional[Dict[str, Any]]) -> str:
+    """First ``cloud-tpus.google.com/*`` resource name a pod template
+    requests ('' when it requests none) — the accelerator half of the
+    job's slice shape (the chip *count* rides on the template too, but
+    slices are the scheduling unit, so only the shape matters here)."""
+    pod_spec = (template or {}).get("spec") or {}
+    for container in pod_spec.get("containers") or []:
+        resources = container.get("resources") or {}
+        for section in ("requests", "limits"):
+            for res_name in resources.get(section) or {}:
+                if str(res_name).startswith(TPU_RESOURCE_PREFIX):
+                    return str(res_name)
+    return ""
+
+
+def scheduling_params(spec: TPUJobSpec) -> Tuple[int, str]:
+    """(priority, queue) the admission queue uses for a spec — the ONE
+    place the absent-block/empty-queue fallback lives, so the live
+    reconcile path and the controller's restart rebuild can never drift
+    into different fair-share buckets."""
+    sched = spec.scheduling
+    if sched is None:
+        return 0, DEFAULT_SCHEDULING_QUEUE
+    return sched.priority, sched.queue or DEFAULT_SCHEDULING_QUEUE
+
+
+def job_demand(spec: TPUJobSpec) -> Optional[Tuple[str, int]]:
+    """(inventory key, whole slices) one gang of this job occupies, or
+    None for a zero-footprint job (no replica set requests TPU chips) —
+    those admit unconditionally and are never tracked."""
+    for rs in spec.replica_specs:
+        resource = tpu_resource_name(rs.template)
+        if resource:
+            return (slice_key(resource, spec.tpu_topology),
+                    max(1, spec.num_slices))
+    return None
+
+
+class SliceInventory:
+    """Slice-granular capacity ledger: reserve on admission, release on
+    teardown/TTL/terminal failure. Reservations may exceed capacity via
+    :meth:`reserve` — the rebuild-from-cache path re-admits jobs that
+    already hold hardware, and refusing them would be fiction; the
+    over-commit drains as those jobs finish."""
+
+    def __init__(self, capacity: Optional[Dict[str, int]] = None):
+        self._capacity: Dict[str, int] = {
+            str(k): int(v) for k, v in (capacity or {}).items()}
+        self._used: Dict[str, int] = {}
+
+    @classmethod
+    def from_config(cls, config: Any) -> "SliceInventory":
+        """Static feed: ``ControllerConfig.slice_inventory``."""
+        return cls(getattr(config, "slice_inventory", None) or {})
+
+    @classmethod
+    def from_node_objects(cls, nodes: Iterable[Dict[str, Any]]
+                          ) -> "SliceInventory":
+        """Discovery feed: count distinct slices per (resource, topology)
+        across node objects (see module docstring for the label contract)."""
+        slices: Dict[str, set] = {}
+        for node in nodes:
+            md = node.get("metadata") or {}
+            labels = md.get("labels") or {}
+            allocatable = ((node.get("status") or {})
+                           .get("allocatable") or {})
+            resource = next(
+                (str(r) for r in allocatable
+                 if str(r).startswith(TPU_RESOURCE_PREFIX)), "")
+            if not resource:
+                continue
+            key = slice_key(resource, str(labels.get(NODE_TOPOLOGY_LABEL,
+                                                     "")))
+            # One slice per distinct slice id; an unlabeled node is its own
+            # single-host slice (keyed by node name).
+            sid = labels.get(NODE_SLICE_ID_LABEL) or f"node:{md.get('name', '')}"
+            slices.setdefault(key, set()).add(sid)
+        return cls({k: len(v) for k, v in slices.items()})
+
+    # -- queries ---------------------------------------------------------------
+
+    @property
+    def empty(self) -> bool:
+        return not self._capacity
+
+    def modeled(self, key: str) -> bool:
+        return key in self._capacity
+
+    def capacity(self, key: str) -> Optional[int]:
+        """Total modeled slices of a shape (None when unmodeled) — what
+        distinguishes 'waiting for capacity' from 'can NEVER fit'."""
+        return self._capacity.get(key)
+
+    def free(self, key: str) -> int:
+        if key not in self._capacity:
+            return 0
+        return self._capacity[key] - self._used.get(key, 0)
+
+    def fits(self, key: str, slices: int) -> bool:
+        """Whether a whole gang of ``slices`` slices fits right now.
+        Unmodeled keys always fit (module docstring)."""
+        if key not in self._capacity:
+            return True
+        return self.free(key) >= slices
+
+    def snapshot(self) -> Dict[str, Dict[str, int]]:
+        """Introspection view: key → {capacity, used}."""
+        return {k: {"capacity": c, "used": self._used.get(k, 0)}
+                for k, c in sorted(self._capacity.items())}
+
+    # -- accounting ------------------------------------------------------------
+
+    def reserve(self, key: str, slices: int) -> None:
+        """Unchecked reservation (callers decide via fits(); the rebuild
+        path reserves past capacity on purpose). Unmodeled keys are not
+        tracked — there is nothing to account against."""
+        if key in self._capacity:
+            self._used[key] = self._used.get(key, 0) + slices
+
+    def release(self, key: str, slices: int) -> None:
+        if key in self._capacity:
+            self._used[key] = max(0, self._used.get(key, 0) - slices)

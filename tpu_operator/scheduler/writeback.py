@@ -1,0 +1,64 @@
+"""Status-writeback rate limiting: a global token bucket over
+*non-critical* status PUTs.
+
+At fleet scale the status writes that matter (phase/attempt transitions —
+the restart machinery's source of truth) are a small fraction of the
+writes a naive controller issues: heartbeat telemetry, replica-state
+roll-up deltas, and queue-position updates would turn 5k jobs into 5k
+PUT/s against the apiserver. The limiter gates only the non-critical
+class: a deferred write leaves the in-memory status dirty, the
+TrainingJob arms a retry obligation, and the coalesced state lands in ONE
+PUT when a token frees — the same ride-along idiom the heartbeat
+coalescing already uses.
+
+Critical writes (phase, attempt, state, reason, backoff transitions and
+setup's spec persistence) NEVER wait here: correctness transitions must
+not queue behind telemetry.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from typing import Callable
+
+
+class WritebackLimiter:
+    """Token bucket: ``qps`` sustained PUT/s with a ``burst`` reservoir.
+
+    ``allow()`` consumes a token when available; callers defer the write
+    otherwise and use ``retry_after()`` to arm the retry obligation.
+    Thread-safe: every reconcile worker shares one instance."""
+
+    def __init__(self, qps: float, burst: int = 0,
+                 clock: Callable[[], float] = time.monotonic):
+        if qps <= 0:
+            raise ValueError("qps must be > 0 (use no limiter for unlimited)")
+        self._qps = float(qps)
+        self._burst = float(burst if burst > 0 else max(1.0, qps))
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tokens = self._burst  # guarded-by: _lock
+        self._last = clock()  # guarded-by: _lock
+
+    def _refill_locked(self) -> None:
+        now = self._clock()
+        self._tokens = min(self._burst,
+                           self._tokens + (now - self._last) * self._qps)
+        self._last = now
+
+    def allow(self) -> bool:
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= 1.0:
+                self._tokens -= 1.0
+                return True
+            return False
+
+    def retry_after(self) -> float:
+        """Seconds until a token will be available (0 when one already is)."""
+        with self._lock:
+            self._refill_locked()
+            if self._tokens >= 1.0:
+                return 0.0
+            return (1.0 - self._tokens) / self._qps

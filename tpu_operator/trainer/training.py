@@ -92,6 +92,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobSpec,
 )
 from tpu_operator.client import errors
+from tpu_operator.scheduler.inventory import job_demand, scheduling_params
 from tpu_operator.trainer import labels as labels_mod
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.trainer.snapshot import ReplicaSnapshot
@@ -121,13 +122,23 @@ BACKOFF_RESET_SECONDS = 300.0
 EXPECTATION_TTL_SECONDS = 60.0
 
 
+def live_pod(pod: Dict[str, Any]) -> bool:
+    """A pod still occupying hardware — anything not terminally finished
+    (terminated pods are retained for logs long after their slice freed,
+    so they must never count as held capacity)."""
+    return (pod.get("status") or {}).get("phase") not in ("Succeeded",
+                                                          "Failed")
+
+
 class TrainingJob:
     """One reconciled TPUJob (ref: TrainingJob, training.go:45-86)."""
 
     def __init__(self, clientset: Any, recorder: Any, job: TPUJob,
                  config: Optional[ControllerConfig] = None,
                  metrics: Optional[Any] = None,
-                 listers: Optional[Any] = None):
+                 listers: Optional[Any] = None,
+                 scheduler: Optional[Any] = None,
+                 writeback: Optional[Any] = None):
         self.clientset = clientset
         self.recorder = recorder
         self.job = job
@@ -137,6 +148,18 @@ class TrainingJob:
         # steady-state read — child classification AND the status-writeback
         # diff — is served from cache; the apiserver sees only writes.
         self.listers = listers
+        # Fleet scheduler (scheduler/fleet.FleetScheduler): the admission
+        # gate consulted before any gang create, and the slice-accounting
+        # ledger released on teardown/TTL/terminal failure. None (tests,
+        # standalone use) = no admission control, the pre-fleet behavior.
+        self.scheduler = scheduler
+        # Global non-critical status-PUT token bucket
+        # (scheduler/writeback.WritebackLimiter); None = every status
+        # change writes immediately.
+        self.writeback = writeback
+        # True while a rate-limited status write is parked in memory; the
+        # next_time_obligation arms a retry so it always lands.
+        self._writeback_deferred = False
         self.replica_sets: List[replicas_mod.TPUReplicaSet] = []
         # True only while setup's spec mutations (defaults, runtimeId) await
         # persistence; status writebacks must not overwrite user spec edits.
@@ -480,6 +503,18 @@ class TrainingJob:
         # paid only when a write actually happens, never on the steady-state
         # no-change pass this PR benchmarks.
         if base_src.get("status") == wire and not self._spec_dirty:
+            self._writeback_deferred = False
+            return
+        # Fleet-scale writeback batching: a NON-critical delta (telemetry,
+        # replica roll-up, queue position — anything but a phase/attempt/
+        # state transition or setup's spec persistence) defers when the
+        # global token bucket is dry; the dirty status rides in memory and
+        # lands coalesced into ONE PUT when the retry obligation fires.
+        if (self.writeback is not None and not self._spec_dirty
+                and not self._critical_status_delta(
+                    base_src.get("status") or {}, wire)
+                and not self.writeback.allow()):
+            self._writeback_deferred = True
             return
         current = copy.deepcopy(base_src)
 
@@ -511,6 +546,23 @@ class TrainingJob:
         # deep-copied so fake-clientset store aliases are never mutated.
         self._last_applied = copy.deepcopy(updated) if updated else current
         self._spec_dirty = False
+        self._writeback_deferred = False
+
+    # Status fields whose change makes a writeback CRITICAL (never
+    # rate-limited): the restart/admission machinery reads these back, so
+    # deferring them would defer correctness, not telemetry. ``startup``
+    # is here because it is a ONE-SHOT: the payload drops its breakdown
+    # after the statusserver's 200 ACK (PR 5 hardened exactly this field
+    # past the heartbeat coalescing), so a deferred PUT that dies with
+    # the operator would lose it forever — unlike the per-beat telemetry
+    # the next heartbeat re-carries.
+    _CRITICAL_STATUS_FIELDS = ("phase", "attempt", "state", "reason",
+                               "backoffUntil", "failures", "startup")
+
+    def _critical_status_delta(self, base: Dict[str, Any],
+                               wire: Dict[str, Any]) -> bool:
+        return any(base.get(f) != wire.get(f)
+                   for f in self._CRITICAL_STATUS_FIELDS)
 
     # -- reconcile (ref: training.go:346-441) ----------------------------------
 
@@ -539,6 +591,7 @@ class TrainingJob:
 
         if phase == TPUJobPhase.CLEANUP:
             self.delete_resources()
+            self._release_slices()
             self._transition(TPUJobPhase.DONE)
             self.update_crd_status()
             return
@@ -565,6 +618,28 @@ class TrainingJob:
         self.setup_replicas()
         attempt = self.job.status.attempt
 
+        # Fleet-scheduler eviction directive, checked before the suspend/
+        # backoff parking below: a victim sitting out a restart backoff has
+        # no pods but still holds its reservation — the preemptor must get
+        # the slices NOW, not when the backoff elapses. A gang that already
+        # SUCCEEDED is not torn down: the pop released its reservation (the
+        # preemptor has the capacity either way), and the normal roll-up
+        # below lands Done instead of pointlessly re-running finished work.
+        finished_despite_eviction = False
+        if self.scheduler is not None and not self.job.spec.suspend:
+            eviction = self.scheduler.pop_eviction(self._sched_key(),
+                                                   uid=self.uid)
+            if eviction is not None:
+                state, _ = self.get_status(self.build_snapshot())
+                if state != State.SUCCEEDED:
+                    self._preempt_to_queue(attempt, eviction)
+                    self.update_crd_status()
+                    return
+                # The finished gang needs no capacity: skip the admission
+                # gate below (its terminated pods rightly don't count as
+                # held hardware) and let the roll-up land Done.
+                finished_despite_eviction = True
+
         # Suspend/resume (spec.suspend, batch/v1 Job semantics): suspension
         # tears down the whole generation — a partial JAX group computes
         # nothing, so freeing part of the slice would waste the rest — and
@@ -577,6 +652,7 @@ class TrainingJob:
                 # exited 0 must still roll up to Done on resume, not
                 # re-run.
                 self._delete_live_pods()
+                self._release_slices()
                 self._transition(TPUJobPhase.SUSPENDED)
                 self.job.status.state = State.UNKNOWN
                 self.job.status.reason = "suspended by spec"
@@ -620,6 +696,40 @@ class TrainingJob:
                     f"backoff elapsed; re-ganging attempt {attempt}")
             # fall through: the normal sync below creates the new gang.
 
+        # Fleet-scheduler admission gate (scheduler/fleet.py): the whole
+        # gang's slice demand must be admitted before any pod exists; an
+        # unadmitted job parks in Queued before the snapshot — it does no
+        # child I/O at all.
+        if self.scheduler is not None and not finished_despite_eviction:
+            if not self.scheduler.ensure_admitted(self._sched_key(),
+                                                  uid=self.uid,
+                                                  holds_hardware=self._holds_hardware,
+                                                  **self._sched_args()):
+                self._park_queued()
+                self.update_crd_status()
+                return
+            if self.job.status.phase == TPUJobPhase.QUEUED:
+                # Just admitted: leave the queue, enter the normal
+                # gang-create path below under the current attempt.
+                first_start = (TPUJobPhase.RUNNING
+                               not in self.job.status.phase_timeline)
+                self._transition(TPUJobPhase.CREATING)
+                if first_start:
+                    # Re-base the lifecycle origin to the ADMISSION: the
+                    # Creating stamp from setup() predates the queue wait,
+                    # and the deadline/runtime clocks must measure runtime
+                    # budget, not how full the cluster was.
+                    self.job.status.phase_timeline[TPUJobPhase.CREATING] = \
+                        _now()
+                self.job.status.state = State.RUNNING
+                self.job.status.reason = ""
+                self._sync_sched_status(queued=False)
+                if self.recorder:
+                    self.recorder.event(
+                        self, "Normal", "Admitted",
+                        f"slice capacity reserved; creating gang "
+                        f"(attempt {attempt})")
+
         # ONE cache snapshot for the whole pass: every classification below
         # (service existence, missing indices, status roll-up, failure scan)
         # reads it instead of the apiserver — steady state is zero-read.
@@ -641,6 +751,7 @@ class TrainingJob:
             self.job.status.state = State.SUCCEEDED
             self._transition(TPUJobPhase.DONE)
             self.job.status.reason = ""
+            self._release_slices()
             if self.recorder:
                 self.recorder.event(self, "Normal", "JobSucceeded",
                                     f"chief exited 0 on attempt {attempt}")
@@ -720,6 +831,7 @@ class TrainingJob:
         # still-live pods; terminated ones are kept so their logs survive
         # (tf_job_design_doc.md:86).
         self._delete_live_pods()
+        self._release_slices()
 
     def _delete_live_pods(self) -> None:
         """Teardown path: read LIVE state (one job-scoped LIST — not the
@@ -798,27 +910,9 @@ class TrainingJob:
         ``maxRestarts * PREEMPTION_BUDGET_FACTOR`` — then teardown happens
         immediately (the slice frees) while the next gang-create is spaced
         by exponential backoff in phase Backoff."""
-        self._record_failure(attempt, kind, reason)
-        counts = self.job.status.restart_counts
-        if kind == FailureKind.PREEMPTION:
-            used = counts.get(FailureKind.PREEMPTION, 0)
-            budget = self.job.spec.max_restarts * PREEMPTION_BUDGET_FACTOR
-            budget_desc = f"{budget} preemption restarts"
-        else:
-            used = (counts.get(FailureKind.APPLICATION, 0)
-                    + counts.get(FailureKind.STALL, 0))
-            budget = self.job.spec.max_restarts
-            budget_desc = f"{budget} application restarts"
-        if used > budget:
-            self._fail(
-                f"retry budget exhausted: {used} {kind} failures exceed "
-                f"{budget_desc} ({reason})"
-            )
-            return
-        for rs in self.replica_sets:
-            rs.delete_pods_for_attempt(attempt)
-        next_attempt = attempt + 1
-        self.job.status.attempt = next_attempt
+        if not self._teardown_generation(attempt, kind, reason):
+            return  # budget exhausted; _fail already ran
+        next_attempt = self.job.status.attempt
         self.job.status.state = State.RUNNING
         delay = 0.0
         backoff = self.job.spec.restart_backoff
@@ -845,6 +939,7 @@ class TrainingJob:
             self._transition(TPUJobPhase.CREATING)
             self.job.status.reason = (
                 f"group restart: attempt {next_attempt} ({reason})")
+        used, budget, _desc = self._restart_budget_usage(kind)
         if self.recorder:
             self.recorder.event(
                 self, "Normal", "GroupRestart",
@@ -852,6 +947,173 @@ class TrainingJob:
                 f"(attempt {next_attempt}; {used}/{budget} {kind} budget "
                 f"used; backoff {delay:.0f}s)",
             )
+
+    def _teardown_generation(self, attempt: int, kind: str,
+                             reason: str) -> bool:
+        """The shared restart teardown (group restart AND scheduler
+        preemption): classify into the ledger, charge the per-kind
+        budget, delete the generation's pods, drop its create
+        expectations, and bump the attempt. False = budget exhausted
+        (``_fail`` already ran and released the slices)."""
+        self._record_failure(attempt, kind, reason)
+        if not self._within_restart_budget(kind, reason):
+            return False
+        for rs in self.replica_sets:
+            rs.delete_pods_for_attempt(attempt)
+        # The torn-down generation's in-flight create expectations are
+        # moot; the next attempt's creates register their own.
+        self._expected_pods.clear()
+        self.job.status.attempt = attempt + 1
+        return True
+
+    def _restart_budget_usage(self, kind: str) -> Tuple[int, int, str]:
+        """(used, budget, description) of the per-kind retry budget:
+        preemptions draw from ``maxRestarts * PREEMPTION_BUDGET_FACTOR``,
+        application/stall restarts share ``maxRestarts``."""
+        counts = self.job.status.restart_counts
+        if kind == FailureKind.PREEMPTION:
+            used = counts.get(FailureKind.PREEMPTION, 0)
+            budget = self.job.spec.max_restarts * PREEMPTION_BUDGET_FACTOR
+            return used, budget, f"{budget} preemption restarts"
+        used = (counts.get(FailureKind.APPLICATION, 0)
+                + counts.get(FailureKind.STALL, 0))
+        budget = self.job.spec.max_restarts
+        return used, budget, f"{budget} application restarts"
+
+    def _within_restart_budget(self, kind: str, reason: str) -> bool:
+        """Charge-check the (already-recorded) failure against its budget;
+        on exhaustion the job fails terminally here and False returns."""
+        used, budget, budget_desc = self._restart_budget_usage(kind)
+        if used > budget:
+            self._fail(
+                f"retry budget exhausted: {used} {kind} failures exceed "
+                f"{budget_desc} ({reason})"
+            )
+            return False
+        return True
+
+    # -- fleet scheduling (scheduler/fleet.py consults + accounting) -----------
+
+    def _sched_key(self) -> str:
+        return f"{self.namespace}/{self.name}"
+
+    def _sched_args(self) -> Dict[str, Any]:
+        """The scheduler-facing view of this job: gang demand + the
+        effective priority/queue (spec.scheduling, defaulted)."""
+        priority, queue = scheduling_params(self.job.spec)
+        return {"demand": job_demand(self.job.spec),
+                "priority": priority, "queue": queue}
+
+    def _holds_hardware(self) -> bool:
+        """Rebuild signal for the scheduler's restart path: this job's
+        persisted state shows it already owns its slices (phase Running,
+        or Creating with LIVE gang pods visible in the cache), so
+        admission is an accounting fact to record, not a decision to
+        make. Terminated pods do NOT count — they are retained for logs
+        (tf_job_design_doc.md:86) long after the slice was released, and
+        counting them force-admitted a resumed job past a full inventory
+        on the strength of a finished pod."""
+        phase = self.job.status.phase
+        if phase == TPUJobPhase.RUNNING:
+            return True
+        if phase in (TPUJobPhase.CREATING, TPUJobPhase.BACKOFF):
+            # BACKOFF holds its reservation across the gap by contract
+            # (restarts must not lose their slot to the queue); live
+            # pods-in-cache cover the Creating half.
+            if phase == TPUJobPhase.BACKOFF:
+                return True
+            return any(live_pod(p)
+                       for p in self.build_snapshot().all_pods())
+        return False
+
+    def _sync_sched_status(self, queued: bool) -> None:
+        """Fold the scheduler view into ``status.scheduling``. Position
+        updates are coarsened to MATERIAL changes (first sighting, the
+        head of the queue, or a ≥20% move) so a 5k-deep queue draining
+        does not write 5k² position-only PUTs."""
+        args = self._sched_args()
+        new: Dict[str, Any] = {"queue": args["queue"],
+                               "priority": args["priority"]}
+        if queued and self.scheduler is not None:
+            pos = self.scheduler.queue_position(self._sched_key())
+            if pos is not None:
+                old = (self.job.status.scheduling or {}).get("position")
+                material = (old is None or pos <= 2
+                            or abs(pos - old) >= max(1, old // 5))
+                new["position"] = pos if material else old
+        self.job.status.scheduling = new
+
+    def _park_queued(self) -> None:
+        """No capacity for the whole gang: hold the job in phase Queued
+        (no pods, slice untouched) until the admission queue promotes it."""
+        status = self.job.status
+        if status.phase != TPUJobPhase.QUEUED:
+            self._transition(TPUJobPhase.QUEUED)
+            status.state = State.UNKNOWN
+            status.reason = "queued: waiting for slice capacity"
+            status.backoff_until = ""
+            # Pre-queue replica roll-ups describe pods that don't exist.
+            status.replica_statuses = []
+            if self.recorder:
+                # ONE event per queueing decision (stable message, so the
+                # recorder aggregates re-queues of the same job).
+                self.recorder.event(
+                    self, "Normal", "Queued",
+                    "whole-gang slice demand does not fit the inventory; "
+                    "waiting for capacity")
+        # "Waiting" and "can never fit as specced" must not read the same:
+        # a demand past the shape's total capacity says so in the reason.
+        impossible = (self.scheduler.unschedulable_reason(self._sched_key())
+                      if self.scheduler is not None else None)
+        if impossible:
+            status.reason = f"unschedulable: {impossible}"
+        self._sync_sched_status(queued=True)
+
+    def _preempt_to_queue(self, attempt: int, reason: str) -> None:
+        """Scheduler eviction: tear the gang down as a PREEMPTION-kind
+        restart (the PR-2 preemption budget — an eviction must requeue the
+        job, not burn its crash-loop budget) and park it in Queued; the
+        next admission re-gangs under a bumped attempt."""
+        if self.metrics is not None:
+            # Counted here — the actual eviction — not at pop_eviction: a
+            # directive consumed by an already-succeeded gang is a no-op.
+            self.metrics.inc("tpujob_preemptions_total")
+        if not self._teardown_generation(attempt, FailureKind.PREEMPTION,
+                                         reason):
+            return  # budget exhausted; _fail already ran + released
+        self.job.status.backoff_until = ""
+        self.job.status.replica_statuses = []
+        if self.recorder:
+            self.recorder.event(
+                self, "Normal", "Preempted",
+                f"{reason}; gang torn down, attempt "
+                f"{self.job.status.attempt} re-queued")
+        # Re-enter the admission queue right away so the job has a
+        # position the moment the eviction lands. The re-offer can admit
+        # IMMEDIATELY (the eviction freed more than the preemptor needed,
+        # or another release raced in): then the job goes straight back to
+        # Creating — parking it Queued-while-admitted would strand it,
+        # since the scheduler's wakeup for this key already fired.
+        readmitted = False
+        if self.scheduler is not None:
+            readmitted = self.scheduler.ensure_admitted(
+                self._sched_key(), uid=self.uid, **self._sched_args())
+        if readmitted:
+            self._transition(TPUJobPhase.CREATING)
+            self.job.status.state = State.RUNNING
+            self.job.status.reason = f"preempted: {reason}; re-admitted"
+            self._sync_sched_status(queued=False)
+        else:
+            self._transition(TPUJobPhase.QUEUED)
+            self.job.status.state = State.UNKNOWN
+            self.job.status.reason = f"preempted: {reason}"
+            self._sync_sched_status(queued=True)
+
+    def _release_slices(self) -> None:
+        """Return this job's slice reservation (terminal phases, TTL reap,
+        suspension, explicit delete). Idempotent."""
+        if self.scheduler is not None:
+            self.scheduler.release(self._sched_key())
 
     # -- time obligations (enforced here; woken exactly on time by
     # controller/deadlines.DeadlineManager) ------------------------------------
@@ -865,9 +1127,22 @@ class TrainingJob:
                     self.job.metadata.get("creationTimestamp", "")))
 
     def _deadline_epoch(self) -> Optional[float]:
-        """Epoch at which activeDeadlineSeconds expires (None: no deadline)."""
+        """Epoch at which activeDeadlineSeconds expires (None: no deadline).
+
+        A job parked in Queued that has NEVER run does not age toward the
+        deadline: the clock measures runtime budget (batch/v1 counts from
+        job start), and queue wait under a full inventory can legitimately
+        exceed any sane deadline — failing a job 'DeadlineExceeded' that
+        never created a pod would be nonsense. Once the job has run, queue
+        time between preemption and re-admission DOES count, same as
+        Suspended/Backoff parking (a preempted job must not dodge its
+        deadline by waiting)."""
         ads = self.job.spec.active_deadline_seconds
         if not ads:
+            return None
+        if (self.job.status.phase == TPUJobPhase.QUEUED
+                and TPUJobPhase.RUNNING
+                not in self.job.status.phase_timeline):
             return None
         start = self._start_epoch()
         if start is None:
@@ -921,7 +1196,8 @@ class TrainingJob:
         if phase in (TPUJobPhase.DONE, TPUJobPhase.FAILED):
             candidates.append(self._ttl_epoch())
         elif phase in (TPUJobPhase.CREATING, TPUJobPhase.RUNNING,
-                       TPUJobPhase.BACKOFF, TPUJobPhase.SUSPENDED):
+                       TPUJobPhase.BACKOFF, TPUJobPhase.SUSPENDED,
+                       TPUJobPhase.QUEUED):
             if phase == TPUJobPhase.BACKOFF:
                 candidates.append(
                     parse_rfc3339(self.job.status.backoff_until))
@@ -943,6 +1219,16 @@ class TrainingJob:
                     candidates.append(
                         now_epoch
                         + max(0.0, soonest - time.monotonic()) + 1.0)
+        if self._writeback_deferred:
+            # A rate-limited status write is parked in memory: arm a retry
+            # just past the token bucket's refill so it always lands even
+            # with no further events for this job.
+            now_epoch = parse_rfc3339(_now())
+            if now_epoch is not None:
+                retry = 1.0
+                if self.writeback is not None:
+                    retry = max(0.1, self.writeback.retry_after())
+                candidates.append(now_epoch + retry)
         live = [c for c in candidates if c is not None]
         return min(live) if live else None
 
@@ -956,6 +1242,7 @@ class TrainingJob:
                 f"{self.job.spec.ttl_seconds_after_finished}s ago; "
                 f"deleting job")
         self.delete_resources()
+        self._release_slices()
         try:
             self.clientset.tpujobs.delete(self.namespace, self.name)
         except errors.ApiError as e:
@@ -1009,5 +1296,6 @@ class TrainingJob:
         CRD-deletion path without any operator action)."""
         self._transition(TPUJobPhase.CLEANUP)
         self.delete_resources()
+        self._release_slices()
         self._transition(TPUJobPhase.DONE)
         self.update_crd_status()
