@@ -90,6 +90,14 @@ def parse_args(argv=None):
                         "compilation cache + checkpoint dir); exits nonzero "
                         "if the warm restart stops beating cold or the "
                         "cache stops hitting")
+    p.add_argument("--store", action="store_true",
+                   help="run ONLY the remote warm-start store rows: "
+                        "fresh-node restart (cold local dirs, warm remote "
+                        "store) TTFS vs a fully cold start, with the "
+                        "prefetch hit and per-run goodput asserted, plus "
+                        "the write-behind step-time guard (uploads must "
+                        "never ride the step loop); exits nonzero on "
+                        "regression")
     p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
@@ -1369,18 +1377,36 @@ def startup_worker_main(cfg_json: str) -> int:
     # cache dir is read at config init, the platform at backend init.
     os.environ["JAX_PLATFORMS"] = cfg.get("platform", "cpu")
     os.environ["JAX_COMPILATION_CACHE_DIR"] = cfg["cache_dir"]
+    if cfg.get("store_uri"):
+        # Remote warm-start store (the --store rows): the same env the
+        # operator injects for spec.store, so the payload-side prefetch +
+        # write-behind run exactly the production path.
+        os.environ["TPUJOB_STORE_BACKEND"] = cfg.get("store_backend",
+                                                     "localfs")
+        os.environ["TPUJOB_STORE_URI"] = cfg["store_uri"]
+        os.environ["TPUJOB_STORE_PARALLELISM"] = "4"
+        os.environ["TPUJOB_STORE_PREFETCH"] = "1"
+        os.environ["TPUJOB_NAMESPACE"] = "bench"
+        os.environ["TPUJOB_NAME"] = cfg.get("job_name", "store-bench")
+        if cfg.get("ckpt_dir"):
+            os.environ["TPU_CHECKPOINT_DIR"] = cfg["ckpt_dir"]
 
     from tpu_operator.payload import bootstrap
     from tpu_operator.payload import checkpoint as ckpt_mod
     from tpu_operator.payload import startup as startup_mod
-    from tpu_operator.payload import train, transformer
+    from tpu_operator.payload import train, transformer, warmstore
 
     bootstrap.enable_compilation_cache()
     t0 = time.perf_counter()
+    if cfg.get("store_uri") and warmstore.start_prefetch():
+        # No rendezvous to overlap in a single-process worker, so the
+        # whole download lands inside TTFS — the honest fresh-node cost
+        # (production overlaps it with the DNS/rendezvous wait).
+        warmstore.finish_prefetch()
     targs = transformer.parse_args(cfg["argv"])
     mesh, _model, state, step, batches = transformer.build(targs)
-    ck = ckpt_mod.Checkpointer(cfg["ckpt_dir"], save_every=10_000) \
-        if cfg.get("ckpt_dir") else None
+    ck = (ckpt_mod.from_env_or_args(cfg["ckpt_dir"], save_every=10_000)
+          if cfg.get("ckpt_dir") else None)
     tracker = startup_mod.new_tracker()
     spec = transformer.lm_token_spec(mesh)
     try:
@@ -1389,16 +1415,25 @@ def startup_worker_main(cfg_json: str) -> int:
             checkpointer=ck, heartbeat=None, startup=tracker)
     finally:
         if ck is not None:
-            ck.close()
-    ttfs = (tracker.first_step_done_at or time.perf_counter()) - t0
-    # Steady-state guard rows: the fast path must not trade steady step
-    # time for TTFS (same executable either way — this proves it).
+            ck.close()  # flushes the async save AND the remote upload
+    t_end = time.perf_counter()
+    ttfs = (tracker.first_step_done_at or t_end) - t0
+    # Per-run goodput, payload-side: useful step time = the first step
+    # plus everything after its completion (pure stepping + save
+    # bookkeeping); wallclock = the whole attempt. The controller computes
+    # the production equivalent from heartbeats; this is the bench's
+    # self-contained version of the same ratio.
+    first_step = tracker.durations.get(startup_mod.FIRST_STEP, 0.0)
+    wall = max(t_end - t0, 1e-9)
+    useful = max(0.0, (t_end - t0) - ttfs) + first_step
     state, steps_per_sec = train.throughput(
         mesh, step, state, batches, steps=cfg.get("steady_steps", 3),
         warmup=1, spec=spec)
     print(json.dumps({
         "ttfs_s": round(ttfs, 4),
         "steady_step_ms": round(1e3 / steps_per_sec, 2),
+        "goodput": round(min(1.0, useful / wall), 4),
+        "wall_s": round(wall, 4),
         "breakdown": tracker.breakdown(),
     }), flush=True)
     return 0
@@ -1463,6 +1498,170 @@ def bench_startup(quick: bool) -> list:
     return rows
 
 
+# --- remote warm-start store rows ----------------------------------------------
+
+def bench_store_writebehind_guard(quick: bool) -> dict:
+    """The non-blocking proof: the same interval-save loop with and
+    without a write-behind uploader pointed at a HIGH-LATENCY fake
+    backend. If uploads rode the step loop, each save boundary would pay
+    ≥ latency × (chunk exists/put + manifest put) ≈ 3×latency; the guard
+    asserts the measured per-step overhead stays an order of magnitude
+    under ONE latency unit."""
+    import shutil
+    import tempfile
+
+    from tpu_operator.payload import checkpoint as ckpt_mod
+    from tpu_operator.store import (FakeBackend, WarmStartStore,
+                                    WriteBehindUploader)
+
+    steps = 6 if quick else 10
+    latency = 0.15
+    state = _ckpt_state(0.25 if quick else 1.0)
+
+    def run(with_store: bool) -> float:
+        d = tempfile.mkdtemp(prefix="bench-store-wb-")
+        uploader = None
+        try:
+            if with_store:
+                backend = FakeBackend(latency=latency)
+                uploader = WriteBehindUploader(
+                    WarmStartStore(backend, prefix="bench"),
+                    fail_after=1_000_000)
+            ck = ckpt_mod.Checkpointer(d, save_every=1, uploader=uploader)
+            t0 = time.perf_counter()
+            for s in range(1, steps + 1):
+                ck.maybe_save(s, state)
+            per_step = (time.perf_counter() - t0) / steps
+            ck.close()
+            return per_step * 1e3
+        finally:
+            shutil.rmtree(d, ignore_errors=True)
+
+    base_ms = run(False)
+    with_ms = run(True)
+    return {
+        "metric": "store_writebehind_overhead_ms_per_step",
+        "value": round(with_ms - base_ms, 2),
+        "unit": "ms",
+        "base_ms_per_step": round(base_ms, 2),
+        "with_store_ms_per_step": round(with_ms, 2),
+        "injected_latency_ms": latency * 1e3,
+        "blocking_would_cost_ms": round(3 * latency * 1e3, 1),
+        "budget_ms": round(latency * 1e3 / 2, 1),
+        "steps": steps,
+    }
+
+
+def bench_store(quick: bool) -> list:
+    """Fresh-node warm start through the remote store, measured: run 1
+    (fully cold: empty local dirs AND empty store) populates the store —
+    write-behind checkpoint upload + compilation-cache sync; run 2
+    simulates the fleet scheduler re-placing the gang on a FRESH node
+    (brand-new empty local cache + checkpoint dirs, same remote store):
+    the rendezvous-overlapped prefetch must pull the executables and the
+    latest checkpoint back down and beat the cold TTFS by the budget
+    factor. Both runs report payload-side goodput; the write-behind guard
+    proves uploads never ride the step loop."""
+    import shutil
+    import tempfile
+
+    if quick:
+        argv = ["--dim", "128", "--layers", "2", "--heads", "4",
+                "--batch", "4", "--seq-len", "128", "--vocab", "1024"]
+    else:
+        # Same deep-narrow compile-dominated shape as bench_startup: the
+        # fresh-node ratio must measure the store bringing the compile
+        # cache + checkpoint across nodes, not host matmul throughput.
+        argv = ["--dim", "64", "--layers", "16", "--heads", "4",
+                "--batch", "2", "--seq-len", "64", "--vocab", "512"]
+    store_root = tempfile.mkdtemp(prefix="bench-store-remote-")
+    # ONE fixed cache/checkpoint path for both runs, WIPED between them —
+    # exactly what a fresh node looks like in production: the mount
+    # points (spec.compilationCache.path, spec.checkpointDir) are the
+    # same configured paths on every node, only the contents are gone.
+    # The path must be byte-identical or the persistent cache cannot hit
+    # at all: jax derives debug_options.xla_gpu_per_fusion_autotune_
+    # cache_dir from the cache dir and (as of jax 0.4.37) fails to scrub
+    # it from the compilation-cache key, so entries written under a
+    # different cache PATH hash to different keys.
+    cache_dir = tempfile.mkdtemp(prefix="bench-store-cache-")
+    ckpt_dir = tempfile.mkdtemp(prefix="bench-store-ckpt-")
+
+    def wipe(path: str) -> None:
+        shutil.rmtree(path, ignore_errors=True)
+        os.makedirs(path, exist_ok=True)
+
+    base = {"argv": argv, "store_uri": store_root, "job_name": "store-bench",
+            "cache_dir": cache_dir, "ckpt_dir": ckpt_dir,
+            "steady_steps": 5 if quick else 10}
+    try:
+        cold = _run_startup_worker({**base, "steps": 2})
+        # The fleet scheduler re-placed the gang: fresh node, same mount
+        # points, empty local state — only the remote store is warm.
+        wipe(cache_dir)
+        wipe(ckpt_dir)
+        fresh = _run_startup_worker({**base, "steps": 4})
+    finally:
+        for d in (store_root, cache_dir, ckpt_dir):
+            shutil.rmtree(d, ignore_errors=True)
+    speedup = cold["ttfs_s"] / fresh["ttfs_s"] if fresh["ttfs_s"] else 0.0
+    rows = [
+        {"metric": "store_ttfs_cold_s", "value": cold["ttfs_s"],
+         "unit": "s", "goodput": cold.get("goodput"),
+         "steady_step_ms": cold["steady_step_ms"],
+         **{f"cold_{k}": v for k, v in cold["breakdown"].items()}},
+        {"metric": "store_ttfs_fresh_node_s", "value": fresh["ttfs_s"],
+         "unit": "s", "speedup_vs_cold": round(speedup, 2),
+         "goodput": fresh.get("goodput"),
+         "steady_step_ms": fresh["steady_step_ms"],
+         "local_dirs": "empty (fresh node); store warm",
+         **{f"fresh_{k}": v for k, v in fresh["breakdown"].items()}},
+        bench_store_writebehind_guard(quick),
+    ]
+    return rows
+
+
+def _store_ok(rows: list, quick: bool) -> bool:
+    """The CI contract (hack/verify.sh runs --store --quick): the
+    fresh-node attempt must hit the prefetch (cache + checkpoint pulled
+    from the store), beat the fully cold TTFS by the budget factor, carry
+    a sane goodput that IMPROVES on cold (less dead startup time), and
+    the write-behind must stay off the step loop."""
+    ok = True
+    cold = next(r for r in rows if r["metric"] == "store_ttfs_cold_s")
+    fresh = next(r for r in rows if r["metric"] == "store_ttfs_fresh_node_s")
+    guard = next(r for r in rows
+                 if r["metric"] == "store_writebehind_overhead_ms_per_step")
+    if not fresh.get("fresh_prefetchHit"):
+        print(f"FAIL: fresh-node run did not hit the store prefetch "
+              f"({fresh})", file=sys.stderr)
+        ok = False
+    # Same noise policy as the startup gate: tiny --quick shapes on a
+    # shared CI box leave less compile time to win back.
+    budget = 1.2 if quick else 1.5
+    if fresh.get("speedup_vs_cold", 0) < budget:
+        print(f"FAIL: fresh-node TTFS only {fresh.get('speedup_vs_cold')}x "
+              f"faster than fully cold (budget: {budget}x)", file=sys.stderr)
+        ok = False
+    for row in (cold, fresh):
+        gp = row.get("goodput")
+        if gp is None or not 0.0 < gp <= 1.0:
+            print(f"FAIL: {row['metric']} goodput {gp!r} out of (0, 1]",
+                  file=sys.stderr)
+            ok = False
+    if ok and fresh["goodput"] <= cold["goodput"]:
+        print(f"FAIL: fresh-node goodput {fresh['goodput']} did not improve "
+              f"on cold {cold['goodput']} (warm start should cut dead "
+              f"startup time)", file=sys.stderr)
+        ok = False
+    if guard["value"] > guard["budget_ms"]:
+        print(f"FAIL: write-behind added {guard['value']} ms/step "
+              f"(budget {guard['budget_ms']} ms — uploads must not ride "
+              f"the step loop)", file=sys.stderr)
+        ok = False
+    return ok
+
+
 def _startup_ok(rows: list, quick: bool) -> bool:
     """The CI contract (hack/verify.sh runs --startup --quick): the warm
     attempt must hit the persistent compilation cache, beat cold TTFS by
@@ -1520,6 +1719,12 @@ def main(argv=None) -> int:
     if args.startup:
         rows = [_emit(row) for row in bench_startup(args.quick)]
         return 0 if _startup_ok(rows, args.quick) else 1
+    if args.store:
+        # Workers run on CPU; the in-driver write-behind guard does orbax
+        # host I/O — pin CPU like --checkpoint.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = [_emit(row) for row in bench_store(args.quick)]
+        return 0 if _store_ok(rows, args.quick) else 1
     if args.fleet:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_fleet(args.quick)]
@@ -1560,6 +1765,8 @@ def main(argv=None) -> int:
         for row in bench_checkpoint(args.quick):
             rows.append(_emit(row))
         for row in bench_startup(args.quick):
+            rows.append(_emit(row))
+        for row in bench_store(args.quick):
             rows.append(_emit(row))
         rows.append(_emit(bench_matmul(args.quick)))
         for row in bench_attention(args.quick):

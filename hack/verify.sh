@@ -55,6 +55,17 @@ python -m pytest tests/test_startup_path.py -x -q
 # + overlapped prologue) must beat cold time-to-first-step by the budget
 # factor, with steady-state step time held — exits nonzero otherwise.
 python bench.py --startup --quick
+# Standalone remote warm-start store gate: blob backends + chunked
+# integrity transfer (torn-upload resume, checksum-retry, next-oldest
+# fallback), the spec.store wiring, write-behind upload + escalation,
+# quarantine parity (local corrupt step never re-preferred remotely),
+# rendezvous-overlapped prefetch, and the status.store/goodput folds.
+python -m pytest tests/test_store.py -x -q
+# And its measured form: a fresh-node restart (cold local dirs, warm
+# remote store) must beat a fully cold start by the budget factor with
+# the prefetch hit + goodput asserted, and the write-behind must stay
+# off the step loop — exits nonzero otherwise.
+python bench.py --store --quick
 # Standalone fleet-scheduler gate: slice-inventory admission (whole-gang
 # fit or phase Queued), fair-share + priority ordering, preemption victim
 # selection + the preemption-budget requeue, inventory release on
@@ -80,6 +91,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_checkpoint_chaos.py \
   --ignore=tests/test_api_budget.py \
   --ignore=tests/test_startup_path.py \
+  --ignore=tests/test_store.py \
   --ignore=tests/test_fleet_scheduler.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

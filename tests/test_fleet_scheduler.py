@@ -161,6 +161,90 @@ def test_inventory_from_node_objects():
     assert inv.snapshot()[KEY]["capacity"] == 3
 
 
+# --- live node-informer inventory (capacity changes without restart) ---------
+
+def test_update_inventory_admits_queued_job_and_preserves_usage():
+    s, wakes = sched(capacity=1)
+    assert offer(s, "a")
+    assert not offer(s, "b")
+    # A node pool came up: capacity 1 → 2. The queued job admits and its
+    # reconcile is woken — no operator restart, no release needed.
+    s.update_inventory({KEY: 2})
+    assert s.is_admitted("default/b")
+    assert "default/b" in wakes
+    # Reservations survived the swap: nothing fits a third gang.
+    assert not offer(s, "c")
+    # Shrink BELOW usage: honest over-commit (the gangs physically run);
+    # drains as they release, and no new admission meanwhile.
+    s.update_inventory({KEY: 1})
+    assert s.summary()["inventory"][KEY] == {"capacity": 1, "used": 2}
+    assert not offer(s, "d")
+    s.release("default/a")
+    assert not s.is_admitted("default/d")  # still over capacity
+    s.release("default/b")
+    # The drain frees the single modeled slot; FIFO hands it to the
+    # earliest-queued waiter (c, parked since before the shrink).
+    assert s.is_admitted("default/c")
+    assert not s.is_admitted("default/d")
+
+
+def test_update_inventory_unsidelines_impossible_demand():
+    s, wakes = sched(capacity=1)
+    # Demands 3 slices of a 1-slice shape: sidelined as unschedulable
+    # (must not head-block the shape), with the reason exposed.
+    assert not offer(s, "big", slices=3)
+    assert s.unschedulable_reason("default/big")
+    # A small same-shape job is NOT blocked by the sidelined head.
+    assert offer(s, "small")
+    # The node pool grew: the old verdict no longer holds — the job
+    # un-sidelines, and admits once capacity actually frees.
+    s.update_inventory({KEY: 4})
+    assert s.unschedulable_reason("default/big") is None
+    assert s.is_admitted("default/big")
+
+
+def test_node_watch_updates_admission_live():
+    """ROADMAP item 1 follow-on, end to end over the real informer loop:
+    with --discover-slice-inventory the capacity model follows the node
+    watch, so a node pool scaling up admits a queued gang — and
+    rebalances the queue — with the operator NEVER restarting."""
+    cs = FakeClientset()
+
+    def node(name, sid):
+        return {"metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-tpu-topology": "2x2x2",
+            "tpuoperator.dev/slice-id": sid}},
+            "status": {"allocatable": {V4: "4"}}}
+
+    cs.nodes.create("", node("n1", "slice-a"))
+    cs.tpujobs.create("default", tpu_job("first").to_dict())
+    cs.tpujobs.create("default", tpu_job("second").to_dict())
+
+    factory = SharedInformerFactory(cs, resync_period=0)
+    config = t.ControllerConfig(discover_slice_inventory=True)
+    controller = Controller(cs, factory, config, shards=2)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True)
+    runner.start()
+    try:
+        # One discovered slice: exactly one of the two jobs admits.
+        assert wait_for(lambda: sorted(
+            phase_of(cs, n) for n in ("first", "second"))
+            == ["Creating", "Queued"])
+        queued = ("first" if phase_of(cs, "first") == "Queued"
+                  else "second")
+        # The pool scales up — the queued gang admits off the node event.
+        cs.nodes.create("", node("n2", "slice-b"))
+        assert wait_for(lambda: phase_of(cs, queued) == "Creating")
+        assert wait_for(lambda: any(
+            queued in p["metadata"]["name"]
+            for p in cs.pods.list("default")))
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)
+
+
 # --- admission queue ordering ------------------------------------------------
 
 def sched(capacity=1, metrics=None, clock=time.time):

@@ -47,9 +47,11 @@ SCAN = (
     ("tpu_operator", "client"),
     ("tpu_operator", "controller"),
     ("tpu_operator", "scheduler"),
+    ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "payload", "checkpoint.py"),
     ("tpu_operator", "payload", "train.py"),
+    ("tpu_operator", "payload", "warmstore.py"),
 )
 
 _BLOCKING_ATTRS = {"sleep", "_sleep", "urlopen", "getaddrinfo",

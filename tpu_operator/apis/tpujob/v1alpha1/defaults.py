@@ -19,6 +19,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     CacheMedium,
     RestartBackoffSpec,
     RestartPolicy,
+    StoreBackend,
     TerminationPolicySpec,
     TPUJobSpec,
     TPUReplicaType,
@@ -88,4 +89,24 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
             cache.path = DEFAULT_CACHE_PATH
         if not cache.medium:
             cache.medium = CacheMedium.HOSTPATH
+
+    # Remote warm-start store: opt-in (None = off); a present block fills
+    # its unset fields. The backend defaults from the URI scheme when the
+    # user gave only a URI (``store: {uri: fake://t}`` means the fake
+    # backend, ``gs://…`` a registered "gs" backend — never a localfs
+    # path that happens to contain "://"); bare paths and file:// default
+    # to localfs. ``uri`` itself is never defaulted — validation requires
+    # one.
+    # An explicitly invalid uploadParallelism is NOT clamped here —
+    # StoreSpec.from_dict already defaults an absent field, so any < 1
+    # value reaching this point was user-written and validation.py must
+    # reject it loudly, like every other invalid store field.
+    if spec.store is not None:
+        store = spec.store
+        if not store.backend:
+            scheme, sep, _rest = store.uri.partition("://")
+            if sep and scheme and scheme != "file":
+                store.backend = scheme.lower()
+            else:
+                store.backend = StoreBackend.LOCALFS
     return spec

@@ -121,6 +121,18 @@ def spec_schema() -> Dict[str, Any]:
                              maximum=types.MAX_SCHEDULING_PRIORITY),
             "queue": _str(),
         }),
+        # Remote warm-start store: write-behind checkpoint/cache uploads
+        # + rendezvous-overlapped prefetch for fresh-node warm restarts.
+        # backend is a PATTERN, not an enum: beyond the in-repo localfs/
+        # fake, any slug may name a deployment-registered backend
+        # (store/blob.register_backend); validation.py enforces URI-scheme
+        # consistency.
+        "store": _obj({
+            "backend": _str(pattern=types.StoreBackend.NAME_PATTERN),
+            "uri": _str(),
+            "uploadParallelism": _int(minimum=1),
+            "prefetch": {"type": "boolean"},
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -130,6 +142,11 @@ def startup_breakdown_schema() -> Dict[str, Any]:
     (as folded in by the controller, which adds attempt/time)."""
     return _obj({
         "rendezvousSeconds": _num(minimum=0),
+        # Remote warm-start store: time the prefetch (compilation cache +
+        # latest checkpoint download, overlapped with rendezvous) kept on
+        # the critical path, and whether it delivered anything.
+        "prefetchSeconds": _num(minimum=0),
+        "prefetchHit": {"type": "boolean"},
         "restoreSeconds": _num(minimum=0),
         "compileSeconds": _num(minimum=0),
         "firstStepSeconds": _num(minimum=0),
@@ -182,6 +199,9 @@ def status_schema() -> Dict[str, Any]:
             "lastCheckpointStep": _int(minimum=0),
             "checkpointSaveFailures": _int(minimum=0),
             "checkpointRestoreFallbacks": _int(minimum=0),
+            # Remote warm-start store fields (write-behind uploader).
+            "storeLastUploadedStep": _int(minimum=0),
+            "storeUploadFailures": _int(minimum=0),
             # Warm-restart startup telemetry: pre-first-step liveness beats
             # carry the in-flight stage; the post-first-step beat carries
             # the full breakdown (folded into status.startup).
@@ -204,6 +224,26 @@ def status_schema() -> Dict[str, Any]:
         # breakdown (rendezvous/restore/compile/first-step seconds and
         # whether the XLA compile hit the persistent cache).
         "startup": startup_breakdown_schema(),
+        # Remote warm-start store roll-up: the newest step durable
+        # REMOTELY (what a fresh node warm-starts from), lifetime upload
+        # failures, and the per-attempt delta-accounting baselines.
+        "store": _obj({
+            "lastUploadedStep": _int(minimum=0),
+            "uploadFailures": _int(minimum=0),
+            "attempt": _int(minimum=0),
+            "attemptUploadFailures": _int(minimum=0),
+            "time": _str(),
+        }),
+        # Restart-goodput accounting: useful-step-seconds over attempt
+        # wallclock — what fleet churn actually costs this job.
+        "goodput": _obj({
+            "usefulStepSeconds": _num(minimum=0),
+            "wallclockSeconds": _num(minimum=0),
+            "ratio": _num(minimum=0),
+            "attempt": _int(minimum=0),
+            "lastStep": _int(minimum=0),
+            "time": _str(),
+        }),
         # Fleet-scheduling state: effective queue/priority, and — while
         # phase is Queued — the admission-order position (0 = next).
         "scheduling": _obj({

@@ -181,6 +181,35 @@ class CacheMedium:
 DEFAULT_CACHE_PATH = "/var/cache/tpujob/xla"
 
 
+# --- Remote warm-start store (checkpoints + compilation cache) ---------------
+
+class StoreBackend:
+    """Blob backends of the remote warm-start store.
+
+    LOCALFS points the store at any shared-filesystem mount (NFS,
+    Filestore, a gcsfuse mount) — the URI is an absolute path or
+    ``file://`` URI visible inside the pods. FAKE is the in-process test
+    backend (``fake://name``). Any OTHER slug names a deployment-
+    registered backend (``tpu_operator.store.blob.register_backend`` —
+    cloud SDK wrappers the images deliberately don't vendor); validation
+    then requires the URI scheme to match the backend name (``backend:
+    gs`` ↔ ``gs://…``), and resolution is gated at payload runtime with
+    a clear error when no factory was registered.
+    """
+
+    LOCALFS = "localfs"
+    FAKE = "fake"
+
+    # The in-repo backends; NOT an exhaustive enum — see class docstring.
+    ALL = (LOCALFS, FAKE)
+
+    # Backend slugs (and registered URI schemes) must match this.
+    NAME_PATTERN = r"^[a-z][a-z0-9-]{0,31}$"
+
+
+DEFAULT_STORE_UPLOAD_PARALLELISM = 4
+
+
 # --- Fleet scheduling (admission queue + priority preemption) ----------------
 
 # Fair-share queue a job lands in when spec.scheduling names none.
@@ -316,6 +345,50 @@ class CompilationCacheSpec:
 
 
 @dataclass
+class StoreSpec:
+    """Remote warm-start store wiring (``spec.store``).
+
+    When present, the operator injects ``TPUJOB_STORE_*`` so payloads (a)
+    write-behind every verified checkpoint (and new compilation-cache
+    entries) to the remote blob store without ever blocking the step
+    loop, and (b) *prefetch* the newest healthy checkpoint + the compiled
+    executables during the rendezvous/DNS wait — so a whole-group restart
+    landing on a FRESH node (the normal outcome of fleet-scheduler
+    preemption) still warm-starts instead of paying a cold compile and a
+    cold (or step-0) restore.
+
+    ``uri`` must be reachable from inside the pods: an absolute path /
+    ``file://`` URI on a volume the user template mounts (backend
+    ``localfs``), or ``fake://name`` for tests. ``uploadParallelism``
+    bounds the chunk-transfer fan-out; ``prefetch: false`` keeps the
+    write-behind but skips the startup download (upload-only mirroring).
+    """
+
+    backend: str = StoreBackend.LOCALFS
+    uri: str = ""
+    upload_parallelism: int = DEFAULT_STORE_UPLOAD_PARALLELISM
+    prefetch: bool = True
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"backend": self.backend, "uri": self.uri,
+                "uploadParallelism": self.upload_parallelism,
+                "prefetch": self.prefetch}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["StoreSpec"]:
+        if d is None:
+            return None
+        return cls(
+            backend=str(d.get("backend", StoreBackend.LOCALFS)),
+            uri=str(d.get("uri", "")),
+            upload_parallelism=int(d.get("uploadParallelism",
+                                         DEFAULT_STORE_UPLOAD_PARALLELISM)),
+            prefetch=bool(d.get("prefetch", True)),
+        )
+
+
+@dataclass
 class SchedulingSpec:
     """Fleet-scheduler knobs (``spec.scheduling``).
 
@@ -442,6 +515,11 @@ class TPUJobSpec:
     # defaults, priority 0 in the "default" queue — kept absent so specs
     # round-trip unchanged).
     scheduling: Optional[SchedulingSpec] = None
+    # Remote warm-start store: write-behind checkpoint/cache uploads plus
+    # rendezvous-overlapped prefetch, so cross-node restarts stay warm
+    # (None = off; restarts only warm-start on the same node, the
+    # pre-store behavior).
+    store: Optional[StoreSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -478,6 +556,8 @@ class TPUJobSpec:
             d["compilationCache"] = self.compilation_cache.to_dict()
         if self.scheduling is not None:
             d["scheduling"] = self.scheduling.to_dict()
+        if self.store is not None:
+            d["store"] = self.store.to_dict()
         return d
 
     @classmethod
@@ -505,6 +585,7 @@ class TPUJobSpec:
             compilation_cache=CompilationCacheSpec.from_dict(
                 d.get("compilationCache")),
             scheduling=SchedulingSpec.from_dict(d.get("scheduling")),
+            store=StoreSpec.from_dict(d.get("store")),
         )
 
 
@@ -603,6 +684,20 @@ class TPUJobStatus:
     # the number that proves (or disproves) the warm-restart fast path on
     # a live job.
     startup: Optional[Dict[str, Any]] = None
+    # Remote warm-start store roll-up, folded in from heartbeat fields by
+    # the controller: lastUploadedStep (newest checkpoint step durable
+    # REMOTELY — what a fresh-node restart can actually warm-start from,
+    # distinct from checkpoint.lastCheckpointStep which may be local-only),
+    # lifetime uploadFailures, and the per-attempt baseline the delta
+    # accounting persists (attempt/attemptUploadFailures).
+    store: Optional[Dict[str, Any]] = None
+    # Restart-goodput accounting, computed by the controller from the
+    # phase timeline + startup breakdown + heartbeat step cadence:
+    # usefulStepSeconds (time spent in completed optimizer steps),
+    # wallclockSeconds (since the job first started running), and their
+    # ratio — the number that says what fleet churn (preemptions, cold
+    # restarts) actually costs this job.
+    goodput: Optional[Dict[str, Any]] = None
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -644,6 +739,10 @@ class TPUJobStatus:
             d["checkpoint"] = dict(self.checkpoint)
         if self.startup:
             d["startup"] = dict(self.startup)
+        if self.store:
+            d["store"] = dict(self.store)
+        if self.goodput:
+            d["goodput"] = dict(self.goodput)
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -678,6 +777,8 @@ class TPUJobStatus:
             checkpoint=(dict(d["checkpoint"])
                         if d.get("checkpoint") else None),
             startup=(dict(d["startup"]) if d.get("startup") else None),
+            store=(dict(d["store"]) if d.get("store") else None),
+            goodput=(dict(d["goodput"]) if d.get("goodput") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),
@@ -816,6 +917,13 @@ class ControllerConfig:
     status_url: str = ""
     create_parallelism: int = 16
     slice_inventory: Dict[str, int] = field(default_factory=dict)
+    # Live slice-inventory discovery (``discoverSliceInventory`` /
+    # ``--discover-slice-inventory``): the controller watches node objects
+    # and rebuilds the fleet scheduler's capacity model on every node
+    # add/remove/relabel — so capacity changes admit queued gangs without
+    # an operator restart. When set alongside a static ``sliceInventory``,
+    # the discovered model wins as soon as the node cache syncs.
+    discover_slice_inventory: bool = False
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -827,6 +935,8 @@ class ControllerConfig:
             d["createParallelism"] = self.create_parallelism
         if self.slice_inventory:
             d["sliceInventory"] = dict(self.slice_inventory)
+        if self.discover_slice_inventory:
+            d["discoverSliceInventory"] = True
         return d
 
     @classmethod
@@ -855,4 +965,6 @@ class ControllerConfig:
             status_url=str(d.get("statusUrl", "")),
             create_parallelism=int(d.get("createParallelism", 16) or 16),
             slice_inventory=inventory,
+            discover_slice_inventory=bool(
+                d.get("discoverSliceInventory", False)),
         )

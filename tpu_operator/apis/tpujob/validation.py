@@ -23,6 +23,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     MAX_SCHEDULING_PRIORITY,
     CacheMedium,
     RestartPolicy,
+    StoreBackend,
     TPUJobSpec,
     TPUReplicaType,
 )
@@ -122,6 +123,49 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
                 "scheduling.queue must be a non-empty string of at most "
                 "63 characters"
             )
+
+    # Remote warm-start store: the URI must be present and scheme-
+    # consistent with the backend, and the chunk fan-out must be a usable
+    # pool size. Backends beyond the in-repo pair are allowed — they name
+    # a deployment-registered factory (store/blob.register_backend), so
+    # validation checks shape and consistency here and resolution is
+    # gated at payload runtime with a clear error. A store block with no
+    # URI is a misconfiguration, not a default — silently running
+    # store-less would quietly forfeit every fresh-node warm start the
+    # user asked for.
+    store = spec.store
+    if store is not None:
+        import re as _re
+
+        if not _re.match(StoreBackend.NAME_PATTERN, store.backend or ""):
+            raise ValidationError(
+                f"store.backend {store.backend!r} must match "
+                f"{StoreBackend.NAME_PATTERN} (localfs, fake, or a "
+                f"registered backend slug)"
+            )
+        if not store.uri:
+            raise ValidationError(
+                "store.uri is required (an absolute path / file:// URI on "
+                "a pod-visible shared filesystem, fake://name in tests, or "
+                "a registered backend's <scheme>://... URI)"
+            )
+        if store.backend == StoreBackend.LOCALFS:
+            if not (store.uri.startswith("/")
+                    or store.uri.startswith("file://")):
+                raise ValidationError(
+                    "store.uri for the localfs backend must be an absolute "
+                    "path or file:// URI (it is resolved inside the pods)"
+                )
+        elif not store.uri.startswith(f"{store.backend}://"):
+            # fake ↔ fake://, and every registered backend ↔ its scheme:
+            # the payload resolves by URI scheme, so a mismatched pair
+            # would silently use a different backend than spec'd.
+            raise ValidationError(
+                f"store.uri for the {store.backend!r} backend must be "
+                f"{store.backend}://..."
+            )
+        if store.upload_parallelism < 1:
+            raise ValidationError("store.uploadParallelism must be >= 1")
 
     # Warm-restart compilation cache (validated only when enabled: a
     # disabled block is inert, whatever its other fields say).

@@ -291,6 +291,9 @@ class FakeClientset:
         self.leases = FakeResourceClient("Lease", self)
         self.configmaps = FakeResourceClient("ConfigMap", self)
         self.tpujobs = FakeResourceClient("TPUJob", self)
+        # Cluster-scoped in real K8s; the fake namespaces everything, and
+        # the node-inventory informer lists with namespace "" (= all).
+        self.nodes = FakeResourceClient("Node", self)
 
     def next_version(self) -> int:
         self._version += 1
@@ -320,7 +323,8 @@ class FakeClientset:
         quiet resources). The apiserver harness calls this on shutdown so
         handler threads parked in a watch iteration always exit."""
         for client in (self.pods, self.services, self.events, self.endpoints,
-                       self.leases, self.configmaps, self.tpujobs):
+                       self.leases, self.configmaps, self.tpujobs,
+                       self.nodes):
             with self.lock:
                 watchers = list(client._watchers)
             for q, _ns, _sel in watchers:

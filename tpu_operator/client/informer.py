@@ -424,10 +424,16 @@ class SharedInformerFactory:
         """Live view of the created informers (status server readiness)."""
         return self._informers
 
-    def informer_for(self, resource: str) -> Informer:
+    def informer_for(self, resource: str,
+                     namespace: Optional[str] = None) -> Informer:
+        """One shared informer per resource kind. ``namespace`` overrides
+        the factory default for cluster-scoped resources (nodes pass ""
+        = all namespaces, which maps to the un-namespaced list/watch
+        path); it only applies on first creation."""
         if resource not in self._informers:
             client = getattr(self._clientset, resource)
-            inf = Informer(client, self._namespace, self._resync)
+            ns = self._namespace if namespace is None else namespace
+            inf = Informer(client, ns, self._resync)
             self._informers[resource] = inf
             if self._started and self._stop_event is not None:
                 inf.start(self._stop_event)

@@ -410,3 +410,6 @@ class Clientset:
         self.tpujobs = RestResourceClient(
             self.rest, f"/apis/{CRD_GROUP}/{CRD_VERSION}", CRD_KIND_PLURAL, CRD_KIND
         )
+        # Cluster-scoped: the node-inventory informer lists/watches with
+        # namespace "" so the path is the un-namespaced /api/v1/nodes.
+        self.nodes = RestResourceClient(self.rest, core, "nodes", "Node")

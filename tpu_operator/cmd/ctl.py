@@ -191,11 +191,22 @@ def cmd_describe(cs, opts) -> int:
               f"{'-' if durable is None else durable} "
               f"(save failures {ck.get('saveFailures', 0)}, "
               f"restore fallbacks {ck.get('restoreFallbacks', 0)})")
+    # Remote warm-start store: the spec half (backend/URI) and the status
+    # roll-up half (what is actually durable remotely).
+    spec_store = spec.get("store") or {}
+    st = status.get("store") or {}
+    if spec_store or st:
+        uploaded = st.get("lastUploadedStep")
+        print(f"Store:      {spec_store.get('backend', '?')} "
+              f"{spec_store.get('uri', '')} — last uploaded step "
+              f"{'-' if uploaded is None else uploaded} "
+              f"(upload failures {st.get('uploadFailures', 0)})")
     su = status.get("startup") or {}
     if su:
         stages = " ".join(
             f"{label} {su[key]:.2f}s"
             for label, key in (("rendezvous", "rendezvousSeconds"),
+                               ("prefetch", "prefetchSeconds"),
                                ("restore", "restoreSeconds"),
                                ("compile", "compileSeconds"),
                                ("first-step", "firstStepSeconds"))
@@ -203,8 +214,16 @@ def cmd_describe(cs, opts) -> int:
         cache = su.get("cacheHit")
         cache_s = ("warm (compilation cache hit)" if cache
                    else "cold" if cache is not None else "unknown")
+        pf = su.get("prefetchHit")
+        if pf is not None:
+            cache_s += (", prefetch hit" if pf else ", prefetch miss")
         print(f"Startup:    attempt {su.get('attempt', 0)}: {stages} "
               f"[{cache_s}]")
+    gp = status.get("goodput") or {}
+    if gp.get("ratio") is not None:
+        print(f"Goodput:    {100 * gp['ratio']:.1f}% "
+              f"(useful {gp.get('usefulStepSeconds', 0):.1f}s / "
+              f"wallclock {gp.get('wallclockSeconds', 0):.1f}s)")
     if status.get("failures"):
         print("Failures:")
         for f in status["failures"][-10:]:

@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "config file's sliceInventory (an explicit '' "
                         "disables admission control even when the config "
                         "file sets one)")
+    p.add_argument("--discover-slice-inventory", action="store_true",
+                   help="discover fleet-scheduler slice capacity from a "
+                        "live node watch (allocatable TPU resource × "
+                        "topology label × slice-id label) instead of a "
+                        "static map; capacity changes (node added/removed/"
+                        "relabeled) update admission and rebalance the "
+                        "queue without an operator restart")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="informer resync/re-list period in seconds")
     p.add_argument("--no-leader-elect", action="store_true",

@@ -86,6 +86,8 @@ def run(opts: Any, clientset: Optional[Any] = None,
         # The flag overrides the config file outright; an explicit ''
         # parses to an empty map = admission control off.
         config.slice_inventory = parse_slice_inventory(opts.slice_inventory)
+    if getattr(opts, "discover_slice_inventory", False):
+        config.discover_slice_inventory = True
     tracing.configure(span_buffer=getattr(opts, "trace_buffer",
                                           tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()

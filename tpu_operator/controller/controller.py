@@ -156,6 +156,20 @@ class Controller:
             pods=self.factory.informer_for("pods").store,
             services=self.factory.informer_for("services").store,
         )
+        # Live slice-inventory discovery (ROADMAP item 1 follow-on): a
+        # node informer rebuilds the scheduler's capacity model on every
+        # node add/remove/relabel, so capacity changes update admission —
+        # and trigger a queue rebalance — without an operator restart.
+        # Cluster-scoped: namespace "" = the un-namespaced node path.
+        self._node_informer = None
+        if getattr(self.config, "discover_slice_inventory", False):
+            self._node_informer = self.factory.informer_for("nodes",
+                                                            namespace="")
+            self._node_informer.add_event_handler(
+                on_add=lambda _obj: self._refresh_node_inventory(),
+                on_update=lambda _old, _new: self._refresh_node_inventory(),
+                on_delete=lambda _obj: self._refresh_node_inventory(),
+            )
 
     # -- enqueue (ref: controller.go:270-279) ----------------------------------
 
@@ -182,6 +196,12 @@ class Controller:
         self.factory.start(stop_event)
         if not self.factory.wait_for_cache_sync():
             raise RuntimeError("timed out waiting for informer caches to sync")
+        # Discovery mode: seed the capacity model from the synced node
+        # cache once, unconditionally — a cluster with zero (TPU) nodes
+        # must yield an EMPTY discovered inventory, not silently keep a
+        # stale static one that per-node events would never fire to
+        # replace.
+        self._refresh_node_inventory()
         self._rebuild_scheduler_accounting()
         num_shards = getattr(self.queue, "num_shards", None)
         if num_shards is not None:
@@ -231,6 +251,18 @@ class Controller:
                 demand=job_demand(job.spec),
                 priority=priority, queue=queue,
                 holds_hardware=True)
+
+    def _refresh_node_inventory(self) -> None:
+        """Recompute slice capacity from the cached node objects and swap
+        it into the fleet scheduler (reservations preserved; newly
+        fitting gangs admit and their reconciles are woken). O(nodes) per
+        node event — idempotent, so the initial sync's per-node add burst
+        just converges on the same model."""
+        if self._node_informer is None:
+            return
+        inv = SliceInventory.from_node_objects(
+            self._node_informer.store.list())
+        self.scheduler.update_inventory(inv.capacities())
 
     def _worker(self, stop_event: threading.Event,
                 shard: Optional[int] = None) -> None:
@@ -291,6 +323,11 @@ class Controller:
             # A deleted job's slice reservation (or queue slot) frees for
             # the next pending gang.
             self.scheduler.release(key)
+            # Per-job gauge series must not outlive the job (the same
+            # slow-leak class the queue-depth LRU bounds).
+            self.metrics.remove_series(
+                "job_goodput_ratio",
+                labels={"namespace": namespace, "name": name})
             return True
 
         job = TPUJob.from_dict(cached)
@@ -383,13 +420,19 @@ class Controller:
                                   "tokensPerSec", "loss",
                                   "lastCheckpointStep",
                                   "checkpointSaveFailures",
-                                  "checkpointRestoreFallbacks"):
+                                  "checkpointRestoreFallbacks",
+                                  "storeLastUploadedStep",
+                                  "storeUploadFailures"):
                         if field not in merged and field in prev:
                             merged[field] = prev[field]
             tj.job.status.last_heartbeat = merged
             self._apply_checkpoint_heartbeat(tj, namespace, name, heartbeat,
                                              hb_attempt)
+            self._apply_store_heartbeat(tj, namespace, name, heartbeat,
+                                        hb_attempt)
             self._apply_startup_heartbeat(tj, namespace, name, heartbeat,
+                                          hb_attempt)
+            self._apply_goodput_heartbeat(tj, namespace, name, heartbeat,
                                           hb_attempt)
             # Compare against the last *persisted* stamp, not the last
             # received one — a steady sub-interval cadence would otherwise
@@ -459,6 +502,97 @@ class Controller:
             ck["time"] = str(heartbeat["time"])
         tj.job.status.checkpoint = ck
 
+    def _apply_store_heartbeat(self, tj: TrainingJob, namespace: str,
+                               name: str, heartbeat: Dict[str, Any],
+                               hb_attempt: Optional[int]) -> None:
+        """Fold a heartbeat's remote-store fields into ``status.store``
+        (called under _jobs_lock). Same delta discipline as the
+        checkpoint fold: the payload's upload-failure counter is
+        per-attempt, status keeps the lifetime total with the per-attempt
+        baseline persisted IN status so operator restarts never
+        double-count; deltas tick ``job_store_upload_failures_total``.
+        ``lastUploadedStep`` is taken as reported — it can move backwards
+        when a fresh attempt's store sees older steps than a previous
+        attempt uploaded (quarantine pruned the newest)."""
+        relevant = [heartbeat.get(k) for k in
+                    ("storeLastUploadedStep", "storeUploadFailures")]
+        if all(v is None for v in relevant):
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        st = dict(tj.job.status.store or {})
+        same_attempt = st.get("attempt") == gen
+        if heartbeat.get("storeLastUploadedStep") is not None:
+            st["lastUploadedStep"] = int(heartbeat["storeLastUploadedStep"])
+        reported = heartbeat.get("storeUploadFailures")
+        if reported is not None:
+            reported = int(reported)
+            baseline = int(st.get("attemptUploadFailures", 0)) \
+                if same_attempt else 0
+            delta = reported if reported < baseline else reported - baseline
+            st["uploadFailures"] = int(st.get("uploadFailures", 0)) + delta
+            if delta > 0:
+                self.metrics.inc("job_store_upload_failures_total", delta,
+                                 labels={"namespace": namespace,
+                                         "name": name})
+            st["attemptUploadFailures"] = reported
+        st["attempt"] = int(gen)
+        if heartbeat.get("time"):
+            st["time"] = str(heartbeat["time"])
+        tj.job.status.store = st
+
+    def _apply_goodput_heartbeat(self, tj: TrainingJob, namespace: str,
+                                 name: str, heartbeat: Dict[str, Any],
+                                 hb_attempt: Optional[int]) -> None:
+        """Accumulate restart goodput into ``status.goodput`` (called
+        under _jobs_lock): useful-step-seconds over attempt wallclock.
+
+        Useful time adds up from two complementary sources that never
+        overlap: the startup breakdown contributes ``firstStepSeconds``
+        once per attempt (folded in _apply_startup_heartbeat, which calls
+        here indirectly via the shared dict), and every subsequent
+        heartbeat contributes ``(step - lastStep) * stepTimeSeconds`` —
+        stepTimeSeconds is the payload's average over exactly that step
+        span, so the product IS the wall time spent stepping between
+        posts. Wallclock runs from the first entry into Creating (the
+        phase timeline) to the heartbeat's receipt stamp — queue wait
+        before the first start is excluded by the same re-basing the
+        admission path applies to the timeline. The ratio is what fleet
+        churn costs: every preemption's rendezvous + restore + recompile
+        + lost-step replay shows up as the gap below 1.0."""
+        from tpu_operator.util.util import parse_rfc3339
+
+        step = heartbeat.get("step")
+        if step is None:
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        gp = dict(tj.job.status.goodput or {})
+        same_attempt = gp.get("attempt") == gen
+        useful = float(gp.get("usefulStepSeconds", 0.0))
+        last_step = gp.get("lastStep") if same_attempt else None
+        step_time = heartbeat.get("stepTimeSeconds")
+        if last_step is not None and step_time is not None \
+                and int(step) > int(last_step):
+            useful += (int(step) - int(last_step)) * float(step_time)
+        gp["usefulStepSeconds"] = round(useful, 6)
+        gp["lastStep"] = int(step)
+        gp["attempt"] = int(gen)
+        now = parse_rfc3339(str(heartbeat.get("time", "")))
+        started = parse_rfc3339(
+            tj.job.status.phase_timeline.get(TPUJobPhase.CREATING, "")) \
+            or parse_rfc3339(tj.job.metadata.get("creationTimestamp", ""))
+        if now is not None and started is not None and now > started:
+            wall = now - started
+            gp["wallclockSeconds"] = round(wall, 6)
+            # Clamped: step-time averaging noise can nudge useful past
+            # wall on short windows; a ratio above 1 would just confuse.
+            gp["ratio"] = round(min(1.0, useful / wall), 6)
+            self.metrics.set_gauge("job_goodput_ratio", gp["ratio"],
+                                   labels={"namespace": namespace,
+                                           "name": name})
+        if heartbeat.get("time"):
+            gp["time"] = str(heartbeat["time"])
+        tj.job.status.goodput = gp
+
     def _apply_startup_heartbeat(self, tj: TrainingJob, namespace: str,
                                  name: str, heartbeat: Dict[str, Any],
                                  hb_attempt: Optional[int]) -> None:
@@ -482,6 +616,8 @@ class Controller:
                 new[field] = float(su[field])
         if su.get("cacheHit") is not None:
             new["cacheHit"] = bool(su["cacheHit"])
+        if su.get("prefetchHit") is not None:
+            new["prefetchHit"] = bool(su["prefetchHit"])
         if not new:
             return
         new["attempt"] = int(gen)
@@ -497,6 +633,22 @@ class Controller:
         if new.get("cacheHit"):
             self.metrics.inc("compilation_cache_hits_total",
                              labels={"namespace": namespace, "name": name})
+        if new.get("prefetchHit") is not None:
+            # Once per attempt (guarded by ``already``, like the cache-hit
+            # tick): did the rendezvous-overlapped store prefetch deliver?
+            self.metrics.inc("store_prefetch_hits_total"
+                             if new["prefetchHit"]
+                             else "store_prefetch_misses_total",
+                             labels={"namespace": namespace, "name": name})
+        if new.get("firstStepSeconds") is not None:
+            # The attempt's first step is useful work the goodput fold
+            # can't see (the first heartbeat carries no stepTimeSeconds);
+            # credit it here, once per attempt.
+            gp = dict(tj.job.status.goodput or {})
+            gp["usefulStepSeconds"] = round(
+                float(gp.get("usefulStepSeconds", 0.0))
+                + float(new["firstStepSeconds"]), 6)
+            tj.job.status.goodput = gp
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

@@ -194,6 +194,25 @@ class Metrics:
                       "Attempts whose XLA compile was served from the "
                       "persistent compilation cache (warm restart), per "
                       "startup breakdown reports.")
+        self.register("job_store_upload_failures_total", "counter",
+                      "Remote warm-start-store checkpoint-upload failures "
+                      "reported by payload heartbeats (delta-accumulated "
+                      "per job; the write-behind uploader retries on the "
+                      "next verified save).")
+        self.register("store_prefetch_hits_total", "counter",
+                      "Attempts whose rendezvous-overlapped store prefetch "
+                      "delivered a checkpoint and/or compilation-cache "
+                      "entries (fresh-node warm start), once per attempt.")
+        self.register("store_prefetch_misses_total", "counter",
+                      "Attempts whose store prefetch found nothing to "
+                      "fetch (cold store or first attempt), once per "
+                      "attempt.")
+        self.register("job_goodput_ratio", "gauge",
+                      "Per-job restart goodput: useful-step-seconds over "
+                      "attempt wallclock since the job first started "
+                      "running — what fleet churn (preemptions, cold "
+                      "restarts) costs the job, computed from heartbeat "
+                      "step cadence + the startup breakdown.")
         self.register("tpujob_preemptions_total", "counter",
                       "Admitted jobs evicted by the fleet scheduler so a "
                       "higher-priority job could fit the slice inventory "
@@ -567,7 +586,9 @@ class StatusServer:
                             ("tokensPerSec", float), ("loss", float),
                             ("lastCheckpointStep", int),
                             ("checkpointSaveFailures", int),
-                            ("checkpointRestoreFallbacks", int)):
+                            ("checkpointRestoreFallbacks", int),
+                            ("storeLastUploadedStep", int),
+                            ("storeUploadFailures", int)):
             if body.get(field) is not None:
                 try:
                     value = cast(body[field])
@@ -611,6 +632,8 @@ class StatusServer:
                 clean[field] = value
             if su.get("cacheHit") is not None:
                 clean["cacheHit"] = bool(su["cacheHit"])
+            if su.get("prefetchHit") is not None:
+                clean["prefetchHit"] = bool(su["prefetchHit"])
             # An empty breakdown carries nothing: storing it would defeat
             # heartbeat coalescing (the controller force-persists any beat
             # with a "startup" key) and 503 no-op beats on a fresh leader.
@@ -743,6 +766,9 @@ class StatusServer:
                 # Durability state: which step is actually safe to restart
                 # from, and how the payload's checkpoint storage is faring.
                 "checkpoint": status.get("checkpoint"),
+                # Remote warm-start store roll-up + restart goodput.
+                "store": status.get("store"),
+                "goodput": status.get("goodput"),
                 # The in-memory heartbeat is fresher than the informer-cached
                 # status copy (which lags by a reconcile + watch round-trip);
                 # the internal receivedAt bookkeeping stays out of the API.
@@ -804,6 +830,10 @@ class StatusServer:
                     ("job_last_checkpoint_step", "lastCheckpointStep",
                      "Last verified (durable) checkpoint step reported by "
                      "the payload."),
+                    ("job_store_last_uploaded_step", "storeLastUploadedStep",
+                     "Newest checkpoint step durable in the remote "
+                     "warm-start store (what a fresh-node restart "
+                     "warm-starts from)."),
                 )
                 for metric, field, help_text in gauges:
                     rows = [((ns, name), hb[field])

@@ -119,11 +119,23 @@ def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
     """Form the process group. Single-process jobs skip jax.distributed
     entirely (a v4-8 single-worker job needs no coordinator —
     BASELINE config 2 degenerates to plain jax). The DNS wait + rendezvous
-    time is recorded as the RENDEZVOUS stage of the startup breakdown."""
+    time is recorded as the RENDEZVOUS stage of the startup breakdown.
+
+    The remote warm-start store prefetch (payload/warmstore.py) starts
+    FIRST and joins LAST: the compilation-cache + latest-checkpoint
+    download runs concurrently with the DNS/rendezvous wait that is
+    already on the critical path, so on a fresh node the warm bytes are
+    usually in place the moment the group forms — only the tail that
+    outlives rendezvous is paid (recorded as the PREFETCH stage)."""
+    from tpu_operator.payload import warmstore
+
     info = info or process_info_from_env()
+    prefetching = warmstore.start_prefetch()
     if info.num_processes <= 1:
         log.info("single-process job; skipping jax.distributed")
         startup_mod.record_rendezvous(0.0)
+        if prefetching:
+            warmstore.finish_prefetch()
         return info
     import jax
 
@@ -135,6 +147,8 @@ def initialize(info: Optional[ProcessInfo] = None) -> ProcessInfo:
         process_id=info.process_id,
     )
     startup_mod.record_rendezvous(time.perf_counter() - t0)
+    if prefetching:
+        warmstore.finish_prefetch()
     log.info("process %d/%d joined group at %s (%d devices visible)",
              info.process_id, info.num_processes, info.coordinator_address,
              jax.device_count())
@@ -257,12 +271,22 @@ def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
         # agree on a boundary step, group-save, exit 143 — owns preemption.
         signal.signal(signal.SIGTERM, _sigterm)
         fn(info)
-        return 0
+        code = 0
     except SystemExit as e:
-        return int(e.code or 0)
+        code = int(e.code or 0)
     except Exception:  # noqa: BLE001 — the contract: app error = permanent
         log.exception("payload failed")
         return 1
+    if code in (0, EXIT_RETRYABLE):
+        # Ship this attempt's compiled executables to the warm-start
+        # store on the clean/drain exit paths: jobs with a store but no
+        # checkpointing have no write-behind uploader, and even
+        # checkpointed attempts may compile then drain before their
+        # first save. Best-effort set-difference sync, process 0 only.
+        from tpu_operator.payload import warmstore
+
+        warmstore.upload_cache_once()
+    return code
 
 
 def main_wrapper(fn: Callable[[ProcessInfo], None]) -> None:

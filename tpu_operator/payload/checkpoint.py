@@ -143,7 +143,8 @@ class Checkpointer:
                  max_to_keep: int = 3,
                  fail_after: int = DEFAULT_FAIL_AFTER,
                  agree_fn: Optional[Callable[[Optional[int]],
-                                             Optional[int]]] = None):
+                                             Optional[int]]] = None,
+                 uploader: Optional[Any] = None):
         import orbax.checkpoint as ocp
 
         self._ocp = ocp
@@ -167,6 +168,16 @@ class Checkpointer:
         # Steps already condemned this process (quarantine attempted): never
         # reconsidered, so a failing rename cannot loop the restore walk.
         self._condemned: set = set()
+        # Remote warm-start store write-behind
+        # (store/writebehind.WriteBehindUploader, wired by
+        # payload/warmstore.uploader_from_env for process 0): every
+        # VERIFIED save is enqueued for async upload — durability is local
+        # first, remote never blocks the step loop — and quarantined steps
+        # are condemned remotely so a fresh-node prefetch can never prefer
+        # a remote copy of a step the local walk rejected. Persistent
+        # upload failures escalate exactly like local save failures
+        # (checked at save boundaries on the step-loop thread).
+        self._uploader = uploader
         self.manager = ocp.CheckpointManager(
             self.directory,
             options=ocp.CheckpointManagerOptions(
@@ -197,6 +208,11 @@ class Checkpointer:
         }
         if self._last_verified is not None:
             out["lastCheckpointStep"] = int(self._last_verified)
+        if self._uploader is not None:
+            # Remote-store counters ride the same heartbeat channel:
+            # {uploadFailures, lastUploadedStep} → storeUploadFailures /
+            # storeLastUploadedStep on the wire.
+            out.update(self._uploader.stats())
         return out
 
     # -- save path -------------------------------------------------------------
@@ -209,6 +225,7 @@ class Checkpointer:
         are counted and skipped, escalating to SystemExit(143) only after
         ``fail_after`` consecutive failures."""
         step = int(step)
+        self._check_upload_escalation()
         try:
             due = bool(self.manager.should_save(step))
         except Exception:  # noqa: BLE001 — conservative: try the save
@@ -229,6 +246,7 @@ class Checkpointer:
         verified) before deciding, and a pending save that *failed* to
         commit is retried here rather than dedup'd away."""
         step = int(step)
+        self._check_upload_escalation()
         self._finalize_pending(block=True)
         if self._last_verified == step or self.manager.latest_step() == step:
             return False
@@ -326,6 +344,13 @@ class Checkpointer:
         self._last_verified = step
         self.consecutive_save_failures = 0
         log.info("checkpoint step %d verified in %s", step, self.directory)
+        if self._uploader is not None:
+            # Write-behind: only VERIFIED saves ship (the remote store
+            # advertises durable steps, so it must never hold bytes the
+            # local manifest discipline hasn't blessed). enqueue is a
+            # lock-guarded dict update — the step loop never touches the
+            # backend.
+            self._uploader.enqueue(step, self._step_dir(step))
 
     def _record_save_failure(self, step: int, err: Exception) -> None:
         self.save_failures += 1
@@ -340,6 +365,20 @@ class Checkpointer:
                 "checkpoint storage failing persistently (%d consecutive "
                 "save failures); exiting retryable so the operator restarts "
                 "the group", self.consecutive_save_failures)
+            raise SystemExit(EXIT_RETRYABLE)
+
+    def _check_upload_escalation(self) -> None:
+        """Remote-upload health, polled at save boundaries on the
+        step-loop thread (where SystemExit actually exits): a remote that
+        has failed ``fail_after`` consecutive uploads is treated exactly
+        like persistently failing local storage — exit retryable and let
+        the operator re-place the group. Transient blips cost nothing
+        (the uploader skips and retries on the next verified save)."""
+        if self._uploader is not None and self._uploader.escalated():
+            log.error(
+                "remote warm-start store failing persistently (%d upload "
+                "failures); exiting retryable so the operator restarts "
+                "the group", self._uploader.upload_failures)
             raise SystemExit(EXIT_RETRYABLE)
 
     # -- verification / manifest -----------------------------------------------
@@ -452,6 +491,13 @@ class Checkpointer:
         postmortem. Races with a peer process quarantining the same step on
         a shared filesystem resolve to whoever renames first."""
         self._condemned.add(int(step))
+        if self._uploader is not None:
+            # Condemn the REMOTE copy too (async, best-effort): a fresh
+            # node's prefetch must never prefer a remote snapshot of a
+            # step the local walk just proved bad. (Prefetch also skips
+            # locally-quarantined steps independently, covering the
+            # window before this mark lands.)
+            self._uploader.mark_corrupt(int(step))
         src = self._step_dir(step)
         n = 0
         dst = f"{src}{QUARANTINE_SUFFIX}-{n}"
@@ -605,6 +651,15 @@ class Checkpointer:
             pass
         except Exception as e:  # noqa: BLE001
             log.warning("checkpoint flush on close failed: %s", e)
+        if self._uploader is not None:
+            # Bounded drain so the FINAL checkpoint usually lands remotely
+            # (a fresh node restarted after completion warm-starts from
+            # it); best-effort — a completed run is never converted to a
+            # failure by its upload tail.
+            try:
+                self._uploader.close(flush=True)
+            except Exception as e:  # noqa: BLE001
+                log.warning("remote store flush on close failed: %s", e)
         try:
             self.manager.close()
         except Exception as e:  # noqa: BLE001
@@ -616,10 +671,15 @@ def from_env_or_args(checkpoint_dir: str = "", save_every: int = 100,
                      fail_after: int = DEFAULT_FAIL_AFTER,
                      env: Optional[dict] = None) -> Optional[Checkpointer]:
     """Build a Checkpointer from an explicit flag, falling back to the
-    operator-injected TPU_CHECKPOINT_DIR; None when neither is set."""
+    operator-injected TPU_CHECKPOINT_DIR; None when neither is set. When
+    the operator also wired a remote warm-start store (TPUJOB_STORE_*),
+    process 0 gets the write-behind uploader attached."""
     e = env if env is not None else os.environ
     directory = checkpoint_dir or e.get(ENV_VAR, "")
     if not directory:
         return None
+    from tpu_operator.payload import warmstore
+
     return Checkpointer(directory, save_every=save_every,
-                        max_to_keep=max_to_keep, fail_after=fail_after)
+                        max_to_keep=max_to_keep, fail_after=fail_after,
+                        uploader=warmstore.uploader_from_env(e))

@@ -114,7 +114,11 @@ class HeartbeatReporter:
                     checkpoint["lastCheckpointStep"])
             for src, dst in (("saveFailures", "checkpointSaveFailures"),
                              ("restoreFallbacks",
-                              "checkpointRestoreFallbacks")):
+                              "checkpointRestoreFallbacks"),
+                             # Remote warm-start store (write-behind
+                             # uploader counters, merged into stats()).
+                             ("lastUploadedStep", "storeLastUploadedStep"),
+                             ("uploadFailures", "storeUploadFailures")):
                 if checkpoint.get(src) is not None:
                     body[dst] = int(checkpoint[src])
         loss = (metrics or {}).get("loss")

@@ -33,18 +33,23 @@ import time
 from typing import Any, Callable, Dict, List, Optional
 
 # Startup stages, in nominal order. COMPILE and RESTORE overlap in the
-# fast path; FIRST_STEP is the first optimizer step after the prologue
-# (its duration includes any residual compile the AOT path didn't cover).
+# fast path; PREFETCH (the remote warm-start store download) overlaps
+# RENDEZVOUS — its recorded duration is only the tail that outlived the
+# rendezvous wait, i.e. what the store actually kept on the critical
+# path; FIRST_STEP is the first optimizer step after the prologue (its
+# duration includes any residual compile the AOT path didn't cover).
 RENDEZVOUS = "RENDEZVOUS"
+PREFETCH = "PREFETCH"
 RESTORE = "RESTORE"
 COMPILE = "COMPILE"
 FIRST_STEP = "FIRST_STEP"
 
-STAGES = (RENDEZVOUS, RESTORE, COMPILE, FIRST_STEP)
+STAGES = (RENDEZVOUS, PREFETCH, RESTORE, COMPILE, FIRST_STEP)
 
 # Heartbeat/status field name per stage (the ``status.startup`` keys).
 STAGE_FIELDS = {
     RENDEZVOUS: "rendezvousSeconds",
+    PREFETCH: "prefetchSeconds",
     RESTORE: "restoreSeconds",
     COMPILE: "compileSeconds",
     FIRST_STEP: "firstStepSeconds",
@@ -52,8 +57,11 @@ STAGE_FIELDS = {
 
 # Rendezvous happens in bootstrap.initialize, before any tracker exists
 # (the payload's train_loop builds one much later) — recorded at module
-# level and seeded into every new tracker of this process.
+# level and seeded into every new tracker of this process. The store
+# prefetch runs in the same window, so it is recorded the same way.
 _rendezvous_seconds: Optional[float] = None
+_prefetch_seconds: Optional[float] = None
+_prefetch_hit: Optional[bool] = None
 # The persistent compilation cache dir bootstrap enabled ("" = cold).
 _cache_dir: str = ""
 
@@ -61,6 +69,23 @@ _cache_dir: str = ""
 def record_rendezvous(seconds: float) -> None:
     global _rendezvous_seconds
     _rendezvous_seconds = float(seconds)
+
+
+def record_prefetch(seconds: float, hit: Optional[bool]) -> None:
+    """Record the warm-start store prefetch: ``seconds`` is the tail the
+    download kept on the critical path AFTER the rendezvous it overlapped
+    (0.0 = fully hidden), ``hit`` whether it delivered anything (a
+    checkpoint step or cache entries); None = store not configured."""
+    global _prefetch_seconds, _prefetch_hit
+    _prefetch_seconds = float(seconds)
+    _prefetch_hit = None if hit is None else bool(hit)
+
+
+def reset_prefetch() -> None:
+    """Test hook: clear the module-level prefetch record."""
+    global _prefetch_seconds, _prefetch_hit
+    _prefetch_seconds = None
+    _prefetch_hit = None
 
 
 def set_cache_dir(path: str) -> None:
@@ -116,10 +141,13 @@ class StartupTracker:
         self._active: List[str] = []  # innermost last
         self.durations: Dict[str, float] = {}
         self.cache_hit: Optional[bool] = None
+        self.prefetch_hit: Optional[bool] = _prefetch_hit
         # Absolute clock() stamp of first-step completion (TTFS fences).
         self.first_step_done_at: Optional[float] = None
         if _rendezvous_seconds is not None:
             self.durations[RENDEZVOUS] = _rendezvous_seconds
+        if _prefetch_seconds is not None:
+            self.durations[PREFETCH] = _prefetch_seconds
 
     @contextlib.contextmanager
     def stage(self, name: str):
@@ -154,6 +182,8 @@ class StartupTracker:
             }
             if self.cache_hit is not None:
                 out["cacheHit"] = bool(self.cache_hit)
+            if self.prefetch_hit is not None:
+                out["prefetchHit"] = bool(self.prefetch_hit)
         return out
 
 

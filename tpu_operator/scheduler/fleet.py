@@ -193,6 +193,31 @@ class FleetScheduler:
         self._notify(wake, skip=key)
         return reason
 
+    def update_inventory(self, capacity: Dict[str, int]) -> None:
+        """Live capacity refresh (the node-informer feed): swap the
+        modeled capacity, re-examine sidelined jobs, and rebalance so
+        newly-fitting gangs admit WITHOUT an operator restart.
+
+        Un-sidelining matters: a job parked unschedulable ("demand
+        exceeds total capacity") under the old model may fit the new one
+        — and conversely the rebalance re-sidelines heads that now exceed
+        a shrunken shape, so one drained node pool cannot head-block its
+        shape forever. Reservations are preserved across the swap
+        (inventory.set_capacity): a shrink below current usage is honest
+        over-commit that drains as gangs finish."""
+        with self._lock:
+            self._inventory.set_capacity(capacity)
+            for ent in self._pending.values():
+                if not ent.impossible:
+                    continue
+                total = self._inventory.capacity(ent.demand_key)
+                if total is None or ent.slices <= total:
+                    ent.impossible = False
+            wake = self._rebalance_locked()
+        self._notify(wake)
+        log.info("fleet: slice inventory updated (%d shapes)",
+                 len(capacity or {}))
+
     def release(self, key: str) -> None:
         """Return ``key``'s slices (teardown/TTL/terminal/suspend/deleted)
         and drop it from the queue entirely. Idempotent."""

@@ -135,6 +135,20 @@ class SliceInventory:
     def empty(self) -> bool:
         return not self._capacity
 
+    def capacities(self) -> Dict[str, int]:
+        """The modeled capacity map (copy) — what a live inventory refresh
+        feeds into :meth:`set_capacity` on another instance."""
+        return dict(self._capacity)
+
+    def set_capacity(self, capacity: Dict[str, int]) -> None:
+        """Swap the capacity model in place, PRESERVING reservations: the
+        node-informer feed (nodes added/removed/relabeled) changes what
+        the cluster owns, not what admitted gangs hold. A shrink below
+        current usage leaves the shape transiently over-committed — the
+        truth on the ground (the gangs are physically running) — and
+        drains as they finish, exactly like the restart-rebuild path."""
+        self._capacity = {str(k): int(v) for k, v in (capacity or {}).items()}
+
     def modeled(self, key: str) -> bool:
         return key in self._capacity
 

@@ -1,0 +1,40 @@
+"""Remote warm-start store: a pluggable blob backend for checkpoints and
+the persistent compilation cache.
+
+PR 5's warm restart (persistent XLA cache, 2.54x TTFS) and PR 4's durable
+checkpoints both live on node-local directories, so they only survive
+*same-node* rescheduling — while the PR 7 fleet scheduler deliberately
+preempts and re-places gangs across nodes. This package is the missing
+remote half: an object-store-shaped blob API (``blob.py``), chunked
+parallel transfer with per-chunk sha256 integrity (``transfer.py``), a
+job-scoped warm-start store layering checkpoints + compilation-cache sync
++ a corrupt-step index on top (``warmstart.py``), and an async write-behind
+uploader that keeps remote persistence off the training step path
+(``writebehind.py``).
+
+Stdlib-only by design: the package is imported by both the operator image
+(controller-side introspection) and the payload image (upload/prefetch),
+and must drag neither jax nor any cloud SDK into either. Cloud backends
+(gs://, s3://) are deliberately *gated*, not vendored: ``blob.from_uri``
+raises a clear error naming the registration hook
+(``blob.register_backend``) so a deployment wires its own SDK-backed
+implementation instead of this repo growing a dependency.
+"""
+
+from tpu_operator.store.blob import (  # noqa: F401
+    BlobBackend,
+    BlobError,
+    BlobNotFound,
+    FakeBackend,
+    LocalFSBackend,
+    from_uri,
+    register_backend,
+)
+from tpu_operator.store.transfer import (  # noqa: F401
+    IntegrityError,
+    TransferError,
+    download_tree,
+    upload_tree,
+)
+from tpu_operator.store.warmstart import WarmStartStore  # noqa: F401
+from tpu_operator.store.writebehind import WriteBehindUploader  # noqa: F401

@@ -30,7 +30,8 @@ from tpu_operator.client.fake import FakeClientset
 log = logging.getLogger(__name__)
 
 _RESOURCES = (
-    "pods", "services", "events", "endpoints", "configmaps", "leases", "tpujobs",
+    "pods", "services", "events", "endpoints", "configmaps", "leases",
+    "tpujobs", "nodes",
 )
 
 

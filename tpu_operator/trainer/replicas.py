@@ -263,6 +263,15 @@ def build_replica_env(
         env["TPUJOB_CACHE_ENABLED"] = "1"
         env["TPUJOB_CACHE_PATH"] = cache.path
         env["TPUJOB_CACHE_MEDIUM"] = cache.medium
+    store = spec.store
+    if store is not None and store.uri:
+        # Remote warm-start store (payload/warmstore.py consumes): write-
+        # behind checkpoint/cache uploads + the rendezvous-overlapped
+        # prefetch that makes a FRESH-node restart warm.
+        env["TPUJOB_STORE_BACKEND"] = store.backend
+        env["TPUJOB_STORE_URI"] = store.uri
+        env["TPUJOB_STORE_PARALLELISM"] = str(store.upload_parallelism)
+        env["TPUJOB_STORE_PREFETCH"] = "1" if store.prefetch else "0"
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)
