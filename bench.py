@@ -98,6 +98,13 @@ def parse_args(argv=None):
                         "the write-behind step-time guard (uploads must "
                         "never ride the step loop); exits nonzero on "
                         "regression")
+    p.add_argument("--steptrace", action="store_true",
+                   help="run ONLY the flight-recorder overhead guard "
+                        "(CPU-hostable): the same step loop with the "
+                        "per-step phase recorder on vs off, interleaved "
+                        "windows; exits nonzero if recorder-on steady "
+                        "step time exceeds recorder-off by more than 1% "
+                        "(50 µs absolute floor)")
     p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
@@ -1362,6 +1369,124 @@ def bench_checkpoint(quick: bool) -> list:
     return rows
 
 
+# --- data-plane flight recorder overhead ----------------------------------------
+
+def bench_steptrace(quick: bool) -> list:
+    """The --steptrace guard: the flight recorder's cost on the steady
+    step path must be noise. Two arms run the SAME loop body over the
+    same pre-staged batches — recorder off (production loop shape: no
+    per-step fence, window fenced by a device_get like every other row)
+    vs recorder on (per-phase laps + the per-step ``block_until_ready``
+    COMPUTE fence) — in INTERLEAVED windows, so clock drift and host
+    noise land on both arms equally. Budget: recorder-on median per-step
+    time within 1% of recorder-off, with a 50 µs absolute floor (the
+    recorder's cost is constant per step — a handful of clock reads —
+    while the baseline shrinks with the bench shape; at production step
+    times the relative budget is the binding one)."""
+    import jax
+
+    from tpu_operator.payload import cifar, data as data_mod
+    from tpu_operator.payload import steptrace as steptrace_mod
+
+    # Small batch, many steps per window: the recorder's cost is constant
+    # per STEP, so more steps per window averages host noise down while
+    # keeping the per-step time in the few-ms regime where the 1% budget
+    # and the 50 µs floor agree.
+    if quick:
+        batch, steps, windows = 32, 60, 5
+        cfg = ["--blocks", "1", "--widths", "8", "8", "8"]
+    else:
+        batch, steps, windows = 64, 120, 7
+        cfg = ["--blocks", "1", "--widths", "8", "16", "32"]
+    cargs = cifar.parse_args(["--batch", str(batch), *cfg])
+    mesh, _model, state, step_fn, batches = cifar.build(cargs)
+    pregen = [data_mod.put_global_batch(mesh, *b)
+              for b in itertools.islice(batches, 4)]
+    cycled = itertools.cycle(pregen)
+
+    def run_window(rec):
+        nonlocal state
+        t0 = time.perf_counter()
+        metrics = fence = None
+        for i in range(steps):
+            if rec is not None:
+                rec.begin(i)
+            args = next(cycled)
+            if rec is not None:
+                rec.lap(steptrace_mod.DATA)
+            state, metrics = step_fn(state, *args)
+            if rec is not None:
+                # One-step-deferred COMPUTE fence, exactly as the
+                # production loop runs it (train.train_loop): dispatch
+                # pipelining is preserved; a same-step fence measured
+                # 1-3% loss right here, which is what this guard exists
+                # to catch.
+                rec.lap(steptrace_mod.DISPATCH)
+                if fence is not None:
+                    jax.block_until_ready(fence)
+                rec.lap(steptrace_mod.COMPUTE)
+                fence = metrics
+                rec.lap(steptrace_mod.HOST)
+                rec.commit()
+        jax.device_get(metrics["loss"])
+        return (time.perf_counter() - t0) / steps
+
+    # Warmup (compile) outside any timed window.
+    for _ in range(3):
+        state, metrics = step_fn(state, *next(cycled))
+    jax.device_get(metrics["loss"])
+
+    recorder = steptrace_mod.StepRecorder(capacity=1024)
+    off_times, on_times = [], []
+    for _ in range(windows):
+        off_times.append(run_window(None))
+        on_times.append(run_window(recorder))
+    # Min of PAIRWISE deltas, not a median-vs-median comparison: this is
+    # an overhead guard on a shared CI host whose contention bursts dwarf
+    # the µs being measured. A real recorder regression is present in
+    # EVERY adjacent off/on pair; a contention burst is absent from at
+    # least one — so the smallest per-pair delta isolates the systematic
+    # cost (median gates flaked at several percent right here).
+    off = min(off_times)
+    deltas = [on_t - off_t for off_t, on_t in zip(off_times, on_times)]
+    # A negative min-delta means a burst hit an off-window harder than
+    # any on-window — i.e. the overhead is below the noise floor. Clamp
+    # the headline at 0 rather than report a nonsense negative cost.
+    overhead = max(0.0, min(deltas))
+    on = off + overhead
+    overhead_pct = 100.0 * overhead / off
+    # The recorder's own digest must be coherent: every phase present,
+    # whole-step p50 within the timed window's ballpark.
+    summary = recorder.summary()
+    assert summary is not None and summary["steps"] == windows * steps
+    assert {"dataWait", "dispatch", "compute", "host"} \
+        <= set(summary["phases"]), summary
+    return [{
+        "metric": "steptrace_overhead",
+        "off_step_ms": round(off * 1e3, 4),
+        "on_step_ms": round(on * 1e3, 4),
+        "overhead_pct": round(overhead_pct, 2),
+        "overhead_us_per_step": round((on - off) * 1e6, 2),
+        "digest_step_p50_ms": round(summary["stepP50Seconds"] * 1e3, 4),
+        "windows": windows,
+        "unit": "pct",
+        "value": round(overhead_pct, 2),
+    }]
+
+
+def _steptrace_ok(rows: list) -> bool:
+    (row,) = rows
+    over_pct = row["overhead_pct"]
+    over_abs = (row["on_step_ms"] - row["off_step_ms"]) / 1e3
+    if over_pct <= 1.0 or over_abs <= 50e-6:
+        return True
+    print(f"steptrace budget EXCEEDED: recorder-on step "
+          f"{row['on_step_ms']} ms vs off {row['off_step_ms']} ms "
+          f"({over_pct:.2f}% > 1% and {over_abs * 1e6:.1f} µs > 50 µs)",
+          file=sys.stderr)
+    return False
+
+
 # --- warm-restart startup rows --------------------------------------------------
 
 def startup_worker_main(cfg_json: str) -> int:
@@ -1740,6 +1865,12 @@ def main(argv=None) -> int:
         for row in bench_checkpoint(args.quick):
             _emit(row)
         return 0
+    if args.steptrace:
+        # Recorder cost is host-side clock reads: pin CPU (the tunnel's
+        # per-fence RTT would swamp the µs-scale number being guarded).
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = [_emit(row) for row in bench_steptrace(args.quick)]
+        return 0 if _steptrace_ok(rows) else 1
     if args.quick:
         # Force CPU even when a TPU plugin pinned the platform at boot
         # (backend clients initialize lazily, so this override wins).
@@ -1764,6 +1895,17 @@ def main(argv=None) -> int:
             return 1
         for row in bench_checkpoint(args.quick):
             rows.append(_emit(row))
+        if jax.devices()[0].platform == "cpu":
+            # The overhead being guarded is µs-scale host cost; through
+            # the TPU tunnel every recorder fence pays the ~100 ms RTT
+            # and the budget would fail on transport, not on the
+            # recorder. The CPU-pinned standalone gate (verify.sh runs
+            # `--steptrace --quick`) owns the budget; the suite row only
+            # exists where it measures the right thing.
+            st_rows = [_emit(row) for row in bench_steptrace(args.quick)]
+            rows.extend(st_rows)
+            if not _steptrace_ok(st_rows):
+                return 1
         for row in bench_startup(args.quick):
             rows.append(_emit(row))
         for row in bench_store(args.quick):

@@ -66,6 +66,16 @@ python -m pytest tests/test_store.py -x -q
 # the prefetch hit + goodput asserted, and the write-behind must stay
 # off the step loop — exits nonzero otherwise.
 python bench.py --store --quick
+# Standalone data-plane observability gate: the step flight recorder
+# (phase laps, ring buffer, windowed digests, postmortem dump), the
+# stepTiming heartbeat chain through statusserver sanitization and the
+# controller fold, and gang straggler detection (slowed replica flagged
+# into status.stragglers + StragglerDetected + describe + /metrics).
+python -m pytest tests/test_steptrace.py -x -q
+# And its measured form: recorder-on steady step time must stay within
+# 1% of recorder-off (50 µs absolute floor) — the near-zero-cost claim
+# as an enforced budget, exits nonzero on regression.
+python bench.py --steptrace --quick
 # Standalone fleet-scheduler gate: slice-inventory admission (whole-gang
 # fit or phase Queued), fair-share + priority ordering, preemption victim
 # selection + the preemption-budget requeue, inventory release on
@@ -92,6 +102,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_api_budget.py \
   --ignore=tests/test_startup_path.py \
   --ignore=tests/test_store.py \
-  --ignore=tests/test_fleet_scheduler.py
+  --ignore=tests/test_fleet_scheduler.py \
+  --ignore=tests/test_steptrace.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

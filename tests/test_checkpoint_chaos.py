@@ -45,6 +45,7 @@ from tpu_operator.controller.controller import Controller
 from tpu_operator.controller.statusserver import StatusServer
 from tpu_operator.payload import checkpoint as ckpt_mod
 from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
 
 pytestmark = pytest.mark.slow  # standalone verify.sh gate
 
@@ -55,13 +56,9 @@ KILL_STEP = 6
 TOTAL_STEPS = 10
 
 
-def wait_for(pred, timeout=60.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return pred()
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=60.0, interval=0.05)
 
 
 def chaos_job(ckdir):

@@ -32,19 +32,16 @@ from tpu_operator.scheduler.inventory import (
 from tpu_operator.scheduler.sharding import ShardedWorkQueue
 from tpu_operator.scheduler.writeback import WritebackLimiter
 from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.testing.waiting import make_wait_for
 from tests.test_types import make_template
 
 V4 = "cloud-tpus.google.com/v4"
 KEY = slice_key(V4, "2x2x2")
 
 
-def wait_for(predicate, timeout=5.0, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return predicate()
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=5.0, interval=0.02)
 
 
 def tpu_job(name="fleet", replicas=1, priority=0, queue="default",

@@ -14,16 +14,13 @@ from tpu_operator.apis.tpujob.v1alpha1 import types as t
 from tpu_operator.client.fake import FakeClientset
 from tpu_operator.client.informer import SharedInformerFactory
 from tpu_operator.controller.controller import Controller
+from tpu_operator.testing.waiting import make_wait_for
 from tests.test_types import make_template
 
 
-def wait_for(predicate, timeout=5.0, interval=0.02):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if predicate():
-            return True
-        time.sleep(interval)
-    return predicate()
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=5.0, interval=0.02)
 
 
 def worker_job_dict(name="train", replicas=2, runtime_id="ab12"):

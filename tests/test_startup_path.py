@@ -22,6 +22,7 @@ from tpu_operator.payload import heartbeat as heartbeat_mod
 from tpu_operator.payload import startup as startup_mod
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.testing.waiting import make_wait_for
 from tests.test_types import make_template
 
 
@@ -642,13 +643,9 @@ def e2e():
         api.stop()
 
 
-def wait_for(pred, timeout=45.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return pred()
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=45.0, interval=0.05)
 
 
 def test_startup_breakdown_e2e(e2e):

@@ -16,6 +16,7 @@ from tpu_operator.client.fake import FakeClientset
 from tpu_operator.client.informer import SharedInformerFactory
 from tpu_operator.controller.controller import Controller
 from tpu_operator.controller.statusserver import Metrics, StatusServer
+from tpu_operator.testing.waiting import make_wait_for
 
 
 def worker_job(name: str, replicas: int = 2) -> dict:
@@ -37,13 +38,9 @@ def get(port: int, path: str):
         return r.status, r.read().decode(), r.headers.get("Content-Type", "")
 
 
-def wait_for(pred, timeout=10.0):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(0.05)
-    return False
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=10.0, interval=0.05)
 
 
 @pytest.fixture()

@@ -1,0 +1,875 @@
+"""Data-plane flight recorder: recorder unit tests, the stepTiming
+heartbeat chain (payload → statusserver sanitization → controller fold →
+CRD status/metrics), gang straggler detection, the postmortem ring-buffer
+dump, and the per-job metric-series cleanup on job deletion.
+
+The e2e section drives the REAL operator over the in-process HTTP
+apiserver (strict status-subresource schema admission) with simulated
+gang members posting cadence beats — one artificially slowed — and
+asserts the straggler surfaces in status.stragglers, the
+StragglerDetected event, ``tpujobctl describe``, and ``/metrics``.
+"""
+
+import contextlib
+import io
+import json
+import threading
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+from tpu_operator.apis.tpujob.v1alpha1 import types
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.apis.tpujob.validation import (
+    ValidationError,
+    validate_tpujob_spec,
+)
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.payload import steptrace
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.trainer.training import TrainingJob
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+
+class FakeClock:
+    """Deterministic perf_counter: advances by the programmed increments."""
+
+    def __init__(self, *increments):
+        self.now = 0.0
+        self.steps = list(increments)
+
+    def __call__(self):
+        if self.steps:
+            self.now += self.steps.pop(0)
+        return self.now
+
+
+def worker_job(name, replicas=1, spec_extra=None):
+    spec = {"replicaSpecs": [{
+        "replicas": replicas, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+        "template": {"spec": {"containers": [{"name": "tpu",
+                                              "image": "x"}]}}}]}
+    spec.update(spec_extra or {})
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+# --- recorder unit -----------------------------------------------------------
+
+def test_recorder_laps_attribute_time_to_phases():
+    clock = FakeClock()
+    rec = steptrace.StepRecorder(capacity=16, clock=clock)
+    clock.steps = [0.0,   # begin
+                   0.010,  # DATA lap
+                   0.001,  # DISPATCH lap
+                   0.100,  # COMPUTE lap
+                   0.002,  # CHECKPOINT lap
+                   0.003,  # HOST lap
+                   0.0]    # commit total read
+    rec.begin(7)
+    rec.lap(steptrace.DATA)
+    rec.lap(steptrace.DISPATCH)
+    rec.lap(steptrace.COMPUTE)
+    rec.lap(steptrace.CHECKPOINT)
+    rec.lap(steptrace.HOST)
+    rec.commit()
+    (row,) = rec.snapshot()
+    assert row["step"] == 7
+    assert row["dataWait"] == pytest.approx(0.010)
+    assert row["dispatch"] == pytest.approx(0.001)
+    assert row["compute"] == pytest.approx(0.100)
+    assert row["checkpoint"] == pytest.approx(0.002)
+    assert row["host"] == pytest.approx(0.003)
+    assert row["stepSeconds"] == pytest.approx(0.116)
+
+
+def test_recorder_ring_is_bounded_and_summary_windows_are_disjoint():
+    rec = steptrace.StepRecorder(capacity=8)
+    for i in range(20):
+        rec.begin(i)
+        rec.lap(steptrace.COMPUTE)
+        rec.commit()
+    snap = rec.snapshot()
+    assert len(snap) == 8                      # ring bound
+    assert [r["step"] for r in snap] == list(range(12, 20))  # newest kept
+    assert rec.steps_recorded == 20
+
+    s1 = rec.summary()
+    # The digest window is bounded at the ring capacity too: with no
+    # heartbeat draining it (standalone payload), accumulation must not
+    # grow O(steps) — the digest covers the newest `capacity` steps.
+    assert s1["steps"] == 8
+    assert rec.summary() is None               # window reset: nothing new
+    rec.begin(20)
+    rec.lap(steptrace.COMPUTE)
+    rec.commit()
+    s2 = rec.summary()
+    assert s2["steps"] == 1                    # disjoint second window
+
+
+def test_recorder_digest_percentiles():
+    # 100 samples 0.01..1.00: nearest-rank p50 = 0.50, p95 = 0.95.
+    values = [i / 100.0 for i in range(1, 101)]
+    d = steptrace.digest(values)
+    assert d["p50Seconds"] == pytest.approx(0.50)
+    assert d["p95Seconds"] == pytest.approx(0.95)
+    assert d["maxSeconds"] == pytest.approx(1.00)
+
+
+def test_recorder_summary_wire_shape():
+    rec = steptrace.StepRecorder(capacity=16)
+    for i in range(4):
+        rec.begin(i)
+        rec.lap(steptrace.DATA)
+        rec.lap(steptrace.COMPUTE)
+        rec.commit()
+    s = rec.summary()
+    assert set(s) == {"steps", "stepP50Seconds", "stepP95Seconds",
+                      "stepMaxSeconds", "stepLocalP95Seconds", "phases"}
+    assert set(s["phases"]) == {"dataWait", "compute"}
+    for stats in s["phases"].values():
+        assert set(stats) == set(steptrace.DIGEST_KEYS)
+
+
+def test_recorder_abandon_drops_partial_step():
+    rec = steptrace.StepRecorder()
+    rec.begin(0)
+    rec.lap(steptrace.DATA)
+    rec.abandon()
+    rec.commit()  # no-op: nothing in flight
+    assert rec.snapshot() == [] and rec.summary() is None
+
+
+def test_recorder_dump_and_postmortem(tmp_path):
+    ckpt = tmp_path / "data" / "ckpt"
+    ckpt.mkdir(parents=True)
+    rec = steptrace.StepRecorder(capacity=8)
+    for i in range(3):
+        rec.begin(i)
+        rec.lap(steptrace.COMPUTE)
+        rec.commit()
+    path = steptrace.postmortem_dump(rec, str(ckpt), env={
+        "TPUJOB_NAME": "pm", "TPUJOB_NAMESPACE": "ns",
+        "TPUJOB_ATTEMPT": "2", "JAX_PROCESS_ID": "1"})
+    # Artifact lands NEXT TO the checkpoint dir, named by attempt+process.
+    assert path == str(tmp_path / "data" / "steptrace-attempt2-p1.json")
+    body = json.loads(open(path).read())
+    assert body["kind"] == "tpujob-steptrace"
+    assert body["job"] == "pm" and body["attempt"] == 2
+    assert body["processId"] == 1
+    assert [r["step"] for r in body["steps"]] == [0, 1, 2]
+    # No checkpoint dir → no dump, no raise (best-effort contract).
+    assert steptrace.postmortem_dump(rec, "", env={}) is None
+    # Unwritable destination (sibling AND in-dir fallback) → logged None,
+    # never an exception.
+    assert steptrace.postmortem_dump(
+        rec, "/proc/definitely-unwritable/ck", env={}) is None
+    # checkpointDir that IS a top-level mount point: the sibling slot
+    # would be the container rootfs — the artifact goes INSIDE instead.
+    assert steptrace.postmortem_path("/ckpt", 1, 2) \
+        == "/ckpt/steptrace-attempt1-p2.json"
+
+
+def test_from_env_gating():
+    assert steptrace.from_env({}) is not None                # default ON
+    assert steptrace.from_env({"TPUJOB_STEPTRACE_ENABLED": "0"}) is None
+    assert steptrace.from_env({"TPUJOB_STEPTRACE_ENABLED": "false"}) is None
+    rec = steptrace.from_env({"TPUJOB_STEPTRACE_BUFFER": "64"})
+    assert rec.capacity == 64
+    # malformed buffer falls back to the default, never kills training
+    rec = steptrace.from_env({"TPUJOB_STEPTRACE_BUFFER": "lots"})
+    assert rec.capacity == steptrace.DEFAULT_BUFFER_STEPS
+
+
+# --- spec wiring -------------------------------------------------------------
+
+def test_steptrace_spec_roundtrip_defaults_validation():
+    doc = worker_job("t", spec_extra={
+        "stepTrace": {"bufferSteps": 128, "stragglerRatio": 1.5}})
+    spec = types.TPUJobSpec.from_dict(doc["spec"])
+    assert spec.step_trace.enabled is True
+    assert spec.step_trace.buffer_steps == 128
+    assert spec.step_trace.straggler_ratio == 1.5
+    assert spec.to_dict()["stepTrace"] == {
+        "enabled": True, "bufferSteps": 128, "stragglerRatio": 1.5}
+    validate_tpujob_spec(set_defaults(spec))
+
+    # absent block round-trips absent (None = the defaults)
+    bare = types.TPUJobSpec.from_dict(worker_job("t")["spec"])
+    assert bare.step_trace is None and "stepTrace" not in bare.to_dict()
+
+    # strict schema admits the block and rejects unknown keys inside it
+    ok, _ = schema_mod.validate_tpujob_strict(doc)
+    assert ok
+    bad = worker_job("t", spec_extra={"stepTrace": {"bufSteps": 1}})
+    ok, msg = schema_mod.validate_tpujob_strict(bad)
+    assert not ok and "bufSteps" in msg
+
+    # explicit junk reaches validation and fails loudly (never clamped) —
+    # even on a DISABLED block: the generated CRD enforces the same
+    # minimums unconditionally, so an enabled-only check would diverge
+    # the fake apiserver from a real one
+    for block in ({"bufferSteps": 4}, {"stragglerRatio": 0.5},
+                  {"enabled": False, "bufferSteps": 4}):
+        junk = types.TPUJobSpec.from_dict(
+            worker_job("t", spec_extra={"stepTrace": block})["spec"])
+        with pytest.raises(ValidationError):
+            validate_tpujob_spec(set_defaults(junk))
+
+
+def test_steptrace_env_injection():
+    from tpu_operator.trainer.replicas import build_replica_env
+
+    spec = types.TPUJobSpec.from_dict(worker_job("j", spec_extra={
+        "stepTrace": {"bufferSteps": 256}})["spec"])
+    set_defaults(spec)
+    env = build_replica_env("j", "rt1", spec, types.TPUReplicaType.WORKER,
+                            0, 0)
+    assert env["TPUJOB_STEPTRACE_ENABLED"] == "1"
+    assert env["TPUJOB_STEPTRACE_BUFFER"] == "256"
+
+    off = types.TPUJobSpec.from_dict(worker_job("j", spec_extra={
+        "stepTrace": {"enabled": False}})["spec"])
+    env = build_replica_env("j", "rt1", off, types.TPUReplicaType.WORKER,
+                            0, 0)
+    assert env["TPUJOB_STEPTRACE_ENABLED"] == "0"
+
+    # no block → no injection (recorder default-on without env)
+    bare = types.TPUJobSpec.from_dict(worker_job("j")["spec"])
+    env = build_replica_env("j", "rt1", bare, types.TPUReplicaType.WORKER,
+                            0, 0)
+    assert "TPUJOB_STEPTRACE_ENABLED" not in env
+
+
+# --- heartbeat reporter ------------------------------------------------------
+
+def _capture_reporter(**kw):
+    posts = []
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://x", "j", poster=lambda _url, body: posts.append(body),
+        clock=FakeClock(), **kw)
+    return reporter, posts
+
+
+def test_report_carries_steptiming():
+    reporter, posts = _capture_reporter()
+    digest = {"steps": 5, "stepP95Seconds": 0.2,
+              "phases": {"compute": {"p95Seconds": 0.18}}}
+    assert reporter.report(5, {"loss": 1.0}, steptiming=digest)
+    assert posts[0]["stepTiming"] == digest
+    assert posts[0]["loss"] == 1.0
+    # None digest (no steps since last beat) → field simply absent
+    assert reporter.report(6, {"loss": 0.9}, steptiming=None)
+    assert "stepTiming" not in posts[1]
+
+
+def test_cadence_reporters_not_built_when_steptrace_disabled():
+    """spec.stepTrace.enabled: false → the detector no-ops every cadence
+    beat, so non-zero processes must not build reporters at all (63
+    discarded POSTs per interval on a 64-gang); process 0's stream is
+    independent telemetry and keeps flowing."""
+    env = {"TPUJOB_STATUS_URL": "http://x", "TPUJOB_NAME": "j",
+           "JAX_PROCESS_ID": "1", "TPUJOB_STEPTRACE_ENABLED": "0"}
+    assert heartbeat_mod.from_env(env) is None
+    r0 = heartbeat_mod.from_env({**env, "JAX_PROCESS_ID": "0"})
+    assert r0 is not None and not r0.cadence_only
+
+
+def test_cadence_only_reporter_posts_minimal_body():
+    reporter, posts = _capture_reporter(process_id=3, cadence_only=True,
+                                        tokens_per_batch=4096)
+    reporter._clock = FakeClock(0.0, 10.0)  # two posts 10 s apart
+    digest = {"steps": 3, "stepP95Seconds": 0.5}
+    assert reporter.report(10, {"loss": 2.0},
+                           checkpoint={"saveFailures": 1},
+                           startup={"compileSeconds": 3.0},
+                           steptiming=digest)
+    assert reporter.report(20, {"loss": 1.5}, steptiming=digest)
+    first, second = posts
+    # identity + cadence + digest only — no loss/tokens/checkpoint/startup
+    assert first["processId"] == 3 and first["stepTiming"] == digest
+    for key in ("loss", "tokensPerSec", "startup", "lastCheckpointStep",
+                "checkpointSaveFailures"):
+        assert key not in first and key not in second
+    assert second["stepTimeSeconds"] == pytest.approx(1.0)  # 10 s / 10 steps
+
+
+# --- statusserver sanitization ----------------------------------------------
+
+class _ControllerStub:
+    """Minimal controller: knows one job, captures sanitized heartbeats."""
+
+    class _Store:
+        def get(self, _ns, name):
+            return {"metadata": {"namespace": "default", "name": name}} \
+                if name == "jb" else None
+
+        def list(self):
+            return []
+
+    class _Informer:
+        def __init__(self):
+            self.store = _ControllerStub._Store()
+
+    def __init__(self):
+        self.job_informer = self._Informer()
+        self.heartbeats = []
+
+    def record_heartbeat(self, _ns, _name, hb):
+        self.heartbeats.append(hb)
+        return True
+
+
+@pytest.fixture()
+def sanitizing_server():
+    server = StatusServer(0)
+    server.start()  # stop() blocks unless serve_forever is running
+    stub = _ControllerStub()
+    server.set_controller(stub)
+    try:
+        yield server, stub
+    finally:
+        server.stop()
+
+
+def test_steptiming_sanitization_rejects_bad_values(sanitizing_server):
+    server, _stub = sanitizing_server
+    base = {"namespace": "default", "name": "jb", "step": 1}
+
+    ok, msg = server.record_heartbeat({**base, "stepTiming": "fast"})
+    assert not ok and "must be an object" in msg
+    ok, msg = server.record_heartbeat(
+        {**base, "stepTiming": {"stepP95Seconds": -0.1}})
+    assert not ok and "stepP95Seconds" in msg
+    ok, msg = server.record_heartbeat(
+        {**base, "stepTiming": {"stepP50Seconds": float("nan")}})
+    assert not ok
+    ok, msg = server.record_heartbeat(
+        {**base, "stepTiming": {"steps": -1}})
+    assert not ok and "negative" in msg
+    ok, msg = server.record_heartbeat(
+        {**base, "stepTiming": {"phases": {"compute": {
+            "p95Seconds": "slow"}}}})
+    assert not ok and "non-numeric" in msg
+    ok, msg = server.record_heartbeat(
+        {**base, "stepTiming": {"phases": {"compute": {
+            "maxSeconds": -3}}}})
+    assert not ok and "maxSeconds" in msg
+
+
+def test_steptiming_sanitization_drops_unknown_phases(sanitizing_server):
+    server, stub = sanitizing_server
+    ok, _ = server.record_heartbeat({
+        "namespace": "default", "name": "jb", "step": 1,
+        "stepTiming": {"steps": 2,
+                       "phases": {"compute": {"p95Seconds": 0.1},
+                                  "quantumFlux": {"p95Seconds": 9.9}}}})
+    assert ok
+    (hb,) = stub.heartbeats
+    # known phase kept, unknown phase dropped (forward compatibility),
+    # never persisted toward the strict CRD schema
+    assert set(hb["stepTiming"]["phases"]) == {"compute"}
+    ok, _ = schema_mod.validate_tpujob_strict(worker_job("jb"))
+    assert ok
+
+
+def test_nonzero_process_beat_skips_gauge_stash(sanitizing_server):
+    server, _stub = sanitizing_server
+    ok, _ = server.record_heartbeat({
+        "namespace": "default", "name": "jb", "step": 50, "processId": 2,
+        "stepTimeSeconds": 0.5})
+    assert ok
+    with server._heartbeats_lock:
+        assert ("default", "jb") not in server._heartbeats
+    ok, _ = server.record_heartbeat({
+        "namespace": "default", "name": "jb", "step": 50, "processId": 0})
+    assert ok
+    with server._heartbeats_lock:
+        assert server._heartbeats[("default", "jb")]["step"] == 50
+
+
+# --- controller fold + straggler detection ----------------------------------
+
+def _controller_with_job(name="sj", spec_extra=None, attempt=0):
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=3600.0)
+    job = types.TPUJob.from_dict(worker_job(name, spec_extra=spec_extra))
+    job.metadata["uid"] = "u1"
+    job.status.attempt = attempt
+    controller.jobs[f"default/{name}"] = TrainingJob(
+        cs, controller.recorder, job)
+    return cs, controller, controller.jobs[f"default/{name}"]
+
+
+def _beat(pid, local_p95, step=100, attempt=0,
+          time="2026-08-04T00:00:00.000000Z"):
+    """One cadence beat. The gang-synchronized whole-step p95 is the SAME
+    for every member (1.0 s — the collectives equalize it; that is the
+    whole point of the local-time signal); ``local_p95`` is the
+    per-process local share the detector compares."""
+    return {"time": time, "step": step, "attempt": attempt,
+            "processId": pid,
+            "stepTiming": {"steps": 10, "stepP95Seconds": 1.0,
+                           "stepLocalP95Seconds": local_p95,
+                           "phases": {"compute": {"p50Seconds": 0.85,
+                                                  "p95Seconds": 0.9,
+                                                  "maxSeconds": 1.0}}}}
+
+
+def test_steptiming_folds_into_status_and_histograms():
+    _cs, controller, tj = _controller_with_job()
+    assert controller.record_heartbeat("default", "sj", _beat(0, 0.25))
+    st = tj.job.status.step_timing
+    assert st["stepP95Seconds"] == 1.0
+    assert st["stepLocalP95Seconds"] == 0.25
+    assert st["attempt"] == 0 and st["processId"] == 0
+    assert st["phases"]["compute"]["p95Seconds"] == 0.9
+    hist = controller.metrics.histogram_snapshot(
+        "job_step_phase_seconds", labels={"phase": "compute"})
+    assert hist["count"] == 1 and hist["sum"] == pytest.approx(0.9)
+    # a second digest observes again (windows are disjoint by contract)
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(0, 0.30, step=110))
+    hist = controller.metrics.histogram_snapshot(
+        "job_step_phase_seconds", labels={"phase": "compute"})
+    assert hist["count"] == 2
+
+
+def test_straggler_flagged_event_gauge_and_clearing():
+    cs, controller, tj = _controller_with_job()
+    # gang of 4, LOCAL p95s: pids 0-2 healthy at 0.1 s, pid 3 at 0.5 s
+    # (5x median) — the whole-step p95 is identical across the gang (the
+    # collectives equalize it), which is exactly why the detector keys on
+    # the local share.
+    for pid in (0, 1, 2):
+        assert controller.record_heartbeat("default", "sj", _beat(pid, 0.1))
+    assert tj.job.status.stragglers == []      # nobody above 2x yet
+    assert controller.record_heartbeat("default", "sj", _beat(3, 0.5))
+    (s,) = tj.job.status.stragglers
+    assert s["processId"] == 3
+    assert s["ratio"] == pytest.approx(5.0)
+    assert s["gangMedianSeconds"] == pytest.approx(0.1)
+    assert controller.metrics.counter_value(
+        "job_straggler_ratio",
+        labels={"namespace": "default", "name": "sj"}) == pytest.approx(5.0)
+    events = [e for e in cs.events.list("default")
+              if e.get("reason") == "StragglerDetected"]
+    assert len(events) == 1 and "process 3" in events[0]["message"]
+    # the flagged set change forced a persist enqueue
+    assert controller.queue.get(timeout=0) == "default/sj"
+    controller.queue.done("default/sj")
+
+    # same straggler again: no second event, no forced persist, and the
+    # status entry stays the FROZEN flagging snapshot (a per-beat value
+    # refresh would make every reconcile see a critical stragglers delta
+    # and bypass the writeback limiter); the gauge tracks the drift
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(3, 0.6, step=120))
+    events = [e for e in cs.events.list("default")
+              if e.get("reason") == "StragglerDetected"]
+    assert len(events) == 1
+    (s2,) = tj.job.status.stragglers
+    assert s2["p95Seconds"] == pytest.approx(0.5)   # snapshot, not 0.6
+    assert controller.metrics.counter_value(
+        "job_straggler_ratio",
+        labels={"namespace": "default", "name": "sj"}) == pytest.approx(6.0)
+
+    # recovery: pid 3 back to median → flag clears (and that change
+    # persists: an eviction signal must not linger)
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(3, 0.1, step=130))
+    assert tj.job.status.stragglers == []
+
+    # the status roll-up passes the strict CRD status schema
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(3, 0.9, step=140))
+    ok, msg = schema_mod.validate_tpujob_strict(tj.job.to_dict())
+    assert ok, msg
+
+
+def test_straggler_respects_spec_ratio_and_enabled():
+    # custom ratio 6.0: a 5x member is NOT flagged
+    _cs, controller, tj = _controller_with_job(
+        spec_extra={"stepTrace": {"stragglerRatio": 6.0}})
+    for pid, p95 in ((0, 0.1), (1, 0.1), (2, 0.1), (3, 0.5)):
+        assert controller.record_heartbeat("default", "sj", _beat(pid, p95))
+    assert tj.job.status.stragglers == []
+
+    # disabled recorder: no detection at all
+    _cs, controller, tj = _controller_with_job(
+        spec_extra={"stepTrace": {"enabled": False}})
+    for pid, p95 in ((0, 0.1), (1, 0.1), (2, 0.1), (3, 5.0)):
+        assert controller.record_heartbeat("default", "sj", _beat(pid, p95))
+    assert tj.job.status.stragglers == []
+
+
+def test_straggler_gauge_respects_materiality_floor():
+    """µs-level local-time ratios between healthy device-bound hosts are
+    noise: the materiality floor suppresses them from the FLAG and from
+    the GAUGE alike — the gauge's help text promises above-threshold
+    means flagged, so it must never advertise a ratio the detector
+    discarded."""
+    _cs, controller, tj = _controller_with_job()
+    for pid, local in ((0, 1e-6), (1, 1e-6), (2, 1e-6), (3, 20e-6)):
+        assert controller.record_heartbeat("default", "sj",
+                                           _beat(pid, local))
+    assert tj.job.status.stragglers == []     # 20x ratio, but µs vs a 1 s step
+    assert controller.metrics.counter_value(
+        "job_straggler_ratio",
+        labels={"namespace": "default", "name": "sj"}) == pytest.approx(1.0)
+
+
+def test_straggler_falls_back_to_step_time_without_digest():
+    """Digest-less payloads (recorder off, old payload) still get
+    detection from the plain stepTimeSeconds cadence."""
+    _cs, controller, tj = _controller_with_job()
+    for pid, sec in ((0, 0.1), (1, 0.1), (2, 0.1)):
+        hb = {"time": "2026-08-04T00:00:00.000000Z", "step": 100,
+              "attempt": 0, "processId": pid, "stepTimeSeconds": sec}
+        assert controller.record_heartbeat("default", "sj", hb)
+    hb = {"time": "2026-08-04T00:00:00.000000Z", "step": 100,
+          "attempt": 0, "processId": 3, "stepTimeSeconds": 0.4}
+    assert controller.record_heartbeat("default", "sj", hb)
+    (s,) = tj.job.status.stragglers
+    assert s["processId"] == 3 and s["ratio"] == pytest.approx(4.0)
+
+
+def test_stale_generation_cadence_dropped_and_attempt_resets():
+    _cs, controller, tj = _controller_with_job(attempt=2)
+    # stale-generation beat carrying stepTiming: dropped whole (PR-2 rule)
+    assert controller.record_heartbeat(
+        "default", "sj", _beat(3, 9.9, attempt=1)) is None
+    assert tj.job.status.stragglers == []
+    assert tj.job.status.step_timing is None
+
+    # attempt 2 cadence accumulates...
+    for pid, p95 in ((0, 0.1), (1, 0.1), (2, 0.1), (3, 0.5)):
+        assert controller.record_heartbeat("default", "sj",
+                                           _beat(pid, p95, attempt=2))
+    assert tj.job.status.stragglers
+    # ...and an attempt bump resets the gang map: the new generation is
+    # judged only on its own beats
+    tj.job.status.attempt = 3
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(0, 0.1, attempt=3))
+    assert controller._gang_cadence["default/sj"]["procs"].keys() == {0}
+
+
+def test_cadence_entries_expire_and_ghosts_do_not_skew_median():
+    """A member that stopped posting (dead pod, replaced replica) must
+    not pin the gang median at its frozen last value forever, and the
+    per-job map stays bounded — the HEARTBEAT_CAP slow-leak class."""
+    from tpu_operator.controller import controller as controller_mod
+
+    clock = {"now": 1_000.0}
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=3600.0,
+                            wall_clock=lambda: clock["now"])
+    job = types.TPUJob.from_dict(worker_job("sj"))
+    job.metadata["uid"] = "u1"
+    controller.jobs["default/sj"] = TrainingJob(cs, controller.recorder, job)
+    tj = controller.jobs["default/sj"]
+    for pid, local in ((0, 0.1), (1, 0.1), (2, 0.1), (3, 0.5)):
+        assert controller.record_heartbeat("default", "sj",
+                                           _beat(pid, local))
+    assert [s["processId"] for s in tj.job.status.stragglers] == [3]
+
+    # everyone but pid 0 goes silent past the expiry: the ghosts drop,
+    # the gang shrinks below 2, and the stale flag clears
+    clock["now"] += controller_mod.CADENCE_EXPIRY_SECONDS + 1
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(0, 0.1, step=200))
+    procs = controller._gang_cadence["default/sj"]["procs"]
+    assert set(procs) == {0}
+    assert tj.job.status.stragglers == []
+
+
+def test_two_member_gang_uses_even_median():
+    """len-2 gang: median is the mean of both members, so the flagging
+    ratio tops out below 2.0 — the default threshold deliberately cannot
+    fire on a pair (one member being 'half the gang' is not a straggler
+    signal); a lower spec ratio can opt in."""
+    _cs, controller, tj = _controller_with_job(
+        spec_extra={"stepTrace": {"stragglerRatio": 1.5}})
+    assert controller.record_heartbeat("default", "sj", _beat(0, 0.1))
+    assert controller.record_heartbeat("default", "sj", _beat(1, 0.9))
+    (s,) = tj.job.status.stragglers
+    assert s["processId"] == 1
+    assert s["gangMedianSeconds"] == pytest.approx(0.5)
+    assert s["ratio"] == pytest.approx(1.8)
+
+
+def test_per_job_series_removed_on_job_deletion():
+    """Satellite: ALL registry-resident per-job labeled series — the
+    PR 8 goodput gauge, the new straggler gauge, and the per-job
+    counters — are dropped when the job is deleted, so a long-lived
+    operator never accumulates dead series (the PR-1 event-cache
+    slow-leak class)."""
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0))
+    labels = {"namespace": "default", "name": "gone"}
+    controller.metrics.set_gauge("job_goodput_ratio", 0.5, labels=labels)
+    controller.metrics.set_gauge("job_straggler_ratio", 3.0, labels=labels)
+    for counter in ("job_checkpoint_save_failures_total",
+                    "job_checkpoint_restore_fallbacks_total",
+                    "job_store_upload_failures_total",
+                    "compilation_cache_hits_total",
+                    "store_prefetch_hits_total",
+                    "store_prefetch_misses_total"):
+        controller.metrics.inc(counter, labels=labels)
+    rendered = "\n".join(controller.metrics.render_lines())
+    assert 'name="gone"' in rendered
+
+    # job absent from the informer cache → the deletion branch runs
+    assert controller.sync_tpujob("default/gone") is True
+    rendered = "\n".join(controller.metrics.render_lines())
+    assert 'name="gone"' not in rendered
+    assert "default/gone" not in controller._gang_cadence
+
+
+# --- e2e: slowed replica over the real operator + apiserver ------------------
+
+@pytest.fixture()
+def harness():
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def _get(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_e2e_straggler_detection_status_metrics_describe(harness):
+    api, cs, _controller, server = harness
+    cs.tpujobs.create("default", worker_job("gang", replicas=4))
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) == 4)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", "gang")
+                    .get("status", {}).get("phase") == "Running")
+
+    # four gang members post through the REAL reporters built from the
+    # operator's env contract; process 2 is artificially slowed (5x)
+    env = {"TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+           "TPUJOB_NAME": "gang", "TPUJOB_NAMESPACE": "default",
+           "TPUJOB_ATTEMPT": "0"}
+    for pid in range(4):
+        reporter = heartbeat_mod.from_env({**env,
+                                           "JAX_PROCESS_ID": str(pid)})
+        assert reporter.cadence_only == (pid != 0)
+        # local share differs per process; the whole-step p95 is gang-
+        # synchronized (identical) — the realistic SPMD shape
+        p95 = 0.5 if pid == 2 else 0.1
+        digest = {"steps": 20, "stepP50Seconds": 0.9,
+                  "stepP95Seconds": 1.0, "stepMaxSeconds": 1.2,
+                  "stepLocalP95Seconds": p95,
+                  "phases": {"dataWait": {"p50Seconds": 0.001,
+                                          "p95Seconds": 0.002,
+                                          "maxSeconds": 0.003},
+                             "compute": {"p50Seconds": p95 * 0.9,
+                                         "p95Seconds": p95,
+                                         "maxSeconds": p95 * 1.2}}}
+        assert reporter.report(100, {"loss": 2.5}, steptiming=digest)
+
+    # → status.stragglers flags process 2 through the strict status schema
+    def stragglers():
+        return (cs.tpujobs.get("default", "gang").get("status", {})
+                .get("stragglers") or [])
+    assert wait_for(lambda: [s.get("processId") for s in stragglers()]
+                    == [2],
+                    describe=lambda: cs.tpujobs.get("default",
+                                                    "gang").get("status"))
+    (s,) = stragglers()
+    assert s["ratio"] == pytest.approx(5.0)
+
+    # → status.stepTiming carries process 0's phase breakdown
+    status = cs.tpujobs.get("default", "gang")["status"]
+    assert status["stepTiming"]["phases"]["compute"]["p95Seconds"] == 0.1
+
+    # → StragglerDetected event on the job
+    events = [e for e in cs.events.list("default")
+              if e.get("reason") == "StragglerDetected"]
+    assert events and "process 2" in events[0]["message"]
+
+    # → /metrics: the straggler gauge and the phase histogram
+    body = _get(server.port, "/metrics")
+    assert ('tpu_operator_job_straggler_ratio'
+            '{name="gang",namespace="default"} 5' in body)
+    assert 'tpu_operator_job_step_phase_seconds_bucket{le="0.5",' \
+           'phase="compute"}' in body
+
+    # → tpujobctl describe prints the phase table and the straggler
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--master", api.url, "describe", "gang"])
+    assert rc == 0
+    text = out.getvalue()
+    assert "Step:" in text and "compute" in text and "dataWait" in text
+    assert "Straggler:  process 2" in text and "5.0x" in text
+
+
+# --- train_loop integration --------------------------------------------------
+
+def _tiny_build(steps=6):
+    from tpu_operator.payload.cifar import build, parse_args
+
+    args = parse_args(["--steps", str(steps), "--batch", "16",
+                       "--blocks", "1", "--widths", "8", "8", "8",
+                       "--log-every", "0"])
+    return build(args)
+
+
+@pytest.mark.slow
+def test_train_loop_records_phases_and_posts_digest():
+    from tpu_operator.payload import train
+
+    mesh, _m, state, step, batches = _tiny_build()
+    rec = steptrace.StepRecorder(capacity=32)
+    posts = []
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://x", "lj", poster=lambda _u, b: posts.append(b),
+        interval=0.0)  # every step is due
+    train.train_loop(mesh, step, state, batches, steps=4,
+                     heartbeat=reporter, steptrace=rec, overlap=False)
+    assert rec.steps_recorded == 4
+    rows = rec.snapshot()
+    for row in rows:
+        # every phase boundary in the loop landed in the record (no
+        # checkpointer → no checkpoint lap: an absent phase is honest,
+        # a zero-duration one would just pad every digest)
+        assert {"dataWait", "compute", "host"} <= set(row), row
+        assert "checkpoint" not in row, row
+    # Self-measurement guard: with a beat due EVERY step, the report's
+    # device_get reads the already-fenced previous metrics — the HOST lap
+    # must not swallow a whole step's compute (the old same-step fence
+    # made host ≈ the full step time and falsely flagged process 0 as
+    # the gang straggler). On the synchronous CPU backend the device
+    # work lands in DISPATCH, so compare host against the step total;
+    # majority vote, not per-row, to shrug off CI noise.
+    later = rows[1:]
+    assert sum(r["host"] < 0.5 * r["stepSeconds"] for r in later) \
+        > len(later) / 2, rows
+    timed = [p["stepTiming"] for p in posts if "stepTiming" in p]
+    # Each beat drains the window BEFORE the current step commits (the
+    # post itself is timed as HOST work of the step it rides), so the
+    # final step's window has no later beat to ride — it stays in the
+    # ring for the postmortem. 4 steps → 3 posted window-steps.
+    assert timed and sum(t["steps"] for t in timed) == 3
+    assert "compute" in timed[0]["phases"]
+
+
+@pytest.mark.slow
+def test_train_loop_dumps_postmortem_on_retryable_exit(tmp_path, monkeypatch):
+    from tpu_operator.payload import bootstrap, checkpoint, train
+
+    monkeypatch.setenv("TPUJOB_ATTEMPT", "0")
+    monkeypatch.setenv("JAX_PROCESS_ID", "0")
+    mesh, _m, state, step, batches = _tiny_build()
+    ckpt_dir = tmp_path / "work" / "ckpt"
+
+    shipped = []
+
+    class _UploaderStub:
+        """The write-behind surface the checkpointer + dump path touch."""
+
+        def escalated(self):
+            return False
+
+        def enqueue(self, _step, _step_dir):
+            pass
+
+        def mark_corrupt(self, _step):
+            pass
+
+        def stats(self):
+            return {}
+
+        def enqueue_artifact(self, path, name=""):
+            shipped.append(path)
+
+        def close(self, flush=False, timeout=0.0):
+            pass
+
+    ck = checkpoint.Checkpointer(str(ckpt_dir), save_every=100,
+                                 uploader=_UploaderStub())
+    rec = steptrace.StepRecorder(capacity=32)
+
+    def trip_drain(step_no, _metrics):
+        if step_no >= 2:
+            bootstrap.request_drain()
+
+    bootstrap.reset_drain()
+    try:
+        with pytest.raises(SystemExit) as ei:
+            train.train_loop(mesh, step, state, batches, steps=6,
+                             log_every=1, log_fn=trip_drain,
+                             checkpointer=ck, heartbeat=None,
+                             steptrace=rec, overlap=False)
+        assert ei.value.code == bootstrap.EXIT_RETRYABLE
+    finally:
+        bootstrap.reset_drain()
+        ck.close()
+
+    artifact = tmp_path / "work" / "steptrace-attempt0-p0.json"
+    assert artifact.exists()
+    body = json.loads(artifact.read_text())
+    assert body["kind"] == "tpujob-steptrace"
+    assert len(body["steps"]) >= 2
+    assert all("compute" in row for row in body["steps"])
+    # the artifact rode the existing write-behind worker toward the store
+    assert shipped == [str(artifact)]
+
+
+@pytest.mark.slow
+def test_train_loop_passes_through_non_retryable_systemexit():
+    """SystemExit.code may be any object (sys.exit("message") is legal):
+    the retryable-exit dump hook must compare, never int()-coerce — a
+    string code raised a ValueError inside the except handler and
+    replaced the intended exit with an unrelated traceback."""
+    from tpu_operator.payload import train
+
+    mesh, _m, state, step, batches = _tiny_build()
+
+    def explode(step_no, _metrics):
+        raise SystemExit("operator asked politely")
+
+    with pytest.raises(SystemExit) as ei:
+        train.train_loop(mesh, step, state, batches, steps=3,
+                         log_every=1, log_fn=explode, heartbeat=None,
+                         overlap=False)
+    assert ei.value.code == "operator asked politely"

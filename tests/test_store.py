@@ -452,6 +452,51 @@ def test_upload_cache_once_for_checkpointless_jobs(tmp_path):
         {"JAX_COMPILATION_CACHE_DIR": cache_dir}) == 0
 
 
+def test_upload_cache_once_ignores_ambient_cache_global(tmp_path):
+    """An explicit env mapping is the caller's whole contract: the
+    module-level cache dir (what bootstrap enabled in THIS process) must
+    not leak into it — one test's enable_compilation_cache() polluting a
+    later explicit-env upload was an order-dependent tier-1 flake,
+    reproduced on the unmodified tree."""
+    from tpu_operator.payload import startup as startup_mod, warmstore
+
+    cache_dir = str(tmp_path / "cache")
+    write_tree(cache_dir, {"jit_a": b"x"})
+    ambient = str(tmp_path / "ambient")
+    write_tree(ambient, {"jit_b": b"y", "jit_c": b"z"})
+    startup_mod.set_cache_dir(ambient)
+    try:
+        env = {"TPUJOB_STORE_URI": "fake://ambient-leak",
+               "TPUJOB_NAMESPACE": "ns", "TPUJOB_NAME": "jb",
+               "JAX_COMPILATION_CACHE_DIR": cache_dir}
+        # exactly the env's one entry — never the ambient dir's two
+        assert warmstore.upload_cache_once(env) == 1
+    finally:
+        startup_mod.set_cache_dir("")
+
+
+def test_writebehind_ships_artifacts(tmp_path):
+    """Postmortem step-trace dumps ride the same async worker as
+    checkpoints: enqueue_artifact never blocks, the file lands under the
+    job's artifacts/ prefix, and an upload failure is logged — never
+    counted toward the escalation streak (a postmortem aid must not
+    convert a retryable exit into a failed remote)."""
+    art = tmp_path / "steptrace-attempt1-p0.json"
+    art.write_text('{"kind": "tpujob-steptrace", "steps": []}')
+    ws = WarmStartStore(FakeBackend(), prefix="ns/aj")
+    up = WriteBehindUploader(ws)
+    try:
+        up.enqueue_artifact(str(art))
+        assert up.flush(timeout=10.0)
+        assert ws.list_artifacts() == ["steptrace-attempt1-p0.json"]
+        # a missing file fails the upload quietly, without escalation
+        up.enqueue_artifact(str(tmp_path / "gone.json"))
+        assert up.flush(timeout=10.0)
+        assert up.consecutive_failures == 0 and not up.escalated()
+    finally:
+        up.close()
+
+
 def test_writebehind_enqueue_never_blocks(tmp_path):
     step_dir = str(tmp_path / "sd")
     write_tree(step_dir, {"f": os.urandom(10_000)})

@@ -23,15 +23,12 @@ from tpu_operator.controller.statusserver import StatusServer
 from tpu_operator.payload import heartbeat as heartbeat_mod
 from tpu_operator.testing.apiserver import ApiServerHarness
 from tpu_operator.util import tracing
+from tpu_operator.testing.waiting import make_wait_for
 
 
-def wait_for(pred, timeout=20.0, interval=0.05):
-    deadline = time.monotonic() + timeout
-    while time.monotonic() < deadline:
-        if pred():
-            return True
-        time.sleep(interval)
-    return pred()
+# Shared polling helper (tpu_operator/testing/waiting.py): a timeout
+# raises with the last-observed state instead of a bare assert False.
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
 
 
 def get(port, path):
@@ -318,10 +315,13 @@ def test_heartbeat_reporter_rate_limit_and_failure_isolation():
         "http://x:1", "job", poster=exploding, clock=lambda: 0.0)
     assert r2.report(1) is False
 
-    # non-zero process id or missing URL → disabled
-    assert heartbeat_mod.from_env({"TPUJOB_STATUS_URL": "http://x",
-                                   "TPUJOB_NAME": "j",
-                                   "JAX_PROCESS_ID": "1"}) is None
+    # non-zero process → cadence-only reporter (straggler detection feed:
+    # identity + step cadence + stepTiming, no loss/checkpoint/startup);
+    # missing URL → disabled entirely.
+    rn = heartbeat_mod.from_env({"TPUJOB_STATUS_URL": "http://x",
+                                 "TPUJOB_NAME": "j",
+                                 "JAX_PROCESS_ID": "1"})
+    assert rn is not None and rn.cadence_only and rn.process_id == 1
     assert heartbeat_mod.from_env({"TPUJOB_NAME": "j"}) is None
 
     # a malformed interval knob must not kill training (best-effort contract)

@@ -50,6 +50,7 @@ SCAN = (
     ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "payload", "checkpoint.py"),
+    ("tpu_operator", "payload", "steptrace.py"),
     ("tpu_operator", "payload", "train.py"),
     ("tpu_operator", "payload", "warmstore.py"),
 )

@@ -14,6 +14,8 @@ from __future__ import annotations
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CACHE_PATH,
     DEFAULT_SCHEDULING_QUEUE,
+    DEFAULT_STEPTRACE_BUFFER,
+    DEFAULT_STRAGGLER_RATIO,
     DEFAULT_TPU_PORT,
     DEFAULT_TPU_REPLICAS,
     CacheMedium,
@@ -24,6 +26,16 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobSpec,
     TPUReplicaType,
 )
+
+# Data-plane flight recorder (``step_trace``): deliberately NO defaulting
+# code — the block stays optional (None = recorder on at the defaults,
+# kept absent so specs round-trip unchanged), StepTraceSpec.from_dict
+# already fills absent fields from these constants, and an explicitly
+# written zero/negative bufferSteps or stragglerRatio must reach
+# validation.py and fail loudly (the uploadParallelism lesson: a defaults
+# clamp silently masks the validation error it duplicates). The sanity
+# check pins the shipped defaults inside validation's own bounds.
+assert DEFAULT_STEPTRACE_BUFFER >= 8 and DEFAULT_STRAGGLER_RATIO >= 1.0
 
 
 def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
@@ -109,4 +121,5 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
                 store.backend = scheme.lower()
             else:
                 store.backend = StoreBackend.LOCALFS
+
     return spec

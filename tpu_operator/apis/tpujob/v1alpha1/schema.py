@@ -34,6 +34,12 @@ from tpu_operator.apis.tpujob.v1alpha1 import types
 # the canonical home (the payload emits them); it is stdlib-only, so the
 # schema importing it drags nothing heavy into the control plane.
 from tpu_operator.payload.startup import STAGES as STARTUP_STAGES
+# Phase field names of the data-plane flight recorder (payload/steptrace.py,
+# stdlib-only for the same reason): the keys of stepTiming.phases.
+from tpu_operator.payload.steptrace import (
+    DIGEST_KEYS as STEP_DIGEST_KEYS,
+    PHASE_FIELDS as STEP_PHASE_FIELDS,
+)
 
 
 def _str(**kw) -> Dict[str, Any]:
@@ -133,6 +139,13 @@ def spec_schema() -> Dict[str, Any]:
             "uploadParallelism": _int(minimum=1),
             "prefetch": {"type": "boolean"},
         }),
+        # Data-plane flight recorder: per-step phase timing ring buffer
+        # (payload side) + the controller's straggler-flagging threshold.
+        "stepTrace": _obj({
+            "enabled": {"type": "boolean"},
+            "bufferSteps": _int(minimum=8),
+            "stragglerRatio": _num(minimum=1),
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -152,6 +165,31 @@ def startup_breakdown_schema() -> Dict[str, Any]:
         "firstStepSeconds": _num(minimum=0),
         "cacheHit": {"type": "boolean"},
         "attempt": _int(minimum=0),
+        "time": _str(),
+    })
+
+
+def steptiming_schema() -> Dict[str, Any]:
+    """The data-plane phase-timing digest: shared by
+    ``status.lastHeartbeat.stepTiming`` (as posted, one window's
+    percentiles) and ``status.stepTiming`` (as folded in by the
+    controller, which adds attempt/processId/time)."""
+    return _obj({
+        "steps": _int(minimum=0),
+        "stepP50Seconds": _num(minimum=0),
+        "stepP95Seconds": _num(minimum=0),
+        "stepMaxSeconds": _num(minimum=0),
+        # p95 of per-step LOCAL time (step minus the compute wait): the
+        # straggler detector's per-process signal — whole-step cadence is
+        # gang-synchronized by the collectives and cannot single anyone
+        # out.
+        "stepLocalP95Seconds": _num(minimum=0),
+        "phases": _obj({
+            field: _obj({key: _num(minimum=0) for key in STEP_DIGEST_KEYS})
+            for field in STEP_PHASE_FIELDS.values()
+        }),
+        "attempt": _int(minimum=0),
+        "processId": _int(minimum=0),
         "time": _str(),
     })
 
@@ -207,6 +245,8 @@ def status_schema() -> Dict[str, Any]:
             # the full breakdown (folded into status.startup).
             "startupStage": _str(enum=list(STARTUP_STAGES)),
             "startup": startup_breakdown_schema(),
+            # Data-plane phase digest (flight recorder window summary).
+            "stepTiming": steptiming_schema(),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -244,6 +284,19 @@ def status_schema() -> Dict[str, Any]:
             "lastStep": _int(minimum=0),
             "time": _str(),
         }),
+        # Data-plane phase timing: where step time goes (per-phase
+        # p50/p95/max over the newest digest window from process 0).
+        "stepTiming": steptiming_schema(),
+        # Gang straggler roll-up: members whose p95 step time exceeds the
+        # gang median by spec.stepTrace.stragglerRatio (absent = healthy).
+        "stragglers": _arr(_obj({
+            "processId": _int(minimum=0),
+            "p95Seconds": _num(minimum=0),
+            "gangMedianSeconds": _num(minimum=0),
+            "ratio": _num(minimum=0),
+            "step": _int(minimum=0),
+            "time": _str(),
+        })),
         # Fleet-scheduling state: effective queue/priority, and — while
         # phase is Queued — the admission-order position (0 = next).
         "scheduling": _obj({

@@ -210,6 +210,18 @@ class StoreBackend:
 DEFAULT_STORE_UPLOAD_PARALLELISM = 4
 
 
+# --- Data-plane flight recorder (step phase timing + straggler policy) -------
+
+# Ring-buffer capacity default: last N steps retained for the postmortem
+# artifact (payload/steptrace.py DEFAULT_BUFFER_STEPS mirrors this; the
+# payload module is the runtime home, this is the spec default).
+DEFAULT_STEPTRACE_BUFFER = 512
+
+# Straggler flagging threshold: a gang member whose p95 step time exceeds
+# the gang median by this ratio is flagged into status.stragglers.
+DEFAULT_STRAGGLER_RATIO = 2.0
+
+
 # --- Fleet scheduling (admission queue + priority preemption) ----------------
 
 # Fair-share queue a job lands in when spec.scheduling names none.
@@ -389,6 +401,42 @@ class StoreSpec:
 
 
 @dataclass
+class StepTraceSpec:
+    """Data-plane flight-recorder knobs (``spec.stepTrace``).
+
+    The recorder itself is ON by default (it costs timestamps only — see
+    payload/steptrace.py); this block tunes it. ``enabled: false`` opts
+    the job's payloads out entirely. ``bufferSteps`` sizes the per-process
+    ring buffer the postmortem artifact dumps (last N steps' phase
+    timings). ``stragglerRatio`` is the controller-side flagging
+    threshold: a gang member whose p95 step time exceeds the gang median
+    by this ratio lands in ``status.stragglers`` (+ a StragglerDetected
+    event) — the eviction/replace signal for operators and the fleet
+    scheduler.
+    """
+
+    enabled: bool = True
+    buffer_steps: int = DEFAULT_STEPTRACE_BUFFER
+    straggler_ratio: float = DEFAULT_STRAGGLER_RATIO
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled, "bufferSteps": self.buffer_steps,
+                "stragglerRatio": self.straggler_ratio}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["StepTraceSpec"]:
+        if d is None:
+            return None
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            buffer_steps=int(d.get("bufferSteps", DEFAULT_STEPTRACE_BUFFER)),
+            straggler_ratio=float(d.get("stragglerRatio",
+                                        DEFAULT_STRAGGLER_RATIO)),
+        )
+
+
+@dataclass
 class SchedulingSpec:
     """Fleet-scheduler knobs (``spec.scheduling``).
 
@@ -520,6 +568,10 @@ class TPUJobSpec:
     # (None = off; restarts only warm-start on the same node, the
     # pre-store behavior).
     store: Optional[StoreSpec] = None
+    # Data-plane flight recorder: per-step phase timing ring buffer +
+    # straggler threshold (None = the defaults — recorder on, ratio 2.0;
+    # kept absent so specs round-trip unchanged).
+    step_trace: Optional[StepTraceSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -558,6 +610,8 @@ class TPUJobSpec:
             d["scheduling"] = self.scheduling.to_dict()
         if self.store is not None:
             d["store"] = self.store.to_dict()
+        if self.step_trace is not None:
+            d["stepTrace"] = self.step_trace.to_dict()
         return d
 
     @classmethod
@@ -586,6 +640,7 @@ class TPUJobSpec:
                 d.get("compilationCache")),
             scheduling=SchedulingSpec.from_dict(d.get("scheduling")),
             store=StoreSpec.from_dict(d.get("store")),
+            step_trace=StepTraceSpec.from_dict(d.get("stepTrace")),
         )
 
 
@@ -698,6 +753,18 @@ class TPUJobStatus:
     # ratio — the number that says what fleet churn (preemptions, cold
     # restarts) actually costs this job.
     goodput: Optional[Dict[str, Any]] = None
+    # Data-plane phase timing, folded in from process 0's heartbeat
+    # ``stepTiming`` digests: per-phase (dataWait/dispatch/compute/
+    # checkpoint/host) p50/p95/max over the most recent digest window,
+    # plus whole-step percentiles, attempt, and time — where step time
+    # actually goes, visible from ``kubectl get -o yaml``.
+    step_timing: Optional[Dict[str, Any]] = None
+    # Gang straggler roll-up, computed by the controller from EVERY
+    # process's cadence beats: members whose p95 step time exceeds the
+    # gang median by spec.stepTrace.stragglerRatio, newest evaluation
+    # (empty/absent = gang healthy). Each entry: {processId, p95Seconds,
+    # gangMedianSeconds, ratio, step, time}.
+    stragglers: List[Dict[str, Any]] = field(default_factory=list)
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -743,6 +810,10 @@ class TPUJobStatus:
             d["store"] = dict(self.store)
         if self.goodput:
             d["goodput"] = dict(self.goodput)
+        if self.step_timing:
+            d["stepTiming"] = dict(self.step_timing)
+        if self.stragglers:
+            d["stragglers"] = [dict(s) for s in self.stragglers]
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -779,6 +850,9 @@ class TPUJobStatus:
             startup=(dict(d["startup"]) if d.get("startup") else None),
             store=(dict(d["store"]) if d.get("store") else None),
             goodput=(dict(d["goodput"]) if d.get("goodput") else None),
+            step_timing=(dict(d["stepTiming"])
+                         if d.get("stepTiming") else None),
+            stragglers=[dict(s) for s in d.get("stragglers", [])],
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),

@@ -167,6 +167,29 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
         if store.upload_parallelism < 1:
             raise ValidationError("store.uploadParallelism must be >= 1")
 
+    # Data-plane flight recorder — validated UNCONDITIONALLY (unlike the
+    # cache block): the generated CRD carries these minimums with no
+    # enabled-conditional, so an enabled-only check here would admit a
+    # disabled-but-invalid block everywhere the fake apiserver runs and
+    # have the real apiserver reject it at the door. The ring buffer
+    # needs enough samples for a p95 to mean anything, and a straggler
+    # ratio below 1.0 would flag the MAJORITY of a healthy gang (every
+    # member sits near the median; ratio 1.0 = flag anything
+    # at-or-above median — permitted as the maximally-sensitive
+    # setting, but nothing below it parses).
+    trace = spec.step_trace
+    if trace is not None:
+        if trace.buffer_steps < 8:
+            raise ValidationError(
+                "stepTrace.bufferSteps must be >= 8 (the postmortem ring "
+                "needs enough steps for its percentiles to mean anything)"
+            )
+        if trace.straggler_ratio < 1.0:
+            raise ValidationError(
+                "stepTrace.stragglerRatio must be >= 1.0 (below the gang "
+                "median, every healthy member would be flagged)"
+            )
+
     # Warm-restart compilation cache (validated only when enabled: a
     # disabled block is inert, whatever its other fields say).
     cache = spec.compilation_cache

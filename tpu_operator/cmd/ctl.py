@@ -224,6 +224,31 @@ def cmd_describe(cs, opts) -> int:
         print(f"Goodput:    {100 * gp['ratio']:.1f}% "
               f"(useful {gp.get('usefulStepSeconds', 0):.1f}s / "
               f"wallclock {gp.get('wallclockSeconds', 0):.1f}s)")
+    # Data-plane flight recorder: where step time goes (newest digest
+    # window from process 0) and any gang member pacing the collective.
+    st = status.get("stepTiming") or {}
+    if st:
+        p50, p95 = st.get("stepP50Seconds"), st.get("stepP95Seconds")
+        head = (f"p50 {p50:.4f}s p95 {p95:.4f}s"
+                if p50 is not None and p95 is not None else "-")
+        print(f"Step:       {head} over {st.get('steps', '?')} steps "
+              f"(attempt {st.get('attempt', 0)})")
+        phases = st.get("phases") or {}
+        if phases:
+            print("  Phase         p50          p95          max")
+            for key in ("dataWait", "dispatch", "compute", "checkpoint",
+                        "host"):
+                d = phases.get(key)
+                if not d:
+                    continue
+                print(f"  {key:<12}  {d.get('p50Seconds', 0):>9.6f}s  "
+                      f"{d.get('p95Seconds', 0):>9.6f}s  "
+                      f"{d.get('maxSeconds', 0):>9.6f}s")
+    for s in status.get("stragglers") or []:
+        print(f"Straggler:  process {s.get('processId', '?')} p95 "
+              f"{s.get('p95Seconds', 0):.3f}s vs gang median "
+              f"{s.get('gangMedianSeconds', 0):.3f}s "
+              f"({s.get('ratio', 0):.1f}x) at step {s.get('step', '?')}")
     if status.get("failures"):
         print("Failures:")
         for f in status["failures"][-10:]:

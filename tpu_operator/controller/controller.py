@@ -33,6 +33,7 @@ from typing import Any, Callable, Dict, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
+    DEFAULT_STRAGGLER_RATIO,
     LABEL_GROUP_KEY,
     TPUJob,
     TPUJobPhase,
@@ -62,6 +63,15 @@ from tpu_operator.util.tracing import traced
 
 log = logging.getLogger(__name__)
 
+# Gang-cadence hygiene: a member whose last cadence beat is older than
+# this is dropped from the straggler evaluation (a dead/replaced process
+# must not skew the gang median forever with its frozen last value), and
+# the per-job map is bounded (stalest-evicted) against misconfigured
+# payloads minting ever-new processIds — the same unbounded-labeled-state
+# class HEARTBEAT_CAP and the queue-depth LRU bound elsewhere.
+CADENCE_EXPIRY_SECONDS = 300.0
+CADENCE_MAX_PROCS = 1024
+
 
 class Controller:
     """ref: controller.New (controller.go:90) + Run (controller.go:145)."""
@@ -85,6 +95,7 @@ class Controller:
         self.config = config or ControllerConfig()
         self.namespace = namespace
         self._clock = clock
+        self._wall_clock = wall_clock
         # Minimum seconds between heartbeat-triggered status writes per job
         # (see record_heartbeat); 0 persists every heartbeat immediately.
         self.heartbeat_persist_interval = heartbeat_persist_interval
@@ -132,6 +143,12 @@ class Controller:
         # key -> heartbeat "time" of the last persist-enqueued heartbeat
         # (guarded by _jobs_lock; see record_heartbeat's coalescing).
         self._hb_persisted: Dict[str, float] = {}  # guarded-by: _jobs_lock
+        # Straggler detection state, key -> {"attempt": n, "procs":
+        # {processId -> {"p95", "step", "time"}}, "flagged": set(pid)}.
+        # In-memory only (rebuilt from fresh cadence beats after an
+        # operator restart — it is telemetry, not state); reset on attempt
+        # change, dropped on job deletion.
+        self._gang_cadence: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
 
         self.job_informer = self.factory.informer_for("tpujobs")
         self.job_informer.add_event_handler(
@@ -318,16 +335,32 @@ class Controller:
             with self._jobs_lock:
                 self.jobs.pop(key, None)
                 self._hb_persisted.pop(key, None)
+                self._gang_cadence.pop(key, None)
             self.recorder.forget_object(namespace, name)
             self.deadlines.forget(key)
             # A deleted job's slice reservation (or queue slot) frees for
             # the next pending gang.
             self.scheduler.release(key)
-            # Per-job gauge series must not outlive the job (the same
-            # slow-leak class the queue-depth LRU bounds).
-            self.metrics.remove_series(
-                "job_goodput_ratio",
-                labels={"namespace": namespace, "name": name})
+            # Per-job labeled series must not outlive the job (the same
+            # slow-leak class the event dedup cache and the queue-depth
+            # LRU bound): every registry-resident {namespace,name} series
+            # is dropped here. The render-time heartbeat gauges
+            # (job_last_step / job_step_time_seconds / job_tokens_per_
+            # second / job_loss / job_last_checkpoint_step /
+            # job_store_last_uploaded_step) never live in the registry —
+            # _live_heartbeats prunes their backing map against the
+            # informer cache — so gauges and counters alike go to zero
+            # series for a deleted job.
+            for series in ("job_goodput_ratio",
+                           "job_straggler_ratio",
+                           "job_checkpoint_save_failures_total",
+                           "job_checkpoint_restore_fallbacks_total",
+                           "job_store_upload_failures_total",
+                           "compilation_cache_hits_total",
+                           "store_prefetch_hits_total",
+                           "store_prefetch_misses_total"):
+                self.metrics.remove_series(
+                    series, labels={"namespace": namespace, "name": name})
             return True
 
         job = TPUJob.from_dict(cached)
@@ -380,6 +413,7 @@ class Controller:
 
         key = f"{namespace}/{name}"
         new_t = parse_rfc3339(str(heartbeat.get("time", ""))) or 0.0
+        straggler_events: list = []
         with self._jobs_lock:
             tj = self.jobs.get(key)
             if tj is None:
@@ -401,58 +435,93 @@ class Controller:
             if (hb_attempt is not None
                     and hb_attempt < tj.job.status.attempt):
                 return None
-            prev = tj.job.status.last_heartbeat
-            merged = dict(heartbeat)
-            if prev is not None:
-                # Same generation (missing attempt = current, as above): a
-                # partial post must not erase telemetry it didn't carry —
-                # a liveness-only beat would otherwise wipe step/loss from
-                # status and drop the per-job gauges until the next full
-                # post. Resolve BOTH sides against the current attempt so
-                # a stored pre-restart beat never leaks stale step/loss
-                # into the new generation's heartbeat.
-                now_attempt = tj.job.status.attempt
-                prev_attempt = prev.get("attempt")
-                hb_gen = now_attempt if hb_attempt is None else hb_attempt
-                prev_gen = now_attempt if prev_attempt is None else prev_attempt
-                if hb_gen == prev_gen:
-                    for field in ("step", "processId", "stepTimeSeconds",
-                                  "tokensPerSec", "loss",
-                                  "lastCheckpointStep",
-                                  "checkpointSaveFailures",
-                                  "checkpointRestoreFallbacks",
-                                  "storeLastUploadedStep",
-                                  "storeUploadFailures"):
-                        if field not in merged and field in prev:
-                            merged[field] = prev[field]
-            tj.job.status.last_heartbeat = merged
-            self._apply_checkpoint_heartbeat(tj, namespace, name, heartbeat,
-                                             hb_attempt)
-            self._apply_store_heartbeat(tj, namespace, name, heartbeat,
-                                        hb_attempt)
-            self._apply_startup_heartbeat(tj, namespace, name, heartbeat,
-                                          hb_attempt)
-            self._apply_goodput_heartbeat(tj, namespace, name, heartbeat,
-                                          hb_attempt)
-            # Compare against the last *persisted* stamp, not the last
-            # received one — a steady sub-interval cadence would otherwise
-            # keep resetting the baseline and never persist again. A
-            # startup-breakdown beat is always persisted immediately: it is
-            # a one-shot per attempt, and coalescing would park it in
-            # memory until the next natural reconcile (up to a resync
-            # period) — observed as status.startup missing while the
-            # payload already trains.
-            last = self._hb_persisted.get(key)
-            persist = (prev is None
-                       or prev.get("attempt") != heartbeat.get("attempt")
-                       or "startup" in heartbeat
-                       or last is None
-                       or new_t - last >= self.heartbeat_persist_interval)
-            if persist:
-                self._hb_persisted[key] = new_t
+            try:
+                pid = int(heartbeat.get("processId") or 0)
+            except (TypeError, ValueError):
+                pid = 0
+            # Every process's cadence feeds the straggler detector;
+            # StragglerDetected events are emitted AFTER the lock drops
+            # (recorder RPCs must never run under _jobs_lock).
+            straggler_changed = self._apply_cadence_locked(
+                key, tj, pid, heartbeat, hb_attempt, straggler_events)
+            if pid != 0:
+                # Cadence-only beat from a non-zero gang member: it exists
+                # for the detector alone. status.lastHeartbeat and every
+                # other fold stay process 0's single stream; persistence
+                # is forced only when the straggler roll-up changed.
+                persist = straggler_changed
+            else:
+                self._apply_steptiming_heartbeat(tj, pid, heartbeat,
+                                                 hb_attempt)
+                persist = self._fold_heartbeat_locked(
+                    key, tj, namespace, name, heartbeat, hb_attempt, new_t
+                ) or straggler_changed
+        for message in straggler_events:
+            self.recorder.event(tj, "Warning", "StragglerDetected", message)
         if persist:
             self.queue.add(key)
         return True
+
+    def _fold_heartbeat_locked(self, key: str, tj: TrainingJob,
+                               namespace: str, name: str,
+                               heartbeat: Dict[str, Any],
+                               hb_attempt: Optional[int],
+                               new_t: float) -> bool:
+        """Process 0's full-stream fold (called under _jobs_lock): the
+        lastHeartbeat merge plus the checkpoint/store/startup/goodput/
+        stepTiming roll-ups. Returns whether the beat must persist
+        immediately (vs riding the coalescing window)."""
+        prev = tj.job.status.last_heartbeat
+        merged = dict(heartbeat)
+        if prev is not None:
+            # Same generation (missing attempt = current, as above): a
+            # partial post must not erase telemetry it didn't carry —
+            # a liveness-only beat would otherwise wipe step/loss from
+            # status and drop the per-job gauges until the next full
+            # post. Resolve BOTH sides against the current attempt so
+            # a stored pre-restart beat never leaks stale step/loss
+            # into the new generation's heartbeat.
+            now_attempt = tj.job.status.attempt
+            prev_attempt = prev.get("attempt")
+            hb_gen = now_attempt if hb_attempt is None else hb_attempt
+            prev_gen = now_attempt if prev_attempt is None else prev_attempt
+            if hb_gen == prev_gen:
+                for field in ("step", "processId", "stepTimeSeconds",
+                              "tokensPerSec", "loss",
+                              "lastCheckpointStep",
+                              "checkpointSaveFailures",
+                              "checkpointRestoreFallbacks",
+                              "storeLastUploadedStep",
+                              "storeUploadFailures",
+                              "stepTiming"):
+                    if field not in merged and field in prev:
+                        merged[field] = prev[field]
+        tj.job.status.last_heartbeat = merged
+        self._apply_checkpoint_heartbeat(tj, namespace, name, heartbeat,
+                                         hb_attempt)
+        self._apply_store_heartbeat(tj, namespace, name, heartbeat,
+                                    hb_attempt)
+        self._apply_startup_heartbeat(tj, namespace, name, heartbeat,
+                                      hb_attempt)
+        self._apply_goodput_heartbeat(tj, namespace, name, heartbeat,
+                                      hb_attempt)
+        # Compare against the last *persisted* stamp, not the last
+        # received one — a steady sub-interval cadence would otherwise
+        # keep resetting the baseline and never persist again. A
+        # startup-breakdown beat is always persisted immediately: it is
+        # a one-shot per attempt, and coalescing would park it in
+        # memory until the next natural reconcile (up to a resync
+        # period) — observed as status.startup missing while the
+        # payload already trains.
+        last = self._hb_persisted.get(key)
+        persist = (prev is None
+                   or prev.get("attempt") != heartbeat.get("attempt")
+                   or "startup" in heartbeat
+                   or last is None
+                   or new_t - last >= self.heartbeat_persist_interval)
+        if persist:
+            self._hb_persisted[key] = new_t
+        return persist
 
     def _apply_checkpoint_heartbeat(self, tj: TrainingJob, namespace: str,
                                     name: str, heartbeat: Dict[str, Any],
@@ -649,6 +718,183 @@ class Controller:
                 float(gp.get("usefulStepSeconds", 0.0))
                 + float(new["firstStepSeconds"]), 6)
             tj.job.status.goodput = gp
+
+    def _apply_steptiming_heartbeat(self, tj: TrainingJob, pid: int,
+                                    heartbeat: Dict[str, Any],
+                                    hb_attempt: Optional[int]) -> None:
+        """Fold process 0's ``stepTiming`` phase digest into
+        ``status.stepTiming`` (called under _jobs_lock) and observe the
+        ``job_step_phase_seconds{phase}`` histograms. Each digest
+        summarizes a DISJOINT window of steps (the payload drains its
+        window per post), so observing every digest's per-phase p95 once
+        builds an unbiased time-local distribution — no double counting,
+        no dedup bookkeeping needed."""
+        st = heartbeat.get("stepTiming")
+        if not isinstance(st, dict) or not st:
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        folded = dict(st)
+        folded["attempt"] = int(gen)
+        folded["processId"] = int(pid)
+        if heartbeat.get("time"):
+            folded["time"] = str(heartbeat["time"])
+        tj.job.status.step_timing = folded
+        for field, stats in (st.get("phases") or {}).items():
+            p95 = (stats or {}).get("p95Seconds")
+            if p95 is not None:
+                self.metrics.observe("job_step_phase_seconds", float(p95),
+                                     labels={"phase": field})
+
+    def _apply_cadence_locked(self, key: str, tj: TrainingJob, pid: int,
+                              heartbeat: Dict[str, Any],
+                              hb_attempt: Optional[int],
+                              events: list) -> bool:
+        """Gang straggler detector (called under _jobs_lock): fold one
+        process's step cadence into the per-job map and re-evaluate. A
+        member whose p95 LOCAL step time exceeds the gang median by
+        ``spec.stepTrace.stragglerRatio`` (default 2.0) is flagged into
+        ``status.stragglers``; the worst member's ratio is the
+        ``job_straggler_ratio`` gauge, and a NEWLY flagged process
+        appends a StragglerDetected message to ``events`` (the caller
+        emits after releasing the lock — recorder RPCs never run under
+        _jobs_lock). Returns True when the flagged roll-up changed (the
+        caller forces a status persist: a straggler flag is an eviction
+        signal, not coalescable telemetry).
+
+        The signal is ``stepLocalP95Seconds`` — per-step time MINUS the
+        compute wait — because a synchronous gang's collectives equalize
+        everything else: one slow member paces every step, so whole-step
+        cadence (and the compute wait, which IS the collective wait)
+        converges to the same number on every process and can never
+        single anyone out. The local share — input wait, dispatch,
+        checkpoint, host work — stays genuinely per-process, so a slow
+        input pipeline, GC-bound host, or sick NIC stands out at its
+        source. (A slow *device* is host-invisible by the same argument
+        and needs device-level telemetry — out of scope here.) Fallback
+        for digest-less payloads: whole-step p95 / stepTimeSeconds,
+        meaningful only when the payload is not gang-synchronized
+        (PER_POD compat mode). A materiality floor skips flags whose
+        local time is under 2% of the gang's median step — µs-level
+        ratio noise between healthy hosts is not a straggler."""
+        trace_spec = tj.job.spec.step_trace
+        if trace_spec is not None and not trace_spec.enabled:
+            return False
+        st = heartbeat.get("stepTiming")
+        local_p95 = step_p95 = None
+        if isinstance(st, dict):
+            local_p95 = st.get("stepLocalP95Seconds")
+            step_p95 = st.get("stepP95Seconds")
+        value = local_p95
+        if value is None:
+            value = step_p95 if step_p95 is not None \
+                else heartbeat.get("stepTimeSeconds")
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        cleared = False
+        state = self._gang_cadence.get(key)
+        if state is None or state.get("attempt") != int(gen):
+            # New attempt (or first beat): stale cadence from the previous
+            # generation must not flag the new gang — and a flag the OLD
+            # generation earned must not outlive it in status (the
+            # restart likely replaced that very replica). The clear is a
+            # roll-up change, so it persist-forces like any other.
+            state = {"attempt": int(gen), "procs": {}, "flagged": set()}
+            self._gang_cadence[key] = state
+            if tj.job.status.stragglers:
+                tj.job.status.stragglers = []
+                cleared = True
+        if value is None:
+            return cleared
+        step = heartbeat.get("step")
+        now = self._wall_clock()
+        state["procs"][int(pid)] = {
+            "p95": float(value),
+            "step_p95": (float(step_p95) if step_p95 is not None else None),
+            "step": int(step) if step is not None else 0,
+            "time": str(heartbeat.get("time", "")),
+            "seen": now,
+        }
+        # Hygiene before evaluating: expire members that stopped posting
+        # (dead pod, replaced replica) and bound the map against bogus
+        # ever-new processIds.
+        stale = [p for p, entry in state["procs"].items()
+                 if now - entry["seen"] > CADENCE_EXPIRY_SECONDS]
+        for p in stale:
+            del state["procs"][p]
+        while len(state["procs"]) > CADENCE_MAX_PROCS:
+            del state["procs"][min(state["procs"],
+                                   key=lambda p: state["procs"][p]["seen"])]
+
+        def rollup_changed(flagged: Dict[int, Dict[str, Any]]) -> bool:
+            # Compare against what STATUS currently says, not the
+            # in-memory detector state: a rebuilt detector (operator
+            # restart, attempt reset) starts empty while status may
+            # still carry flags — the empty evaluation must clear them
+            # and persist the clear. The roll-up is rewritten ONLY on a
+            # membership change: entries are a snapshot of the flagging
+            # evaluation (the gauge tracks live ratio drift) — per-beat
+            # value refreshes would make every reconcile see a
+            # "critical" stragglers delta and bypass the writeback
+            # limiter for the whole flagged duration.
+            prev = {int(s.get("processId", -1))
+                    for s in (tj.job.status.stragglers or [])}
+            if set(flagged) == prev:
+                return False
+            tj.job.status.stragglers = [flagged[p] for p in sorted(flagged)]
+            return True
+
+        procs = state["procs"]
+        if len(procs) < 2:
+            # A gang of one has no peers to straggle behind; also covers
+            # single-process jobs, which never see a second cadence
+            # stream.
+            return rollup_changed({}) or cleared
+        values = sorted(p["p95"] for p in procs.values())
+        mid = len(values) // 2
+        median = (values[mid] if len(values) % 2
+                  else (values[mid - 1] + values[mid]) / 2.0)
+        if median <= 0:
+            return rollup_changed({}) or cleared
+        step_p95s = sorted(p["step_p95"] for p in procs.values()
+                           if p.get("step_p95") is not None)
+        median_step = step_p95s[len(step_p95s) // 2] if step_p95s else None
+        threshold = (trace_spec.straggler_ratio if trace_spec is not None
+                     else DEFAULT_STRAGGLER_RATIO)
+        worst = 1.0
+        flagged: Dict[int, Dict[str, Any]] = {}
+        for proc_id, p in procs.items():
+            ratio = p["p95"] / median
+            if median_step is not None and p["p95"] < 0.02 * median_step:
+                # Materiality floor: µs-level local time is ratio noise
+                # between healthy hosts, not a straggler — suppressed
+                # from the flag AND from the gauge (the gauge's help
+                # text promises "above threshold = flagged", so it must
+                # never advertise a ratio the detector itself discarded).
+                continue
+            worst = max(worst, ratio)
+            if ratio < threshold:
+                continue
+            flagged[proc_id] = {
+                "processId": proc_id,
+                "p95Seconds": round(p["p95"], 6),
+                "gangMedianSeconds": round(median, 6),
+                "ratio": round(ratio, 3),
+                "step": p["step"],
+                "time": p["time"],
+            }
+        self.metrics.set_gauge(
+            "job_straggler_ratio", round(worst, 3),
+            labels={"namespace": tj.job.namespace, "name": tj.job.name})
+        for proc_id in sorted(set(flagged) - state["flagged"]):
+            entry = flagged[proc_id]
+            events.append(
+                f"process {proc_id} is pacing the gang: p95 local step "
+                f"time {entry['p95Seconds']:.3f}s vs gang median "
+                f"{entry['gangMedianSeconds']:.3f}s "
+                f"({entry['ratio']:.1f}x >= {threshold:.1f}x threshold)")
+        # Event dedup keys on the detector's own memory (once per
+        # attempt+process); the persist decision keys on the STATUS delta.
+        state["flagged"] = set(flagged)
+        return rollup_changed(flagged) or cleared
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

@@ -45,6 +45,10 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
 from tpu_operator.payload.startup import STAGE_FIELDS, STAGES as STARTUP_STAGES
+from tpu_operator.payload.steptrace import (
+    DIGEST_KEYS as STEP_DIGEST_KEYS,
+    PHASE_FIELDS as STEP_PHASE_FIELDS,
+)
 from tpu_operator.util import tracing
 from tpu_operator.util.util import now_rfc3339, parse_rfc3339
 
@@ -74,6 +78,11 @@ STARTUP_BUCKETS = (0.1, 0.5, 1.0, 5.0, 10.0, 30.0, 60.0, 120.0, 300.0,
 # to hours parked behind a full cluster.
 ADMISSION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
                      3600.0, 14400.0)
+# Step-phase durations span µs (an idle dataWait/dispatch boundary on a
+# healthy pipeline) to tens of seconds (a checkpoint stall, a straggling
+# collective) — log-spaced across five decades.
+STEP_PHASE_BUCKETS = (0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                      10.0, 30.0)
 
 LabelsT = Optional[Dict[str, str]]
 
@@ -248,6 +257,18 @@ class Metrics:
                       "(label stage: rendezvous/restore/compile/"
                       "first_step), from payload startup breakdowns.",
                       STARTUP_BUCKETS)
+        self.register("job_step_phase_seconds", "histogram",
+                      "Per-phase step-time split (label phase: dataWait/"
+                      "dispatch/compute/checkpoint/host) from the payload "
+                      "flight recorder's windowed digests — each digest's "
+                      "p95 observed once per disjoint step window.",
+                      STEP_PHASE_BUCKETS)
+        self.register("job_straggler_ratio", "gauge",
+                      "Worst p95-step-time-to-gang-median ratio across the "
+                      "job's gang (1.0 = perfectly even; above "
+                      "spec.stepTrace.stragglerRatio flags the member into "
+                      "status.stragglers). Only set while ≥2 processes "
+                      "report cadence.")
 
     # -- registry --------------------------------------------------------------
 
@@ -390,6 +411,69 @@ class Metrics:
                         lines.append(f"{full}{_label_str(labels)} "
                                      f"{_fmt(fam.series[key])}")
         return lines
+
+
+def _sanitize_steptiming(st: Any) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Sanitize a heartbeat's ``stepTiming`` phase digest down to exactly
+    the CRD schema's shape: (clean-or-None, error). Same door discipline
+    as the startup breakdown — a non-finite or negative duration rejects
+    the beat (persisted, it would wedge every later status write against
+    a real apiserver's schema), while *unknown phase names* are dropped
+    silently (a newer payload posting a phase this operator doesn't know
+    must not lose the whole beat — forward compatibility, like startup's
+    unknown-stage-field skip)."""
+    if not isinstance(st, dict):
+        return None, "bad heartbeat: stepTiming must be an object"
+    clean: Dict[str, Any] = {}
+    for field in ("steps",):
+        if st.get(field) is not None:
+            try:
+                value = int(st[field])
+            except (TypeError, ValueError):
+                return None, f"bad heartbeat: non-numeric stepTiming.{field}"
+            if value < 0:
+                return None, f"bad heartbeat: negative stepTiming.{field}"
+            clean[field] = value
+    for field in ("stepP50Seconds", "stepP95Seconds", "stepMaxSeconds",
+                  "stepLocalP95Seconds"):
+        if st.get(field) is not None:
+            try:
+                value = float(st[field])
+            except (TypeError, ValueError):
+                return None, f"bad heartbeat: non-numeric stepTiming.{field}"
+            if not math.isfinite(value) or value < 0:
+                return None, f"bad heartbeat: bad stepTiming.{field}"
+            clean[field] = value
+    phases = st.get("phases")
+    if phases is not None:
+        if not isinstance(phases, dict):
+            return None, "bad heartbeat: stepTiming.phases must be an object"
+        known = set(STEP_PHASE_FIELDS.values())
+        clean_phases: Dict[str, Any] = {}
+        for name, stats in phases.items():
+            if name not in known:
+                continue  # unknown phase: dropped, never persisted
+            if not isinstance(stats, dict):
+                return None, (f"bad heartbeat: stepTiming.phases.{name} "
+                              f"must be an object")
+            clean_stats: Dict[str, float] = {}
+            for key in STEP_DIGEST_KEYS:
+                if stats.get(key) is None:
+                    continue
+                try:
+                    value = float(stats[key])
+                except (TypeError, ValueError):
+                    return None, (f"bad heartbeat: non-numeric "
+                                  f"stepTiming.phases.{name}.{key}")
+                if not math.isfinite(value) or value < 0:
+                    return None, (f"bad heartbeat: bad "
+                                  f"stepTiming.phases.{name}.{key}")
+                clean_stats[key] = value
+            if clean_stats:
+                clean_phases[name] = clean_stats
+        if clean_phases:
+            clean["phases"] = clean_phases
+    return (clean or None), ""
 
 
 def _public_heartbeat(hb: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
@@ -615,6 +699,13 @@ class StatusServer:
             if stage not in STARTUP_STAGES:
                 return False, f"bad heartbeat: unknown startupStage {stage!r}"
             hb["startupStage"] = str(stage)
+        st = body.get("stepTiming")
+        if st is not None:
+            clean_st, err = _sanitize_steptiming(st)
+            if err:
+                return False, err
+            if clean_st:
+                hb["stepTiming"] = clean_st
         su = body.get("startup")
         if su is not None:
             if not isinstance(su, dict):
@@ -675,6 +766,14 @@ class StatusServer:
                 # observations. Fail retryably instead; the payload
                 # re-attaches it to the next due beat.
                 return False, "not ready: job not yet reconciled; retry"
+        if hb.get("processId") not in (None, 0):
+            # Cadence-only beats from non-zero gang members feed the
+            # controller's straggler detector above; stashing them here
+            # would flip the per-job gauges (job_last_step, step time,
+            # loss) between whichever process posted last — the gauges
+            # stay process 0's stream.
+            self.metrics.inc("heartbeats_total")
+            return True, ""
         with self._heartbeats_lock:
             self._heartbeats[(namespace, name)] = {
                 **hb, "receivedAt": time.time()}

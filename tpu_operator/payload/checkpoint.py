@@ -199,6 +199,13 @@ class Checkpointer:
         process — the step a restart is guaranteed to resume from."""
         return self._last_verified
 
+    @property
+    def uploader(self) -> Optional[Any]:
+        """The write-behind store uploader (None when the remote store is
+        unwired or this is not process 0) — exposed so exit paths can
+        enqueue postmortem artifacts through the same worker."""
+        return self._uploader
+
     def stats(self) -> Dict[str, int]:
         """Durability counters for the heartbeat body
         (→ ``status.checkpoint`` and the ``job_checkpoint_*`` metrics)."""

@@ -49,14 +49,21 @@ class HeartbeatReporter:
 
     ``tokens_per_batch`` (> 0) turns step cadence into tokens/sec — LM
     payloads pass B·T; payloads without a token notion leave it 0 and the
-    field is omitted. ``clock``/``poster`` are injectable for tests."""
+    field is omitted. ``clock``/``poster`` are injectable for tests.
+
+    ``cadence_only`` is the non-zero-process flavor (straggler
+    detection): the beat carries only identity + step cadence + the
+    ``stepTiming`` phase digest — no loss/tokens/checkpoint/startup
+    payload, which stays process 0's single stream. The controller feeds
+    these into its per-process gang cadence map and nothing else."""
 
     def __init__(self, base_url: str, job_name: str,
                  namespace: str = "default", process_id: int = 0,
                  attempt: int = 0, interval: float = DEFAULT_INTERVAL,
                  tokens_per_batch: int = 0,
                  clock: Callable[[], float] = time.monotonic,
-                 poster: Optional[Callable[[str, Dict[str, Any]], None]] = None):
+                 poster: Optional[Callable[[str, Dict[str, Any]], None]] = None,
+                 cadence_only: bool = False):
         self.url = base_url.rstrip("/") + "/api/heartbeat"
         self.job_name = job_name
         self.namespace = namespace
@@ -64,6 +71,7 @@ class HeartbeatReporter:
         self.attempt = attempt
         self.interval = interval
         self.tokens_per_batch = tokens_per_batch
+        self.cadence_only = cadence_only
         self._clock = clock
         self._poster = poster or _http_post
         self._last_post: Optional[float] = None
@@ -76,7 +84,8 @@ class HeartbeatReporter:
 
     def report(self, step: int, metrics: Optional[Dict[str, Any]] = None,
                checkpoint: Optional[Dict[str, Any]] = None,
-               startup: Optional[Dict[str, Any]] = None) -> bool:
+               startup: Optional[Dict[str, Any]] = None,
+               steptiming: Optional[Dict[str, Any]] = None) -> bool:
         """Post one heartbeat; returns True when the post succeeded. Step
         time is averaged over the steps since the previous post, so it is
         meaningful at any reporting interval.
@@ -91,7 +100,13 @@ class HeartbeatReporter:
         ``startup`` is the attempt's startup-phase breakdown
         (``StartupTracker.breakdown()``), attached once after the first
         step — the operator folds it into ``status.startup`` and the
-        ``job_startup_seconds`` histograms."""
+        ``job_startup_seconds`` histograms.
+
+        ``steptiming`` is the flight recorder's windowed phase digest
+        (``StepRecorder.summary()``) — per-phase p50/p95/max since the
+        previous digest. The operator folds process 0's into
+        ``status.stepTiming`` + the ``job_step_phase_seconds`` histograms
+        and feeds EVERY process's into the gang straggler detector."""
         now = self._clock()
         body: Dict[str, Any] = {
             "namespace": self.namespace,
@@ -100,14 +115,22 @@ class HeartbeatReporter:
             "processId": self.process_id,
             "attempt": self.attempt,
         }
-        if startup:
+        if steptiming:
+            body["stepTiming"] = dict(steptiming)
+        if startup and not self.cadence_only:
             body["startup"] = dict(startup)
         if self._last_post is not None and self._last_step is not None \
                 and step > self._last_step:
             per_step = (now - self._last_post) / (step - self._last_step)
             body["stepTimeSeconds"] = round(per_step, 6)
-            if self.tokens_per_batch > 0 and per_step > 0:
+            if self.tokens_per_batch > 0 and per_step > 0 \
+                    and not self.cadence_only:
                 body["tokensPerSec"] = round(self.tokens_per_batch / per_step, 3)
+        if self.cadence_only:
+            # Non-zero processes contribute cadence for straggler
+            # detection only; everything else is process 0's stream.
+            self._last_post, self._last_step = now, int(step)
+            return self._post(body)
         if checkpoint:
             if checkpoint.get("lastCheckpointStep") is not None:
                 body["lastCheckpointStep"] = int(
@@ -155,7 +178,11 @@ class HeartbeatReporter:
         a long compile from reading as a hang. Deliberately does NOT touch
         the step-cadence bookkeeping (``_last_post``): the first real step
         report must fire immediately, and step-time averaging must not
-        span the startup window."""
+        span the startup window. Startup liveness is process 0's job —
+        cadence-only reporters no-op (the operator would discard the
+        post)."""
+        if self.cadence_only:
+            return False
         return self._post({
             "namespace": self.namespace,
             "name": self.job_name,
@@ -175,9 +202,12 @@ class HeartbeatReporter:
 def from_env(env: Optional[Dict[str, str]] = None,
              tokens_per_batch: int = 0) -> Optional[HeartbeatReporter]:
     """Reporter from the operator's env contract, or None when heartbeats
-    are not wired (no TPUJOB_STATUS_URL) or this is not process 0 — only
-    the group's first process posts, so the operator sees one stream per
-    job, not one per worker."""
+    are not wired (no TPUJOB_STATUS_URL). Process 0 posts the full
+    telemetry stream (one per job, as before); every OTHER process posts
+    ``cadence_only`` beats — identity + step cadence + the stepTiming
+    phase digest — which the controller's straggler detector compares
+    across the gang to find the replica pacing the collective. One small
+    POST per process per interval, rate-limited inside the reporter."""
     e = env if env is not None else os.environ
     url = e.get("TPUJOB_STATUS_URL", "")
     job = e.get("TPUJOB_NAME", "")
@@ -192,13 +222,23 @@ def from_env(env: Optional[Dict[str, str]] = None,
             log.warning("ignoring malformed %s=%r", var, e.get(var))
             return default
 
-    if _num("JAX_PROCESS_ID", 0, int) != 0:
+    process_id = _num("JAX_PROCESS_ID", 0, int)
+    if process_id != 0 and str(
+            e.get("TPUJOB_STEPTRACE_ENABLED", "1")).lower() in ("0",
+                                                                "false"):
+        # Cadence beats exist FOR the straggler detector; with the flight
+        # recorder explicitly disabled (spec.stepTrace.enabled: false)
+        # the controller no-ops every one of them — a 64-process gang
+        # would pay 63 discarded POSTs per interval for a feature the
+        # user turned off. Process 0's stream is independent telemetry
+        # and keeps flowing.
         return None
     return HeartbeatReporter(
         url, job,
         namespace=e.get("TPUJOB_NAMESPACE", "default"),
-        process_id=0,
+        process_id=process_id,
         attempt=_num("TPUJOB_ATTEMPT", 0, int),
         interval=_num("TPUJOB_HEARTBEAT_INTERVAL", DEFAULT_INTERVAL, float),
         tokens_per_batch=tokens_per_batch,
+        cadence_only=process_id != 0,
     )

@@ -1,0 +1,358 @@
+"""Data-plane flight recorder: per-step phase timing for the train loop.
+
+The control plane and the restart path are instrumented end to end (PR 1
+metrics, PR 5 startup breakdown, PR 8 goodput), but steady-state step time
+— where a training job spends almost all of its life — was a single
+averaged ``stepTimeSeconds`` on the heartbeat: no split between input
+wait, device compute, host work, and checkpoint stalls, and no way to see
+that ONE replica in a gang is pacing the collective for everyone. This
+module is the payload half of that gap:
+
+- :class:`StepRecorder` times each step's phases into a fixed-size ring
+  buffer. The step path pays **timestamps only** (one ``clock()`` call and
+  one dict store per phase boundary, one lock-guarded append per step);
+  percentile aggregation runs off-loop, on the heartbeat cadence.
+- :meth:`StepRecorder.summary` drains the since-last-summary window into
+  the wire-format digest the heartbeat carries (``stepTiming``): per-phase
+  p50/p95/max plus whole-step percentiles. Windowed on purpose — each
+  digest describes a disjoint span of steps, so the controller can feed
+  histograms without double counting and the straggler detector sees
+  time-local cadence, not a lifetime average.
+- On a retryable payload exit the ring buffer dumps as a JSON artifact
+  next to the checkpoint dir (:func:`postmortem_dump`) — and ships through
+  the write-behind store worker when ``spec.store`` is wired — so a
+  postmortem of a preempted or stalled attempt sees the last N steps'
+  phase timings, not just the final heartbeat.
+
+Phase definitions (one step, in loop order):
+
+- ``DATA`` — input/data wait: time blocked in ``next()`` on the
+  ``device_prefetch`` stream. Near zero while the prefetcher keeps up;
+  growth here means host batch generation or H2D transfer fell behind.
+- ``DISPATCH`` — the jitted step call itself: async enqueue of the device
+  program. Growth means trace/compile on the dispatch path or the runtime
+  throttling a too-deep queue.
+- ``COMPUTE`` — device execution: the host's residual wait, bounded by
+  ``block_until_ready`` fenced ONE STEP DEEP (after dispatching step i
+  the loop blocks on step i-1's metrics) so dispatch pipelining is
+  preserved — a same-step fence serialized host dispatch against device
+  compute and cost measurable throughput. The dominant phase on a
+  healthy, device-bound step; shrinkage here with wall time flat means
+  the host became the bottleneck.
+- ``CHECKPOINT`` — the ``maybe_save`` boundary: normally the async
+  handoff (~0), spiking when a save blocks on the previous one.
+- ``HOST`` — everything else host-side: logging, metrics fetch, the
+  heartbeat post.
+
+Stdlib-only on purpose: the controller (statusserver sanitization, schema)
+imports the phase names from here, and this module must not drag jax into
+the control plane — same discipline as ``payload/startup.py``.
+"""
+
+from __future__ import annotations
+
+import collections
+import json
+import logging
+import math
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional
+
+log = logging.getLogger(__name__)
+
+# Step phases, in loop order.
+DATA = "DATA"
+DISPATCH = "DISPATCH"
+COMPUTE = "COMPUTE"
+CHECKPOINT = "CHECKPOINT"
+HOST = "HOST"
+
+PHASES = (DATA, DISPATCH, COMPUTE, CHECKPOINT, HOST)
+
+# Wire-format field name per phase: the keys of ``stepTiming.phases`` on
+# the heartbeat, in ``status.stepTiming``, and in the postmortem artifact.
+PHASE_FIELDS = {
+    DATA: "dataWait",
+    DISPATCH: "dispatch",
+    COMPUTE: "compute",
+    CHECKPOINT: "checkpoint",
+    HOST: "host",
+}
+
+# Per-phase digest stats carried for each phase field.
+DIGEST_KEYS = ("p50Seconds", "p95Seconds", "maxSeconds")
+
+# Ring-buffer capacity default (last N steps retained for the postmortem).
+DEFAULT_BUFFER_STEPS = 512
+
+# Operator env contract (trainer/replicas.py injects when spec.stepTrace
+# is present; absent env keeps the recorder on at defaults — it costs
+# timestamps only).
+ENV_ENABLED = "TPUJOB_STEPTRACE_ENABLED"
+ENV_BUFFER = "TPUJOB_STEPTRACE_BUFFER"
+
+
+def _pct(sorted_vals: List[float], q: float) -> float:
+    """Nearest-rank percentile of an already-sorted list."""
+    if not sorted_vals:
+        return 0.0
+    rank = max(0, min(len(sorted_vals) - 1,
+                      math.ceil(q * len(sorted_vals)) - 1))
+    return sorted_vals[rank]
+
+
+def digest(values: List[float]) -> Dict[str, float]:
+    """{p50Seconds, p95Seconds, maxSeconds} of one phase's samples."""
+    s = sorted(values)
+    return {
+        "p50Seconds": round(_pct(s, 0.50), 6),
+        "p95Seconds": round(_pct(s, 0.95), 6),
+        "maxSeconds": round(s[-1], 6) if s else 0.0,
+    }
+
+
+class StepRecorder:
+    """Per-step phase timing into a bounded ring buffer.
+
+    Step-loop usage (one thread — the train loop — drives begin/lap/
+    commit; ``summary``/``snapshot``/``dump`` may be called from any
+    thread, hence the lock on the shared buffers)::
+
+        rec.begin(i)
+        batch = next(stream);            rec.lap(steptrace.DATA)
+        state, m = step(state, batch);   rec.lap(steptrace.DISPATCH)
+        block_until_ready(prev_m);       rec.lap(steptrace.COMPUTE)
+        ckpt.maybe_save(i + 1, state);   rec.lap(steptrace.CHECKPOINT)
+        log/heartbeat;                   rec.lap(steptrace.HOST)
+        rec.commit();                    prev_m = m
+
+    ``lap`` attributes the time since the previous boundary to the named
+    phase (re-entering a phase accumulates). ``clock`` is injectable for
+    deterministic tests.
+    """
+
+    def __init__(self, capacity: int = DEFAULT_BUFFER_STEPS,
+                 clock: Callable[[], float] = time.perf_counter):
+        self._clock = clock
+        self.capacity = max(8, int(capacity))
+        self._lock = threading.Lock()
+        # Last-N completed step records: {"step": i, "seconds": total,
+        # DATA: dt, ...} with raw phase-name keys.
+        self._ring: collections.deque = collections.deque(
+            maxlen=self.capacity)  # guarded-by: _lock
+        # Since-last-summary window: phase -> samples, whole-step totals,
+        # and per-step LOCAL time (total minus the COMPUTE wait) — the
+        # straggler detector's signal. Drained and reset by summary();
+        # BOUNDED at the ring capacity because summary() only runs when a
+        # heartbeat is wired — a standalone payload (no TPUJOB_STATUS_URL)
+        # with the recorder default-ON must not accumulate O(steps) floats
+        # forever. A window that hit the bound simply digests the newest
+        # `capacity` steps, same retention story as the ring itself.
+        self._window: Dict[str, collections.deque] = {}  # guarded-by: _lock
+        self._window_steps: collections.deque = collections.deque(
+            maxlen=self.capacity)  # guarded-by: _lock
+        self._window_local: collections.deque = collections.deque(
+            maxlen=self.capacity)  # guarded-by: _lock
+        # In-flight step state: step-loop thread only, never shared.
+        self._cur: Optional[Dict[str, Any]] = None
+        self._t0 = 0.0
+        self._tlast = 0.0
+        self.steps_recorded = 0
+
+    # -- step path (timestamps only) -------------------------------------------
+
+    def begin(self, step: int) -> None:
+        self._cur = {"step": int(step)}
+        self._t0 = self._tlast = self._clock()
+
+    def lap(self, phase: str) -> None:
+        """Attribute time since the previous boundary to ``phase``."""
+        cur = self._cur
+        if cur is None:
+            return
+        now = self._clock()
+        cur[phase] = cur.get(phase, 0.0) + (now - self._tlast)
+        self._tlast = now
+
+    def commit(self) -> None:
+        cur = self._cur
+        if cur is None:
+            return
+        self._cur = None
+        cur["seconds"] = self._clock() - self._t0
+        with self._lock:
+            self._ring.append(cur)
+            self._window_steps.append(cur["seconds"])
+            # Local time = everything the COMPUTE fence did NOT cover. In
+            # a synchronous gang every member's step (and compute wait)
+            # converges on the slowest member — the collective equalizes
+            # them — so whole-step cadence can never single out a
+            # straggler; the local share is the only per-process signal
+            # that stays per-process.
+            self._window_local.append(
+                max(0.0, cur["seconds"] - cur.get(COMPUTE, 0.0)))
+            for phase in PHASES:
+                if phase in cur:
+                    if phase not in self._window:
+                        self._window[phase] = collections.deque(
+                            maxlen=self.capacity)
+                    self._window[phase].append(cur[phase])
+        self.steps_recorded += 1
+
+    def abandon(self) -> None:
+        """Drop the in-flight step (loop exiting mid-step): a partial
+        record would skew every digest low."""
+        self._cur = None
+
+    # -- off-loop aggregation --------------------------------------------------
+
+    def summary(self) -> Optional[Dict[str, Any]]:
+        """Drain the since-last-summary window into the heartbeat's
+        ``stepTiming`` wire dict, or None when no step completed since the
+        previous summary. Each summary describes a disjoint step span, so
+        downstream histogram observation never double-counts."""
+        with self._lock:
+            steps = list(self._window_steps)
+            local = list(self._window_local)
+            window = {phase: list(v) for phase, v in self._window.items()}
+            if not steps:
+                return None
+            self._window_steps.clear()
+            self._window_local.clear()
+            self._window = {}
+        whole = digest(steps)
+        out: Dict[str, Any] = {
+            "steps": len(steps),
+            "stepP50Seconds": whole["p50Seconds"],
+            "stepP95Seconds": whole["p95Seconds"],
+            "stepMaxSeconds": whole["maxSeconds"],
+            # The straggler detector's signal: p95 of per-step LOCAL time
+            # (step minus the compute wait) — see commit().
+            "stepLocalP95Seconds": round(_pct(sorted(local), 0.95), 6),
+        }
+        phases = {
+            PHASE_FIELDS[phase]: digest(values)
+            for phase, values in window.items()
+        }
+        if phases:
+            out["phases"] = phases
+        return out
+
+    def snapshot(self) -> List[Dict[str, Any]]:
+        """The ring buffer's records, oldest first, with wire-format phase
+        names — the postmortem artifact body."""
+        with self._lock:
+            ring = list(self._ring)
+        out = []
+        for rec in ring:
+            row: Dict[str, Any] = {"step": rec["step"],
+                                   "stepSeconds": round(rec["seconds"], 6)}
+            for phase in PHASES:
+                if phase in rec:
+                    row[PHASE_FIELDS[phase]] = round(rec[phase], 6)
+            out.append(row)
+        return out
+
+    def dump(self, path: str, meta: Optional[Dict[str, Any]] = None) -> str:
+        """Write the ring buffer as a JSON artifact (atomic tmp+rename).
+        Raises OSError on an unwritable destination — callers on exit
+        paths should use :func:`postmortem_dump`, which never raises."""
+        body: Dict[str, Any] = {
+            "kind": "tpujob-steptrace",
+            "capacity": self.capacity,
+            "stepsRecorded": self.steps_recorded,
+            **(meta or {}),
+            "steps": self.snapshot(),
+        }
+        tmp = f"{path}.tmp-{os.getpid()}"
+        with open(tmp, "w", encoding="utf-8") as f:
+            json.dump(body, f)
+        os.replace(tmp, path)
+        return path
+
+
+def from_env(env: Optional[Dict[str, str]] = None) -> Optional[StepRecorder]:
+    """Recorder from the operator's env contract. Default ON (absent env):
+    the recorder costs timestamps only, and a black-box data plane costs
+    more. ``TPUJOB_STEPTRACE_ENABLED=0`` opts out; TPUJOB_STEPTRACE_BUFFER
+    sizes the ring."""
+    e = env if env is not None else os.environ
+    if str(e.get(ENV_ENABLED, "1")).lower() in ("0", "false"):
+        return None
+    try:
+        capacity = int(e.get(ENV_BUFFER) or DEFAULT_BUFFER_STEPS)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", ENV_BUFFER, e.get(ENV_BUFFER))
+        capacity = DEFAULT_BUFFER_STEPS
+    return StepRecorder(capacity=capacity)
+
+
+def postmortem_path(checkpoint_dir: str, attempt: int,
+                    process_id: int) -> str:
+    """The artifact path for one attempt's trace: a sibling of the
+    checkpoint dir (same volume — it survives the pod exactly as long as
+    the checkpoints do), named by attempt + process so successive attempts
+    and gang members never clobber each other. When the checkpoint dir IS
+    a top-level mount point (``checkpointDir: /ckpt`` with the PVC at
+    /ckpt), its parent is the container root fs — outside the volume —
+    so the artifact goes INSIDE the checkpoint dir instead (a
+    non-numeric file there is invisible to both the orbax step walk and
+    the quarantine scan)."""
+    name = f"steptrace-attempt{int(attempt)}-p{int(process_id)}.json"
+    ckpt = os.path.abspath(checkpoint_dir.rstrip("/") or "/")
+    base = os.path.dirname(ckpt)
+    if base == os.path.dirname(base):  # parent of a top-level dir: rootfs
+        return os.path.join(ckpt, name)
+    return os.path.join(base, name)
+
+
+def postmortem_dump(recorder: StepRecorder, checkpoint_dir: str,
+                    env: Optional[Dict[str, str]] = None) -> Optional[str]:
+    """Best-effort ring-buffer dump on a retryable exit: writes the
+    artifact next to the checkpoint dir and returns its path, or None
+    (logged) when there is nowhere to write or the write failed — a
+    postmortem aid must never turn a retryable exit into a permanent
+    one."""
+    e = env if env is not None else os.environ
+    if not checkpoint_dir:
+        log.debug("steptrace: no checkpoint dir; skipping postmortem dump")
+        return None
+
+    def _num(var: str) -> int:
+        try:
+            return int(e.get(var) or 0)
+        except ValueError:
+            return 0
+
+    attempt, process_id = _num("TPUJOB_ATTEMPT"), _num("JAX_PROCESS_ID")
+    path = postmortem_path(checkpoint_dir, attempt, process_id)
+    meta = {
+        "job": e.get("TPUJOB_NAME", ""),
+        "namespace": e.get("TPUJOB_NAMESPACE", "default"),
+        "attempt": attempt,
+        "processId": process_id,
+    }
+    try:
+        recorder.dump(path, meta=meta)
+    except OSError as err:
+        # The sibling slot can be unwritable (read-only parent, the
+        # checkpoint dir deeper than the mount): fall back INSIDE the
+        # checkpoint dir, which the payload provably writes.
+        fallback = os.path.join(os.path.abspath(checkpoint_dir),
+                                os.path.basename(path))
+        if fallback == path:
+            log.warning("steptrace: postmortem dump to %s failed: %s",
+                        path, err)
+            return None
+        try:
+            recorder.dump(fallback, meta=meta)
+            path = fallback
+        except OSError as err2:
+            log.warning("steptrace: postmortem dump failed (%s: %s; "
+                        "%s: %s)", path, err, fallback, err2)
+            return None
+    log.info("steptrace: dumped last %d step timings to %s",
+             min(recorder.steps_recorded, recorder.capacity), path)
+    return path

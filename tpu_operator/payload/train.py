@@ -20,6 +20,7 @@ from __future__ import annotations
 
 import itertools
 import logging
+import os
 import threading
 import time
 from typing import Any, Callable, Optional, Tuple
@@ -35,6 +36,7 @@ from tpu_operator.payload import bootstrap as bootstrap_mod
 from tpu_operator.payload import data as data_mod
 from tpu_operator.payload import models as models_mod
 from tpu_operator.payload import startup as startup_mod
+from tpu_operator.payload import steptrace as steptrace_mod
 
 log = logging.getLogger(__name__)
 
@@ -655,6 +657,27 @@ def _overlapped_prologue(train_step: Callable, state: TrainState, batches,
     return state, start, stream, result.get("compiled")
 
 
+def _dump_steptrace(recorder: Optional[steptrace_mod.StepRecorder],
+                    checkpointer) -> None:
+    """Retryable-exit postmortem: dump the flight recorder's ring buffer
+    next to the checkpoint dir and, when the remote warm-start store is
+    wired, ship the artifact through the existing write-behind worker (the
+    caller's ``checkpointer.close()`` drains it) — so a postmortem of a
+    preempted/stalled attempt sees the last N steps' phase timings even
+    when the node itself is gone. Strictly best-effort on every branch."""
+    if recorder is None:
+        return
+    recorder.abandon()
+    ckpt_dir = getattr(checkpointer, "directory", "") \
+        or os.environ.get("TPU_CHECKPOINT_DIR", "")
+    path = steptrace_mod.postmortem_dump(recorder, ckpt_dir)
+    if path is None:
+        return
+    uploader = getattr(checkpointer, "uploader", None)
+    if uploader is not None and hasattr(uploader, "enqueue_artifact"):
+        uploader.enqueue_artifact(path)
+
+
 def _startup_heartbeat_ticker(tracker: startup_mod.StartupTracker,
                               heartbeat, stop: threading.Event) -> None:
     """Pre-first-step liveness: until the first step lands there are no
@@ -678,7 +701,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                profile_range: Tuple[int, int] = (10, 20),
                prefetch: int = 2,
                heartbeat="auto", startup=None,
-               overlap: bool = True) -> Tuple[TrainState, dict]:
+               overlap: bool = True,
+               steptrace="auto") -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
     interval — and the batch transfers run ``prefetch`` deep ahead of the
@@ -723,13 +747,29 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     first heartbeat after the first step (→ ``status.startup``), and
     pre-first-step liveness beats carry the in-flight ``startupStage`` so
     a long compile never reads as a stall.
+
+    ``steptrace`` is the data-plane flight recorder
+    (payload/steptrace.py): ``"auto"`` (default) builds one from the env
+    contract (on unless TPUJOB_STEPTRACE_ENABLED=0), or pass a
+    StepRecorder / None explicitly. The step path pays timestamps only;
+    phase digests ride due heartbeats as ``stepTiming`` and the ring
+    buffer dumps as a postmortem artifact on a retryable exit. The
+    COMPUTE fence is deferred one step (see the ``fence`` comment below)
+    so dispatch pipelining survives — bench.py --steptrace enforces the
+    <1% overhead budget.
     """
     if heartbeat == "auto":
         from tpu_operator.payload import heartbeat as heartbeat_mod
         heartbeat = heartbeat_mod.from_env()
+    recorder = steptrace_mod.from_env() if steptrace == "auto" else steptrace
     tracker = startup if startup is not None else startup_mod.new_tracker()
     ticker_stop = threading.Event()
-    if heartbeat is not None:
+    # Startup-liveness beats are process 0's job (the watchdog baseline is
+    # per JOB, not per process): a cadence-only reporter skips the ticker
+    # entirely — on a 64-process gang, 63 startupStage posts per interval
+    # the operator would discard anyway.
+    if heartbeat is not None and not getattr(heartbeat, "cadence_only",
+                                             False):
         threading.Thread(target=_startup_heartbeat_ticker,
                          args=(tracker, heartbeat, ticker_stop),
                          daemon=True, name="startup-heartbeat").start()
@@ -783,8 +823,25 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         drain_agreed = bootstrap_mod.draining
 
     bootstrap_mod.enter_step_loop()  # SIGTERM now defers to a step boundary
+    # Flight-recorder COMPUTE fence, one step deep: after dispatching step
+    # i, block on step i-1's metrics (never the donated state). Fencing
+    # the CURRENT step would serialize host dispatch against device
+    # compute and cost real throughput (measured ~1-3% at bench shapes);
+    # deferred by one step, the dispatch of i overlaps i-1's tail and the
+    # lap still measures the honest device-bound share of the step wall
+    # time. metrics is not donated, so the held reference stays valid.
+    # ``ready`` is the newest metrics the fence has COMPLETED: while the
+    # recorder runs, logs and heartbeats read it instead of the current
+    # step's metrics — a same-step device_get on the telemetry path is a
+    # full compute stall billed to the HOST lap, which inflated process
+    # 0's local time into a FALSE straggler flag on large-step jobs (one
+    # beat per digest window, and a <20-step window's nearest-rank p95 IS
+    # its max). One step of telemetry lag, zero self-measurement.
+    fence = ready = None
     try:
         for i in range(start, steps):
+            if recorder is not None:
+                recorder.begin(i)
             if drain_agreed():
                 # Drain: persist the i completed steps and exit retryable —
                 # the restarted attempt resumes exactly here. The caller's
@@ -813,6 +870,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 jax.profiler.start_trace(profile_dir)
                 tracing = True
             batch_args = next(dev_batches)
+            if recorder is not None:
+                recorder.lap(steptrace_mod.DATA)
             if heartbeat is not None and i == start \
                     and getattr(heartbeat, "tokens_per_batch", 0) == 0:
                 heartbeat.tokens_per_batch = _infer_tokens_per_batch(batch_args)
@@ -845,16 +904,43 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                     jax.device_get(metrics)
                 ticker_stop.set()
                 pending_startup = tracker.breakdown()
+                if recorder is not None:
+                    # First step: dispatch, residual compile, and the
+                    # device_get fence are one indivisible TTFS leg —
+                    # recorded whole as COMPUTE.
+                    recorder.lap(steptrace_mod.COMPUTE)
+                    fence = ready = metrics
             else:
                 state, metrics = step_fn(state, *batch_args)
+                if recorder is not None:
+                    recorder.lap(steptrace_mod.DISPATCH)
+                    if fence is not None:
+                        jax.block_until_ready(fence)
+                        ready = fence
+                    recorder.lap(steptrace_mod.COMPUTE)
+                    fence = metrics
             if tracing and (i + 1) >= trace_to:
                 jax.device_get(metrics)  # drain async work into the trace
                 jax.profiler.stop_trace()
                 tracing, profiled = False, True
+                if recorder is not None:
+                    # The profiler-stop drain fenced a whole step's
+                    # compute; billed to HOST (one-off bookkeeping), it
+                    # must not masquerade as a checkpoint stall in the
+                    # phase digest.
+                    recorder.lap(steptrace_mod.HOST)
             if checkpointer is not None:
                 checkpointer.maybe_save(i + 1, state)
+                if recorder is not None:
+                    recorder.lap(steptrace_mod.CHECKPOINT)
+            # Telemetry (logs + heartbeats) reads the newest FENCED
+            # metrics while the recorder runs: already computed, so the
+            # device_get is a scalar copy, not a compute stall — one step
+            # of lag instead of a self-measured phantom HOST phase.
+            telemetry = metrics if recorder is None or ready is None \
+                else ready
             if log_every and log_fn and (i + 1) % log_every == 0:
-                log_fn(i + 1, jax.device_get(metrics))
+                log_fn(i + 1, jax.device_get(telemetry))
             # The first step's report is forced (not just when due): it
             # carries the startup breakdown the operator folds into
             # status.startup; thereafter the breakdown rides along on due
@@ -862,12 +948,40 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             if heartbeat is not None and (heartbeat.due(i + 1)
                                           or (i == start
                                               and pending_startup)):
+                # The phase digest drains the recorder's window only on a
+                # due beat (aggregation stays off the steady step path); a
+                # failed post drops that window's digest — the ring buffer
+                # still holds the raw steps for the postmortem. A
+                # cadence-only reporter (non-zero process) skips the
+                # device_get and checkpoint stats outright: it strips
+                # loss/checkpoint from the body anyway, and the device_get
+                # is a SAME-step fence — exactly the pipeline stall the
+                # recorder's deferred COMPUTE fence exists to avoid.
+                cadence = getattr(heartbeat, "cadence_only", False)
                 if heartbeat.report(
-                        i + 1, jax.device_get(metrics),
+                        i + 1,
+                        None if cadence else jax.device_get(telemetry),
                         checkpoint=(checkpointer.stats()
-                                    if checkpointer is not None else None),
-                        startup=pending_startup):
+                                    if checkpointer is not None
+                                    and not cadence else None),
+                        startup=pending_startup,
+                        steptiming=(recorder.summary()
+                                    if recorder is not None else None)):
                     pending_startup = None
+            if recorder is not None:
+                recorder.lap(steptrace_mod.HOST)
+                recorder.commit()
+    except SystemExit as e:
+        # Retryable exits (preemption drain, save-failure escalation) are
+        # exactly when a postmortem wants the last N steps' phase timings:
+        # dump the flight recorder next to the checkpoint dir (and ship it
+        # via the write-behind store worker) before the exit propagates.
+        # Direct equality, no int() coercion: SystemExit.code may legally
+        # be any object (sys.exit("message")) and must pass through
+        # untouched.
+        if getattr(e, "code", None) == bootstrap_mod.EXIT_RETRYABLE:
+            _dump_steptrace(recorder, checkpointer)
+        raise
     finally:
         ticker_stop.set()
         bootstrap_mod.exit_step_loop()
@@ -895,6 +1009,7 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                 "final checkpoint of step %d is not durable (last verified "
                 "step: %s); exiting retryable so the restart re-earns it",
                 steps, checkpointer.last_verified_step())
+            _dump_steptrace(recorder, checkpointer)
             raise SystemExit(bootstrap_mod.EXIT_RETRYABLE)
     return state, (jax.device_get(metrics) if metrics else {})
 

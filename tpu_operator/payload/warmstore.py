@@ -225,9 +225,16 @@ def upload_cache_once(env: Optional[Dict[str, str]] = None) -> int:
     store = store_from_env(e)
     if store is None:
         return 0
-    cache_dir = startup_mod.cache_dir() \
-        or e.get("JAX_COMPILATION_CACHE_DIR", "") \
+    # The module-level cache_dir() (what bootstrap actually enabled) is
+    # authoritative ONLY for the ambient path (env=None, production): an
+    # explicit env mapping is the caller's whole contract, and consulting
+    # ambient process state from it let one test's enable_compilation_
+    # cache() leak its tmp dir into a later test's upload (order-
+    # dependent tier-1 flake, reproduced on the unmodified tree).
+    cache_dir = e.get("JAX_COMPILATION_CACHE_DIR", "") \
         or e.get("TPUJOB_CACHE_PATH", "")
+    if env is None:
+        cache_dir = startup_mod.cache_dir() or cache_dir
     if not cache_dir:
         return 0
     try:

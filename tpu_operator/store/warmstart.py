@@ -47,6 +47,7 @@ log = logging.getLogger(__name__)
 
 CHECKPOINT_PREFIX = "checkpoints"
 CACHE_PREFIX = "cache"
+ARTIFACT_PREFIX = "artifacts"
 CORRUPT_SUFFIX = ".corrupt"
 
 # Local quarantine directory names the PR 4 restore walk writes
@@ -108,6 +109,23 @@ class WarmStartStore:
         transfer.delete_tree(self.backend, self._step_prefix(step))
         log.warning("remote store: marked checkpoint step %d corrupt (%s)",
                     step, reason or "local quarantine")
+
+    # -- artifacts (postmortem step traces etc.) ------------------------------
+
+    def upload_artifact(self, local_path: str, name: str) -> None:
+        """Ship one small file under the job's ``artifacts/`` prefix as a
+        single object (postmortem step-trace dumps are a few hundred KB —
+        no chunking needed; the backend's put is atomic per object).
+        Raises BlobError flavors / OSError on failure — the write-behind
+        worker owns the best-effort handling."""
+        with open(local_path, "rb") as f:
+            data = f.read()
+        self.backend.put(self._key(ARTIFACT_PREFIX, name), data)
+
+    def list_artifacts(self) -> List[str]:
+        """Names of uploaded artifacts (postmortem discovery)."""
+        base = self._key(ARTIFACT_PREFIX) + "/"
+        return sorted(key[len(base):] for key in self.backend.list(base))
 
     # -- checkpoints: read side -----------------------------------------------
 

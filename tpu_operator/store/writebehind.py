@@ -31,6 +31,7 @@ failure by its upload tail.
 from __future__ import annotations
 
 import logging
+import os
 import threading
 from typing import Any, Dict, Optional
 
@@ -63,9 +64,11 @@ class WriteBehindUploader:
         self._cache_dir_fn = cache_dir_fn
         self._cond = threading.Condition()
         # kind -> pending task; "checkpoint" holds (step, dir) last-wins,
-        # "corrupt" holds a set of steps to mark.
+        # "corrupt" holds a set of steps to mark, "artifacts" maps remote
+        # name -> local path (postmortem step traces; last-wins per name).
         self._pending_step: Optional[tuple] = None  # guarded-by: _cond
         self._pending_corrupt: set = set()  # guarded-by: _cond
+        self._pending_artifacts: Dict[str, str] = {}  # guarded-by: _cond
         self._busy = False  # guarded-by: _cond
         self._closed = False  # guarded-by: _cond
         # Counters (read by stats()/escalated() from the step loop).
@@ -105,6 +108,18 @@ class WriteBehindUploader:
                 self._pending_step = None  # never upload a condemned step
             self._cond.notify()
 
+    def enqueue_artifact(self, path: str, name: str = "") -> None:
+        """Queue one small file (a postmortem step-trace dump) for remote
+        upload under the job's ``artifacts/`` prefix. Same non-blocking
+        discipline as checkpoints; failures are logged, never counted
+        toward escalation — an artifact is a postmortem aid, not
+        durability."""
+        with self._cond:
+            if self._closed:
+                return
+            self._pending_artifacts[name or os.path.basename(path)] = path
+            self._cond.notify()
+
     def escalated(self) -> bool:
         """True when the remote has failed ``fail_after`` consecutive
         uploads — the step loop converts this to the retryable exit, the
@@ -125,7 +140,8 @@ class WriteBehindUploader:
     def idle(self) -> bool:
         with self._cond:
             return (self._pending_step is None
-                    and not self._pending_corrupt and not self._busy)
+                    and not self._pending_corrupt
+                    and not self._pending_artifacts and not self._busy)
 
     def flush(self, timeout: float = DEFAULT_FLUSH_TIMEOUT) -> bool:
         """Wait (bounded) until the queue drains; True when it did."""
@@ -134,7 +150,8 @@ class WriteBehindUploader:
         deadline = time.monotonic() + max(0.0, timeout)
         with self._cond:
             while (self._pending_step is not None
-                   or self._pending_corrupt or self._busy):
+                   or self._pending_corrupt
+                   or self._pending_artifacts or self._busy):
                 remaining = deadline - time.monotonic()
                 if remaining <= 0:
                     return False
@@ -156,15 +173,19 @@ class WriteBehindUploader:
         while True:
             with self._cond:
                 while (self._pending_step is None
-                       and not self._pending_corrupt and not self._closed):
+                       and not self._pending_corrupt
+                       and not self._pending_artifacts and not self._closed):
                     self._cond.wait()
                 if self._closed and self._pending_step is None \
-                        and not self._pending_corrupt:
+                        and not self._pending_corrupt \
+                        and not self._pending_artifacts:
                     return
                 task_step = self._pending_step
                 self._pending_step = None
                 corrupt = set(self._pending_corrupt)
                 self._pending_corrupt.clear()
+                artifacts = dict(self._pending_artifacts)
+                self._pending_artifacts.clear()
                 self._busy = True
             try:
                 for step in sorted(corrupt):
@@ -173,6 +194,12 @@ class WriteBehindUploader:
                     except Exception as e:  # noqa: BLE001 — best-effort mark
                         log.warning("remote corrupt-mark of step %d failed: "
                                     "%s", step, e)
+                for name, path in sorted(artifacts.items()):
+                    try:
+                        self.store.upload_artifact(path, name)
+                    except Exception as e:  # noqa: BLE001 — postmortem aid
+                        log.warning("artifact upload of %s failed: %s",
+                                    name, e)
                 if task_step is not None:
                     self._upload(*task_step)
                     # Cache sync is INDEPENDENT of the checkpoint upload's

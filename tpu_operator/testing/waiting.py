@@ -1,0 +1,74 @@
+"""The shared polling helper for telemetry/e2e test harnesses.
+
+Every e2e harness needs "poll until the operator converges"; before this
+module each test file carried its own ad-hoc ``wait_for`` whose timeout
+produced a bare ``assert False`` — the flake report said *that* it timed
+out, never *what* the poller last saw (the PR 7 reflector bug cost a day
+of re-running exactly because of this). One definition, two upgrades:
+
+- **Timeout raises** :class:`WaitTimeout` (an ``AssertionError`` subclass,
+  so ``pytest.raises``/``assert``-style handling both work) carrying the
+  deadline AND the last observed value — a failed wait reports the state
+  it saw, not just that it waited.
+- **``describe``** lets call sites attach a state probe richer than the
+  predicate's falsy return (e.g. the full job status while waiting on one
+  phase field), evaluated only on failure so the happy path stays cheap.
+
+Use :func:`make_wait_for` to bind per-harness defaults::
+
+    from tpu_operator.testing.waiting import make_wait_for
+    wait_for = make_wait_for(timeout=60.0, interval=0.25)
+"""
+
+from __future__ import annotations
+
+import functools
+import time
+from typing import Any, Callable, Optional
+
+DEFAULT_TIMEOUT = 20.0
+DEFAULT_INTERVAL = 0.05
+
+
+class WaitTimeout(AssertionError):
+    """A wait_for deadline expired; the message carries the last state."""
+
+
+def wait_for(pred: Callable[[], Any], timeout: float = DEFAULT_TIMEOUT,
+             interval: float = DEFAULT_INTERVAL,
+             message: str = "condition",
+             describe: Optional[Callable[[], Any]] = None,
+             clock: Callable[[], float] = time.monotonic,
+             sleep: Callable[[float], None] = time.sleep) -> Any:
+    """Poll ``pred`` until truthy; return its value. On deadline, raise
+    :class:`WaitTimeout` naming the timeout and the last observed state
+    (``describe()`` when given, else the predicate's last return) — so a
+    flake reports what it saw instead of a bare timeout.
+
+    A predicate that RAISES propagates immediately (a broken probe is a
+    test bug, not a condition to wait out)."""
+    deadline = clock() + timeout
+    last: Any = None
+    while True:
+        last = pred()
+        if last:
+            return last
+        if clock() >= deadline:
+            observed: Any = last
+            if describe is not None:
+                try:
+                    observed = describe()
+                except Exception as e:  # noqa: BLE001 — best-effort probe
+                    observed = f"<describe() failed: {e}>"
+            raise WaitTimeout(
+                f"{message} not met within {timeout:.1f}s; "
+                f"last observed: {observed!r}")
+        sleep(interval)
+
+
+def make_wait_for(timeout: float = DEFAULT_TIMEOUT,
+                  interval: float = DEFAULT_INTERVAL
+                  ) -> Callable[..., Any]:
+    """Bind harness-level defaults (call sites can still override
+    per-call)."""
+    return functools.partial(wait_for, timeout=timeout, interval=interval)

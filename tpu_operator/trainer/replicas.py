@@ -272,6 +272,15 @@ def build_replica_env(
         env["TPUJOB_STORE_URI"] = store.uri
         env["TPUJOB_STORE_PARALLELISM"] = str(store.upload_parallelism)
         env["TPUJOB_STORE_PREFETCH"] = "1" if store.prefetch else "0"
+    trace = spec.step_trace
+    if trace is not None:
+        # Data-plane flight recorder (payload/steptrace.py consumes): the
+        # recorder is on by default without any env; the block is only
+        # injected to tune the ring size or opt out. stragglerRatio is
+        # controller-side (the detector compares heartbeats), so it never
+        # rides the pod env.
+        env["TPUJOB_STEPTRACE_ENABLED"] = "1" if trace.enabled else "0"
+        env["TPUJOB_STEPTRACE_BUFFER"] = str(trace.buffer_steps)
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)

@@ -555,9 +555,13 @@ class TrainingJob:
     # after the statusserver's 200 ACK (PR 5 hardened exactly this field
     # past the heartbeat coalescing), so a deferred PUT that dies with
     # the operator would lose it forever — unlike the per-beat telemetry
-    # the next heartbeat re-carries.
+    # the next heartbeat re-carries. ``stragglers`` is here because a
+    # flag change is an eviction/replace SIGNAL the fleet scheduler and
+    # operators act on — deferring it defers the action (stepTiming, by
+    # contrast, is per-beat telemetry and rides the limiter).
     _CRITICAL_STATUS_FIELDS = ("phase", "attempt", "state", "reason",
-                               "backoffUntil", "failures", "startup")
+                               "backoffUntil", "failures", "startup",
+                               "stragglers")
 
     def _critical_status_delta(self, base: Dict[str, Any],
                                wire: Dict[str, Any]) -> bool:
