@@ -76,6 +76,13 @@ python -m pytest tests/test_steptrace.py -x -q
 # 1% of recorder-off (50 µs absolute floor) — the near-zero-cost claim
 # as an enforced budget, exits nonzero on regression.
 python bench.py --steptrace --quick
+# Standalone elastic-gangs gate: inventory-sized attempts (grant in
+# [minSlices, maxSlices], shrink-don't-queue, re-expand, granted — not
+# spec — accounting), the reshard-aware restore through the remote
+# store, straggler remediation (replace without budget / shed one slice
+# on the preemption budget), and the acceptance e2es over the
+# in-process apiserver.
+python -m pytest tests/test_elastic.py -x -q
 # Standalone fleet-scheduler gate: slice-inventory admission (whole-gang
 # fit or phase Queued), fair-share + priority ordering, preemption victim
 # selection + the preemption-budget requeue, inventory release on
@@ -103,6 +110,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_startup_path.py \
   --ignore=tests/test_store.py \
   --ignore=tests/test_fleet_scheduler.py \
-  --ignore=tests/test_steptrace.py
+  --ignore=tests/test_steptrace.py \
+  --ignore=tests/test_elastic.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

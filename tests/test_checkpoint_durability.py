@@ -404,6 +404,131 @@ def test_gang_agree_single_process_is_identity():
     assert checkpoint.gang_agree_step(None) is None
 
 
+# --- reshard-restore matrix (elastic gangs resize between attempts) ----------
+
+def _mesh_build(ndev):
+    """(mesh, state, step_fn) of the tiny regression payload on an
+    ndev-device data mesh — the reshard matrix's world-size knob."""
+    import jax
+    import optax
+
+    from tpu_operator.payload import models, train
+
+    mesh = train.make_mesh(ndev)
+    model = models.LinearRegressor()
+    tx = optax.sgd(0.1)
+    sample = jnp.zeros((8, 8), jnp.float32)
+    state = train.create_train_state(model, jax.random.key(0), sample, tx)
+    state = train.place_state(mesh, state)
+    step = train.make_regression_train_step(model, tx, mesh, state)
+    return mesh, state, step
+
+
+def _run(ndev, steps, ckpt_dir, save_every=4, losses=None):
+    """Drive train_loop on an ndev mesh to ``steps`` total steps (resume
+    + fast-forward included), collecting (step, loss). The flight
+    recorder is off: its one-step telemetry lag differs between a fresh
+    and a resumed run, which would skew the trajectory comparison."""
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh, state, step_fn = _mesh_build(ndev)
+    ck = checkpoint.Checkpointer(str(ckpt_dir), save_every=save_every)
+    try:
+        train.train_loop(
+            mesh, step_fn, state, data_mod.synthetic_linear(0, 8, 8),
+            steps, checkpointer=ck, steptrace=None, log_every=1,
+            log_fn=(lambda i, m: losses.append((i, float(m["loss"])))
+                    if losses is not None else None))
+    finally:
+        ck.close()
+    return ck
+
+
+@pytest.mark.parametrize("save_dev,resume_dev", [(8, 4), (4, 8)],
+                         ids=["shrink-8to4", "grow-4to8"])
+def test_reshard_restore_matches_unresized_trajectory(tmp_path, save_dev,
+                                                      resume_dev):
+    """A checkpoint saved on mesh {data: save_dev} restores onto
+    {data: resume_dev} inside the verified walk, and the resumed loss
+    trajectory matches the unresized run after fast-forward — global
+    batches and global math are mesh-layout-invariant, so the only
+    acceptable difference is f32 reduction noise."""
+    ckpt = tmp_path / "ck"
+    _run(save_dev, 6, ckpt)
+
+    resumed = []
+    ck = _run(resume_dev, 10, ckpt, save_every=100, losses=resumed)
+    assert ck.restore_fallbacks == 0  # resharding is NOT a fallback walk
+    assert resumed and resumed[0][0] == 7  # fast-forwarded past step 6
+
+    reference = []
+    _run(save_dev, 10, tmp_path / "ref", save_every=100, losses=reference)
+    ref = dict(reference)
+    for i, loss in resumed:
+        assert loss == pytest.approx(ref[i], abs=1e-4), (i, loss, ref[i])
+
+
+def test_corrupt_latest_falls_back_across_size_boundary(tmp_path):
+    """The quarantine walk composes with resharding: the newest step
+    (saved by an 8-device mesh) is corrupt, so restore on a 4-device
+    mesh quarantines it and reshard-restores the older verified step."""
+    import jax
+
+    _mesh8, state8, _step = _mesh_build(8)
+    ck = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    assert ck.maybe_save(2, state8.replace(step=jnp.int32(2)))
+    assert ck.maybe_save(4, state8.replace(step=jnp.int32(4)))
+    ck.close()
+    corrupt_a_file(str(tmp_path / "ck" / "4"), keep_size=True)
+
+    _mesh4, state4, _step4 = _mesh_build(4)
+    ck2 = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    restored, start = ck2.restore(state4)
+    ck2.close()
+    assert start == 2
+    assert int(restored.step) == 2
+    assert ck2.restore_fallbacks == 1
+    leaf = restored.params["linear"]["kernel"]
+    assert leaf.sharding.mesh.shape["data"] == 4
+    assert [d.id for d in leaf.sharding.mesh.devices.flat] \
+        == [d.id for d in jax.devices()[:4]]
+
+
+def test_reshard_fallback_path_when_direct_restore_refuses(tmp_path):
+    """Future-proofing the walk against orbax versions that REFUSE a
+    mesh change on the direct sharded restore: with intact bytes, the
+    host-roundtrip + device_put fallback re-lays the leaves out instead
+    of the old behavior (re-raise as a permanent error)."""
+    _mesh8, state8, _step = _mesh_build(8)
+    ck = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    assert ck.maybe_save(6, state8.replace(step=jnp.int32(6)))
+    ck.close()
+
+    _mesh4, state4, _step4 = _mesh_build(4)
+    ck2 = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=1)
+    real_restore = ck2.manager.restore
+    calls = []
+
+    def refuses_sharded_restore(step, *a, **kw):
+        calls.append(step)
+        if len(calls) == 1:
+            raise ValueError("sharding mismatch: saved mesh shape (8, 1) "
+                             "!= target mesh shape (4, 1)")
+        return real_restore(step, *a, **kw)
+
+    ck2.manager.restore = refuses_sharded_restore
+    restored, start = ck2.restore(state4)
+    ck2.close()
+    assert calls == [6, 6]      # direct refused once, fallback restored
+    assert start == 6
+    assert int(restored.step) == 6
+    assert ck2.reshard_restores == 1
+    assert ck2.restore_fallbacks == 0   # nothing was quarantined
+    assert (tmp_path / "ck" / "6").is_dir()
+    leaf = restored.params["linear"]["kernel"]
+    assert leaf.sharding.mesh.shape["data"] == 4
+
+
 # --- heartbeat / operator plumbing -------------------------------------------
 
 def test_heartbeat_carries_checkpoint_fields():

@@ -1,0 +1,948 @@
+"""Elastic gangs: inventory-sized attempts, reshard-restore, straggler
+remediation.
+
+The graceful-degradation layer (ROADMAP item 3): ``spec.elastic
+{minSlices, maxSlices, stragglerPolicy}`` lets each attempt's world size
+be granted from the LIVE slice inventory — preferring maxSlices,
+shrinking instead of queueing, re-expanding when capacity returns — with
+the chosen size recorded in ``status.elastic`` + the failure ledger and
+the env contract regenerated for the actual size. Persistently flagged
+stragglers are replaced (same rendezvous, excluded node) or shed (group
+restart one slice smaller, preemption budget).
+
+The e2e at the bottom is the acceptance flow over the in-process
+apiserver: a Running 8-slice elastic job is preempted while the
+inventory shrinks to 4 → the next attempt gangs at 4 with the resize in
+status and metrics; a sibling e2e proves ``stragglerPolicy: replace``
+swaps a flagged member without consuming crash-loop budget. The
+payload half (a checkpoint saved at one world size restoring onto
+another, through the remote store) is in
+tests/test_checkpoint_durability.py's reshard matrix plus the
+store-composed test here.
+"""
+
+import contextlib
+import io
+import threading
+
+import pytest
+
+from tpu_operator.apis.tpujob import validation
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import Metrics, StatusServer
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.scheduler.fleet import FleetScheduler
+from tpu_operator.scheduler.inventory import SliceInventory, slice_key
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.trainer import elastic as elastic_mod
+from tpu_operator.trainer.training import TrainingJob
+
+V4 = "cloud-tpus.google.com/v4"
+KEY = slice_key(V4, "2x2x2")
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+
+def make_template(tpu_chips=4):
+    return {"spec": {"containers": [{"name": "tpu", "image": "x",
+                                     "resources": {"requests": {
+                                         V4: str(tpu_chips)}}}]}}
+
+
+def elastic_job(name="el", replicas=8, num_slices=8, min_slices=2,
+                max_slices=0, policy=t.StragglerPolicy.NONE, patience=300,
+                uid=None, **spec_kw):
+    """A WORKER gang of ``replicas`` processes over ``num_slices`` v4
+    slices whose attempts may gang anywhere in [min, max]."""
+    spec_kw.setdefault("restart_backoff",
+                       t.RestartBackoffSpec(base_seconds=0))
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=replicas, template=make_template(),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="el01",
+        tpu_topology="2x2x2",
+        num_slices=num_slices,
+        elastic=t.ElasticSpec(min_slices=min_slices, max_slices=max_slices,
+                              straggler_policy=policy,
+                              straggler_patience_seconds=patience),
+        **spec_kw,
+    )
+    return t.TPUJob(metadata={"name": name, "namespace": "default",
+                              "uid": uid or f"uid-{name}"}, spec=spec)
+
+
+def mark_pods(cs, phase="Running", state=None, only_live=False):
+    state = state if state is not None else {"running": {}}
+    for pod in cs.pods.list("default"):
+        if only_live and (pod.get("status") or {}).get("phase") in (
+                "Succeeded", "Failed"):
+            continue
+        pod["status"] = {"phase": phase, "containerStatuses": [
+            {"name": "tpu", "state": state}]}
+        cs.pods.update("default", pod)
+
+
+def live_pods(cs):
+    return [p for p in cs.pods.list("default")
+            if (p.get("status") or {}).get("phase") not in ("Succeeded",
+                                                            "Failed")]
+
+
+def pod_env(pod):
+    return {e["name"]: e["value"]
+            for e in pod["spec"]["containers"][0]["env"]}
+
+
+# --- spec plumbing (types/schema/defaults/validation round-trip) -------------
+
+
+def test_elastic_spec_roundtrip():
+    job = elastic_job(min_slices=2, max_slices=6, policy="replace",
+                      patience=120)
+    wire = job.to_dict()
+    assert wire["spec"]["elastic"] == {
+        "minSlices": 2, "maxSlices": 6, "stragglerPolicy": "replace",
+        "stragglerPatienceSeconds": 120}
+    back = t.TPUJob.from_dict(wire)
+    assert back.spec.elastic.min_slices == 2
+    assert back.spec.elastic.max_slices == 6
+    assert back.spec.elastic.straggler_policy == "replace"
+    # Absent block stays absent (specs round-trip unchanged).
+    bare = t.TPUJobSpec.from_dict({"replicaSpecs": []})
+    assert bare.elastic is None
+    assert "elastic" not in bare.to_dict()
+
+
+def test_elastic_defaults_and_validation():
+    job = elastic_job(min_slices=3, max_slices=0, num_slices=8)
+    set_defaults(job.spec)
+    assert job.spec.elastic.max_slices == 8  # defaulted from numSlices
+    validation.validate_tpujob_spec(job.spec)
+
+    bad = elastic_job(min_slices=5, max_slices=3)
+    set_defaults(bad.spec)
+    with pytest.raises(validation.ValidationError, match="maxSlices"):
+        validation.validate_tpujob_spec(bad.spec)
+
+    # maxSlices past numSlices demands processes the template never
+    # provisioned.
+    over = elastic_job(min_slices=2, max_slices=16, num_slices=8)
+    set_defaults(over.spec)
+    with pytest.raises(validation.ValidationError, match="numSlices"):
+        validation.validate_tpujob_spec(over.spec)
+
+    perpod = elastic_job(restart_policy=t.RestartPolicy.PER_POD)
+    set_defaults(perpod.spec)
+    with pytest.raises(validation.ValidationError, match="WholeGroup"):
+        validation.validate_tpujob_spec(perpod.spec)
+
+    bad_policy = elastic_job(policy="evict")
+    set_defaults(bad_policy.spec)
+    with pytest.raises(validation.ValidationError, match="stragglerPolicy"):
+        validation.validate_tpujob_spec(bad_policy.spec)
+
+    bad_patience = elastic_job(policy="replace", patience=0)
+    set_defaults(bad_patience.spec)
+    with pytest.raises(validation.ValidationError, match="Patience"):
+        validation.validate_tpujob_spec(bad_patience.spec)
+
+    # Worker replicas must scale evenly across the range.
+    uneven = elastic_job(replicas=6, num_slices=4, min_slices=2,
+                         max_slices=4)
+    set_defaults(uneven.spec)
+    with pytest.raises(validation.ValidationError, match="divisible"):
+        validation.validate_tpujob_spec(uneven.spec)
+
+
+def test_elastic_strict_schema():
+    job = elastic_job(min_slices=2, policy="shed")
+    set_defaults(job.spec)
+    job.status.elastic = {
+        "slices": 4, "workers": 4, "minSlices": 2, "maxSlices": 8,
+        "attempt": 1, "resizes": 1, "lastResizeDirection": "down",
+        "capNextAttempt": 3, "time": "2026-08-04T00:00:00.000000Z",
+        "remediations": [{"attempt": 1, "processId": 2,
+                          "policy": "replace", "node": "n-2",
+                          "time": "2026-08-04T00:00:00.000000Z"}]}
+    job.status.failures = [t.FailureRecord(
+        attempt=0, kind=t.FailureKind.PREEMPTION, reason="x",
+        time="2026-08-04T00:00:00.000000Z", resume_step=6, world_slices=8)]
+    ok, msg = schema_mod.validate_tpujob_strict(job.to_dict())
+    assert ok, msg
+    # Unknown elastic field rejected (the typo-catching contract).
+    wire = job.to_dict()
+    wire["spec"]["elastic"]["minSlice"] = 1
+    ok, msg = schema_mod.validate_tpujob_strict(wire)
+    assert not ok and "minSlice" in msg
+
+
+def test_elastic_helpers():
+    job = elastic_job(replicas=8, num_slices=8, min_slices=2)
+    set_defaults(job.spec)
+    assert elastic_mod.elastic_range(job.spec) == (2, 8)
+    eff = elastic_mod.scaled_spec(job.spec, 4)
+    assert eff.num_slices == 4
+    assert eff.replica_specs[0].replicas == 4
+    assert elastic_mod.world_workers(job.spec, 4) == 4
+    # Two workers per slice scale together.
+    wide = elastic_job(replicas=16, num_slices=8, min_slices=2)
+    set_defaults(wide.spec)
+    assert elastic_mod.scaled_spec(wide.spec, 3).replica_specs[0].replicas \
+        == 6
+    # granted == numSlices or nothing recorded → the spec applies as-is
+    assert elastic_mod.granted_slices(job.spec, None) is None
+    assert elastic_mod.granted_slices(job.spec, {"slices": 8}) is None
+    assert elastic_mod.granted_slices(job.spec, {"slices": 4}) == 4
+    # shed cap clamps the next sizing only within [lo, hi]
+    assert elastic_mod.capped_max({"capNextAttempt": 3}, 2, 8) == 3
+    assert elastic_mod.capped_max({"capNextAttempt": 1}, 2, 8) == 2
+    assert elastic_mod.capped_max({}, 2, 8) == 8
+
+
+# --- scheduler: range demand, granted accounting, resize ---------------------
+
+
+def test_admission_grants_largest_fitting_size():
+    s = FleetScheduler(SliceInventory({KEY: 6}))
+    assert s.ensure_admitted("default/el", uid="u", demand=(KEY, 8),
+                             min_slices=2)
+    # Preferred 8 does not fit; the gang shrinks to the 6 that do.
+    assert s.granted_slices("default/el") == 6
+    # Satellite (fleet.py elastic-parallelism stub): the inventory
+    # accounts the GRANTED size, not the spec's — no phantom capacity.
+    assert s.summary()["inventory"][KEY]["used"] == 6
+
+
+def test_admission_queues_below_min_and_floor_drives_impossible():
+    s = FleetScheduler(SliceInventory({KEY: 1}))
+    assert not s.ensure_admitted("default/el", uid="u", demand=(KEY, 8),
+                                 min_slices=2)
+    # The floor (2) exceeds total capacity (1): sidelined unschedulable
+    # — the preferred size (8) must not be what decides.
+    assert "2 slice(s)" in s.unschedulable_reason("default/el")
+    # A rigid 1-slice job is not blocked by the sidelined elastic head.
+    assert s.ensure_admitted("default/one", uid="u2", demand=(KEY, 1))
+
+
+def test_elastic_head_preempts_only_its_floor():
+    s = FleetScheduler(SliceInventory({KEY: 4}))
+    assert s.ensure_admitted("default/lo-a", uid="a", demand=(KEY, 2))
+    assert s.ensure_admitted("default/lo-b", uid="b", demand=(KEY, 2))
+    # Elastic high-priority head [2, 8]: needs only its floor — ONE
+    # victim frees 2 slices; evicting both for the preferred 8 would
+    # trade a running gang for capacity the head can live without.
+    assert not s.ensure_admitted("default/hi", uid="h", demand=(KEY, 8),
+                                 min_slices=2, priority=10)
+    marked = [k for k in ("default/lo-a", "default/lo-b")
+              if s.pop_eviction(k) is not None]
+    assert len(marked) == 1
+    # The pop released the victim's 2 slices; the head admits shrunk.
+    assert s.is_admitted("default/hi")
+    assert s.granted_slices("default/hi") == 2
+
+
+def test_resize_shrinks_grows_and_requeues():
+    s = FleetScheduler(SliceInventory({KEY: 8}))
+    assert s.ensure_admitted("default/el", uid="u", demand=(KEY, 8),
+                             min_slices=2)
+    assert s.granted_slices("default/el") == 8
+    # The pool shrank to 4 (honest over-commit until the resize).
+    s.update_inventory({KEY: 4})
+    assert s.resize("default/el", uid="u", min_slices=2, max_slices=8) == 4
+    assert s.summary()["inventory"][KEY]["used"] == 4
+    # Capacity returned: the next attempt re-expands to the preferred 8.
+    s.update_inventory({KEY: 8})
+    assert s.resize("default/el", uid="u", min_slices=2, max_slices=8) == 8
+    # Below the floor: the reservation releases and the job re-queues.
+    s.update_inventory({KEY: 1})
+    assert s.resize("default/el", uid="u", min_slices=2, max_slices=8) \
+        is None
+    assert not s.is_admitted("default/el")
+    assert s.summary()["inventory"][KEY]["used"] == 0
+
+
+def test_resize_shrink_wakes_queued_jobs():
+    wakes = []
+    s = FleetScheduler(SliceInventory({KEY: 8}),
+                       enqueue=wakes.append)
+    assert s.ensure_admitted("default/el", uid="u", demand=(KEY, 8),
+                             min_slices=2)
+    assert not s.ensure_admitted("default/waiter", uid="w",
+                                 demand=(KEY, 3))
+    # el re-sizes down to its floor: the freed 6 slices admit the waiter
+    # without any external release.
+    assert s.resize("default/el", uid="u", min_slices=2, max_slices=2) == 2
+    assert s.is_admitted("default/waiter")
+    assert "default/waiter" in wakes
+
+
+# --- TrainingJob: sizing, env regeneration, ledger ---------------------------
+
+
+def fleet_tj(job, scheduler, metrics=None, cs=None):
+    from tpu_operator.controller.events import EventRecorder
+
+    cs = cs or FakeClientset()
+    try:
+        cs.tpujobs.get(job.namespace, job.name)
+    except Exception:
+        cs.tpujobs.create(job.namespace, job.to_dict())
+    tj = TrainingJob(cs, EventRecorder(cs), job, scheduler=scheduler,
+                     metrics=metrics)
+    return cs, tj
+
+
+def test_fresh_elastic_job_gangs_at_granted_size():
+    metrics = Metrics()
+    s = FleetScheduler(SliceInventory({KEY: 4}), metrics=metrics)
+    cs, tj = fleet_tj(elastic_job(replicas=8, num_slices=8, min_slices=2),
+                      s, metrics=metrics)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    pods = cs.pods.list("default")
+    assert len(pods) == 4  # 8 spec'd, 4 granted: one worker per slice
+    el = tj.job.status.elastic
+    assert el["slices"] == 4 and el["workers"] == 4 and el["attempt"] == 0
+    # A first sizing is not a resize.
+    assert el["resizes"] == 0
+    envs = pod_env(sorted(pods,
+                          key=lambda p: p["metadata"]["name"])[0])
+    assert envs["JAX_NUM_PROCESSES"] == "4"
+    assert envs["MEGASCALE_NUM_SLICES"] == "4"
+    assert metrics.counter_value("job_world_size",
+                                 labels={"namespace": "default",
+                                         "name": "el"}) == 4
+
+
+def test_restart_resizes_down_then_reexpands_with_ledger_world():
+    metrics = Metrics()
+    s = FleetScheduler(SliceInventory({KEY: 8}), metrics=metrics)
+    cs, tj = fleet_tj(elastic_job(), s, metrics=metrics)
+    tj.reconcile()
+    assert len(cs.pods.list("default")) == 8
+    mark_pods(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+
+    # Preempted while the inventory shrinks to 4.
+    s.update_inventory({KEY: 4})
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}})
+    tj.reconcile()   # teardown, attempt bump
+    tj.reconcile()   # size + re-gang
+    el = tj.job.status.elastic
+    assert el["slices"] == 4 and el["attempt"] == 1
+    assert el["resizes"] == 1 and el["lastResizeDirection"] == "down"
+    assert len(live_pods(cs)) == 4
+    envs = pod_env(live_pods(cs)[0])
+    assert envs["JAX_NUM_PROCESSES"] == "4"
+    assert len(envs["TPU_WORKER_HOSTNAMES"].split(",")) == 1
+    assert metrics.counter_value("job_elastic_resizes_total",
+                                 labels={"direction": "down"}) == 1
+    # Satellite: the ledger records the failed attempt's world size
+    # NEXT TO its resume step — auditable from one record.
+    rec = tj.job.status.failures[-1]
+    assert rec.kind == t.FailureKind.PREEMPTION
+    assert rec.world_slices == 8
+    events = [e["reason"] for e in cs.events.list("default")]
+    assert "ElasticResized" in events
+
+    # Capacity returns: the next restart re-expands to the full spec.
+    s.update_inventory({KEY: 8})
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}},
+              only_live=True)
+    tj.reconcile()
+    tj.reconcile()
+    el = tj.job.status.elastic
+    assert el["slices"] == 8 and el["lastResizeDirection"] == "up"
+    assert el["resizes"] == 2
+    assert metrics.counter_value("job_elastic_resizes_total",
+                                 labels={"direction": "up"}) == 1
+    assert tj.job.status.failures[-1].world_slices == 4
+
+
+def test_resize_below_min_parks_queued_until_capacity_returns():
+    s = FleetScheduler(SliceInventory({KEY: 8}))
+    cs, tj = fleet_tj(elastic_job(min_slices=2), s)
+    tj.reconcile()
+    mark_pods(cs)
+    tj.reconcile()
+    # The pool collapses below the floor while the gang is preempted.
+    s.update_inventory({KEY: 1})
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}})
+    tj.reconcile()
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.QUEUED
+    assert live_pods(cs) == []
+    # Capacity returns: the next reconcile admits and gangs shrunk.
+    s.update_inventory({KEY: 2})
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.CREATING
+    assert tj.job.status.elastic["slices"] == 2
+    assert len(live_pods(cs)) == 2
+
+
+def test_rebuild_reaccounts_granted_not_spec_size():
+    """Operator restart: the eager rebuild re-reserves what the
+    persisted status.elastic says the gang holds (4), never the spec's
+    8 — phantom capacity would starve the rest of the pool."""
+    s1 = FleetScheduler(SliceInventory({KEY: 4}))
+    cs, tj = fleet_tj(elastic_job(min_slices=2), s1)
+    tj.reconcile()
+    mark_pods(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.elastic["slices"] == 4
+
+    factory = SharedInformerFactory(cs, resync_period=0)
+    config = t.ControllerConfig(slice_inventory={KEY: 8})
+    controller = Controller(cs, factory, config)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(1, stop),
+                              daemon=True)
+    runner.start()
+    try:
+        assert wait_for(
+            lambda: controller.scheduler.is_admitted("default/el"))
+        assert controller.scheduler.granted_slices("default/el") == 4
+        assert controller.scheduler.summary()["inventory"][KEY]["used"] == 4
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)
+
+
+def test_shrunk_gang_teardown_deletes_all_services():
+    """Explicit delete of a gang running SHRUNK must remove the services
+    its full-width attempt created: index enumeration over the effective
+    (4-wide) world would leak services 4..7 forever."""
+    s = FleetScheduler(SliceInventory({KEY: 8}))
+    cs, tj = fleet_tj(elastic_job(min_slices=2), s)
+    tj.reconcile()
+    assert len(cs.services.list("default")) == 8 + 1  # per-index + headless
+    mark_pods(cs)
+    tj.reconcile()
+    # Preempted while the pool shrinks: re-gang at 4.
+    s.update_inventory({KEY: 4})
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}})
+    tj.reconcile()
+    tj.reconcile()
+    assert tj.job.status.elastic["slices"] == 4
+    tj.delete()
+    assert cs.services.list("default") == []
+    assert live_pods(cs) == []
+
+
+# --- straggler remediation ---------------------------------------------------
+
+
+def remediation_harness(policy, patience=5, replicas=4, num_slices=4,
+                        min_slices=1, capacity=8):
+    now = [1000.0]
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=0.0,
+                            wall_clock=lambda: now[0])
+    controller.scheduler.update_inventory({KEY: capacity})
+    job = elastic_job("rem", replicas=replicas, num_slices=num_slices,
+                      min_slices=min_slices, policy=policy,
+                      patience=patience)
+    cs.tpujobs.create("default", job.to_dict())
+    tj = TrainingJob(cs, controller.recorder, job,
+                     metrics=controller.metrics,
+                     scheduler=controller.scheduler)
+    controller.jobs["default/rem"] = tj
+    tj.reconcile()
+    for pod in cs.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        pod["spec"]["nodeName"] = \
+            f"node-{pod['metadata']['labels']['task_index']}"
+        cs.pods.update("default", pod)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+
+    def beat(pid, local_p95):
+        return controller.record_heartbeat("default", "rem", {
+            "time": "2026-08-04T00:00:00.000000Z", "step": 50,
+            "attempt": tj.job.status.attempt, "processId": pid,
+            "stepTiming": {"steps": 10, "stepLocalP95Seconds": local_p95,
+                           "stepP95Seconds": 1.0}})
+
+    return cs, controller, tj, now, beat
+
+
+def test_replace_swaps_flagged_member_without_budget():
+    cs, controller, tj, now, beat = remediation_harness("replace")
+    n = tj.job_spec.replica_specs[0].replicas
+    for pid in range(n):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert [s["processId"] for s in tj.job.status.stragglers] == [2]
+    # Flagged but the patience window has not elapsed: nothing pending.
+    assert tj._pending_remediation is None
+    now[0] += 6.0
+    for pid in range(n):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is not None
+
+    before = {p["metadata"]["name"] for p in cs.pods.list("default")}
+    tj.reconcile()   # executes the replace: straggler pod deleted
+    assert len(cs.pods.list("default")) == n - 1
+    tj.reconcile()   # gang sync re-creates the member
+    pods = cs.pods.list("default")
+    assert len(pods) == n
+    (new_pod,) = [p for p in pods if p["metadata"]["name"] not in before]
+    envs = pod_env(new_pod)
+    # Same rendezvous slot: same process id, same coordinator address.
+    assert envs["JAX_PROCESS_ID"] == "2"
+    terms = (new_pod["spec"]["affinity"]["nodeAffinity"]
+             ["requiredDuringSchedulingIgnoredDuringExecution"]
+             ["nodeSelectorTerms"])
+    assert terms[0]["matchExpressions"][0] == {
+        "key": "kubernetes.io/hostname", "operator": "NotIn",
+        "values": ["node-2"]}
+    # No budget consumed, no attempt bump, no ledger entry.
+    assert tj.job.status.restart_counts == {}
+    assert tj.job.status.attempt == 0
+    assert tj.job.status.failures == []
+    trail = tj.job.status.elastic["remediations"]
+    assert trail[-1]["policy"] == "replace" and trail[-1]["node"] == "node-2"
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total",
+        labels={"policy": "replace"}) == 1
+    assert "StragglerReplaced" in [e["reason"]
+                                   for e in cs.events.list("default")]
+
+
+def test_replace_fires_once_per_attempt_and_flag():
+    cs, controller, tj, now, beat = remediation_harness("replace")
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    now[0] += 6.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    tj.reconcile()
+    tj.reconcile()
+    # More flagged beats for the SAME process: already remediated this
+    # attempt — no second replace, the replacement earns its own window.
+    now[0] += 30.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is None
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total",
+        labels={"policy": "replace"}) == 1
+
+
+def test_shed_restarts_one_slice_smaller_on_preemption_budget():
+    cs, controller, tj, now, beat = remediation_harness("shed")
+    for pid in range(4):
+        beat(pid, 0.99 if pid == 1 else 0.1)
+    now[0] += 6.0
+    for pid in range(4):
+        beat(pid, 0.99 if pid == 1 else 0.1)
+    tj.reconcile()   # shed: teardown billed preemption + cap recorded
+    assert tj.job.status.attempt == 1
+    assert tj.job.status.restart_counts == \
+        {t.FailureKind.PREEMPTION: 1}
+    rec = tj.job.status.failures[-1]
+    assert rec.reason.startswith("StragglerShed")
+    assert rec.world_slices == 4
+    assert tj.job.status.elastic["capNextAttempt"] == 3
+    tj.reconcile()   # re-gang one slice smaller
+    el = tj.job.status.elastic
+    assert el["slices"] == 3 and el["lastResizeDirection"] == "down"
+    assert "capNextAttempt" not in el   # one-attempt cap, consumed
+    assert len(live_pods(cs)) == 3
+    assert controller.scheduler.granted_slices("default/rem") == 3
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total", labels={"policy": "shed"}) == 1
+
+
+def test_shed_at_floor_replaces_instead():
+    cs, controller, tj, now, beat = remediation_harness(
+        "shed", replicas=2, num_slices=2, min_slices=2)
+    for pid in range(2):
+        beat(pid, 0.9 if pid == 1 else 0.1)
+    # A 2-member gang's even median needs a sensitive threshold; drive
+    # the flag via a direct request instead of cadence statistics.
+    tj.request_remediation(1, t.StragglerPolicy.SHED,
+                           tj.job.status.attempt)
+    tj.reconcile()
+    # No slice to shed (already at minSlices): the member is replaced.
+    assert tj.job.status.attempt == 0
+    assert tj.job.status.restart_counts == {}
+    assert len(cs.pods.list("default")) == 1
+    trail = tj.job.status.elastic["remediations"]
+    assert trail[-1]["policy"] == "replace"
+
+
+def test_cleared_flag_resets_patience_window_even_when_gang_shrinks():
+    """A flag that clears via the detector's EMPTY evaluation paths
+    (the flagged member's cadence expired, procs dropped below 2) must
+    reset the patience window too: a later one-beat re-flag within the
+    same attempt starts a fresh window instead of firing an instant
+    remediation off the stale one."""
+    cs, controller, tj, now, beat = remediation_harness("replace",
+                                                        patience=100)
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert [s["processId"] for s in tj.job.status.stragglers] == [2]
+    # Everyone but process 0 stops posting; past the cadence expiry the
+    # next beat prunes the map below 2 — the empty evaluation clears
+    # the flag AND (the fix) the tracker's window.
+    now[0] += 400.0
+    beat(0, 0.1)
+    assert tj.job.status.stragglers == []
+    # Fresh flagged round, 400 s after the ORIGINAL first flag: without
+    # the window reset this would be instantly "due".
+    now[0] += 1.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is None
+    # The new window elapses normally: now it is due.
+    now[0] += 101.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is not None
+
+
+def test_failed_replace_delete_rearms_remediation():
+    """A transient API error on the straggler pod's delete must not
+    consume the once-per-attempt remediation: the tracker re-arms and
+    the next flagged beat re-issues it (the window already elapsed)."""
+    from tpu_operator.client import errors as client_errors
+
+    cs, controller, tj, now, beat = remediation_harness("replace")
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    now[0] += 6.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is not None
+
+    real_delete = cs.pods.delete
+    fails = []
+
+    def flaky_delete(ns, name, *a, **kw):
+        fails.append(name)
+        raise client_errors.ApiError(500, message="etcd hiccup")
+
+    cs.pods.delete = flaky_delete
+    tj.reconcile()          # the delete fails; remediation re-armed
+    cs.pods.delete = real_delete
+    assert fails
+    assert len(cs.pods.list("default")) == 4     # nothing deleted
+    # A failed delete must not leave a stale node exclusion behind.
+    assert tj.excluded_node("WORKER", 2) is None
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total",
+        labels={"policy": "replace"}) == 0
+    # Next flagged beat: due again immediately (window already served).
+    now[0] += 1.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    assert tj._pending_remediation is not None
+    tj.reconcile()
+    assert len(cs.pods.list("default")) == 3     # replaced this time
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total",
+        labels={"policy": "replace"}) == 1
+
+
+def test_no_remediation_when_policy_none():
+    cs, controller, tj, now, beat = remediation_harness(
+        t.StragglerPolicy.NONE, patience=1)
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    now[0] += 600.0
+    for pid in range(4):
+        beat(pid, 0.5 if pid == 2 else 0.1)
+    # Flagged (detector unchanged) but never handed to the reconcile.
+    assert [s["processId"] for s in tj.job.status.stragglers] == [2]
+    assert tj._pending_remediation is None
+
+
+def test_remediation_tracker_window_resets_on_unflag_and_attempt():
+    tr = elastic_mod.RemediationTracker()
+    assert tr.observe("j", 0, {2}, 100.0, 30.0) == []
+    # Still flagged at +29: not yet due; at +30: due exactly once.
+    assert tr.observe("j", 0, {2}, 129.0, 30.0) == []
+    assert tr.observe("j", 0, {2}, 130.0, 30.0) == [2]
+    assert tr.observe("j", 0, {2}, 200.0, 30.0) == []
+    # A flag that CLEARS resets the clock for a later re-flag.
+    assert tr.observe("j", 0, {2, 3}, 210.0, 30.0) == []
+    assert tr.observe("j", 0, {2}, 230.0, 30.0) == []       # 3 unflagged
+    assert tr.observe("j", 0, {2, 3}, 240.0, 30.0) == []    # 3 re-flagged
+    assert tr.observe("j", 0, {2, 3}, 269.0, 30.0) == []
+    assert tr.observe("j", 0, {2, 3}, 270.0, 30.0) == [3]
+    # New attempt: everything (done-marks included) starts fresh.
+    assert tr.observe("j", 1, {2}, 300.0, 30.0) == []
+    assert tr.observe("j", 1, {2}, 330.0, 30.0) == [2]
+    tr.forget("j")
+    assert tr.observe("j", 1, {2}, 400.0, 30.0) == []
+
+
+# --- reshard-restore through the remote store --------------------------------
+
+
+def test_resized_gang_reshard_restores_via_remote_store(tmp_path):
+    """The donor snapshot reaches the resized gang through the remote
+    store: a checkpoint saved (and write-behind uploaded) by an 8-device
+    mesh is prefetched into a FRESH local dir — the fresh-node landing
+    of a resized gang — and restores onto a 4-device mesh."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_operator.payload import checkpoint, models, train, warmstore
+    from tpu_operator.store import WarmStartStore, blob, writebehind
+
+    def build(ndev):
+        mesh = train.make_mesh(ndev)
+        model = models.LinearRegressor()
+        tx = optax.sgd(0.1)
+        sample = jnp.zeros((8, 8), jnp.float32)
+        state = train.create_train_state(model, jax.random.key(0), sample,
+                                         tx)
+        return mesh, train.place_state(mesh, state)
+
+    backend = blob.from_uri("fake://elastic-reshard")
+    store = WarmStartStore(backend, prefix="default/el")
+    uploader = writebehind.WriteBehindUploader(store)
+
+    mesh8, state8 = build(8)
+    state8 = state8.replace(step=jnp.int32(6))
+    donor = checkpoint.Checkpointer(str(tmp_path / "donor"), save_every=1,
+                                    uploader=uploader)
+    assert donor.maybe_save(6, state8)
+    donor.close()   # drains the write-behind upload
+
+    # Fresh node of the shrunken gang: empty local dir, warm store.
+    fresh = tmp_path / "fresh"
+    prefetched = warmstore.store_from_env({
+        "TPUJOB_STORE_URI": "fake://elastic-reshard",
+        "TPUJOB_NAMESPACE": "default", "TPUJOB_NAME": "el"})
+    step, fallbacks = prefetched.prefetch_checkpoint(str(fresh))
+    assert step == 6 and fallbacks == 0
+
+    mesh4, state4 = build(4)
+    ck = checkpoint.Checkpointer(str(fresh), save_every=100)
+    restored, start = ck.restore(state4)
+    ck.close()
+    assert start == 6
+    assert int(restored.step) == 6
+    # Every leaf landed on the LIVE (4-device) mesh's shardings.
+    leaf = restored.params["linear"]["kernel"]
+    assert leaf.sharding.mesh.shape["data"] == 4
+
+
+# --- e2e over the in-process apiserver ---------------------------------------
+
+
+@pytest.fixture()
+def harness():
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    config = t.ControllerConfig(slice_inventory={KEY: 8})
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            config, heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop),
+                          daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def phase_of(cs, name):
+    return (cs.tpujobs.get("default", name).get("status") or {}) \
+        .get("phase")
+
+
+def test_e2e_preemption_with_shrunken_inventory_gangs_at_4(harness):
+    """Acceptance: a Running 8-slice elastic job is preempted while the
+    inventory shrinks to 4 → the next attempt gangs at 4, reaches Done
+    with status.elastic showing the resize and the down-direction
+    resize counter ticked. (The payload half — the checkpoint saved at
+    8 reshard-restoring through the remote store — is proven in
+    test_resized_gang_reshard_restores_via_remote_store and the
+    durability matrix.)"""
+    api, cs, controller, _server = harness
+    job = elastic_job("grow", min_slices=2)
+    cs.tpujobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) == 8)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: phase_of(cs, "grow") == "Running")
+
+    # The node pool shrinks to 4 slices; the gang is then preempted.
+    controller.scheduler.update_inventory({KEY: 4})
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Failed", "containerStatuses": [
+            {"name": "tpu", "state": {"terminated": {"exitCode": 137}}}]}
+        api.clientset.pods.update("default", pod)
+
+    def attempt1_live():
+        return [p for p in api.clientset.pods.list("default")
+                if (p.get("status") or {}).get("phase")
+                not in ("Failed", "Succeeded")]
+
+    assert wait_for(lambda: len(attempt1_live()) == 4,
+                    describe=lambda: cs.tpujobs.get("default",
+                                                    "grow")["status"])
+    status = cs.tpujobs.get("default", "grow")["status"]
+    assert status["elastic"]["slices"] == 4
+    assert status["elastic"]["lastResizeDirection"] == "down"
+    assert status["failures"][-1]["worldSlices"] == 8
+    envs = pod_env(attempt1_live()[0])
+    assert envs["JAX_NUM_PROCESSES"] == "4"
+    assert controller.metrics.counter_value(
+        "job_elastic_resizes_total", labels={"direction": "down"}) == 1
+
+    # The shrunk gang finishes: Done, never Queued.
+    for pod in attempt1_live():
+        pod["status"] = {"phase": "Succeeded", "containerStatuses": [
+            {"name": "tpu", "state": {"terminated": {"exitCode": 0}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: phase_of(cs, "grow") == "Done")
+    # describe prints the elastic state + the per-attempt world sizes.
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--master", api.url, "describe", "grow"])
+    assert rc == 0
+    text = out.getvalue()
+    assert "Elastic:" in text and "4/8 slices" in text
+    assert "world 8" in text
+
+
+def test_e2e_straggler_replace_preserves_restart_budget(harness):
+    """Acceptance sibling: stragglerPolicy: replace swaps a persistently
+    flagged member over the full controller loop — heartbeats through
+    the real status server, pod deleted and re-created into the same
+    rendezvous — without consuming crash-loop restart budget."""
+    api, cs, controller, server = harness
+    job = elastic_job("swap", replicas=4, num_slices=4, min_slices=1,
+                      policy="replace", patience=1)
+    cs.tpujobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) == 4)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        pod["spec"]["nodeName"] = \
+            f"node-{pod['metadata']['labels']['task_index']}"
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: phase_of(cs, "swap") == "Running")
+    before = {p["metadata"]["name"]
+              for p in api.clientset.pods.list("default")}
+
+    env = {"TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+           "TPUJOB_NAME": "swap", "TPUJOB_NAMESPACE": "default",
+           "TPUJOB_ATTEMPT": "0"}
+
+    def post_round(step):
+        for pid in range(4):
+            reporter = heartbeat_mod.from_env(
+                {**env, "JAX_PROCESS_ID": str(pid)})
+            digest = {"steps": 20, "stepP95Seconds": 1.0,
+                      "stepLocalP95Seconds": 0.5 if pid == 2 else 0.1}
+            assert reporter.report(step, {"loss": 2.0},
+                                   steptiming=digest)
+
+    post_round(100)
+    assert wait_for(lambda: [s.get("processId") for s in
+                             (cs.tpujobs.get("default", "swap")["status"]
+                              .get("stragglers") or [])] == [2])
+    import time as time_mod
+    time_mod.sleep(1.2)   # the patience window (1 s) elapses flagged
+    post_round(120)
+
+    # The flagged member's pod is deleted and re-created; the gang never
+    # restarts (attempt stays 0, no budget spent).
+    assert wait_for(lambda: {
+        p["metadata"]["name"]
+        for p in api.clientset.pods.list("default")} != before
+        and len(api.clientset.pods.list("default")) == 4,
+        describe=lambda: sorted(
+            p["metadata"]["name"]
+            for p in api.clientset.pods.list("default")))
+    (new_pod,) = [p for p in api.clientset.pods.list("default")
+                  if p["metadata"]["name"] not in before]
+    envs = pod_env(new_pod)
+    assert envs["JAX_PROCESS_ID"] == "2"
+    terms = (new_pod["spec"]["affinity"]["nodeAffinity"]
+             ["requiredDuringSchedulingIgnoredDuringExecution"]
+             ["nodeSelectorTerms"])
+    assert {"key": "kubernetes.io/hostname", "operator": "NotIn",
+            "values": ["node-2"]} in terms[0]["matchExpressions"]
+    status = cs.tpujobs.get("default", "swap")["status"]
+    assert status["attempt"] == 0
+    assert status.get("restartCounts") is None \
+        or status["restartCounts"] == {}
+    assert (status["elastic"]["remediations"][-1]["policy"]
+            == "replace")
+    events = [e for e in cs.events.list("default")
+              if e.get("reason") == "StragglerReplaced"]
+    assert events and "process 2" in events[0]["message"]
+    assert controller.metrics.counter_value(
+        "job_straggler_remediations_total",
+        labels={"policy": "replace"}) == 1
+
+
+# --- tpujobctl surfacing -----------------------------------------------------
+
+
+def test_describe_shows_elastic_state():
+    with ApiServerHarness() as srv:
+        cs = Clientset(RestConfig(host=srv.url, timeout=5.0))
+        job = elastic_job("shape", min_slices=2, policy="shed")
+        set_defaults(job.spec)
+        job.status.phase = t.TPUJobPhase.RUNNING
+        job.status.elastic = {
+            "slices": 4, "workers": 4, "minSlices": 2, "maxSlices": 8,
+            "attempt": 2, "resizes": 2, "lastResizeDirection": "down",
+            "time": "2026-08-04T00:00:00.000000Z",
+            "remediations": [{"attempt": 1, "processId": 3,
+                              "policy": "shed",
+                              "time": "2026-08-04T00:00:00.000000Z"}]}
+        job.status.failures = [
+            t.FailureRecord(attempt=0, kind=t.FailureKind.PREEMPTION,
+                            reason="slice preempted",
+                            time="2026-08-04T00:00:00Z", resume_step=6,
+                            world_slices=8),
+            t.FailureRecord(attempt=1, kind=t.FailureKind.PREEMPTION,
+                            reason="StragglerShed: process 3",
+                            time="2026-08-04T00:01:00Z", resume_step=8,
+                            world_slices=5)]
+        cs.tpujobs.create("default", job.to_dict())
+
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = ctl.main(["--master", srv.url, "describe", "shape"])
+        text = out.getvalue()
+    assert rc == 0
+    assert "Elastic:    4/8 slices" in text
+    assert "range 2-8" in text
+    assert "resizes 2" in text and "policy shed" in text
+    assert "Remediated: attempt 1: shed process 3" in text
+    # Each ledger line carries world size AND resume step together.
+    assert "resume@6" in text and "world 8" in text
+    assert "resume@8" in text and "world 5" in text

@@ -92,6 +92,15 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
     if spec.scheduling is not None and not spec.scheduling.queue:
         spec.scheduling.queue = DEFAULT_SCHEDULING_QUEUE
 
+    # Elastic gangs: the block stays opt-in (None = rigid sizing). A
+    # present block fills only the UNSET maxSlices from numSlices — the
+    # spec'd size is the most the worker pods provision processes for,
+    # so the range can shrink from it but never grow past it. An
+    # explicitly written bad minSlices/maxSlices/policy reaches
+    # validation.py and fails loudly (the uploadParallelism lesson).
+    if spec.elastic is not None and not spec.elastic.max_slices:
+        spec.elastic.max_slices = max(1, spec.num_slices)
+
     # Warm-restart compilation cache: the block stays opt-in (None = off),
     # but a present block fills its unset fields — ``compilationCache: {}``
     # means "the default cache": enabled, hostPath, the standard path.

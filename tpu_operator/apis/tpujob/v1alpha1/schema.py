@@ -146,6 +146,16 @@ def spec_schema() -> Dict[str, Any]:
             "bufferSteps": _int(minimum=8),
             "stragglerRatio": _num(minimum=1),
         }),
+        # Elastic gangs: each attempt's world size is picked from the
+        # live slice inventory within [minSlices, maxSlices] (maxSlices
+        # 0 = defaulted to numSlices), and persistently flagged
+        # stragglers are replaced or shed per stragglerPolicy.
+        "elastic": _obj({
+            "minSlices": _int(minimum=1),
+            "maxSlices": _int(minimum=0),
+            "stragglerPolicy": _str(enum=list(types.StragglerPolicy.ALL)),
+            "stragglerPatienceSeconds": _int(minimum=1),
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -297,6 +307,28 @@ def status_schema() -> Dict[str, Any]:
             "step": _int(minimum=0),
             "time": _str(),
         })),
+        # Elastic-gang state: the attempt's granted world size, the
+        # effective range, resize accounting, the one-attempt shed cap,
+        # and the bounded straggler-remediation audit trail.
+        "elastic": _obj({
+            "slices": _int(minimum=1),
+            "workers": _int(minimum=1),
+            "minSlices": _int(minimum=1),
+            "maxSlices": _int(minimum=1),
+            "attempt": _int(minimum=0),
+            "resizes": _int(minimum=0),
+            "lastResizeDirection": _str(enum=["up", "down"]),
+            "capNextAttempt": _int(minimum=1),
+            "time": _str(),
+            "remediations": _arr(_obj({
+                "attempt": _int(minimum=0),
+                "processId": _int(minimum=0),
+                "policy": _str(enum=[types.StragglerPolicy.REPLACE,
+                                     types.StragglerPolicy.SHED]),
+                "node": _str(),
+                "time": _str(),
+            })),
+        }),
         # Fleet-scheduling state: effective queue/priority, and — while
         # phase is Queued — the admission-order position (0 = next).
         "scheduling": _obj({
@@ -318,6 +350,9 @@ def status_schema() -> Dict[str, Any]:
             # Last durable checkpoint step known when the restart was
             # recorded — what the next attempt resumed from.
             "resumeStep": _int(minimum=0),
+            # World size (slices) the failed attempt ran at (elastic
+            # jobs): size and resume step are auditable together.
+            "worldSlices": _int(minimum=1),
         })),
         # Lifetime failure counters by kind (retry budgets charge these).
         "restartCounts": {

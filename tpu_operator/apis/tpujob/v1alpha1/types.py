@@ -222,6 +222,41 @@ DEFAULT_STEPTRACE_BUFFER = 512
 DEFAULT_STRAGGLER_RATIO = 2.0
 
 
+# --- Elastic gangs (inventory-sized attempts + straggler remediation) --------
+
+class StragglerPolicy:
+    """What the operator does when ``status.stragglers`` flags the same
+    (attempt, process) past ``spec.elastic.stragglerPatienceSeconds``.
+
+    NONE keeps the PR-9 behavior: flag, event, gauge — a human decides.
+    REPLACE deletes the flagged member's pod (recording its node so the
+    replacement avoids it) and re-creates the member into the SAME
+    rendezvous under the same attempt — no restart budget is spent.
+    SHED triggers a whole-group restart at the current world size minus
+    one slice, billed to the preemption budget (never the crash-loop
+    budget): a persistently slow host caps goodput harder than a
+    slightly smaller gang does.
+    """
+
+    NONE = "none"
+    REPLACE = "replace"
+    SHED = "shed"
+
+    ALL = (NONE, REPLACE, SHED)
+
+
+# How long the SAME (attempt, process) must stay flagged in
+# status.stragglers before a non-none stragglerPolicy acts on it — long
+# enough that a transient host hiccup (GC pause, log rotation) never
+# costs a pod.
+DEFAULT_STRAGGLER_PATIENCE = 300
+
+# Bound on retained status.elastic.remediations entries (newest kept) —
+# an audit trail, not an unbounded event log (the FAILURE_LEDGER_CAP
+# discipline).
+ELASTIC_REMEDIATION_CAP = 16
+
+
 # --- Fleet scheduling (admission queue + priority preemption) ----------------
 
 # Fair-share queue a job lands in when spec.scheduling names none.
@@ -467,6 +502,59 @@ class SchedulingSpec:
 
 
 @dataclass
+class ElasticSpec:
+    """Elastic gang sizing (``spec.elastic``).
+
+    A non-elastic job's world size is immutable: a restart re-gangs
+    exactly ``spec.numSlices`` slices or parks in Queued — a shrunken
+    slice pool turns a recoverable preemption into indefinite queue
+    wait. With this block, each gang (re)create asks the fleet scheduler
+    for the LARGEST admissible world size in ``[minSlices, maxSlices]``
+    from the live inventory — preferring ``maxSlices``, shrinking
+    instead of queueing, and re-expanding on a later restart when
+    capacity returns. ``maxSlices`` defaults to ``spec.numSlices`` (the
+    worker template provisions one slice's worth of processes per
+    ``numSlices`` unit, so the range can only shrink from the spec'd
+    size, never grow past it). The chosen size per attempt is recorded
+    in ``status.elastic`` and the failure ledger; env injection
+    (``TPU_WORKER_HOSTNAMES``, ``JAX_NUM_PROCESSES``, ``MEGASCALE_*``)
+    regenerates for the attempt's ACTUAL size. Checkpoints reshard
+    across sizes on restore (payload/checkpoint.py).
+
+    ``stragglerPolicy``/``stragglerPatienceSeconds``: see
+    :class:`StragglerPolicy` — what to do about a member that
+    ``status.stragglers`` keeps flagging.
+    """
+
+    min_slices: int = 1
+    # 0 = unset → defaulted to spec.numSlices (set_defaults).
+    max_slices: int = 0
+    straggler_policy: str = StragglerPolicy.NONE
+    straggler_patience_seconds: int = DEFAULT_STRAGGLER_PATIENCE
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"minSlices": self.min_slices,
+                "maxSlices": self.max_slices,
+                "stragglerPolicy": self.straggler_policy,
+                "stragglerPatienceSeconds": self.straggler_patience_seconds}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["ElasticSpec"]:
+        if d is None:
+            return None
+        return cls(
+            min_slices=int(d.get("minSlices", 1)),
+            max_slices=int(d.get("maxSlices", 0)),
+            straggler_policy=str(d.get("stragglerPolicy",
+                                       StragglerPolicy.NONE)),
+            straggler_patience_seconds=int(
+                d.get("stragglerPatienceSeconds",
+                      DEFAULT_STRAGGLER_PATIENCE)),
+        )
+
+
+@dataclass
 class TPUReplicaSpec:
     """One replica set: N pods of one role (ref: types.go:93-104).
 
@@ -572,6 +660,12 @@ class TPUJobSpec:
     # straggler threshold (None = the defaults — recorder on, ratio 2.0;
     # kept absent so specs round-trip unchanged).
     step_trace: Optional[StepTraceSpec] = None
+    # Elastic gangs: each attempt's world size is picked from the live
+    # slice inventory within [minSlices, maxSlices] instead of being
+    # pinned to numSlices, and persistently flagged stragglers are
+    # replaced or shed per stragglerPolicy (None = rigid sizing, the
+    # pre-elastic behavior).
+    elastic: Optional[ElasticSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -612,6 +706,8 @@ class TPUJobSpec:
             d["store"] = self.store.to_dict()
         if self.step_trace is not None:
             d["stepTrace"] = self.step_trace.to_dict()
+        if self.elastic is not None:
+            d["elastic"] = self.elastic.to_dict()
         return d
 
     @classmethod
@@ -641,6 +737,7 @@ class TPUJobSpec:
             scheduling=SchedulingSpec.from_dict(d.get("scheduling")),
             store=StoreSpec.from_dict(d.get("store")),
             step_trace=StepTraceSpec.from_dict(d.get("stepTrace")),
+            elastic=ElasticSpec.from_dict(d.get("elastic")),
         )
 
 
@@ -686,12 +783,19 @@ class FailureRecord:
     # the step the next attempt resumes from (None: job never reported
     # checkpoint state; the postmortem then knows the restart was cold).
     resume_step: Optional[int] = None
+    # World size (whole slices) the failed attempt ran at — recorded for
+    # elastic jobs so a post-resize restart is auditable: which size ran
+    # and which step it resumed from live in ONE record (None: rigid
+    # job, the size is always spec.numSlices).
+    world_slices: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"attempt": self.attempt, "kind": self.kind,
              "reason": self.reason, "time": self.time}
         if self.resume_step is not None:
             d["resumeStep"] = self.resume_step
+        if self.world_slices is not None:
+            d["worldSlices"] = self.world_slices
         return d
 
     @classmethod
@@ -703,6 +807,8 @@ class FailureRecord:
             time=str(d.get("time", "")),
             resume_step=(int(d["resumeStep"])
                          if d.get("resumeStep") is not None else None),
+            world_slices=(int(d["worldSlices"])
+                          if d.get("worldSlices") is not None else None),
         )
 
 
@@ -765,6 +871,12 @@ class TPUJobStatus:
     # (empty/absent = gang healthy). Each entry: {processId, p95Seconds,
     # gangMedianSeconds, ratio, step, time}.
     stragglers: List[Dict[str, Any]] = field(default_factory=list)
+    # Elastic-gang state, written by the controller per attempt: the
+    # granted world size ({slices, workers}), the effective range, a
+    # lifetime resize counter + last direction, the one-attempt shed cap
+    # (capNextAttempt, consumed at the next sizing), and the bounded
+    # straggler-remediation audit trail.
+    elastic: Optional[Dict[str, Any]] = None
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -814,6 +926,8 @@ class TPUJobStatus:
             d["stepTiming"] = dict(self.step_timing)
         if self.stragglers:
             d["stragglers"] = [dict(s) for s in self.stragglers]
+        if self.elastic:
+            d["elastic"] = dict(self.elastic)
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -853,6 +967,7 @@ class TPUJobStatus:
             step_timing=(dict(d["stepTiming"])
                          if d.get("stepTiming") else None),
             stragglers=[dict(s) for s in d.get("stragglers", [])],
+            elastic=(dict(d["elastic"]) if d.get("elastic") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),

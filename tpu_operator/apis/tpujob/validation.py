@@ -24,6 +24,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     CacheMedium,
     RestartPolicy,
     StoreBackend,
+    StragglerPolicy,
     TPUJobSpec,
     TPUReplicaType,
 )
@@ -188,6 +189,55 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
             raise ValidationError(
                 "stepTrace.stragglerRatio must be >= 1.0 (below the gang "
                 "median, every healthy member would be flagged)"
+            )
+
+    # Elastic gangs: the sizing range must be a usable sub-range of the
+    # spec'd world — the worker template provisions one slice's worth of
+    # processes per numSlices unit, so an attempt can gang at FEWER
+    # slices than spec'd (scaling the worker count down evenly) but a
+    # maxSlices past numSlices would demand processes the template never
+    # provisioned. Whole-group restart semantics are required: a PerPod
+    # job has no gang boundary at which a resize could be consistent.
+    el = spec.elastic
+    if el is not None:
+        if el.min_slices < 1:
+            raise ValidationError("elastic.minSlices must be >= 1")
+        if el.max_slices < el.min_slices:
+            raise ValidationError(
+                "elastic.maxSlices must be >= minSlices"
+            )
+        if el.max_slices > spec.num_slices:
+            raise ValidationError(
+                f"elastic.maxSlices ({el.max_slices}) must be <= numSlices "
+                f"({spec.num_slices}): the worker template provisions "
+                f"processes for at most numSlices slices"
+            )
+        if spec.restart_policy and \
+                spec.restart_policy != RestartPolicy.WHOLE_GROUP:
+            raise ValidationError(
+                "elastic sizing requires restartPolicy WholeGroup (a "
+                "PerPod job has no gang boundary to resize at)"
+            )
+        if el.straggler_policy not in StragglerPolicy.ALL:
+            raise ValidationError(
+                f"elastic.stragglerPolicy {el.straggler_policy!r} is not "
+                f"in {list(StragglerPolicy.ALL)}"
+            )
+        if el.straggler_patience_seconds < 1:
+            raise ValidationError(
+                "elastic.stragglerPatienceSeconds must be >= 1"
+            )
+        worker = next((r for r in spec.replica_specs
+                       if r.tpu_replica_type == TPUReplicaType.WORKER),
+                      None)
+        if worker is None:
+            raise ValidationError(
+                "elastic sizing requires a WORKER replicaSpec")
+        if worker.replicas % max(1, spec.num_slices) != 0:
+            raise ValidationError(
+                f"elastic sizing requires WORKER replicas "
+                f"({worker.replicas}) divisible by numSlices "
+                f"({spec.num_slices}) so a resized gang scales evenly"
             )
 
     # Warm-restart compilation cache (validated only when enabled: a

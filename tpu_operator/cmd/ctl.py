@@ -162,6 +162,26 @@ def cmd_describe(cs, opts) -> int:
     for rs in spec.get("replicaSpecs", []):
         print(f"  {rs.get('tpuReplicaType', 'WORKER')}: "
               f"{rs.get('replicas', 0)} × port {rs.get('tpuPort', '')}")
+    # Elastic gangs: the attempt's granted world vs the spec'd range,
+    # resize accounting, and the straggler-remediation audit trail.
+    el_spec = spec.get("elastic") or {}
+    el = status.get("elastic") or {}
+    if el_spec or el:
+        hi = el.get("maxSlices") or el_spec.get("maxSlices") \
+            or spec.get("numSlices", 1)
+        lo = el.get("minSlices") or el_spec.get("minSlices", 1)
+        line = (f"Elastic:    {el.get('slices', '?')}/{hi} slices "
+                f"(range {lo}-{hi}, resizes {el.get('resizes', 0)}, "
+                f"policy {el_spec.get('stragglerPolicy', 'none')})")
+        direction = el.get("lastResizeDirection")
+        if direction:
+            line += f" — last resize {direction}"
+        print(line)
+        for r in (el.get("remediations") or [])[-5:]:
+            node = f" off node {r['node']}" if r.get("node") else ""
+            print(f"Remediated: attempt {r.get('attempt', 0)}: "
+                  f"{r.get('policy', '?')} process "
+                  f"{r.get('processId', '?')}{node} ({r.get('time', '')})")
     # Fleet-scheduling state: effective queue/priority, the admission-order
     # position while parked in phase Queued, and — after a scheduler
     # eviction — the reason from the failure ledger.
@@ -254,8 +274,13 @@ def cmd_describe(cs, opts) -> int:
         for f in status["failures"][-10:]:
             resume = (f" resume@{f['resumeStep']}"
                       if f.get("resumeStep") is not None else "")
+            # Elastic jobs: the failed attempt's world size sits next to
+            # its resume step — which size ran, which size resumed.
+            world = (f" world {f['worldSlices']}"
+                     if f.get("worldSlices") is not None else "")
             print(f"  attempt {f.get('attempt', 0)}\t{f.get('kind', '')}\t"
-                  f"{f.get('reason', '')}\t{f.get('time', '')}{resume}")
+                  f"{f.get('reason', '')}\t{f.get('time', '')}"
+                  f"{resume}{world}")
     if status.get("replicaStatuses"):
         print("Replica statuses:")
         for rstat in status["replicaStatuses"]:

@@ -57,6 +57,7 @@ from tpu_operator.scheduler.inventory import (
 )
 from tpu_operator.scheduler.sharding import ShardedWorkQueue
 from tpu_operator.scheduler.writeback import WritebackLimiter
+from tpu_operator.trainer import elastic as elastic_mod
 from tpu_operator.trainer.training import TrainingJob, live_pod
 from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
@@ -149,6 +150,13 @@ class Controller:
         # operator restart — it is telemetry, not state); reset on attempt
         # change, dropped on job deletion.
         self._gang_cadence: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
+        # Straggler-remediation pacing (spec.elastic.stragglerPolicy):
+        # how long each flagged member has stayed flagged; crossing the
+        # patience window hands the member to the TrainingJob's next
+        # reconcile for replace/shed. Own lock inside (safe under
+        # _jobs_lock); in-memory like the cadence map — a restarted
+        # operator re-earns the window from fresh flags.
+        self._remediation = elastic_mod.RemediationTracker()
 
         self.job_informer = self.factory.informer_for("tpujobs")
         self.job_informer.add_event_handler(
@@ -263,11 +271,17 @@ class Controller:
             if not holds:
                 continue
             priority, queue = scheduling_params(job.spec)
+            # Elastic jobs re-reserve what their persisted
+            # status.elastic says they actually hold (a gang shrunk to
+            # 4 of 8 must not re-reserve 8 phantom slices) — the SAME
+            # derivation the live admission gate uses.
+            demand, kwargs = elastic_mod.sched_kwargs(
+                job.spec, job.status.elastic, job_demand(job.spec))
             self.scheduler.ensure_admitted(
                 f"{job.namespace}/{job.name}", uid=job.uid,
-                demand=job_demand(job.spec),
+                demand=demand,
                 priority=priority, queue=queue,
-                holds_hardware=True)
+                holds_hardware=True, **kwargs)
 
     def _refresh_node_inventory(self) -> None:
         """Recompute slice capacity from the cached node objects and swap
@@ -336,6 +350,7 @@ class Controller:
                 self.jobs.pop(key, None)
                 self._hb_persisted.pop(key, None)
                 self._gang_cadence.pop(key, None)
+            self._remediation.forget(key)
             self.recorder.forget_object(namespace, name)
             self.deadlines.forget(key)
             # A deleted job's slice reservation (or queue slot) frees for
@@ -353,6 +368,7 @@ class Controller:
             # series for a deleted job.
             for series in ("job_goodput_ratio",
                            "job_straggler_ratio",
+                           "job_world_size",
                            "job_checkpoint_save_failures_total",
                            "job_checkpoint_restore_fallbacks_total",
                            "job_store_upload_failures_total",
@@ -846,14 +862,22 @@ class Controller:
         if len(procs) < 2:
             # A gang of one has no peers to straggle behind; also covers
             # single-process jobs, which never see a second cadence
-            # stream.
-            return rollup_changed({}) or cleared
+            # stream. The empty evaluation still feeds the remediation
+            # tracker: a flag cleared THIS way (the flagged member's
+            # cadence entry expired, the gang shrank) must reset its
+            # patience window, or a stale window would fire an instant
+            # remediation on a later one-beat re-flag.
+            return (rollup_changed({}) or cleared
+                    or self._remediation_due_locked(key, tj, gen, set(),
+                                                    now))
         values = sorted(p["p95"] for p in procs.values())
         mid = len(values) // 2
         median = (values[mid] if len(values) % 2
                   else (values[mid - 1] + values[mid]) / 2.0)
         if median <= 0:
-            return rollup_changed({}) or cleared
+            return (rollup_changed({}) or cleared
+                    or self._remediation_due_locked(key, tj, gen, set(),
+                                                    now))
         step_p95s = sorted(p["step_p95"] for p in procs.values()
                            if p.get("step_p95") is not None)
         median_step = step_p95s[len(step_p95s) // 2] if step_p95s else None
@@ -894,7 +918,34 @@ class Controller:
         # Event dedup keys on the detector's own memory (once per
         # attempt+process); the persist decision keys on the STATUS delta.
         state["flagged"] = set(flagged)
-        return rollup_changed(flagged) or cleared
+        due = self._remediation_due_locked(key, tj, gen, set(flagged), now)
+        return rollup_changed(flagged) or cleared or due
+
+    def _remediation_due_locked(self, key: str, tj: TrainingJob, gen: Any,
+                                flagged: set, now: float) -> bool:
+        """Remediation pacing (spec.elastic.stragglerPolicy), called
+        under _jobs_lock with EVERY straggler evaluation's flag set —
+        including the empty ones, so a cleared flag resets its patience
+        window. A member staying flagged past the window is handed to
+        the TrainingJob's next reconcile for replace/shed — exactly
+        once per (attempt, process). The handoff is a field set, not an
+        RPC, so it is safe under the lock; returning True forces the
+        enqueue that runs the reconcile."""
+        policy, patience = elastic_mod.straggler_policy(tj.job.spec)
+        if policy == elastic_mod.StragglerPolicy.NONE:
+            return False
+        due = False
+        for proc_id in self._remediation.observe(key, int(gen), flagged,
+                                                 now, patience):
+            tj.request_remediation(
+                proc_id, policy, int(gen),
+                retry=lambda p=proc_id, g=int(gen):
+                    self._remediation.retry(key, g, p))
+            due = True
+            log.info("straggler remediation due: %s process %d "
+                     "(%s after %.0fs flagged)", key, proc_id,
+                     policy, patience)
+        return due
 
     # -- GC (wires the reference's dead --gc-interval flag) --------------------
 

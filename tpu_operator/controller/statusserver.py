@@ -269,6 +269,24 @@ class Metrics:
                       "spec.stepTrace.stragglerRatio flags the member into "
                       "status.stragglers). Only set while ≥2 processes "
                       "report cadence.")
+        self.register("job_world_size", "gauge",
+                      "Worker-process count of the job's current attempt — "
+                      "for elastic jobs (spec.elastic) the size the fleet "
+                      "scheduler actually granted from the live slice "
+                      "inventory, which may be smaller than the spec'd "
+                      "world after a shrink.")
+        self.register("job_elastic_resizes_total", "counter",
+                      "Elastic gang resizes between attempts, by direction "
+                      "(down: the inventory could not host the previous "
+                      "size or a straggler was shed; up: capacity returned "
+                      "and the gang re-expanded toward maxSlices).")
+        self.register("job_straggler_remediations_total", "counter",
+                      "Straggler remediations executed per "
+                      "spec.elastic.stragglerPolicy, by policy (replace: "
+                      "the flagged member's pod was deleted and re-created "
+                      "into the same rendezvous avoiding its node; shed: "
+                      "whole-group restart at one slice fewer, billed to "
+                      "the preemption budget).")
 
     # -- registry --------------------------------------------------------------
 
@@ -868,6 +886,9 @@ class StatusServer:
                 # Remote warm-start store roll-up + restart goodput.
                 "store": status.get("store"),
                 "goodput": status.get("goodput"),
+                # Elastic-gang state: the attempt's granted world size,
+                # resize accounting, and the remediation audit trail.
+                "elastic": status.get("elastic"),
                 # The in-memory heartbeat is fresher than the informer-cached
                 # status copy (which lags by a reconcile + watch round-trip);
                 # the internal receivedAt bookkeeping stays out of the API.

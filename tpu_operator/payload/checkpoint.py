@@ -43,6 +43,14 @@ recovery input):
   step (the same pattern as train_loop's drain latch), so shared-fs lag or
   per-pod checkpoint dirs can never make the group restore divergent
   state.
+- **Reshard-restore.** The restore target is the LIVE state's shardings,
+  never the saved ones: a checkpoint saved on mesh ``{data: 8}`` restores
+  onto ``{data: 4}`` (and back up) inside the same verified walk —
+  elastic gangs (``spec.elastic``) resize between attempts, and the
+  remote warm-start store makes the donor snapshot reachable from
+  whichever nodes the resized gang lands on. When orbax's sharded
+  restore refuses the mesh change on bytes that verify intact, a host
+  round-trip + ``device_put`` fallback re-lays the leaves out.
 
 The counters (``save_failures``, ``restore_fallbacks``, last verified
 step) flow out through the heartbeat (payload/heartbeat.py →
@@ -157,6 +165,10 @@ class Checkpointer:
         self.save_failures = 0              # total failed saves, this attempt
         self.consecutive_save_failures = 0  # escalation counter
         self.restore_fallbacks = 0          # quarantined steps during restore
+        # Restores that needed the reshard fallback (saved mesh != live
+        # mesh and the direct re-layout refused): elastic resize made
+        # the gang a different size than the one that saved.
+        self.reshard_restores = 0
         self._last_verified: Optional[int] = None  # newest verified commit
         self._pending: Optional[int] = None        # async save awaiting verify
         # Background verification: the read-back + sha256 of a committed
@@ -561,10 +573,53 @@ class Checkpointer:
             self._quarantine(int(step), why)
         return None
 
+    def _reshard_restore(self, step: int, state: Any
+                         ) -> Tuple[Any, Optional[Exception]]:
+        """Re-lay-out a saved checkpoint onto the LIVE state's shardings
+        when the direct sharded restore refused: restore WITHOUT target
+        shardings (host-side buffers), then ``device_put`` each leaf
+        onto the live leaf's sharding. This is the elastic-gang resume
+        path of last resort — a checkpoint saved on mesh ``{data: 8}``
+        restoring onto ``{data: 4}`` (or back up) when orbax's own
+        re-layout declines the mesh change. Costs one host round-trip
+        of the state; correctness is unchanged (the bytes were already
+        manifest-verified)."""
+        import jax
+
+        abstract = jax.tree_util.tree_map(
+            lambda x: jax.ShapeDtypeStruct(x.shape, x.dtype)
+            if hasattr(x, "shape") else x,
+            state,
+        )
+        try:
+            raw = self.manager.restore(
+                step, args=self._ocp.args.StandardRestore(abstract))
+
+            def relay(saved: Any, live: Any) -> Any:
+                sharding = getattr(live, "sharding", None)
+                if sharding is not None and hasattr(saved, "shape"):
+                    return jax.device_put(saved, sharding)
+                return saved
+
+            return jax.tree_util.tree_map(relay, raw, state), None
+        except Exception as e:  # noqa: BLE001 — caller keeps the original
+            return None, e
+
     def restore(self, state: Any) -> Tuple[Any, int]:
         """(state, start_step): the newest *valid* checkpoint agreed across
         the gang, restored onto the live state's shardings, or the input
         state untouched at step 0 when nothing survives.
+
+        **Reshard-restore**: the restore target is always the LIVE
+        state's shardings, never the saved ones — a checkpoint saved on
+        mesh ``{data: 8}`` restores onto ``{data: 4}`` (and back up) by
+        re-laying-out every saved leaf onto the live mesh (elastic gangs
+        resize between attempts, and the remote warm-start store makes
+        the donor snapshot reachable from whichever nodes the resized
+        gang lands on). Orbax's sharded restore does the re-layout
+        directly in the common case; when it refuses a mesh change on
+        bytes that still verify intact, :meth:`_reshard_restore` falls
+        back to a host round-trip + ``device_put``.
 
         The walk: verify newest → quarantine failures → gang-agree the min
         of everyone's newest valid step → restore it → gang-confirm the
@@ -603,14 +658,31 @@ class Checkpointer:
                     agreed, args=self._ocp.args.StandardRestore(abstract))
             except Exception as e:  # noqa: BLE001 — gang-confirmed below
                 err = e
+            intact = (err is not None
+                      and self._has_intact_manifest(int(agreed)))
+            if err is not None and intact:
+                # Intact bytes the sharded restore refused: the benign
+                # cause is a saved-mesh/live-mesh mismatch (an elastic
+                # resize between attempts). Try the reshard fallback
+                # BEFORE the confirm collective, so the whole gang sees
+                # one verdict for this step.
+                restored, reshard_err = self._reshard_restore(int(agreed),
+                                                              state)
+                if reshard_err is None:
+                    self.reshard_restores += 1
+                    log.warning(
+                        "restore of step %d resharded onto the live mesh "
+                        "(direct sharded restore refused: %s)", agreed, err)
+                    err = None
             # Every process reaches this second collective each iteration,
             # success or failure, so the rounds stay paired group-wide.
             confirmed = self._agree(agreed if err is None else None)
             if err is not None:
-                if self._has_intact_manifest(int(agreed)):
-                    # The bytes re-verify against their manifest, so this
-                    # is NOT corruption — a shape/dtype mismatch after a
-                    # model change, orbax version drift, OOM. Quarantining
+                if intact:
+                    # The bytes re-verify against their manifest AND the
+                    # reshard fallback failed too, so this is NOT
+                    # corruption — a shape/dtype mismatch after a model
+                    # change, orbax version drift, OOM. Quarantining
                     # would mangle every resumable checkpoint in turn and
                     # silently restart from step 0; surface it as the
                     # permanent, visible error it is instead.

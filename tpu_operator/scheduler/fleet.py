@@ -68,7 +68,7 @@ class _Entry:
     key: str          # ns/name — the reconcile key
     uid: str          # object UID: a re-created job is a new entry
     demand_key: str   # inventory key (resource:topology)
-    slices: int       # whole slices the gang needs
+    slices: int       # pending: the PREFERRED (max) size; admitted: GRANTED
     priority: int
     queue: str
     seq: int          # arrival order (FIFO tie-break)
@@ -78,6 +78,15 @@ class _Entry:
     # Demand exceeds the shape's TOTAL modeled capacity: can never fit,
     # must never head-block the shape, and the job's status says so.
     impossible: bool = field(default=False)
+    # Smallest admissible world size (elastic jobs: spec.elastic
+    # minSlices; rigid jobs: == slices). Admission fits/victim-selection
+    # tests run against THIS — an elastic gang shrinks instead of
+    # queueing — while the grant prefers ``slices``.
+    min_slices: int = field(default=0)
+
+    def floor(self) -> int:
+        """The size this job must at least be granted to run."""
+        return self.min_slices or self.slices
 
 
 class FleetScheduler:
@@ -110,7 +119,9 @@ class FleetScheduler:
                         demand: Optional[Tuple[str, int]],
                         priority: int = 0,
                         queue: str = DEFAULT_SCHEDULING_QUEUE,
-                        holds_hardware: Any = False) -> bool:
+                        holds_hardware: Any = False,
+                        min_slices: Optional[int] = None,
+                        held_slices: Optional[int] = None) -> bool:
         """True when ``key`` may (continue to) run its gang.
 
         ``demand`` is ``inventory.job_demand(spec)``; None = zero-footprint
@@ -120,12 +131,24 @@ class FleetScheduler:
         show it already owns its slices, so refuse-and-queue would be
         fiction — reserve unconditionally instead (see module docstring).
 
-        A spec edit that changes demand while admitted keeps the original
-        reservation until the next release — resizing a live gang is the
-        elastic-parallelism item (ROADMAP), not an admission concern."""
+        Elastic jobs (``spec.elastic``) pass ``min_slices`` < the demand
+        slices: the demand is the PREFERRED (max) size, and admission
+        grants the largest size in ``[min_slices, slices]`` that fits —
+        shrinking instead of queueing. The GRANTED size is what the
+        inventory accounts (never the spec's full size — a shrunk gang
+        must not reserve phantom capacity it isn't using), readable via
+        :meth:`granted_slices` and re-negotiated per attempt via
+        :meth:`resize`. ``held_slices`` is the rebuild companion: a
+        restarted operator re-reserves what the job's persisted
+        ``status.elastic`` says it actually holds, not the spec maximum.
+
+        A mid-attempt spec edit keeps the original reservation until the
+        next attempt boundary — :meth:`resize` (the gang re-create path)
+        is where sizes change, never under a live gang's feet."""
         if demand is None:
             return True
         demand_key, slices = demand
+        min_req = min(min_slices, slices) if min_slices else slices
         wake: List[str] = []
         with self._lock:
             ent = self._admitted.get(key)
@@ -137,24 +160,26 @@ class FleetScheduler:
             if callable(holds_hardware):
                 holds_hardware = holds_hardware()
             if holds_hardware:
+                held = held_slices if held_slices else slices
                 self._seq += 1
-                self._inventory.reserve(demand_key, slices)
+                self._inventory.reserve(demand_key, held)
                 self._admitted[key] = _Entry(
-                    key=key, uid=uid, demand_key=demand_key, slices=slices,
+                    key=key, uid=uid, demand_key=demand_key, slices=held,
                     priority=priority, queue=queue, seq=self._seq,
-                    admit_seq=self._seq, forced=True)
+                    admit_seq=self._seq, forced=True, min_slices=min_req)
                 self._pending.pop(key, None)
                 self._update_gauges_locked()
                 return True
             pend = self._pending.get(key)
             if (pend is None or pend.uid != uid
                     or pend.demand_key != demand_key
-                    or pend.slices != slices
+                    or pend.slices != slices or pend.min_slices != min_req
                     or pend.priority != priority or pend.queue != queue):
                 self._seq += 1
                 self._pending[key] = _Entry(
                     key=key, uid=uid, demand_key=demand_key, slices=slices,
                     priority=priority, queue=queue, seq=self._seq,
+                    min_slices=min_req,
                     enqueued_at=(pend.enqueued_at
                                  if pend is not None and pend.uid == uid
                                  else self._clock()))
@@ -162,6 +187,72 @@ class FleetScheduler:
             admitted = key in self._admitted
         self._notify(wake, skip=key)
         return admitted
+
+    def granted_slices(self, key: str) -> Optional[int]:
+        """The world size ``key``'s admitted reservation holds (None when
+        not admitted) — what an elastic job's attempt actually gangs at."""
+        with self._lock:
+            ent = self._admitted.get(key)
+            return None if ent is None else ent.slices
+
+    def resize(self, key: str, *, uid: str, min_slices: int,
+               max_slices: int) -> Optional[int]:
+        """Re-negotiate an admitted elastic job's reservation at a gang
+        (re)create boundary: grow toward ``max_slices`` when capacity
+        returned (re-expansion), keep or shrink toward ``min_slices``
+        when it didn't, releasing/reserving exactly the delta. Returns
+        the granted size, or None when the shape cannot host even
+        ``min_slices`` — the job is then moved back to the pending queue
+        (the caller parks it Queued) unless the rebalance admits it off
+        capacity freed in the same breath.
+
+        Safe ONLY between attempts: the caller (TrainingJob) resizes
+        exactly once per attempt, before any of that generation's pods
+        exist. An unknown key/uid returns None — the caller parks
+        Queued and its next reconcile's admission gate re-offers."""
+        wake: List[str] = []
+        granted: Optional[int] = None
+        with self._lock:
+            ent = self._admitted.get(key)
+            if ent is not None and ent.uid == uid:
+                if not self._inventory.modeled(ent.demand_key):
+                    # Unmodeled shape: nothing to account against — the
+                    # gang runs at its preferred size.
+                    ent.slices = max_slices
+                    ent.min_slices = min_slices
+                    return max_slices
+                avail = self._inventory.free(ent.demand_key) + ent.slices
+                if avail >= min_slices:
+                    new = min(max_slices, avail)
+                    delta = new - ent.slices
+                    if delta > 0:
+                        self._inventory.reserve(ent.demand_key, delta)
+                    elif delta < 0:
+                        self._inventory.release(ent.demand_key, -delta)
+                    ent.slices = new
+                    ent.min_slices = min_slices
+                    if delta < 0:
+                        # A shrink freed real capacity: pending gangs
+                        # may now fit.
+                        wake = self._rebalance_locked()
+                    granted = new
+                else:
+                    # Even the minimum no longer fits (the pool shrank
+                    # under a parked restart): back to the queue on the
+                    # normal admission order.
+                    self._release_locked(ent)
+                    self._seq += 1
+                    self._pending[key] = _Entry(
+                        key=key, uid=uid, demand_key=ent.demand_key,
+                        slices=max_slices, min_slices=min_slices,
+                        priority=ent.priority, queue=ent.queue,
+                        seq=self._seq, enqueued_at=self._clock())
+                    wake = self._rebalance_locked()
+                    readmitted = self._admitted.get(key)
+                    granted = (readmitted.slices
+                               if readmitted is not None else None)
+        self._notify(wake, skip=key)
+        return granted
 
     def pop_eviction(self, key: str,
                      uid: Optional[str] = None) -> Optional[str]:
@@ -211,7 +302,7 @@ class FleetScheduler:
                 if not ent.impossible:
                     continue
                 total = self._inventory.capacity(ent.demand_key)
-                if total is None or ent.slices <= total:
+                if total is None or ent.floor() <= total:
                     ent.impossible = False
             wake = self._rebalance_locked()
         self._notify(wake)
@@ -246,7 +337,7 @@ class FleetScheduler:
             if ent is None or not ent.impossible:
                 return None
             total = self._inventory.capacity(ent.demand_key)
-            return (f"demand of {ent.slices} slice(s) of {ent.demand_key} "
+            return (f"demand of {ent.floor()} slice(s) of {ent.demand_key} "
                     f"exceeds the inventory's total capacity ({total})")
 
     def queue_position(self, key: str) -> Optional[int]:
@@ -312,9 +403,12 @@ class FleetScheduler:
                 break
             head = min(candidates,
                        key=lambda e: self._order_key_locked(e, usage))
-            if not self._inventory.fits(head.demand_key, head.slices):
+            # The fit test runs against the head's FLOOR (elastic jobs
+            # shrink before they queue); the grant below prefers the
+            # full preferred size.
+            if not self._inventory.fits(head.demand_key, head.floor()):
                 total = self._inventory.capacity(head.demand_key)
-                if total is not None and head.slices > total:
+                if total is not None and head.floor() > total:
                     # Demand exceeds the shape's TOTAL capacity: it can
                     # NEVER fit, no victim set can change that, and head-
                     # blocking its shape would silently starve every later
@@ -325,7 +419,7 @@ class FleetScheduler:
                         "fleet: %s demands %d slices of %s but the "
                         "inventory models only %d total — unschedulable "
                         "until capacity or the spec changes",
-                        head.key, head.slices, head.demand_key, total)
+                        head.key, head.floor(), head.demand_key, total)
                     wake.append(head.key)
                     continue
                 wake.extend(self._mark_victims_locked(head))
@@ -334,6 +428,15 @@ class FleetScheduler:
             self._pending.pop(head.key)
             self._seq += 1
             head.admit_seq = self._seq
+            if self._inventory.modeled(head.demand_key):
+                # Elastic grant: the largest size in [floor, preferred]
+                # that fits right now; rigid jobs (floor == preferred)
+                # always take their full size. Unmodeled shapes are
+                # untracked and run at the preferred size.
+                head.slices = min(
+                    head.slices,
+                    max(head.floor(),
+                        self._inventory.free(head.demand_key)))
             self._inventory.reserve(head.demand_key, head.slices)
             self._admitted[head.key] = head
             wake.append(head.key)
@@ -378,7 +481,10 @@ class FleetScheduler:
         fit the head once they drain. No sufficient set → no eviction
         (pointlessly killing jobs that cannot free enough is worse than
         waiting)."""
-        need = head.slices - self._inventory.free(head.demand_key)
+        # An elastic head preempts only what its FLOOR needs: it can run
+        # shrunk, so evicting victims to reach its preferred size would
+        # trade running gangs for capacity it can live without.
+        need = head.floor() - self._inventory.free(head.demand_key)
         # Capacity already draining from in-flight evictions counts: their
         # reconciles will release it, and double-marking new victims for
         # the same shortfall would cascade evictions on every rebalance.

@@ -79,7 +79,14 @@ def scheduling_params(spec: TPUJobSpec) -> Tuple[int, str]:
 def job_demand(spec: TPUJobSpec) -> Optional[Tuple[str, int]]:
     """(inventory key, whole slices) one gang of this job occupies, or
     None for a zero-footprint job (no replica set requests TPU chips) —
-    those admit unconditionally and are never tracked."""
+    those admit unconditionally and are never tracked.
+
+    This is the RIGID demand (``spec.numSlices``). Elastic jobs
+    (``spec.elastic``) layer a range on top: callers derive
+    ``[minSlices, maxSlices]`` via ``trainer/elastic.elastic_range`` and
+    pass the preferred size as the demand with ``min_slices`` alongside
+    (scheduler/fleet.py grants the largest fitting size in the range and
+    accounts the GRANT, not this number)."""
     for rs in spec.replica_specs:
         resource = tpu_resource_name(rs.template)
         if resource:

@@ -1,0 +1,209 @@
+"""Elastic gang sizing + straggler-remediation bookkeeping.
+
+The reference operator (and this reproduction through PR 9) treated a
+gang's world size as immutable: a restart re-ganged exactly
+``spec.numSlices`` replicas or parked in Queued — a shrunken slice pool
+turned a recoverable preemption into indefinite queue wait, and the PR-9
+straggler detector could *name* the member pacing the gang but do
+nothing about it. This module holds the pure pieces of the
+graceful-degradation layer (ROADMAP item 3):
+
+- **Range derivation** (:func:`elastic_range`): the per-attempt sizing
+  range ``[minSlices, maxSlices]`` from the spec, with the one-attempt
+  shed cap (:func:`capped_max`) applied on top.
+- **World scaling** (:func:`scaled_spec`): a spec whose WORKER replica
+  count and ``numSlices`` reflect the attempt's GRANTED size — the
+  object the child-management layer (pod creation, env injection,
+  services, status roll-up) sees, so ``TPU_WORKER_HOSTNAMES`` /
+  ``JAX_NUM_PROCESSES`` / ``MEGASCALE_*`` regenerate for the actual
+  size with zero special-casing anywhere downstream. The persisted spec
+  is never touched: scaling is a per-reconcile view.
+- **Remediation pacing** (:class:`RemediationTracker`): when
+  ``status.stragglers`` keeps flagging the same (attempt, process) for
+  ``stragglerPatienceSeconds``, the tracker reports it DUE exactly once
+  per attempt — the controller then asks the TrainingJob to execute
+  ``spec.elastic.stragglerPolicy`` (replace / shed) on its next
+  reconcile. A transient flag that clears before the window elapses
+  resets the clock; a remediated process is never re-remediated within
+  the same attempt (the replacement pod re-earns its own window).
+
+The scheduler half (grant-in-range admission, reservation resize) lives
+in scheduler/fleet.py; the checkpoint half (reshard-restore across mesh
+sizes) in payload/checkpoint.py.
+"""
+
+from __future__ import annotations
+
+import threading
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    StragglerPolicy,
+    TPUJobSpec,
+    TPUReplicaType,
+)
+
+
+def elastic_range(spec: TPUJobSpec) -> Optional[Tuple[int, int]]:
+    """The spec's sizing range ``(minSlices, maxSlices)``, or None for a
+    rigid (non-elastic) job. Normalized defensively — replica sets can
+    be built from cached objects that predate defaulting — but the
+    shipped semantics come from defaults.py/validation.py."""
+    el = spec.elastic
+    if el is None:
+        return None
+    lo = max(1, int(el.min_slices))
+    hi = int(el.max_slices) or max(1, spec.num_slices)
+    return lo, max(lo, hi)
+
+
+def capped_max(status_elastic: Optional[Dict[str, Any]],
+               lo: int, hi: int) -> int:
+    """The effective upper bound for the NEXT sizing: the spec's ``hi``
+    unless a shed remediation left a one-attempt ``capNextAttempt`` in
+    ``status.elastic`` (consumed by the sizing that honors it)."""
+    cap = (status_elastic or {}).get("capNextAttempt")
+    if not cap:
+        return hi
+    return max(lo, min(hi, int(cap)))
+
+
+def granted_slices(spec: TPUJobSpec,
+                   status_elastic: Optional[Dict[str, Any]]
+                   ) -> Optional[int]:
+    """The recorded grant that makes the attempt's world differ from the
+    spec'd one, or None when the spec applies as written (non-elastic
+    job, nothing recorded yet, or granted == numSlices)."""
+    if spec.elastic is None or not status_elastic:
+        return None
+    g = status_elastic.get("slices")
+    if not g:
+        return None
+    g = int(g)
+    if g < 1 or g == max(1, spec.num_slices):
+        return None
+    return g
+
+
+def scaled_spec(spec: TPUJobSpec, granted: int) -> TPUJobSpec:
+    """A deep copy of ``spec`` whose world is ``granted`` slices: WORKER
+    replica counts scale by ``granted / numSlices`` (validation
+    guarantees divisibility) and ``numSlices`` becomes the grant — so
+    the process table, env contract, services, and status roll-up all
+    describe the attempt's ACTUAL gang. Non-WORKER compat roles
+    (SCHEDULER/SERVER) never scale; elastic validation requires
+    WholeGroup WORKER jobs anyway."""
+    eff = TPUJobSpec.from_dict(spec.to_dict())
+    base = max(1, spec.num_slices)
+    for rs in eff.replica_specs:
+        if rs.tpu_replica_type == TPUReplicaType.WORKER:
+            rs.replicas = max(1, rs.replicas // base) * granted
+    eff.num_slices = granted
+    return eff
+
+
+def world_workers(spec: TPUJobSpec, granted: int) -> int:
+    """WORKER process count of a gang ganged at ``granted`` slices —
+    the JAX world size (``job_world_size`` gauge)."""
+    base = max(1, spec.num_slices)
+    total = 0
+    for rs in spec.replica_specs:
+        if rs.tpu_replica_type == TPUReplicaType.WORKER:
+            total += max(1, rs.replicas // base) * granted
+    return total
+
+
+def sched_kwargs(spec: TPUJobSpec,
+                 status_elastic: Optional[Dict[str, Any]],
+                 demand: Optional[Tuple[str, int]]
+                 ) -> Tuple[Optional[Tuple[str, int]], Dict[str, Any]]:
+    """(demand, extra ensure_admitted kwargs) for an elastic job: the
+    demand becomes (key, effective max — shed cap applied) and the
+    kwargs carry the sizing floor plus the size the persisted
+    ``status.elastic`` says the job actually holds (the rebuild
+    force-admit path re-reserves THAT, never the spec's phantom
+    maximum). Rigid jobs pass through unchanged. The ONE home of this
+    derivation — the live reconcile gate (TrainingJob._sched_args) and
+    the controller's restart rebuild must never drift apart."""
+    rng = elastic_range(spec)
+    if rng is None or demand is None:
+        return demand, {}
+    lo, hi = rng
+    el = status_elastic or {}
+    hi = capped_max(el, lo, hi)
+    key, _slices = demand
+    held = el.get("slices")
+    return (key, hi), {"min_slices": lo,
+                       "held_slices": int(held) if held else hi}
+
+
+def straggler_policy(spec: TPUJobSpec) -> Tuple[str, float]:
+    """(policy, patienceSeconds) of the spec's remediation contract —
+    ``("none", 0.0)`` when no elastic block (or an explicit none) makes
+    every flag informational only."""
+    el = spec.elastic
+    if el is None or el.straggler_policy in ("", StragglerPolicy.NONE):
+        return StragglerPolicy.NONE, 0.0
+    return el.straggler_policy, float(el.straggler_patience_seconds)
+
+
+class RemediationTracker:
+    """Per-job persistence windows over straggler flags.
+
+    ``observe`` is fed every straggler evaluation (the controller's
+    cadence fold): it tracks how long each process has been
+    CONTINUOUSLY flagged and returns the ones whose window just crossed
+    ``patience`` — each at most once per attempt (the returned process
+    is marked done immediately, so a pending-but-not-yet-executed
+    remediation is never re-issued on the next beat). Thread-safe: the
+    controller calls it under its jobs lock from heartbeat threads and
+    forgets keys from reconcile workers.
+    """
+
+    def __init__(self) -> None:
+        self._lock = threading.Lock()
+        # key -> {"attempt": n, "since": {pid: first-flag epoch},
+        #         "done": set(pid remediated this attempt)}
+        self._jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+
+    def observe(self, key: str, attempt: int, flagged: Set[int],
+                now: float, patience: float) -> List[int]:
+        """Fold one evaluation; returns process ids due for remediation
+        (flagged continuously >= ``patience`` and not yet remediated
+        this attempt), ascending."""
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is None or state["attempt"] != attempt:
+                # New attempt: the replaced/re-ganged processes start
+                # fresh windows; old remediation marks are moot.
+                state = {"attempt": attempt, "since": {}, "done": set()}
+                self._jobs[key] = state
+            since: Dict[int, float] = state["since"]
+            for pid in list(since):
+                if pid not in flagged:
+                    del since[pid]  # flag cleared: the window resets
+            due: List[int] = []
+            for pid in sorted(flagged):
+                t0 = since.setdefault(pid, now)
+                if pid in state["done"]:
+                    continue
+                if now - t0 >= patience:
+                    state["done"].add(pid)
+                    due.append(pid)
+            return due
+
+    def retry(self, key: str, attempt: int, pid: int) -> None:
+        """Un-mark a remediation that could NOT be executed (transient
+        API error on the pod delete, member already gone): the process
+        re-qualifies on its next flagged beat — its window is already
+        elapsed, so the retry is immediate — instead of the policy
+        silently doing nothing for the rest of the attempt."""
+        with self._lock:
+            state = self._jobs.get(key)
+            if state is not None and state["attempt"] == attempt:
+                state["done"].discard(pid)
+
+    def forget(self, key: str) -> None:
+        """Drop a deleted job's windows. Idempotent."""
+        with self._lock:
+            self._jobs.pop(key, None)

@@ -541,7 +541,34 @@ class TPUReplicaSet:
                 f"pod template has no container named {DEFAULT_CONTAINER_NAME!r}"
             )
         self._inject_cache_volume(pod_spec, job_spec)
+        self._inject_node_exclusion(pod_spec, index)
         return pod
+
+    def _inject_node_exclusion(self, pod_spec: Dict[str, Any],
+                               index: int) -> None:
+        """Straggler-replace support: when the owning TrainingJob
+        recorded a node this replica's replacement must avoid (the
+        flagged member's host), add a NotIn hostname anti-affinity so
+        the re-created member lands elsewhere. Appended into EVERY
+        existing nodeSelectorTerm — terms are OR'd, so only an
+        exclusion present in each one actually holds."""
+        excluded = getattr(self.job, "excluded_node", None)
+        node = excluded(self.replica_type, index) if callable(excluded) \
+            else None
+        if not node:
+            return
+        aff = pod_spec.setdefault("affinity", {}) \
+                      .setdefault("nodeAffinity", {})
+        req = aff.setdefault(
+            "requiredDuringSchedulingIgnoredDuringExecution", {})
+        terms = req.setdefault("nodeSelectorTerms", [])
+        expr = {"key": "kubernetes.io/hostname", "operator": "NotIn",
+                "values": [node]}
+        if not terms:
+            terms.append({"matchExpressions": [expr]})
+            return
+        for term in terms:
+            term.setdefault("matchExpressions", []).append(expr)
 
     @staticmethod
     def _inject_cache_volume(pod_spec: Dict[str, Any],
@@ -660,19 +687,28 @@ class TPUReplicaSet:
     def delete(self) -> None:
         """Delete this replica set's children. One pod DeleteCollection (the
         reference issued it twice — copy-paste bug, replicas.go:292-302),
-        then per-index services."""
+        then the services by LABEL — never by index enumeration, which
+        under-counts after an elastic shrink (a gang ganged at 4 of 8
+        slices still owns the services its 8-wide attempt created)."""
         selector = labels_mod.to_selector(self.labels())
         try:
             self.clientset.pods.delete_collection(self.job.namespace, selector)
         except errors.ApiError as e:
             if not errors.is_not_found(e):
                 log.warning("deleting pods for %s: %s", self.replica_type, e)
-        for index in range(self.spec.replicas):
+        try:
+            services = self.clientset.services.list(self.job.namespace,
+                                                    label_selector=selector)
+        except errors.ApiError as e:
+            log.warning("listing services for %s: %s", self.replica_type, e)
+            services = []
+        for svc in services:
+            name = (svc.get("metadata") or {}).get("name", "")
             try:
-                self.clientset.services.delete(self.job.namespace, self.gen_name(index))
+                self.clientset.services.delete(self.job.namespace, name)
             except errors.ApiError as e:
                 if not errors.is_not_found(e):
-                    log.warning("deleting service %s: %s", self.gen_name(index), e)
+                    log.warning("deleting service %s: %s", name, e)
 
     @traced
     def delete_pods_for_attempt(self, attempt: int) -> None:

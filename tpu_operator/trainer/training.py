@@ -74,12 +74,13 @@ import copy
 import logging
 import threading
 import time
-from typing import Any, Dict, List, Optional, Tuple
+from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_operator.apis.tpujob import helper, validation
 from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
+    ELASTIC_REMEDIATION_CAP,
     FAILURE_LEDGER_CAP,
     FailureKind,
     FailureRecord,
@@ -87,12 +88,14 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     RestartPolicy,
     ReplicaState,
     State,
+    StragglerPolicy,
     TPUJob,
     TPUJobPhase,
     TPUJobSpec,
 )
 from tpu_operator.client import errors
 from tpu_operator.scheduler.inventory import job_demand, scheduling_params
+from tpu_operator.trainer import elastic as elastic_mod
 from tpu_operator.trainer import labels as labels_mod
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.trainer.snapshot import ReplicaSnapshot
@@ -180,6 +183,19 @@ class TrainingJob:
         # crucially including the spec persisted by setup's _spec_dirty
         # write, which a stale cached base would silently revert).
         self._last_applied: Optional[Dict[str, Any]] = None
+        # Elastic world view cache: (spec object, granted) -> scaled spec.
+        # Invalidates whenever refresh() swaps the spec object or a new
+        # attempt is granted a different size.
+        self._eff_cache: Optional[Tuple[Any, int, TPUJobSpec]] = None
+        # Straggler-remediation handoff from the controller's heartbeat
+        # thread to the (single-threaded per key) reconcile: one pending
+        # (processId, policy, attempt) slot, latest wins.
+        self._rem_lock = threading.Lock()
+        self._pending_remediation: Optional[Tuple[int, str, int]] = None  # guarded-by: _rem_lock
+        # Nodes a replaced straggler's replacement must avoid, per
+        # (role, index) of the CURRENT attempt (cleared on teardown —
+        # the next generation re-places freely).
+        self._avoid_nodes: Dict[Tuple[str, int], str] = {}
 
     # -- phase transitions (observability: status.phaseTimeline) ---------------
 
@@ -241,7 +257,27 @@ class TrainingJob:
 
     @property
     def job_spec(self) -> TPUJobSpec:
-        return self.job.spec
+        """The spec the CHILD-MANAGEMENT layer sees: for elastic jobs
+        whose current attempt was granted fewer slices than spec'd, a
+        scaled view (WORKER replicas and numSlices reflect the granted
+        world, so pod counts, the process table, and the injected env —
+        TPU_WORKER_HOSTNAMES / JAX_NUM_PROCESSES / MEGASCALE_* — all
+        describe the gang that actually runs). The persisted spec
+        (``self.job.spec``) is never mutated; scheduler demand and
+        validation read it directly."""
+        return self.effective_spec()
+
+    def effective_spec(self) -> TPUJobSpec:
+        spec = self.job.spec
+        granted = elastic_mod.granted_slices(spec, self.job.status.elastic)
+        if granted is None:
+            return spec
+        cached = self._eff_cache
+        if cached is not None and cached[0] is spec and cached[1] == granted:
+            return cached[2]
+        eff = elastic_mod.scaled_spec(spec, granted)
+        self._eff_cache = (spec, granted, eff)
+        return eff
 
     def refresh(self, job: TPUJob) -> None:
         """Adopt the latest cluster state of this job (same UID).
@@ -296,10 +332,14 @@ class TrainingJob:
 
     @traced
     def setup_replicas(self) -> None:
-        """Build TPUReplicaSet instances once (ref: training.go:289-303)."""
+        """Build TPUReplicaSet instances once (ref: training.go:289-303).
+        Built from the EFFECTIVE spec (elastic jobs: the granted world),
+        so every replica count downstream is the attempt's actual one;
+        ``_sync_elastic`` clears the cached sets when a new attempt's
+        grant changes the world."""
         if self.replica_sets:
             return
-        for rs_spec in self.job.spec.replica_specs:
+        for rs_spec in self.job_spec.replica_specs:
             self.replica_sets.append(
                 replicas_mod.TPUReplicaSet(self.clientset, self.recorder, self, rs_spec)
             )
@@ -307,10 +347,11 @@ class TrainingJob:
     # -- cluster spec (ref: training.go:103-118) -------------------------------
 
     def cluster_spec(self) -> Dict[str, List[str]]:
-        """role → ordered list of ``dns:port`` entries."""
+        """role → ordered list of ``dns:port`` entries (the effective —
+        elastic-granted — world)."""
         out: Dict[str, List[str]] = {}
         for role, _i, dns, port in replicas_mod.process_table(
-            self.name, self.job.spec.runtime_id, self.job.spec
+            self.name, self.job_spec.runtime_id, self.job_spec
         ):
             out.setdefault(role.lower(), []).append(f"{dns}:{port}")
         return out
@@ -559,9 +600,14 @@ class TrainingJob:
     # flag change is an eviction/replace SIGNAL the fleet scheduler and
     # operators act on — deferring it defers the action (stepTiming, by
     # contrast, is per-beat telemetry and rides the limiter).
+    # ``elastic`` is here because the restart rebuild reads the GRANTED
+    # size back from status to re-reserve what the gang actually holds —
+    # a deferred sizing write that dies with the operator would
+    # re-reserve the spec's full (phantom) size; it changes at most once
+    # per attempt plus per remediation, so it cannot storm the limiter.
     _CRITICAL_STATUS_FIELDS = ("phase", "attempt", "state", "reason",
                                "backoffUntil", "failures", "startup",
-                               "stragglers")
+                               "stragglers", "elastic")
 
     def _critical_status_delta(self, base: Dict[str, Any],
                                wire: Dict[str, Any]) -> bool:
@@ -734,10 +780,37 @@ class TrainingJob:
                         f"slice capacity reserved; creating gang "
                         f"(attempt {attempt})")
 
+        # Elastic sizing: the attempt's world size is granted from the
+        # live inventory exactly once, at its gang-create boundary —
+        # preferring maxSlices, shrinking instead of queueing, and
+        # re-expanding when capacity returned. Must run before any child
+        # I/O: the replica sets, env contract, and service set below all
+        # describe the granted world.
+        if not finished_despite_eviction and not self._sync_elastic():
+            self.update_crd_status()
+            return
+        self.setup_replicas()
+
         # ONE cache snapshot for the whole pass: every classification below
         # (service existence, missing indices, status roll-up, failure scan)
         # reads it instead of the apiserver — steady state is zero-read.
         snap = self.build_snapshot()
+
+        # Straggler remediation (spec.elastic.stragglerPolicy): the
+        # controller hands over a member that status.stragglers kept
+        # flagging past the patience window. SHED is a whole-group
+        # restart at one slice fewer (the teardown path returns);
+        # REPLACE deletes the member's pod here — the delete's watch
+        # event re-runs this reconcile, whose gang sync re-creates the
+        # member into the same rendezvous slot, avoiding the old node.
+        rem = self._take_remediation()
+        if rem is not None:
+            pid, policy, retry = rem
+            if policy == StragglerPolicy.SHED:
+                self._remediate_shed(attempt, pid)
+                self.update_crd_status()
+                return
+            self._remediate_replace(attempt, pid, snap, retry=retry)
 
         # Services first: the coordinator's DNS name must resolve before any
         # worker calls jax.distributed.initialize (SURVEY.md hard part (c)).
@@ -896,9 +969,21 @@ class TrainingJob:
                 except (TypeError, ValueError):
                     resume = None
                 break
+        # Elastic jobs: stamp the failed attempt's world size next to its
+        # resume step, so a post-resize restart is auditable from the
+        # ledger alone — which size ran, which step the next size
+        # resumed from.
+        world = None
+        if self.job.spec.elastic is not None:
+            el = status.elastic or {}
+            if el.get("slices") and el.get("attempt") == attempt:
+                world = int(el["slices"])
+            else:
+                world = max(1, self.job.spec.num_slices)
         ledger.append(FailureRecord(attempt=attempt, kind=kind,
                                     reason=reason, time=_now(),
-                                    resume_step=resume))
+                                    resume_step=resume,
+                                    world_slices=world))
         if len(ledger) > FAILURE_LEDGER_CAP:
             del ledger[:len(ledger) - FAILURE_LEDGER_CAP]
         status.restart_counts[kind] = status.restart_counts.get(kind, 0) + 1
@@ -965,8 +1050,11 @@ class TrainingJob:
         for rs in self.replica_sets:
             rs.delete_pods_for_attempt(attempt)
         # The torn-down generation's in-flight create expectations are
-        # moot; the next attempt's creates register their own.
+        # moot; the next attempt's creates register their own. Node
+        # exclusions from replace-remediations die with the generation
+        # too — the next gang places freely (and may be sized anew).
         self._expected_pods.clear()
+        self._avoid_nodes.clear()
         self.job.status.attempt = attempt + 1
         return True
 
@@ -1003,10 +1091,19 @@ class TrainingJob:
 
     def _sched_args(self) -> Dict[str, Any]:
         """The scheduler-facing view of this job: gang demand + the
-        effective priority/queue (spec.scheduling, defaulted)."""
+        effective priority/queue (spec.scheduling, defaulted). Demand is
+        derived from the ORIGINAL spec; elastic jobs additionally carry
+        their sizing floor (``min_slices`` — admission may grant any
+        size in [floor, demand]) and, for the rebuild force-admit path,
+        the size the persisted ``status.elastic`` says the job actually
+        holds (``held_slices`` — a shrunk gang must never re-reserve
+        phantom spec-sized capacity after an operator restart)."""
         priority, queue = scheduling_params(self.job.spec)
-        return {"demand": job_demand(self.job.spec),
-                "priority": priority, "queue": queue}
+        demand, kwargs = elastic_mod.sched_kwargs(
+            self.job.spec, self.job.status.elastic,
+            job_demand(self.job.spec))
+        return {"demand": demand, "priority": priority, "queue": queue,
+                **kwargs}
 
     def _holds_hardware(self) -> bool:
         """Rebuild signal for the scheduler's restart path: this job's
@@ -1118,6 +1215,218 @@ class TrainingJob:
         suspension, explicit delete). Idempotent."""
         if self.scheduler is not None:
             self.scheduler.release(self._sched_key())
+
+    # -- elastic gangs (inventory-sized attempts + straggler remediation) ------
+
+    def _sync_elastic(self) -> bool:
+        """Size the current attempt's world from the live inventory
+        (elastic jobs; rigid jobs no-op True). Runs exactly once per
+        attempt — at its gang-create boundary, before any child I/O —
+        and records the grant in ``status.elastic`` so env injection,
+        pod counts, and the scheduler's accounting all agree on the
+        gang that actually runs. Returns False when the shape cannot
+        host even ``minSlices`` any more: the reservation was released
+        and the job parked back in Queued."""
+        spec = self.job.spec
+        rng = elastic_mod.elastic_range(spec)
+        if rng is None:
+            return True
+        status = self.job.status
+        attempt = status.attempt
+        cur = dict(status.elastic or {})
+        if cur.get("attempt") == attempt and cur.get("slices"):
+            return True  # this attempt is already sized
+        lo, hi = rng
+        target = elastic_mod.capped_max(cur, lo, hi)
+        granted = target
+        demand = job_demand(spec)
+        if self.scheduler is not None and demand is not None:
+            g = self.scheduler.resize(self._sched_key(), uid=self.uid,
+                                      min_slices=lo, max_slices=target)
+            if g is None:
+                self._park_queued()
+                return False
+            granted = g
+        new: Dict[str, Any] = {
+            "slices": int(granted),
+            "workers": elastic_mod.world_workers(spec, granted),
+            "minSlices": lo,
+            "maxSlices": hi,
+            "attempt": attempt,
+            "resizes": int(cur.get("resizes", 0)),
+            "time": _now(),
+        }
+        # The shed cap is one-attempt: consumed by this sizing, never
+        # copied forward — a later restart re-expands toward maxSlices
+        # when capacity (and a healthy gang) allow.
+        if cur.get("remediations"):
+            new["remediations"] = cur["remediations"]
+        prev = cur.get("slices")
+        if prev and int(prev) != int(granted):
+            direction = "down" if int(granted) < int(prev) else "up"
+            new["resizes"] += 1
+            new["lastResizeDirection"] = direction
+            if self.metrics is not None:
+                self.metrics.inc("job_elastic_resizes_total",
+                                 labels={"direction": direction})
+            if self.recorder:
+                self.recorder.event(
+                    self, "Normal", "ElasticResized",
+                    f"attempt {attempt} ganged at {granted} slice(s), "
+                    f"{direction} from {prev} (range {lo}-{hi})")
+            log.info("elastic: %s attempt %d resized %s -> %s (%s)",
+                     self._sched_key(), attempt, prev, granted, direction)
+        elif cur.get("lastResizeDirection"):
+            new["lastResizeDirection"] = cur["lastResizeDirection"]
+        status.elastic = new
+        if self.metrics is not None:
+            self.metrics.set_gauge(
+                "job_world_size", new["workers"],
+                labels={"namespace": self.namespace, "name": self.name})
+        if self.replica_sets and prev != granted:
+            # The world changed: the cached replica sets (and with them
+            # every pod count and env build) describe the old size.
+            self.replica_sets = []
+        return True
+
+    def excluded_node(self, replica_type: str, index: int) -> Optional[str]:
+        """Node the replacement pod for (role, index) must avoid — set
+        by a ``replace`` straggler remediation, consumed by
+        TPUReplicaSet.pod_spec_with_index as a node anti-affinity."""
+        return self._avoid_nodes.get((replica_type, index))
+
+    def request_remediation(self, process_id: int, policy: str,
+                            attempt: int,
+                            retry: Optional[Callable[[], None]] = None
+                            ) -> None:
+        """Controller handoff (heartbeat thread): ask the next reconcile
+        to execute ``policy`` on ``process_id``. One slot, latest wins —
+        remediations are rare and a second flagged member is re-detected
+        on the next beat. ``retry`` re-arms the remediation in the
+        controller's tracker when execution fails transiently (the
+        member re-qualifies on its next flagged beat instead of the
+        policy silently doing nothing for the rest of the attempt)."""
+        with self._rem_lock:
+            self._pending_remediation = (int(process_id), policy,
+                                         int(attempt), retry)
+
+    def _take_remediation(self
+                          ) -> Optional[Tuple[int, str,
+                                              Optional[Callable[[], None]]]]:
+        with self._rem_lock:
+            pending, self._pending_remediation = \
+                self._pending_remediation, None
+        if pending is None:
+            return None
+        pid, policy, attempt, retry = pending
+        if attempt != self.job.status.attempt \
+                or self.job.status.phase not in (TPUJobPhase.RUNNING,
+                                                 TPUJobPhase.CREATING):
+            return None  # the flagged generation is already gone
+        return pid, policy, retry
+
+    def _record_remediation(self, attempt: int, pid: int, policy: str,
+                            node: str = "") -> None:
+        el = dict(self.job.status.elastic or {})
+        trail = list(el.get("remediations") or [])
+        entry: Dict[str, Any] = {"attempt": attempt, "processId": pid,
+                                 "policy": policy, "time": _now()}
+        if node:
+            entry["node"] = node
+        trail.append(entry)
+        el["remediations"] = trail[-ELASTIC_REMEDIATION_CAP:]
+        self.job.status.elastic = el
+        if self.metrics is not None:
+            self.metrics.inc("job_straggler_remediations_total",
+                             labels={"policy": policy})
+
+    def _remediate_replace(self, attempt: int, pid: int,
+                           snapshot: ReplicaSnapshot,
+                           retry: Optional[Callable[[], None]] = None
+                           ) -> None:
+        """Replace one persistently flagged member: delete its pod
+        (recording the node so the replacement avoids it) and let the
+        normal gang sync re-create the member into the SAME rendezvous
+        slot — same process id, same coordinator, same attempt. No
+        restart budget is spent: the gang never loses its slot, and the
+        payload's own whole-group recovery (the surviving members see a
+        member death and the operator re-gangs, or an elastic runtime
+        re-admits the process) owns what happens inside the group. A
+        TRANSIENT delete failure re-arms the remediation via ``retry``
+        (the already-elapsed window re-fires on the next flagged beat)
+        instead of the policy silently lapsing for the attempt."""
+        table = replicas_mod.process_table(
+            self.name, self.job_spec.runtime_id, self.job_spec)
+        if pid < 0 or pid >= len(table):
+            log.warning("remediation: process %d is outside the current "
+                        "world (%d processes); skipping", pid, len(table))
+            return
+        role, index, _dns, _port = table[pid]
+        pods = [p for p in snapshot.pods_for(role, index, attempt)
+                if live_pod(p)]
+        if not pods:
+            return  # already gone (raced a restart/teardown)
+        pod = max(pods, key=lambda p: (
+            (p.get("metadata") or {}).get("creationTimestamp") or "",
+            (p.get("metadata") or {}).get("name") or ""))
+        name = (pod.get("metadata") or {}).get("name", "")
+        node = (pod.get("spec") or {}).get("nodeName", "")
+        try:
+            self.clientset.pods.delete(self.namespace, name)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                log.warning("remediation: deleting straggler pod %s "
+                            "failed (will retry on the next flagged "
+                            "beat): %s", name, e)
+                if retry is not None:
+                    retry()
+                return
+        # Only a pod that actually died records its node exclusion — a
+        # failed delete must not leave a stale anti-affinity behind for
+        # an unrelated later re-create of this index.
+        if node:
+            self._avoid_nodes[(role, index)] = node
+        self._expected_pods.pop((role.lower(), index, attempt), None)
+        self._record_remediation(attempt, pid, StragglerPolicy.REPLACE,
+                                 node)
+        if self.recorder:
+            self.recorder.event(
+                self, "Normal", "StragglerReplaced",
+                f"deleted pod {name} of process {pid} (persistently "
+                f"flagged straggler); re-creating the member into the "
+                f"same rendezvous"
+                + (f", avoiding node {node}" if node else ""))
+        log.info("remediation: replaced straggler process %d (pod %s, "
+                 "node %s) of %s attempt %d", pid, name, node or "?",
+                 self._sched_key(), attempt)
+
+    def _remediate_shed(self, attempt: int, pid: int) -> None:
+        """Shed one slice: whole-group restart at the current world size
+        minus one slice, billed to the PREEMPTION budget (a slow host is
+        an infrastructure problem, not an application crash — it must
+        never exhaust the crash-loop budget). The cap applies to exactly
+        the next attempt's sizing; later restarts re-expand freely."""
+        el = dict(self.job.status.elastic or {})
+        rng = elastic_mod.elastic_range(self.job.spec) or (1, 1)
+        lo, _hi = rng
+        current = int(el.get("slices") or self.job_spec.num_slices)
+        target = current - 1
+        if target < lo:
+            # Already at the floor: there is no slice to shed. Fall back
+            # to replacing the member instead of silently doing nothing.
+            log.info("remediation: %s already at minSlices=%d; replacing "
+                     "process %d instead of shedding", self._sched_key(),
+                     lo, pid)
+            self._remediate_replace(attempt, pid, self.build_snapshot())
+            return
+        self._record_remediation(attempt, pid, StragglerPolicy.SHED)
+        el = dict(self.job.status.elastic or {})
+        el["capNextAttempt"] = target
+        self.job.status.elastic = el
+        self._group_restart(
+            attempt, FailureKind.PREEMPTION,
+            f"StragglerShed: process {pid} persistently flagged; "
+            f"re-ganging at {target} slice(s)")
 
     # -- time obligations (enforced here; woken exactly on time by
     # controller/deadlines.DeadlineManager) ------------------------------------
