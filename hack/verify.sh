@@ -12,10 +12,28 @@ cd "$(dirname "$0")/.."
 # Contract-analysis gate, first and fail-fast: spec-drift across
 # types/schema/defaults/validation/CRD, the env-var contract between
 # trainer/replicas.py and the payload, the heartbeat-key chain, lock
-# discipline (# guarded-by annotations), exception policy, and the
-# payload-image import check (folded in from check_payload_image.py).
+# discipline (# guarded-by annotations), the cross-module lock-order
+# graph (cycles = potential deadlocks, blocking calls one hop below a
+# lock), escape analysis (unguarded state shared across thread
+# entrypoints), exception policy, and the payload-image import check.
 # Cheaper than any test and catches the cross-file drift tests can't.
 python hack/analyze.py
+
+# Runtime lockdep witness ON for the whole test pyramid below (and the
+# subprocess payloads the e2es spawn): every lock the operator creates
+# is order-instrumented, so the chaos soak and fleet gates double as
+# deadlock detectors; a lock-order inversion fails the owning test with
+# both witness stacks. Zero overhead outside verify (factories return
+# raw threading primitives when unset).
+export TPUJOB_LOCKDEP=1
+
+# The witness's own contract, then the deterministic interleaving
+# harness + the four seeded-schedule races (fleet admission/release/
+# rebuild, writeback defer/critical bypass, straggler fold/attempt
+# reset, write-behind enqueue/close-drain) — standalone so a
+# concurrency regression fails by name, before the broad suites.
+python -m pytest tests/test_lockdep.py -x -q
+python -m pytest tests/test_schedules.py -x -q
 # Lint gate (pinned in the pyproject `dev` extra). Skipped with a warning
 # when ruff is not installed — the stdlib-only analyzer above always runs.
 if command -v ruff >/dev/null 2>&1; then
@@ -111,6 +129,8 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_store.py \
   --ignore=tests/test_fleet_scheduler.py \
   --ignore=tests/test_steptrace.py \
-  --ignore=tests/test_elastic.py
+  --ignore=tests/test_elastic.py \
+  --ignore=tests/test_lockdep.py \
+  --ignore=tests/test_schedules.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

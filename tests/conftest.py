@@ -29,3 +29,31 @@ assert not jax._src.xla_bridge.backends_are_initialized(), (
 )
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# Lockdep witness ON for the whole suite (TPUJOB_LOCKDEP=0 opts out):
+# every tpu_operator lock created after this point is order-instrumented,
+# so the chaos soak, the fleet e2es, and every unit test double as
+# deadlock detectors. Exported into the environment too, so subprocess
+# payloads witness their own locks. Must run before any tpu_operator
+# module creates its module-level locks — i.e. here, at conftest import.
+import pytest  # noqa: E402
+
+from tpu_operator.util import lockdep  # noqa: E402
+
+if os.environ.get("TPUJOB_LOCKDEP", "") not in ("0", "false"):
+    os.environ["TPUJOB_LOCKDEP"] = "1"
+    lockdep.enable()
+
+
+@pytest.fixture(autouse=True)
+def _lockdep_guard():
+    """Fail any test on whose watch a lock-order violation was recorded.
+
+    The raise at the offending acquisition is not enough on its own:
+    reconcile workers catch broad exceptions by design (an error is a
+    requeue), so a violation inside a worker thread would otherwise be
+    swallowed into a retry loop and the test could still pass."""
+    before = lockdep.violation_count()
+    yield
+    after = lockdep.violation_count()
+    assert after == before, lockdep.report()

@@ -13,8 +13,8 @@ from pathlib import Path
 
 import pytest
 
-from tpu_operator.analysis import concurrency, env_contract, \
-    exception_policy, payload_image, spec_drift, status_contract
+from tpu_operator.analysis import concurrency, env_contract, escape, \
+    exception_policy, lock_order, payload_image, spec_drift, status_contract
 from tpu_operator.analysis.driver import RULES, run_analysis
 
 REPO = Path(__file__).resolve().parent.parent
@@ -435,7 +435,8 @@ def test_driver_rejects_unknown_rule():
 
 def test_every_rule_registered():
     assert set(RULES) == {"spec-drift", "env-contract", "status-contract",
-                          "concurrency", "exceptions", "payload-image"}
+                          "concurrency", "lock-order", "escape",
+                          "exceptions", "payload-image"}
 
 
 # --- regression tests for the defects the analyzers surfaced -----------------
@@ -571,3 +572,356 @@ def test_event_aggregation_failure_logs_and_falls_back(caplog):
     assert len(cs.events.created) == 2, \
         "aggregation failure must fall back to a fresh create"
     assert any("aggregation" in r.message for r in caplog.records)
+
+
+# --- lock-order rule ----------------------------------------------------------
+
+def test_lock_order_cycle_fixture(tmp_path):
+    """Two classes acquiring each other's locks in opposite orders — the
+    cross-object cycle no per-function rule can see."""
+    write(tmp_path, "tpu_operator/controller/pair.py", """\
+        import threading
+
+
+        class A:
+            def __init__(self, b: "B"):
+                self._lock = threading.Lock()
+                self.b = b
+
+            def forward(self):
+                with self._lock:
+                    self.b.poke()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+
+        class B:
+            def __init__(self, a: A):
+                self._lock = threading.Lock()
+                self.a = a
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+            def backward(self):
+                with self._lock:
+                    self.a.poke()
+        """)
+    found = keyed(lock_order.run(tmp_path))
+    (key,) = [k for k in found if k.startswith("cycle:")]
+    assert "A._lock" in key and "B._lock" in key
+    # The message carries a concrete witness site per edge.
+    assert "pair.py:" in found[key].message
+
+
+def test_lock_order_consistent_nesting_is_clean(tmp_path):
+    write(tmp_path, "tpu_operator/controller/nest.py", """\
+        import threading
+
+
+        class Inner:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+
+        class Outer:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.inner = Inner()
+
+            def one(self):
+                with self._lock:
+                    self.inner.poke()
+
+            def two(self):
+                with self._lock:
+                    self.inner.poke()
+        """)
+    assert lock_order.run(tmp_path) == []
+
+
+def test_lock_order_blocking_one_hop_under_lock(tmp_path):
+    """The PR-6 recorder bug shape one call-hop deeper: the blocking call
+    is in the callee, where the per-function rule is structurally blind."""
+    write(tmp_path, "tpu_operator/controller/hop.py", """\
+        import threading
+        import time
+
+
+        class Holder:
+            def __init__(self):
+                self._lock = threading.Lock()
+
+            def slow_io(self):
+                time.sleep(1)
+
+            def bad(self):
+                with self._lock:
+                    self.slow_io()
+
+            def fine(self):
+                self.slow_io()
+        """)
+    found = keyed(lock_order.run(tmp_path))
+    key = "blocking-hop:tpu_operator/controller/hop.py:Holder.bad:self.slow_io"
+    assert key in found
+    assert "time.sleep" in found[key].message
+    assert len([k for k in found if k.startswith("blocking-hop:")]) == 1
+
+
+def test_lock_order_lockdep_factories_count_as_locks(tmp_path):
+    """Locks created through the witness factories participate in the
+    graph exactly like raw threading constructors."""
+    write(tmp_path, "tpu_operator/controller/dep.py", """\
+        from tpu_operator.util import lockdep
+
+
+        class P:
+            def __init__(self, q: "Q"):
+                self._lock = lockdep.lock("P._lock")
+                self.q = q
+
+            def forward(self):
+                with self._lock:
+                    self.q.poke()
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+
+        class Q:
+            def __init__(self):
+                self._lock = lockdep.condition("Q._lock")
+
+            def poke(self):
+                with self._lock:
+                    pass
+
+            def backward(self, p: P):
+                with self._lock:
+                    p.poke()
+        """)
+    found = keyed(lock_order.run(tmp_path))
+    assert any(k.startswith("cycle:") and "P._lock" in k and "Q._lock" in k
+               for k in found)
+
+
+# --- escape rule --------------------------------------------------------------
+
+def test_escape_thread_shared_attr_fixture(tmp_path):
+    write(tmp_path, "tpu_operator/controller/esc.py", """\
+        import threading
+
+
+        class Worker:
+            def __init__(self):
+                self._lock = threading.Lock()
+                self.results = []
+                self.guarded = []  # guarded-by: _lock
+                self.count = 0
+
+            def start(self):
+                threading.Thread(target=self._run, daemon=True).start()
+
+            def _run(self):
+                self.results.append(1)
+                with self._lock:
+                    self.guarded.append(1)
+                    self.count += 1
+
+            def drain(self):
+                out, self.results = self.results, []
+                return out
+
+            def reset_count(self):
+                with self._lock:
+                    self.count = 0
+        """)
+    found = keyed(escape.run(tmp_path))
+    key = "attr:tpu_operator/controller/esc.py:Worker.results"
+    assert key in found  # mutated in _run (thread) AND drain (main), no lock
+    assert "_run" in found[key].message
+    # guarded-by annotation and under-lock mutations are exempt
+    assert not any("guarded" in k for k in found)
+    assert not any("count" in k for k in found)
+
+
+def test_escape_single_domain_class_is_clean(tmp_path):
+    write(tmp_path, "tpu_operator/controller/solo.py", """\
+        class Solo:
+            def __init__(self):
+                self.items = []
+
+            def add(self, x):
+                self.items.append(x)
+        """)
+    assert escape.run(tmp_path) == []
+
+
+def test_escape_module_global_fixture(tmp_path):
+    write(tmp_path, "tpu_operator/controller/glob.py", """\
+        import threading
+
+        _count = 0
+        _quiet = 0
+
+
+        def listen(register_event_listener):
+            def _cb(event):
+                global _count
+                _count += 1
+            register_event_listener(_cb)
+
+
+        def read():
+            return _count
+        """)
+    found = keyed(escape.run(tmp_path))
+    key = "global:tpu_operator/controller/glob.py:_count"
+    assert key in found
+    assert not any("_quiet" in k for k in found)  # never mutated
+
+
+def test_escape_annotated_module_global_is_enforced(tmp_path):
+    """A module-level guarded-by annotation is a contract: mutations
+    outside `with <lock>:` flag even in an unthreaded module."""
+    write(tmp_path, "tpu_operator/util/glob2.py", """\
+        import threading
+
+        _lock = threading.Lock()
+        _state = {}  # guarded-by: _lock
+
+
+        def good(k, v):
+            with _lock:
+                _state[k] = v
+
+
+        def bad(k):
+            _state.pop(k, None)
+        """)
+    found = keyed(escape.run(tmp_path))
+    key = "global:tpu_operator/util/glob2.py:_state"
+    assert key in found
+    assert "bad" in found[key].message
+
+
+# --- regression tests for the defects the new rules' first run surfaced ------
+
+def test_informer_dispatch_uses_a_handler_snapshot():
+    """Informer._handlers was appended without a lock while the reflector
+    thread iterated it (escape-analyzer finding). The fix gives dispatch
+    snapshot semantics: a handler registered DURING a dispatch sees the
+    next event, not the in-flight one."""
+    from tpu_operator.client.informer import Informer
+
+    class _NullClient:
+        kind = "Test"
+
+    inf = Informer(_NullClient(), resync_period=0)
+    late_calls = []
+
+    def late_handler(obj):
+        late_calls.append(obj["n"])
+
+    def registering_handler(obj):
+        if obj["n"] == 1:
+            inf.add_event_handler(on_add=late_handler)
+
+    inf.add_event_handler(on_add=registering_handler)
+    inf._dispatch_add({"n": 1})  # registers late_handler mid-dispatch
+    assert late_calls == []      # snapshot: not invoked for event 1
+    inf._dispatch_add({"n": 2})
+    assert late_calls == [2]     # but sees every later event
+
+
+def test_startup_cache_hit_counter_is_exact_under_threads():
+    """startup._cache_hits was bumped by the JAX monitoring callback —
+    which fires on the overlapped prologue's compile worker thread —
+    with an unlocked +=, a lost-update race against the heartbeat
+    thread's reads (escape-analyzer finding). Locked, N concurrent
+    events count exactly N."""
+    import threading as _threading
+
+    from jax import monitoring
+
+    from tpu_operator.payload import startup
+
+    assert startup.ensure_cache_listener()
+    before = startup.cache_hit_count()
+    threads = [
+        _threading.Thread(target=lambda: [
+            monitoring.record_event("/jax/compilation_cache/cache_hits")
+            for _ in range(200)])
+        for _ in range(8)
+    ]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert startup.cache_hit_count() - before == 1600
+
+
+def test_fake_clientset_version_counter_is_thread_safe():
+    """FakeClientset.next_version mutated the counter without taking the
+    clientset RLock — safe only because every production caller happened
+    to hold it, which nothing enforced (guarded-by finding after the
+    fake joined the annotation discipline). Direct concurrent callers
+    must now mint unique monotonic versions."""
+    from tpu_operator.client.fake import FakeClientset
+
+    cs = FakeClientset()
+    minted = []
+    lock = __import__("threading").Lock()
+
+    def mint():
+        got = [cs.next_version() for _ in range(500)]
+        with lock:
+            minted.extend(got)
+
+    threads = [__import__("threading").Thread(target=mint)
+               for _ in range(8)]
+    for th in threads:
+        th.start()
+    for th in threads:
+        th.join()
+    assert len(minted) == 4000
+    assert len(set(minted)) == 4000  # no duplicates: no lost updates
+
+
+def test_escape_local_shadow_and_global_declared_mutators(tmp_path):
+    """Mutator-call precision (review finding): a function-local list
+    shadowing a module name is NOT a global mutation, while a
+    `global`-declared receiver's .append IS one."""
+    write(tmp_path, "tpu_operator/controller/shadow.py", """\
+        import threading
+
+        items = []
+
+
+        def spawn():
+            threading.Thread(target=print, daemon=True).start()
+
+
+        def local_only():
+            items = []
+            items.append(1)
+            return items
+
+
+        def real_mutation():
+            global items
+            items.append(2)
+        """)
+    found = keyed(escape.run(tmp_path))
+    key = "global:tpu_operator/controller/shadow.py:items"
+    assert key in found
+    assert "real_mutation" in found[key].message

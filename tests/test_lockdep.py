@@ -1,0 +1,231 @@
+"""Lockdep witness tests: inversion detection with both stacks, reentrant
+and self-deadlock handling, Condition.wait release semantics, the
+violation ledger, and the disabled-mode zero-overhead contract."""
+
+import threading
+
+import pytest
+
+from tpu_operator.util import lockdep
+
+
+@pytest.fixture(autouse=True)
+def _fresh_graph():
+    """Each test gets a clean order graph; the suite-level witness state
+    is not meaningful across unrelated scenarios."""
+    lockdep.reset()
+    yield
+    lockdep.reset()
+
+
+def test_inversion_detected_with_both_stacks():
+    a = lockdep.lock("test.A")
+    b = lockdep.lock("test.B")
+
+    def forward():
+        with a:
+            with b:
+                pass
+
+    forward()  # witnesses A -> B
+    with pytest.raises(lockdep.LockOrderError) as exc:
+        with b:
+            with a:  # closes the cycle
+                pass
+    report = str(exc.value)
+    # The splat names both locks and carries BOTH acquisition stacks:
+    # the inverting one and the prior witness.
+    assert "test.A" in report and "test.B" in report
+    assert "this acquisition" in report
+    assert "prior witness" in report
+    # Both stacks point at real source lines in this test.
+    assert report.count("test_lockdep.py") >= 2
+    assert lockdep.violation_count() == 1
+
+
+def test_inversion_detected_across_threads():
+    a = lockdep.lock("test.A")
+    b = lockdep.lock("test.B")
+    errors = []
+
+    def t1():
+        with a:
+            with b:
+                pass
+
+    th = threading.Thread(target=t1)
+    th.start()
+    th.join()
+
+    def t2():
+        try:
+            with b:
+                with a:
+                    pass
+        except lockdep.LockOrderError as e:
+            errors.append(e)
+
+    th = threading.Thread(target=t2)
+    th.start()
+    th.join()
+    assert len(errors) == 1
+    assert lockdep.violation_count() == 1
+
+
+def test_inversion_unwinds_the_inner_lock():
+    """acquire() raising from a `with` statement must not leave the lock
+    held — __exit__ never runs for a failed __enter__."""
+    a = lockdep.lock("test.A")
+    b = lockdep.lock("test.B")
+    with a:
+        with b:
+            pass
+    with pytest.raises(lockdep.LockOrderError):
+        with b:
+            with a:
+                pass
+    # The failed acquisition released `a`: it is immediately takeable.
+    assert a.acquire(blocking=False)
+    a.release()
+    assert lockdep.held_keys() == []
+
+
+def test_transitive_cycle_through_three_locks():
+    a, b, c = (lockdep.lock(f"test.{n}") for n in "ABC")
+    with a:
+        with b:
+            pass
+    with b:
+        with c:
+            pass
+    with pytest.raises(lockdep.LockOrderError) as exc:
+        with c:
+            with a:
+                pass
+    assert "test.B" in str(exc.value)  # the path runs through B
+
+
+def test_consistent_order_never_flags():
+    a = lockdep.lock("test.A")
+    b = lockdep.lock("test.B")
+    for _ in range(100):
+        with a:
+            with b:
+                pass
+    assert lockdep.violation_count() == 0
+    assert ("test.A", "test.B") in lockdep.edges()
+
+
+def test_rlock_reentrancy_is_not_an_edge():
+    r = lockdep.rlock("test.R")
+    with r:
+        with r:
+            assert lockdep.held_keys() == ["test.R"]
+    assert lockdep.violation_count() == 0
+    assert lockdep.edges() == []
+
+
+def test_plain_lock_self_deadlock_raises_immediately():
+    a = lockdep.lock("test.A")
+    with a:
+        with pytest.raises(lockdep.LockOrderError, match="self-deadlock"):
+            a.acquire()
+    assert lockdep.violation_count() == 1
+    lockdep.reset()  # the guard fixture must not double-count this one
+
+
+def test_same_key_different_instances_flagged():
+    """Two instances of one lock class nested have no defined order —
+    two threads nesting them oppositely deadlock, so it reports."""
+    a1 = lockdep.lock("test.Same")
+    a2 = lockdep.lock("test.Same")
+    with pytest.raises(lockdep.LockOrderError):
+        with a1:
+            with a2:
+                pass
+
+
+def test_condition_wait_releases_for_order_purposes():
+    cond = lockdep.condition("test.C")
+    entered = threading.Event()
+    release = threading.Event()
+    held_during_wait = []
+
+    def waiter():
+        with cond:
+            entered.set()
+            cond.wait(timeout=5.0)
+            held_during_wait.append(list(lockdep.held_keys()))
+
+    th = threading.Thread(target=waiter)
+    th.start()
+    assert entered.wait(5.0)
+    # While the waiter is parked in wait(), the lock is acquirable —
+    # proof the witness (and the real lock) released it.
+    acquired = cond.acquire(timeout=5.0)
+    assert acquired
+    assert lockdep.held_keys() == ["test.C"]
+    cond.notify_all()
+    cond.release()
+    assert lockdep.held_keys() == []
+    th.join(timeout=5.0)
+    assert not th.is_alive()
+    # After re-acquiring out of wait(), the waiter held exactly the cond.
+    assert held_during_wait == [["test.C"]]
+    assert lockdep.violation_count() == 0
+    release.set()
+
+
+def test_condition_ordering_edges_recorded():
+    outer = lockdep.lock("test.Outer")
+    cond = lockdep.condition("test.Cond")
+    with outer:
+        with cond:
+            cond.notify_all()
+    assert ("test.Outer", "test.Cond") in lockdep.edges()
+    with pytest.raises(lockdep.LockOrderError):
+        with cond:
+            with outer:
+                pass
+
+
+def test_disabled_mode_returns_raw_primitives():
+    """The zero-overhead contract: disabled factories hand back the raw
+    threading objects — not wrappers with a cheap fast path, NO wrapper
+    at all."""
+    lockdep.disable_for_test = None  # readability marker only
+    lockdep.enable(False)
+    try:
+        raw = lockdep.lock("test.X")
+        assert type(raw) is type(threading.Lock())
+        rr = lockdep.rlock("test.Y")
+        assert type(rr) is type(threading.RLock())
+        rc = lockdep.condition("test.Z")
+        assert isinstance(rc, threading.Condition)
+        assert type(rc._lock) is type(threading.RLock())
+        # And nothing they do is witnessed.
+        with raw:
+            with rr:
+                pass
+        assert lockdep.edges() == []
+    finally:
+        lockdep.enable(True)
+
+
+def test_violations_accumulate_for_the_conftest_guard():
+    a = lockdep.lock("test.A")
+    b = lockdep.lock("test.B")
+    with a:
+        with b:
+            pass
+    try:
+        with b:
+            with a:
+                pass
+    except lockdep.LockOrderError:
+        pass
+    assert lockdep.violation_count() == 1
+    assert "inversion" in lockdep.report()
+    lockdep.reset()
+    assert lockdep.violation_count() == 0
+    assert "no lock-order violations" in lockdep.report()

@@ -1,0 +1,384 @@
+"""Deterministic interleaving tests: the harness itself, then the four
+known-hairy triples as permuted schedules instead of soak lottery —
+
+1. fleet admission vs. teardown-release vs. eager restart rebuild,
+2. writeback defer vs. critical-field bypass,
+3. straggler fold vs. attempt reset (regression: a stale beat must never
+   regress the detector to a dead generation),
+4. write-behind enqueue vs. close()-drain (regression: an accepted
+   enqueue is never stranded past close(flush=True)'s return).
+"""
+
+import threading
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.store.writebehind import WriteBehindUploader
+from tpu_operator.testing import schedules
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.util import yieldpoints
+
+from tests.test_fleet_scheduler import (
+    KEY,
+    fleet_training_job,
+    mark_pods,
+    sched,
+    tpu_job,
+)
+from tests.test_steptrace import _beat, _controller_with_job
+
+wait_for = make_wait_for(timeout=5.0, interval=0.02)
+
+
+# --- harness self-tests -------------------------------------------------------
+
+def test_merge_orders_enumerates_the_multinomial():
+    orders = list(schedules.merge_orders(2, 2))
+    assert len(orders) == 6  # C(4,2)
+    assert len(set(orders)) == 6
+    assert all(order.count(0) == 2 and order.count(1) == 2
+               for order in orders)
+    assert len(list(schedules.merge_orders(1, 1, 1))) == 6  # 3!
+
+
+def test_run_order_executes_steps_in_merge_order():
+    log = []
+    threads = [[lambda: log.append("a1"), lambda: log.append("a2")],
+               [lambda: log.append("b1")]]
+    schedules.run_order(threads, (0, 1, 0))
+    assert log == ["a1", "b1", "a2"]
+    with pytest.raises(ValueError):
+        schedules.run_order(threads, (0, 1))  # leaves a2 unexecuted
+
+
+def test_exhaustive_rebuilds_state_per_schedule():
+    seen = []
+
+    def scenario():
+        state = []
+        return [[lambda: state.append(1)], [lambda: seen.append(len(state))]]
+
+    count = schedules.exhaustive(scenario)
+    assert count == 2  # two merges of 1+1
+    assert sorted(seen) == [0, 1]  # fresh state each schedule
+
+
+def test_scheduler_same_seed_same_schedule():
+    def build(sched_):
+        log = []
+        sched_.add("a", lambda: log.append("a1"), lambda: log.append("a2"))
+        sched_.add("b", lambda: log.append("b1"), lambda: log.append("b2"))
+        sched_.log = log
+
+    traces = []
+    for _ in range(2):
+        s = schedules.InterleavingScheduler(seed=7)
+        build(s)
+        s.run()
+        traces.append((s.trace, s.log))
+    assert traces[0] == traces[1]  # bit-identical schedule and effects
+    # A different seed explores a different interleaving eventually.
+    orders = set()
+    for seed in range(8):
+        s = schedules.InterleavingScheduler(seed=seed)
+        build(s)
+        s.run()
+        orders.add(tuple(s.log))
+    assert len(orders) > 1
+
+
+def test_scheduler_reports_task_errors_with_schedule():
+    s = schedules.InterleavingScheduler(seed=0)
+    s.add("boom", lambda: (_ for _ in ()).throw(RuntimeError("x")))
+    with pytest.raises(AssertionError, match="seed 0"):
+        s.run()
+    assert not yieldpoints.installed()  # hook always uninstalls
+
+
+def test_point_gate_holds_and_releases_threads():
+    with schedules.PointGate() as gate:
+        gate.hold("p")
+        hits = []
+
+        def worker():
+            yieldpoints.pause("p")
+            hits.append(1)
+
+        th = threading.Thread(target=worker, daemon=True)
+        th.start()
+        assert gate.wait_blocked("p")
+        assert hits == []  # parked at the point
+        gate.release("p")
+        th.join(timeout=5.0)
+        assert hits == [1]
+    assert not yieldpoints.installed()
+
+
+# --- triple 1: admission vs teardown-release vs eager restart rebuild --------
+
+def test_schedule_admission_release_rebuild_accounting():
+    """All 6 serializations of: new job B admitting, old job A's teardown
+    releasing, and the post-restart rebuild force-admitting A. In every
+    schedule the inventory ledger must equal the sum of admitted grants
+    (the invariant whose violation leaks or double-books slices), and B
+    must never be lost — admitted or visibly queued."""
+    state = {}
+
+    def scenario():
+        s, _ = sched(capacity=1)
+        state["s"] = s
+        return [
+            [lambda: s.ensure_admitted("default/b", uid="u-b",
+                                       demand=(KEY, 1))],
+            [lambda: s.release("default/a")],
+            [lambda: s.ensure_admitted("default/a", uid="u-a",
+                                       demand=(KEY, 1),
+                                       holds_hardware=True)],
+        ]
+
+    def check(order):
+        s = state["s"]
+        snap = s.summary()
+        used = snap["inventory"][KEY]["used"]
+        booked = sum(e.slices for e in s._admitted.values())
+        assert used == booked, (order, snap)
+        # B is never lost: admitted, or pending with a position.
+        assert s.is_admitted("default/b") \
+            or s.queue_position("default/b") is not None, order
+        # The pool holds 1 slice; over-commit can only come from the
+        # force-admit path (truth-on-the-ground), never from B.
+        if used > 1:
+            assert s.is_admitted("default/a"), order
+
+    n = schedules.exhaustive(scenario, check)
+    assert n == 6
+
+
+def test_schedule_release_then_rebuild_heals_on_next_release():
+    """The one schedule where teardown-release runs BEFORE the rebuild
+    re-reserves (the release is a no-op, A's ghost reservation survives)
+    is healed by the level-driven terminal path calling release again —
+    the scheduler contract the controller relies on."""
+    s, _ = sched(capacity=1)
+    s.release("default/a")  # teardown raced ahead of the rebuild: no-op
+    s.ensure_admitted("default/a", uid="u-a", demand=(KEY, 1),
+                      holds_hardware=True)
+    s.ensure_admitted("default/b", uid="u-b", demand=(KEY, 1))
+    assert not s.is_admitted("default/b")  # ghost still holds the slice
+    s.release("default/a")  # the terminal reconcile's idempotent release
+    assert s.is_admitted("default/b")
+    assert s.summary()["inventory"][KEY]["used"] == 1
+
+
+# --- triple 2: writeback defer vs critical-field bypass ----------------------
+
+def test_schedule_writeback_defer_vs_critical_bypass():
+    """Both serializations of a telemetry-only write against a critical
+    transition under a dry token bucket: whichever order runs, the
+    critical field is persisted immediately and the telemetry either
+    rides along coalesced or stays deferred WITH the retry obligation
+    armed — never silently dropped."""
+    from tpu_operator.scheduler.writeback import WritebackLimiter
+
+    state = {}
+
+    def scenario():
+        clock = [0.0]
+        limiter = WritebackLimiter(qps=1.0, burst=1,
+                                   clock=lambda: clock[0])
+        s, _ = sched(capacity=1)
+        cs, tj = fleet_training_job(tpu_job("w"), s, writeback=limiter)
+        tj.reconcile()
+        mark_pods(cs)
+        tj.reconcile()
+        assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+        while limiter.allow():
+            pass  # dry bucket: non-critical writes must defer
+        state.update(cs=cs, tj=tj)
+
+        def telemetry():
+            tj.job.status.last_heartbeat = {
+                "step": 7, "time": "2026-08-04T00:00:00Z"}
+            tj.update_crd_status()
+
+        def critical():
+            tj.job.status.reason = "StallDetected"
+            tj.update_crd_status()
+
+        return [[telemetry], [critical]]
+
+    def check(order):
+        cs, tj = state["cs"], state["tj"]
+        stored = cs.tpujobs.get("default", "w")["status"]
+        # The critical field landed no matter the order.
+        assert stored.get("reason") == "StallDetected", order
+        if stored.get("lastHeartbeat", {}).get("step") == 7:
+            # telemetry rode along on the critical write (coalesced)
+            assert not tj._writeback_deferred, order
+        else:
+            # telemetry deferred: dirty in memory, retry armed
+            assert tj._writeback_deferred, order
+            assert tj.job.status.last_heartbeat["step"] == 7, order
+            assert tj.next_time_obligation() is not None, order
+
+    n = schedules.exhaustive(scenario, check)
+    assert n == 2
+
+
+# --- triple 3: straggler fold vs attempt reset --------------------------------
+
+def test_schedule_straggler_fold_vs_attempt_reset():
+    """Every serialization of: the old gang's last beats (one slow
+    member), the reconcile's attempt bump, and the new gang's first
+    beat. No schedule may leave a dead generation's straggler flag in
+    status — and the detector must never regress to the old generation
+    once it has seen the new one."""
+    state = {}
+
+    def scenario():
+        cs, controller, tj = _controller_with_job(name="sj")
+        state.update(controller=controller, tj=tj)
+
+        def old_beat_healthy():
+            controller.record_heartbeat("default", "sj",
+                                        _beat(1, 0.1, attempt=0))
+
+        def old_beat_slow():
+            controller.record_heartbeat("default", "sj",
+                                        _beat(2, 0.5, attempt=0))
+
+        def attempt_bump():
+            tj.job.status.attempt = 1
+
+        def new_beat():
+            controller.record_heartbeat("default", "sj",
+                                        _beat(1, 0.1, attempt=1, step=0))
+
+        return [[old_beat_healthy, old_beat_slow], [attempt_bump],
+                [new_beat]]
+
+    def check(order):
+        tj = state["tj"]
+        controller = state["controller"]
+        # The dead generation's flag never survives the schedule.
+        assert tj.job.status.stragglers == [], order
+        # And the detector's memory never points at a generation older
+        # than the newest beat it accepted.
+        cadence = controller._gang_cadence.get("default/sj")
+        assert cadence is not None and cadence["attempt"] == 1, order
+
+    n = schedules.exhaustive(scenario, check)
+    assert n == 12  # merges of 2+1+1
+
+
+def test_stale_beat_does_not_regress_detector_generation():
+    """Named regression for the defect the schedule above surfaced: a
+    terminating pod's attempt-0 beat landing AFTER the new gang's first
+    attempt-1 beat (but before the reconcile bumps status.attempt) used
+    to reset the detector back to generation 0, wiping the live gang's
+    cadence and force-persisting a spurious stragglers clear."""
+    _cs, controller, tj = _controller_with_job(name="sj")
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(1, 0.1, attempt=1, step=0))
+    cadence = controller._gang_cadence["default/sj"]
+    assert cadence["attempt"] == 1 and 1 in cadence["procs"]
+    # The stale beat: status.attempt is still 0, so the age gate in
+    # record_heartbeat does NOT drop it — the detector itself must.
+    assert controller.record_heartbeat("default", "sj",
+                                       _beat(2, 0.5, attempt=0))
+    cadence = controller._gang_cadence["default/sj"]
+    assert cadence["attempt"] == 1, \
+        "stale attempt-0 beat regressed the detector generation"
+    assert 1 in cadence["procs"] and 2 not in cadence["procs"]
+
+
+# --- triple 4: write-behind enqueue vs close()-drain --------------------------
+
+class _RecordingStore:
+    """WarmStartStore stand-in that records uploads in order."""
+
+    def __init__(self):
+        self.uploads = []
+        self.artifacts = []
+
+    def upload_checkpoint(self, step_dir, step):
+        self.uploads.append(int(step))
+
+    def mark_corrupt(self, step, reason=""):
+        pass
+
+    def upload_artifact(self, path, name):
+        self.artifacts.append(name)
+
+    def upload_cache(self, cache_dir):
+        return 0
+
+
+def test_schedule_writebehind_enqueue_vs_close_drain():
+    """Named regression for the close-ordering defect the interleaving
+    harness surfaced: close(flush=True) used to drain FIRST and mark
+    closed after, so an enqueue landing in between was accepted and then
+    stranded behind close's return (the process exit tears down the
+    daemon worker mid-upload — a lost final checkpoint). The contract
+    now: every enqueue that returns True is uploaded (or superseded by a
+    later accepted step) by the time close(flush=True) returns; a racing
+    enqueue that cannot be honored is REFUSED, never stranded."""
+    store = _RecordingStore()
+    with schedules.PointGate() as gate:
+        gate.hold("writebehind.popped")
+        up = WriteBehindUploader(store)
+        assert up.enqueue(5, "/tmp/s5") is True
+        # The worker pops step 5 and parks mid-window: queue empty,
+        # upload not yet done — the exact state flush() misreads.
+        assert gate.wait_blocked("writebehind.popped")
+        assert up.enqueue(6, "/tmp/s6") is True  # accepted pre-close
+
+        gate.hold("writebehind.close.marked")
+        closer = threading.Thread(target=lambda: up.close(flush=True),
+                                  daemon=True)
+        closer.start()
+        assert gate.wait_blocked("writebehind.close.marked")
+        # The close mark has landed: the racing enqueue is refused
+        # outright instead of being silently accepted-and-stranded.
+        assert up.enqueue(7, "/tmp/s7") is False
+        gate.release("writebehind.close.marked")
+        gate.release("writebehind.popped")
+        closer.join(timeout=10.0)
+        assert not closer.is_alive()
+    # Every accepted step landed before close returned; the refused one
+    # never did.
+    assert store.uploads == [5, 6]
+    assert up.stats()["lastUploadedStep"] == 6
+    assert up.idle()
+
+
+def test_schedule_writebehind_seeded_no_lost_accepted_steps():
+    """Seeded cooperative schedules over enqueue/close against a live
+    worker: under every seed, close(flush=True) returns only after every
+    ACCEPTED step is uploaded or superseded."""
+    def build(sched_):
+        store = _RecordingStore()
+        up = WriteBehindUploader(store)
+        accepted = []
+
+        def enqueue(step):
+            def op():
+                if up.enqueue(step, f"/tmp/s{step}"):
+                    accepted.append(step)
+            return op
+
+        def close_and_check():
+            up.close(flush=True, timeout=10.0)
+            outstanding = [s for s in accepted
+                           if s not in store.uploads
+                           and any(l > s for l in accepted)
+                           is False]
+            assert not [s for s in outstanding
+                        if s == max(accepted, default=-1)], \
+                (sched_.seed, accepted, store.uploads)
+
+        sched_.add("producer", enqueue(1), enqueue(2))
+        sched_.add("closer", close_and_check)
+
+    schedules.run_seeds(build, seeds=range(8), step_timeout=0.75)

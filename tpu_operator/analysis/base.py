@@ -74,11 +74,34 @@ def rel(root: Path, path: Path) -> str:
     return path.relative_to(root).as_posix()
 
 
+# Parsed-tree cache shared by every rule in one analyzer run: the
+# concurrency / lock-order / escape trio walks the same SCAN universe,
+# and re-parsing ~25 files per rule tripled the gate's AST cost. Keyed
+# on (path, mtime_ns, size) so a fixture tree rewritten in place (the
+# self-tests do this) never serves a stale tree; bounded so long test
+# sessions can't grow it without limit. Trees are shared read-only
+# (attach_parents is idempotent).
+_PARSE_CACHE: Dict[Tuple[str, int, int], Optional[ast.Module]] = {}
+_PARSE_CACHE_MAX = 512
+
+
 def parse_file(path: Path) -> Optional[ast.Module]:
     try:
-        return ast.parse(path.read_text(encoding="utf-8"), filename=str(path))
-    except (OSError, SyntaxError):
+        st = path.stat()
+        key = (str(path), st.st_mtime_ns, st.st_size)
+    except OSError:
         return None
+    if key in _PARSE_CACHE:
+        return _PARSE_CACHE[key]
+    try:
+        tree = ast.parse(path.read_text(encoding="utf-8"),
+                         filename=str(path))
+    except (OSError, SyntaxError):
+        tree = None
+    if len(_PARSE_CACHE) >= _PARSE_CACHE_MAX:
+        _PARSE_CACHE.clear()
+    _PARSE_CACHE[key] = tree
+    return tree
 
 
 def iter_py_files(root: Path, *parts: str) -> List[Path]:

@@ -42,14 +42,17 @@ from tpu_operator.analysis.base import Finding, ancestors, attach_parents, \
 
 RULE = "concurrency"
 
-# The threaded control-plane surface this rule watches.
+# The threaded control-plane surface this rule watches — shared with the
+# lock-order and escape rules, so all three see one universe.
 SCAN = (
     ("tpu_operator", "client"),
     ("tpu_operator", "controller"),
     ("tpu_operator", "scheduler"),
     ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),
+    ("tpu_operator", "util"),
     ("tpu_operator", "payload", "checkpoint.py"),
+    ("tpu_operator", "payload", "startup.py"),
     ("tpu_operator", "payload", "steptrace.py"),
     ("tpu_operator", "payload", "train.py"),
     ("tpu_operator", "payload", "warmstore.py"),

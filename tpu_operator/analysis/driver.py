@@ -12,8 +12,8 @@ from __future__ import annotations
 from pathlib import Path
 from typing import Iterable, List, Optional, Set, Tuple
 
-from tpu_operator.analysis import concurrency, env_contract, \
-    exception_policy, payload_image, spec_drift, status_contract
+from tpu_operator.analysis import concurrency, env_contract, escape, \
+    exception_policy, lock_order, payload_image, spec_drift, status_contract
 from tpu_operator.analysis.base import Allowlist, Finding
 
 # Stable rule-id -> module order; findings print grouped in this order.
@@ -22,6 +22,8 @@ RULES = {
     env_contract.RULE: env_contract,
     status_contract.RULE: status_contract,
     concurrency.RULE: concurrency,
+    lock_order.RULE: lock_order,
+    escape.RULE: escape,
     exception_policy.RULE: exception_policy,
     payload_image.RULE: payload_image,
 }

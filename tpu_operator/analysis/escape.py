@@ -1,0 +1,426 @@
+"""Rule ``escape``: shared mutable state reachable from ≥2 thread
+entrypoints with no guarding lock and no ``guarded-by`` annotation.
+
+The ``concurrency`` rule enforces annotations that exist; this rule
+hunts the state nobody annotated. Two scopes:
+
+1. **Class attributes.** For every class that provably runs on more
+   than one thread — it spawns a ``Thread(target=self.X)``, registers
+   ``self.X``/lambda handlers via ``add_event_handler`` (informer
+   dispatch threads), or defines HTTP handler methods (``do_GET``/
+   ``do_POST``) — partition its methods into *thread domains*: the
+   closure of each thread root under same-class calls, plus "main"
+   (everything else). An attribute MUTATED outside ``__init__`` in one
+   domain and TOUCHED in another, where the mutation is not under any
+   lock-shaped ``with`` and the attribute carries no ``# guarded-by:``
+   annotation, has escaped the lock discipline — exactly the shape of
+   an informer handler list appended during a live dispatch.
+
+   Mutation = assignment/augassign/del of ``self.X``, subscript stores,
+   or calls to known mutator methods (``append``/``add``/``pop``/...).
+   Attributes that ARE synchronization objects (``threading.Event``,
+   ``queue.Queue`` — internally locked) are exempt.
+
+2. **Module globals.** In modules that spawn threads or register
+   callbacks onto foreign threads (``Thread(...)``,
+   ``register_event_listener``), a module-level variable mutated from
+   any function without a lock and without an annotation is flagged.
+   Separately, a module-level ``# guarded-by: <lock>`` annotation is
+   ENFORCED on every mutation site regardless of the module's thread
+   profile — an annotation is a contract, not a comment.
+
+Keys: ``attr:<file>:<Class>.<attr>`` and ``global:<file>:<name>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set
+
+from tpu_operator.analysis.base import Finding, ancestors, attach_parents, \
+    comment_annotations, dotted_name, iter_py_files, parse_file, rel
+from tpu_operator.analysis.concurrency import SCAN, _lockish
+
+RULE = "escape"
+
+_MUTATORS = {"append", "add", "pop", "remove", "clear", "update", "extend",
+             "discard", "popitem", "insert", "setdefault", "appendleft",
+             "move_to_end", "set"}
+
+# Constructors whose instances synchronize internally (or ARE the
+# synchronization): mutations through them are not escapes.
+_SYNC_CTORS = {"threading.Event", "Event", "threading.Lock", "Lock",
+               "threading.RLock", "RLock", "threading.Condition",
+               "Condition", "threading.Semaphore", "Semaphore",
+               "queue.Queue", "Queue", "threading.local",
+               "lockdep.lock", "lockdep.rlock", "lockdep.condition"}
+
+_HTTP_ROOTS = {"do_GET", "do_POST", "do_PUT", "do_DELETE"}
+
+_CALLBACK_REGISTRARS = {"register_event_listener", "add_event_handler",
+                        "install"}
+
+
+def _self_attr(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _method_of(node: ast.AST, cls: ast.ClassDef) -> Optional[str]:
+    """Name of the class-body method whose frame contains ``node``
+    (None for nested defs — they are their own threads' business)."""
+    chain = [node] + list(ancestors(node))
+    for i, anc in enumerate(chain):
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            parent = chain[i + 1] if i + 1 < len(chain) else None
+            return anc.name if parent is cls else None
+    return None
+
+
+def _under_lock(node: ast.AST) -> bool:
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                if _lockish(item.context_expr):
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+def _thread_roots(cls: ast.ClassDef) -> Set[str]:
+    """Method names that are thread entrypoints of this class."""
+    roots: Set[str] = set()
+    for method in cls.body:
+        if isinstance(method, ast.FunctionDef) and method.name in _HTTP_ROOTS:
+            roots.add(method.name)
+    for node in ast.walk(cls):
+        if not isinstance(node, ast.Call):
+            continue
+        callee = dotted_name(node.func)
+        leaf = callee.rsplit(".", 1)[-1]
+        if callee in ("threading.Thread", "Thread"):
+            for kw in node.keywords:
+                if kw.arg == "target":
+                    roots.update(_ref_methods(kw.value))
+        elif leaf in _CALLBACK_REGISTRARS:
+            for arg in list(node.args) + [kw.value for kw in node.keywords]:
+                roots.update(_ref_methods(arg))
+    return roots
+
+
+def _ref_methods(expr: ast.AST) -> Set[str]:
+    """Method names referenced by ``self.X`` or by lambdas calling
+    ``self.X(...)`` inside ``expr``."""
+    out: Set[str] = set()
+    for node in ast.walk(expr):
+        attr = _self_attr(node)
+        if attr is not None:
+            out.add(attr)
+    return out
+
+
+def _domain_closure(cls: ast.ClassDef, methods: Dict[str, ast.FunctionDef],
+                    root: str) -> Set[str]:
+    """Methods reachable from ``root`` through same-class calls."""
+    seen: Set[str] = set()
+    stack = [root]
+    while stack:
+        name = stack.pop()
+        if name in seen or name not in methods:
+            continue
+        seen.add(name)
+        for node in ast.walk(methods[name]):
+            if isinstance(node, ast.Call):
+                callee = _self_attr(node.func)
+                if callee is not None and callee in methods:
+                    stack.append(callee)
+    return seen
+
+
+def _self_syncing_classes(trees: List[ast.Module]) -> Set[str]:
+    """Classes in the scanned universe that own a lock (their methods
+    synchronize internally — RateLimitingQueue, Metrics, ...): calling
+    into an instance is not an escape, so attributes holding one are
+    exempt like Queue/Event."""
+    out: Set[str] = set()
+    for tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Call) \
+                        and dotted_name(sub.func) in _SYNC_CTORS:
+                    out.add(node.name)
+                    break
+    return out
+
+
+def _check_class(cls: ast.ClassDef, path_rel: str, notes: Dict[int, str],
+                 selfsync: Set[str]) -> List[Finding]:
+    methods = {m.name: m for m in cls.body
+               if isinstance(m, ast.FunctionDef)}
+    roots = _thread_roots(cls)
+    if not roots:
+        return []
+    domains: Dict[str, Set[str]] = {
+        root: _domain_closure(cls, methods, root) for root in sorted(roots)
+    }
+    threaded = set().union(*domains.values()) if domains else set()
+    domains["<main>"] = {m for m in methods if m not in threaded}
+
+    # guarded-by-annotated attrs (any line of the class body) and
+    # sync-object attrs are exempt.
+    annotated: Set[str] = set()
+    sync_attrs: Set[str] = set()
+    for node in ast.walk(cls):
+        target = None
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target = node.targets[0]
+        elif isinstance(node, ast.AnnAssign):
+            target = node.target
+        attr = _self_attr(target) if target is not None else None
+        if attr is None:
+            continue
+        end = getattr(node, "end_lineno", None) or node.lineno
+        if any(line in notes for line in range(node.lineno, end + 1)):
+            annotated.add(attr)
+        value = getattr(node, "value", None)
+        if value is not None:
+            for sub in ast.walk(value):
+                if not isinstance(sub, ast.Call):
+                    continue
+                ctor = dotted_name(sub.func)
+                if ctor in _SYNC_CTORS \
+                        or ctor.rsplit(".", 1)[-1] in selfsync:
+                    sync_attrs.add(attr)
+                    break
+        ann = getattr(node, "annotation", None)
+        if ann is not None:
+            for sub in ast.walk(ann):
+                if isinstance(sub, ast.Name) and sub.id in selfsync:
+                    sync_attrs.add(attr)
+
+    # Per attr: domains that mutate it (outside __init__, outside locks,
+    # outside *_locked methods) and domains that touch it at all.
+    mutated_in: Dict[str, Dict[str, int]] = {}  # attr -> {domain: line}
+    touched_in: Dict[str, Set[str]] = {}
+    for node in ast.walk(cls):
+        attr, is_mutation = _classify_access(node)
+        if attr is None or attr in annotated or attr in sync_attrs:
+            continue
+        method = _method_of(node, cls)
+        if method is None or method == "__init__":
+            continue
+        for domain, members in domains.items():
+            if method not in members:
+                continue
+            touched_in.setdefault(attr, set()).add(domain)
+            if is_mutation and not method.endswith("_locked") \
+                    and not _under_lock(node):
+                mutated_in.setdefault(attr, {}).setdefault(domain,
+                                                           node.lineno)
+
+    findings: List[Finding] = []
+    for attr in sorted(mutated_in):
+        mut_domains = mutated_in[attr]
+        others = touched_in.get(attr, set()) - set(mut_domains)
+        # Escaped: mutated in ≥2 domains, or mutated in one and touched
+        # in another.
+        if len(mut_domains) < 2 and not others:
+            continue
+        domain, line = sorted(mut_domains.items())[0]
+        all_domains = sorted(set(mut_domains) | others)
+        findings.append(Finding(
+            RULE, path_rel, line,
+            f"{cls.name}.{attr} is mutated without a lock but reachable "
+            f"from {len(all_domains)} thread domains "
+            f"({', '.join(all_domains)}) — guard it and annotate "
+            f"`# guarded-by: <lock>`, or justify via allowlist",
+            key=f"attr:{path_rel}:{cls.name}.{attr}"))
+    return findings
+
+
+def _classify_access(node: ast.AST) -> tuple:
+    """(attr, is_mutation) for one AST node touching ``self.X``."""
+    # self.X = / self.X op= / del self.X
+    if isinstance(node, ast.Attribute):
+        attr = _self_attr(node)
+        if attr is None:
+            return None, False
+        ctx = node.ctx
+        if isinstance(ctx, (ast.Store, ast.Del)):
+            return attr, True
+        # self.X[...] = value
+        parent = getattr(node, "parent", None)
+        if isinstance(parent, ast.Subscript) \
+                and isinstance(parent.ctx, (ast.Store, ast.Del)):
+            return attr, True
+        # self.X.append(...) etc.
+        if isinstance(parent, ast.Attribute) and parent.attr in _MUTATORS:
+            grand = getattr(parent, "parent", None)
+            if isinstance(grand, ast.Call) and grand.func is parent:
+                return attr, True
+        return attr, False
+    return None, False
+
+
+# --- module-level globals -----------------------------------------------------
+
+def _check_module(tree: ast.Module, path_rel: str,
+                  notes: Dict[int, str]) -> List[Finding]:
+    # Module-level variables and their guarded-by annotations.
+    module_vars: Set[str] = set()
+    annotated: Dict[str, str] = {}
+    sync_vars: Set[str] = set()
+    for stmt in tree.body:
+        targets: List[ast.AST] = []
+        if isinstance(stmt, ast.Assign):
+            targets = stmt.targets
+        elif isinstance(stmt, ast.AnnAssign):
+            targets = [stmt.target]
+        for target in targets:
+            if not isinstance(target, ast.Name):
+                continue
+            module_vars.add(target.id)
+            end = getattr(stmt, "end_lineno", None) or stmt.lineno
+            for line in range(stmt.lineno, end + 1):
+                if line in notes:
+                    annotated[target.id] = notes[line]
+                    break
+            value = getattr(stmt, "value", None)
+            if isinstance(value, ast.Call) \
+                    and dotted_name(value.func) in _SYNC_CTORS:
+                sync_vars.add(target.id)
+
+    threaded_module = any(
+        isinstance(node, ast.Call)
+        and (dotted_name(node.func) in ("threading.Thread", "Thread")
+             or dotted_name(node.func).rsplit(".", 1)[-1]
+             in _CALLBACK_REGISTRARS)
+        for node in ast.walk(tree))
+
+    findings: List[Finding] = []
+    flagged: Set[str] = set()
+    for node in ast.walk(tree):
+        if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        declared_global: Set[str] = set()
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Global):
+                declared_global.update(sub.names)
+        # Names BOUND locally (params, non-global assignments): a mutator
+        # call on one of these is local state shadowing a module name,
+        # not a global mutation.
+        local_bound: Set[str] = {
+            a.arg for a in (list(node.args.args)
+                            + list(node.args.kwonlyargs)
+                            + list(node.args.posonlyargs))}
+        for extra in (node.args.vararg, node.args.kwarg):
+            if extra is not None:
+                local_bound.add(extra.arg)
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Name) \
+                    and isinstance(sub.ctx, ast.Store) \
+                    and sub.id not in declared_global:
+                local_bound.add(sub.id)
+        for sub in ast.walk(node):
+            name, line = _global_mutation(sub, declared_global,
+                                          local_bound,
+                                          module_vars, sync_vars)
+            if name is None or name in flagged:
+                continue
+            guard = annotated.get(name)
+            if guard is not None:
+                if not _under_named_lock(sub, guard):
+                    flagged.add(name)
+                    findings.append(Finding(
+                        RULE, path_rel, line,
+                        f"module global {name} is annotated guarded-by "
+                        f"{guard} but {node.name}() mutates it outside "
+                        f"`with {guard}:`",
+                        key=f"global:{path_rel}:{name}"))
+            elif threaded_module and not _under_lock(sub):
+                flagged.add(name)
+                findings.append(Finding(
+                    RULE, path_rel, line,
+                    f"module global {name} is mutated by {node.name}() "
+                    f"without a lock in a module that runs callbacks/"
+                    f"threads — guard it and annotate "
+                    f"`# guarded-by: <lock>`, or justify via allowlist",
+                    key=f"global:{path_rel}:{name}"))
+    return findings
+
+
+def _global_mutation(node: ast.AST, declared_global: Set[str],
+                     local_bound: Set[str], module_vars: Set[str],
+                     sync_vars: Set[str]) -> tuple:
+    """(name, line) when ``node`` mutates a module global, else (None, 0)."""
+    # NAME = / NAME op= (requires a `global` declaration to bind)
+    if isinstance(node, ast.Name) and isinstance(node.ctx,
+                                                 (ast.Store, ast.Del)):
+        if node.id in declared_global and node.id in module_vars \
+                and node.id not in sync_vars:
+            return node.id, node.lineno
+        return None, 0
+    # MODULE_VAR.append(...) / MODULE_VAR[...] = ... — in-place mutation
+    # needs no `global` declaration (and a declared-global receiver is
+    # still the module object); only a LOCALLY-bound name shadowing the
+    # module var is exempt.
+    if isinstance(node, ast.Attribute) and node.attr in _MUTATORS \
+            and isinstance(node.value, ast.Name):
+        name = node.value.id
+        parent = getattr(node, "parent", None)
+        if (name in module_vars and name not in sync_vars
+                and name not in local_bound
+                and isinstance(parent, ast.Call) and parent.func is node):
+            return name, node.lineno
+    if isinstance(node, ast.Subscript) \
+            and isinstance(node.ctx, (ast.Store, ast.Del)) \
+            and isinstance(node.value, ast.Name) \
+            and node.value.id in module_vars \
+            and node.value.id not in local_bound \
+            and node.value.id not in sync_vars:
+        return node.value.id, node.lineno
+    return None, 0
+
+
+def _under_named_lock(node: ast.AST, lock: str) -> bool:
+    lock = lock.removeprefix("self.")
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                name = dotted_name(item.context_expr).removeprefix("self.")
+                if name == lock:
+                    return True
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break
+    return False
+
+
+def run(root: Path) -> List[Finding]:
+    findings: List[Finding] = []
+    seen: Set[Path] = set()
+    parsed: List[tuple] = []
+    for parts in SCAN:
+        for path in iter_py_files(root, *parts):
+            if path in seen:
+                continue
+            seen.add(path)
+            tree = parse_file(path)
+            if tree is None:
+                continue
+            attach_parents(tree)
+            parsed.append((tree, rel(root, path),
+                           comment_annotations(path, "guarded-by")))
+    selfsync = _self_syncing_classes([t for t, _p, _n in parsed])
+    for tree, path_rel, notes in parsed:
+        for node in ast.walk(tree):
+            if isinstance(node, ast.ClassDef):
+                findings += _check_class(node, path_rel, notes, selfsync)
+        findings += _check_module(tree, path_rel, notes)
+    return findings

@@ -1,0 +1,529 @@
+"""Rule ``lock-order``: the cross-module lock-acquisition graph.
+
+The per-function ``concurrency`` rule checks each access against *a*
+lock; this rule checks that locks nest in one consistent global ORDER —
+the property whose violation is a deadlock — and that blocking work
+never hides one call-hop below a lock:
+
+1. **Lock-order cycles.** Every ``with self._lock:`` (and the nested
+   withs and calls lexically inside it) contributes directed edges
+   ``held → acquired`` to one repo-wide graph. Calls are resolved
+   best-effort through the AST — ``self.m()`` to the same class,
+   ``self.attr.m()`` through the attr's inferred class (constructor
+   assignments, parameter annotations, ``Dict[str, T]`` container
+   reads), ``fn()`` to same-module functions — and each callee's
+   *transitive* acquisition set becomes edge targets, so the
+   controller→scheduler→metrics chain is visible even though no single
+   function spells it out. A cycle in the final graph is a potential
+   deadlock: two threads entering it from different arcs stall forever.
+   Lock nodes are named per class attribute (``FleetScheduler._lock``)
+   — instance-agnostic, like Linux lockdep's lock classes.
+
+2. **Blocking one-or-more call-hops under a lock.** The existing rule
+   flags ``time.sleep`` literally inside a ``with``; this one computes,
+   per function, whether it may (transitively) sleep, do socket or
+   subprocess I/O, or issue a clientset RPC — and flags any call made
+   under a held lock into such a function. This is the shape of the
+   PR-6 recorder bug (reconcile workers convoyed behind one thread's
+   apiserver RPC) one abstraction layer deeper, where the per-function
+   rule is structurally blind.
+
+The ``*_locked`` suffix convention composes: a ``_locked`` method's own
+body contributes edges from the caller's held lock (it runs under it),
+and its acquisitions of OTHER locks are ordinary edges.
+
+Resolution is deliberately conservative: an attr whose class cannot be
+inferred contributes no edges (missed edges are possible; false cycles
+are not — every edge has a concrete witness site, reported in the
+message). Keys: ``cycle:<A->B->...>`` (canonical rotation) and
+``blocking-hop:<file>:<qualname>:<callee>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from pathlib import Path
+from typing import Dict, List, Optional, Set, Tuple
+
+from tpu_operator.analysis.base import Finding, ancestors, attach_parents, \
+    dotted_name, iter_py_files, parse_file, rel
+from tpu_operator.analysis.concurrency import SCAN, _lockish
+
+RULE = "lock-order"
+
+# Lock-constructor call names (both raw threading and the lockdep
+# witness factories every operator module now uses).
+_LOCK_CTORS = {
+    "threading.Lock", "threading.RLock", "threading.Condition",
+    "Lock", "RLock", "Condition",
+    "lockdep.lock", "lockdep.rlock", "lockdep.condition",
+}
+
+# Direct blocking shapes (mirrors the per-function concurrency rule).
+_BLOCKING_ATTRS = {"sleep", "_sleep", "urlopen", "getaddrinfo",
+                   "create_connection", "check_call", "check_output"}
+_BLOCKING_PREFIXES = ("subprocess.", "socket.", "requests.")
+
+# Dependency-injected attrs typed ``Any`` in the repo's constructors:
+# name-based hints recover the edges annotation erasure hides. Each hint
+# only applies when the named class actually exists in the scanned set.
+_ATTR_NAME_HINTS = {
+    "metrics": "Metrics",
+    "_metrics": "Metrics",
+    "recorder": "EventRecorder",
+    "scheduler": "FleetScheduler",
+    "writeback": "WritebackLimiter",
+}
+
+
+def _ann_class_names(ann: Optional[ast.AST]) -> List[str]:
+    """Candidate class names inside an annotation expression —
+    ``Optional[FleetScheduler]`` → ["Optional", "FleetScheduler"];
+    string annotations ("FakeClientset") included."""
+    if ann is None:
+        return []
+    names: List[str] = []
+    for node in ast.walk(ann):
+        if isinstance(node, ast.Name):
+            names.append(node.id)
+        elif isinstance(node, ast.Attribute):
+            names.append(node.attr)
+        elif isinstance(node, ast.Constant) and isinstance(node.value, str):
+            names.append(node.value.split("[")[0].strip('"\' '))
+    return names
+
+
+class _ClassInfo:
+    def __init__(self, name: str, path_rel: str, node: ast.ClassDef):
+        self.name = name
+        self.path_rel = path_rel
+        self.node = node
+        self.methods: Dict[str, ast.FunctionDef] = {}
+        self.lock_attrs: Set[str] = set()
+        # attr -> candidate class names (constructor / annotation / hint)
+        self.attr_types: Dict[str, Set[str]] = {}
+        # attr -> candidate VALUE class names for Dict[...]-typed attrs
+        self.attr_value_types: Dict[str, Set[str]] = {}
+
+
+class _FuncInfo:
+    def __init__(self, qual: str, path_rel: str, node: ast.FunctionDef,
+                 cls: Optional[_ClassInfo]):
+        self.qual = qual            # "Class.method" or "module:fn"
+        self.path_rel = path_rel
+        self.node = node
+        self.cls = cls
+
+
+class _Model:
+    """The scanned universe: classes, functions, module locks."""
+
+    def __init__(self) -> None:
+        self.classes: Dict[str, _ClassInfo] = {}
+        self.functions: Dict[str, _FuncInfo] = {}
+        # module path -> {function name -> qual}
+        self.module_funcs: Dict[str, Dict[str, str]] = {}
+        # module path -> {global name known to be a lock}
+        self.module_locks: Dict[str, Set[str]] = {}
+
+
+def _is_lock_ctor(call: ast.AST) -> bool:
+    return (isinstance(call, ast.Call)
+            and dotted_name(call.func) in _LOCK_CTORS)
+
+
+def _collect(model: _Model, tree: ast.Module, path_rel: str) -> None:
+    model.module_funcs.setdefault(path_rel, {})
+    model.module_locks.setdefault(path_rel, set())
+    for stmt in tree.body:
+        if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1 \
+                and isinstance(stmt.targets[0], ast.Name) \
+                and _is_lock_ctor(stmt.value):
+            model.module_locks[path_rel].add(stmt.targets[0].id)
+        if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            qual = f"{path_rel}:{stmt.name}"
+            model.functions[qual] = _FuncInfo(qual, path_rel, stmt, None)
+            model.module_funcs[path_rel][stmt.name] = qual
+        if isinstance(stmt, ast.ClassDef):
+            info = _ClassInfo(stmt.name, path_rel, stmt)
+            # Last definition wins on name collisions across modules —
+            # acceptable for this repo (class names are unique).
+            model.classes[stmt.name] = info
+            for item in stmt.body:
+                if isinstance(item, ast.FunctionDef):
+                    info.methods[item.name] = item
+                    qual = f"{stmt.name}.{item.name}"
+                    model.functions[qual] = _FuncInfo(qual, path_rel, item,
+                                                      info)
+
+
+def _self_attr_target(node: ast.AST) -> Optional[str]:
+    if (isinstance(node, ast.Attribute)
+            and isinstance(node.value, ast.Name)
+            and node.value.id == "self"):
+        return node.attr
+    return None
+
+
+def _infer_types(model: _Model) -> None:
+    """Fill attr_types / attr_value_types per class from constructor
+    calls, parameter annotations, AnnAssign annotations and name hints."""
+    for info in model.classes.values():
+        param_anns: Dict[str, List[str]] = {}
+        init = info.methods.get("__init__")
+        if init is not None:
+            for arg in list(init.args.args) + list(init.args.kwonlyargs):
+                param_anns[arg.arg] = _ann_class_names(arg.annotation)
+        for method in info.methods.values():
+            for stmt in ast.walk(method):
+                target = None
+                value = None
+                ann = None
+                if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                    target, value = stmt.targets[0], stmt.value
+                elif isinstance(stmt, ast.AnnAssign):
+                    target, value, ann = stmt.target, stmt.value, \
+                        stmt.annotation
+                attr = _self_attr_target(target) if target is not None \
+                    else None
+                if attr is None:
+                    continue
+                if _is_lock_ctor(value):
+                    info.lock_attrs.add(attr)
+                    continue
+                cands: Set[str] = set()
+                # Constructor calls anywhere in the value (covers
+                # ``x if cond else Metrics()``).
+                if value is not None:
+                    for sub in ast.walk(value):
+                        if isinstance(sub, ast.Call):
+                            leaf = dotted_name(sub.func).rsplit(".", 1)[-1]
+                            if leaf in model.classes:
+                                cands.add(leaf)
+                    # Plain parameter pass-through: use its annotation.
+                    if isinstance(value, ast.Name):
+                        cands.update(n for n in param_anns.get(value.id, [])
+                                     if n in model.classes)
+                ann_names = _ann_class_names(ann)
+                cands.update(n for n in ann_names if n in model.classes)
+                if cands:
+                    info.attr_types.setdefault(attr, set()).update(cands)
+                # Dict[...]-valued attrs: remember candidate VALUE types
+                # so ``self.jobs.get(k)`` locals resolve.
+                if ann_names and ann_names[0] in ("Dict", "dict",
+                                                  "OrderedDict"):
+                    vals = {n for n in ann_names[1:] if n in model.classes}
+                    if vals:
+                        info.attr_value_types.setdefault(attr,
+                                                         set()).update(vals)
+                hint = _ATTR_NAME_HINTS.get(attr)
+                if hint and hint in model.classes:
+                    info.attr_types.setdefault(attr, set()).add(hint)
+
+
+def _local_types(fn: _FuncInfo, model: _Model) -> Dict[str, Set[str]]:
+    """Best-effort local-variable class inference inside one function
+    (seeded from the function's own annotated parameters)."""
+    out: Dict[str, Set[str]] = {}
+    cls = fn.cls
+    for arg in (list(fn.node.args.args) + list(fn.node.args.kwonlyargs)):
+        cands = {n for n in _ann_class_names(arg.annotation)
+                 if n in model.classes}
+        if cands:
+            out.setdefault(arg.arg, set()).update(cands)
+    for stmt in ast.walk(fn.node):
+        if not (isinstance(stmt, ast.Assign) and len(stmt.targets) == 1
+                and isinstance(stmt.targets[0], ast.Name)):
+            continue
+        name = stmt.targets[0].id
+        value = stmt.value
+        cands: Set[str] = set()
+        if isinstance(value, ast.Call):
+            leaf = dotted_name(value.func).rsplit(".", 1)[-1]
+            if leaf in model.classes:
+                cands.add(leaf)
+            # self.<dictattr>.get(...) → the dict's value type.
+            if (cls is not None and isinstance(value.func, ast.Attribute)
+                    and value.func.attr in ("get", "pop", "setdefault")):
+                recv = _self_attr_target(value.func.value)
+                if recv is not None and recv in cls.attr_value_types:
+                    cands.update(cls.attr_value_types[recv])
+        elif cls is not None:
+            attr = _self_attr_target(value)
+            if attr is not None and attr in cls.attr_types:
+                cands.update(cls.attr_types[attr])
+        if cands:
+            out.setdefault(name, set()).update(cands)
+    return out
+
+
+def _lock_id(expr: ast.AST, fn: _FuncInfo, model: _Model,
+             locals_: Dict[str, Set[str]]) -> Optional[str]:
+    """Node id in the order graph for a lock-shaped with-item."""
+    if _lockish(expr) is None:
+        return None
+    # self.X
+    attr = _self_attr_target(expr)
+    if attr is not None and fn.cls is not None:
+        return f"{fn.cls.name}.{attr}"
+    # self.a.b (lock owned by a typed attribute, e.g. self._cs.lock)
+    if isinstance(expr, ast.Attribute):
+        owner_attr = _self_attr_target(expr.value)
+        if owner_attr is not None and fn.cls is not None:
+            for owner_cls in sorted(fn.cls.attr_types.get(owner_attr, ())):
+                if expr.attr in model.classes[owner_cls].lock_attrs:
+                    return f"{owner_cls}.{expr.attr}"
+        # local.b
+        if isinstance(expr.value, ast.Name):
+            for owner_cls in sorted(locals_.get(expr.value.id, ())):
+                if expr.attr in model.classes[owner_cls].lock_attrs:
+                    return f"{owner_cls}.{expr.attr}"
+    # module-level lock
+    if isinstance(expr, ast.Name):
+        if expr.id in model.module_locks.get(fn.path_rel, ()):
+            return f"{fn.path_rel}:{expr.id}"
+        # function-local lock: node scoped to the function
+        return f"{fn.qual}:{expr.id}"
+    # Unresolvable lock-shaped expression: a conservative local node.
+    return f"{fn.qual}:{dotted_name(expr)}"
+
+
+def _resolve_call(call: ast.Call, fn: _FuncInfo, model: _Model,
+                  locals_: Dict[str, Set[str]]) -> List[str]:
+    """Call site → candidate function quals in the scanned universe."""
+    func = call.func
+    targets: List[str] = []
+    if isinstance(func, ast.Attribute):
+        method = func.attr
+        # self.m()
+        if isinstance(func.value, ast.Name) and func.value.id == "self" \
+                and fn.cls is not None:
+            if method in fn.cls.methods:
+                return [f"{fn.cls.name}.{method}"]
+            return []
+        # self.attr.m() / local.m()
+        owner_classes: Set[str] = set()
+        attr = _self_attr_target(func.value)
+        if attr is not None and fn.cls is not None:
+            owner_classes = fn.cls.attr_types.get(attr, set())
+        elif isinstance(func.value, ast.Name):
+            owner_classes = locals_.get(func.value.id, set())
+        for owner in sorted(owner_classes):
+            if method in model.classes[owner].methods:
+                targets.append(f"{owner}.{method}")
+        return targets
+    if isinstance(func, ast.Name):
+        qual = model.module_funcs.get(fn.path_rel, {}).get(func.id)
+        if qual is not None:
+            return [qual]
+    return []
+
+
+def _direct_blocking(call: ast.Call) -> Optional[str]:
+    callee = dotted_name(call.func)
+    leaf = callee.rsplit(".", 1)[-1]
+    if (leaf in _BLOCKING_ATTRS
+            or callee == "time.sleep"
+            or any(callee.startswith(p) for p in _BLOCKING_PREFIXES)
+            or ".clientset." in f".{callee}."):
+        return callee
+    return None
+
+
+class _Summaries:
+    """Per-function transitive summaries with cycle-safe memoization."""
+
+    def __init__(self, model: _Model):
+        self.model = model
+        self._locals: Dict[str, Dict[str, Set[str]]] = {}
+        self._acq: Dict[str, Set[str]] = {}
+        self._blk: Dict[str, Dict[str, str]] = {}  # qual -> {reason: site}
+        self._stack: Set[str] = set()
+
+    def locals_of(self, qual: str) -> Dict[str, Set[str]]:
+        if qual not in self._locals:
+            self._locals[qual] = _local_types(self.model.functions[qual],
+                                              self.model)
+        return self._locals[qual]
+
+    def acquires(self, qual: str) -> Set[str]:
+        """Lock ids ``qual`` may acquire, transitively."""
+        if qual in self._acq:
+            return self._acq[qual]
+        if qual in self._stack:
+            return set()  # recursion: the fixpoint converges from below
+        self._stack.add(qual)
+        fn = self.model.functions[qual]
+        locals_ = self.locals_of(qual)
+        out: Set[str] = set()
+        for node in ast.walk(fn.node):
+            if isinstance(node, ast.With):
+                for item in node.items:
+                    lid = _lock_id(item.context_expr, fn, self.model,
+                                   locals_)
+                    if lid:
+                        out.add(lid)
+            elif isinstance(node, ast.Call):
+                for target in _resolve_call(node, fn, self.model, locals_):
+                    out |= self.acquires(target)
+        self._stack.discard(qual)
+        self._acq[qual] = out
+        return out
+
+    def blocks(self, qual: str) -> Dict[str, str]:
+        """Blocking reasons reachable from ``qual``: reason -> witness
+        ("file:line"). Direct blocking calls made on a lock-shaped
+        receiver (``cond.wait``) are excluded — they release."""
+        if qual in self._blk:
+            return self._blk[qual]
+        if qual in self._stack:
+            return {}
+        self._stack.add(qual)
+        fn = self.model.functions[qual]
+        locals_ = self.locals_of(qual)
+        out: Dict[str, str] = {}
+        for node in ast.walk(fn.node):
+            if not isinstance(node, ast.Call):
+                continue
+            reason = _direct_blocking(node)
+            if reason is not None:
+                recv = node.func.value if isinstance(node.func,
+                                                     ast.Attribute) else None
+                if recv is not None and _lockish(recv):
+                    continue  # wait/notify on a lock releases it
+                out.setdefault(reason,
+                               f"{fn.path_rel}:{node.lineno}")
+                continue
+            for target in _resolve_call(node, fn, self.model, locals_):
+                for reason, site in self.blocks(target).items():
+                    out.setdefault(reason, site)
+        self._stack.discard(qual)
+        self._blk[qual] = out
+        return out
+
+
+def _enclosing_with_lock_ids(node: ast.AST, fn: _FuncInfo, model: _Model,
+                             locals_: Dict[str, Set[str]]) -> List[str]:
+    out: List[str] = []
+    for anc in ancestors(node):
+        if isinstance(anc, ast.With):
+            for item in anc.items:
+                lid = _lock_id(item.context_expr, fn, model, locals_)
+                if lid:
+                    out.append(lid)
+        if isinstance(anc, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            break  # nested defs (handlers) have their own frames
+    return out
+
+
+def _canonical_cycle(cycle: List[str]) -> str:
+    """Rotation-invariant rendering: start at the lexicographic min."""
+    i = cycle.index(min(cycle))
+    rotated = cycle[i:] + cycle[:i]
+    return "->".join(rotated + [rotated[0]])
+
+
+def run(root: Path) -> List[Finding]:
+    model = _Model()
+    trees: List[Tuple[ast.Module, str]] = []
+    seen: Set[Path] = set()
+    for parts in SCAN:
+        for path in iter_py_files(root, *parts):
+            if path in seen:
+                continue
+            seen.add(path)
+            tree = parse_file(path)
+            if tree is None:
+                continue
+            attach_parents(tree)
+            path_rel = rel(root, path)
+            trees.append((tree, path_rel))
+            _collect(model, tree, path_rel)
+    _infer_types(model)
+    sums = _Summaries(model)
+
+    findings: List[Finding] = []
+    # edge -> (witness file, line, description)
+    edge_witness: Dict[Tuple[str, str], Tuple[str, int, str]] = {}
+    reported_hops: Set[str] = set()
+
+    for qual, fn in model.functions.items():
+        locals_ = sums.locals_of(qual)
+        for node in ast.walk(fn.node):
+            held: List[str] = []
+            acquired_here: List[str] = []
+            if isinstance(node, ast.With):
+                held = _enclosing_with_lock_ids(node, fn, model, locals_)
+                for item in node.items:
+                    lid = _lock_id(item.context_expr, fn, model, locals_)
+                    if lid:
+                        acquired_here.append(lid)
+            elif isinstance(node, ast.Call):
+                held = _enclosing_with_lock_ids(node, fn, model, locals_)
+                if held:
+                    for target in _resolve_call(node, fn, model, locals_):
+                        acquired_here.extend(sums.acquires(target))
+                        blocked = sums.blocks(target)
+                        if blocked:
+                            reason, site = sorted(blocked.items())[0]
+                            callee = dotted_name(node.func)
+                            key = f"blocking-hop:{fn.path_rel}:" \
+                                  f"{qual.rsplit(':', 1)[-1]}:{callee}"
+                            if key not in reported_hops:
+                                reported_hops.add(key)
+                                findings.append(Finding(
+                                    RULE, fn.path_rel, node.lineno,
+                                    f"call {callee}() under `with "
+                                    f"{held[0]}:` reaches blocking "
+                                    f"{reason}() (at {site}) — every "
+                                    f"thread contending on the lock "
+                                    f"serializes behind that I/O",
+                                    key=key))
+            if not held or not acquired_here:
+                continue
+            # A `*_locked` method's own lock is held by its caller, so
+            # an edge onto it from the enclosing with is reentrant
+            # context, not nesting — same-node edges are dropped below.
+            for h in held:
+                for a in acquired_here:
+                    if h == a:
+                        continue
+                    edge_witness.setdefault(
+                        (h, a), (fn.path_rel, node.lineno, qual))
+
+    # Cycle detection over the final edge set (DFS, each cycle once).
+    adj: Dict[str, List[str]] = {}
+    for a, b in edge_witness:
+        adj.setdefault(a, []).append(b)
+    for nbrs in adj.values():
+        nbrs.sort()
+    reported_cycles: Set[str] = set()
+
+    def dfs(start: str) -> None:
+        stack: List[Tuple[str, List[str]]] = [(start, [start])]
+        while stack:
+            node, path = stack.pop()
+            for nxt in adj.get(node, ()):
+                if nxt == start:
+                    cyc = _canonical_cycle(path)
+                    if cyc in reported_cycles:
+                        continue
+                    reported_cycles.add(cyc)
+                    wfile, wline, wqual = edge_witness[(node, start)]
+                    sites = "; ".join(
+                        f"{a}->{b} at "
+                        f"{edge_witness[(a, b)][0]}:"
+                        f"{edge_witness[(a, b)][1]}"
+                        for a, b in zip(path, path[1:] + [start]))
+                    findings.append(Finding(
+                        RULE, wfile, wline,
+                        f"lock-order cycle {cyc} — threads entering it "
+                        f"from different arcs deadlock (witnesses: "
+                        f"{sites})",
+                        key=f"cycle:{cyc}"))
+                elif nxt not in path:
+                    stack.append((nxt, path + [nxt]))
+
+    for start in sorted(adj):
+        dfs(start)
+    return findings

@@ -25,11 +25,11 @@ from __future__ import annotations
 
 import copy
 import queue
-import threading
 from typing import Any, Callable, Dict, Iterator, List, Optional, Tuple
 
 from tpu_operator.client import errors
 from tpu_operator.client.selectors import matches
+from tpu_operator.util import lockdep
 
 
 class Watch:
@@ -61,8 +61,11 @@ class FakeResourceClient:
     def __init__(self, kind: str, clientset: "FakeClientset"):
         self.kind = kind
         self._cs = clientset
-        self._store: Dict[Tuple[str, str], dict] = {}
-        self._watchers: List[Tuple[queue.Queue, str, Optional[str]]] = []  # (q, ns, selector)
+        # Both guarded by the clientset's ONE RLock: cross-resource
+        # operations (close_watches, the global version counter) must see
+        # a consistent world, so per-resource locks would be wrong.
+        self._store: Dict[Tuple[str, str], dict] = {}  # guarded-by: _cs.lock
+        self._watchers: List[Tuple[queue.Queue, str, Optional[str]]] = []  # (q, ns, selector); guarded-by: _cs.lock
 
     # -- helpers -------------------------------------------------------------
 
@@ -72,7 +75,8 @@ class FakeResourceClient:
         )
         return (namespace, name)
 
-    def _notify(self, event_type: str, obj: dict, namespace: str) -> None:
+    def _notify_locked(self, event_type: str, obj: dict, namespace: str) -> None:
+        # Caller holds self._cs.lock (the *_locked convention).
         # Deletion bumps the resourceVersion on the *event* object (real
         # apiserver semantics: the watch DELETED event carries a fresh RV),
         # so the event log stays ordered by the global version counter.
@@ -106,7 +110,7 @@ class FakeResourceClient:
             md["resourceVersion"] = str(self._cs.next_version())
             self._store[key] = stored
             self._cs.record("create", self.kind, namespace, key[1])
-            self._notify("ADDED", stored, namespace)
+            self._notify_locked("ADDED", stored, namespace)
             return copy.deepcopy(stored)
 
     def get(self, namespace: str, name: str) -> dict:
@@ -159,7 +163,7 @@ class FakeResourceClient:
             md["resourceVersion"] = str(self._cs.next_version())
             self._store[key] = stored
             self._cs.record("update", self.kind, namespace, key[1])
-            self._notify("MODIFIED", stored, namespace)
+            self._notify_locked("MODIFIED", stored, namespace)
             return copy.deepcopy(stored)
 
     def update_status(self, namespace: str, obj: dict) -> dict:
@@ -174,7 +178,7 @@ class FakeResourceClient:
             existing["metadata"]["resourceVersion"] = str(self._cs.next_version())
             self._store[key] = existing
             self._cs.record("update_status", self.kind, namespace, key[1])
-            self._notify("MODIFIED", existing, namespace)
+            self._notify_locked("MODIFIED", existing, namespace)
             return copy.deepcopy(existing)
 
     def delete(self, namespace: str, name: str, options: Optional[dict] = None) -> None:
@@ -184,7 +188,7 @@ class FakeResourceClient:
             if obj is None:
                 raise errors.not_found(self.kind, name)
             self._cs.record("delete", self.kind, namespace, name)
-            self._notify("DELETED", obj, namespace)
+            self._notify_locked("DELETED", obj, namespace)
 
     def delete_collection(self, namespace: str, label_selector: str = "") -> int:
         """Delete all matching objects; returns count. (The reference's fake
@@ -201,7 +205,7 @@ class FakeResourceClient:
             for key, obj in victims:
                 del self._store[key]
                 self._cs.record("delete", self.kind, key[0], key[1])
-                self._notify("DELETED", obj, key[0])
+                self._notify_locked("DELETED", obj, key[0])
             return len(victims)
 
     # -- watch ---------------------------------------------------------------
@@ -263,7 +267,7 @@ class FakeClientset:
     def __init__(self) -> None:
         import collections
 
-        self.lock = threading.RLock()
+        self.lock = lockdep.rlock("FakeClientset.lock")
         # Optional metrics registry (controller.statusserver.Metrics):
         # when attached, every recorded action ticks
         # ``api_requests_total{verb,resource}`` — same ledger the REST
@@ -279,11 +283,11 @@ class FakeClientset:
         # list→watch-open window — at fleet burst rates that lost ~25%
         # of submitted jobs until the next resync (caught by
         # bench.py --fleet).
-        self._version = 1
+        self._version = 1  # guarded-by: lock
         self._events: "collections.deque" = collections.deque(
-            maxlen=self.EVENT_LOG_SIZE)
-        self._evicted_through = 0  # highest RV ever dropped from _events
-        self.actions: List[Tuple[str, str, str, str]] = []
+            maxlen=self.EVENT_LOG_SIZE)  # guarded-by: lock
+        self._evicted_through = 0  # highest RV ever dropped from _events; guarded-by: lock
+        self.actions: List[Tuple[str, str, str, str]] = []  # guarded-by: lock
         self.pods = FakeResourceClient("Pod", self)
         self.services = FakeResourceClient("Service", self)
         self.events = FakeResourceClient("Event", self)
@@ -296,27 +300,36 @@ class FakeClientset:
         self.nodes = FakeResourceClient("Node", self)
 
     def next_version(self) -> int:
-        self._version += 1
-        return self._version
+        # Reentrant under the resource clients' CRUD lock; ALSO safe for
+        # direct callers (tests) that hold nothing — the unlocked version
+        # relied on every caller already being inside the RLock, which
+        # nothing enforced (concurrency-analyzer finding).
+        with self.lock:
+            self._version += 1
+            return self._version
 
     def current_version(self) -> int:
-        return self._version
+        with self.lock:
+            return self._version
 
     def log_event(self, rv: int, kind: str, namespace: str, event_type: str,
                   obj: dict) -> None:
-        if len(self._events) == self._events.maxlen:
-            self._evicted_through = max(self._evicted_through,
-                                        self._events[0][0])
-        self._events.append((rv, kind, namespace, event_type,
-                             copy.deepcopy(obj)))
+        with self.lock:
+            if len(self._events) == self._events.maxlen:
+                self._evicted_through = max(self._evicted_through,
+                                            self._events[0][0])
+            self._events.append((rv, kind, namespace, event_type,
+                                 copy.deepcopy(obj)))
 
     def retained_events(self):
-        return list(self._events)
+        with self.lock:
+            return list(self._events)
 
     def evicted_through(self) -> int:
         """Highest resourceVersion evicted from the bounded event log: a
         watch anchored at or below this cannot be gap-free → 410."""
-        return self._evicted_through
+        with self.lock:
+            return self._evicted_through
 
     def close_watches(self) -> None:
         """Terminate every open watch stream (unblocks consumers waiting on
@@ -331,10 +344,12 @@ class FakeClientset:
                 q.put(None)
 
     def record(self, verb: str, resource: str, namespace: str, name: str) -> None:
-        self.actions.append((verb, resource, namespace, name))
+        with self.lock:
+            self.actions.append((verb, resource, namespace, name))
         if self.metrics is not None:
             self.metrics.inc("api_requests_total",
                              labels={"verb": verb, "resource": resource})
 
     def clear_actions(self) -> None:
-        self.actions.clear()
+        with self.lock:
+            self.actions.clear()

@@ -25,6 +25,8 @@ import threading
 from dataclasses import dataclass
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
+from tpu_operator.util import lockdep
+
 log = logging.getLogger(__name__)
 
 DEFAULT_RESYNC_PERIOD = 30.0  # seconds (ref: server.go:85)
@@ -80,7 +82,7 @@ class Store:
     store, let alone the apiserver."""
 
     def __init__(self) -> None:
-        self._lock = threading.RLock()
+        self._lock = lockdep.rlock("informer.Store._lock")
         self._items: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
         self._indexers: Dict[str, IndexFunc] = {}  # guarded-by: _lock
         # index name -> index value -> {object key: object}
@@ -190,17 +192,30 @@ class Informer:
         self._namespace = namespace
         self._resync_period = resync_period
         self.store = Store()
-        self._handlers: List[Tuple[Optional[Handler], Optional[Handler], Optional[Handler]]] = []
+        # Mutated by add_event_handler — which a late informer_for() call
+        # can run AFTER start(), i.e. concurrently with the reflector and
+        # resync threads iterating it (found by the escape analyzer; an
+        # unlocked list append raced the dispatch loop's iteration).
+        self._handlers: List[Tuple[Optional[Handler], Optional[Handler], Optional[Handler]]] = []  # guarded-by: _lock
         self._synced = threading.Event()
         self._threads: List[threading.Thread] = []
         self._watch = None  # guarded-by: _lock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Informer._lock")
 
     def add_event_handler(self, on_add: Optional[Handler] = None,
                           on_update: Optional[Handler] = None,
                           on_delete: Optional[Handler] = None) -> None:
         """ref: controller.go:114-132 AddEventHandler(Add/Update/Delete)."""
-        self._handlers.append((on_add, on_update, on_delete))
+        with self._lock:
+            self._handlers.append((on_add, on_update, on_delete))
+
+    def _handlers_snapshot(self) -> List[Tuple[Optional[Handler],
+                                               Optional[Handler],
+                                               Optional[Handler]]]:
+        """Stable view for one dispatch (handlers registered mid-dispatch
+        catch the NEXT event — the informer replays state on sync anyway)."""
+        with self._lock:
+            return list(self._handlers)
 
     def has_synced(self) -> bool:
         """ref: cache.WaitForCacheSync (controller.go:155)."""
@@ -385,17 +400,17 @@ class Informer:
     # -- dispatch -------------------------------------------------------------
 
     def _dispatch_add(self, obj: Dict[str, Any]) -> None:
-        for on_add, _u, _d in self._handlers:
+        for on_add, _u, _d in self._handlers_snapshot():
             if on_add:
                 self._safe(on_add, obj)
 
     def _dispatch_update(self, old: Any, new: Dict[str, Any]) -> None:
-        for _a, on_update, _d in self._handlers:
+        for _a, on_update, _d in self._handlers_snapshot():
             if on_update:
                 self._safe(on_update, old, new)
 
     def _dispatch_delete(self, obj: Dict[str, Any]) -> None:
-        for _a, _u, on_delete in self._handlers:
+        for _a, _u, on_delete in self._handlers_snapshot():
             if on_delete:
                 self._safe(on_delete, obj)
 

@@ -30,9 +30,9 @@ histogram tests are deterministic.
 from __future__ import annotations
 
 import heapq
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Set
+from tpu_operator.util import lockdep
 
 DEFAULT_BASE_DELAY = 10.0   # seconds (ref: controller.go:61)
 DEFAULT_MAX_DELAY = 360.0   # seconds (ref: controller.go:62)
@@ -50,7 +50,7 @@ class RateLimitingQueue:
         self._max = max_delay
         self._clock = clock
         self._metrics = metrics
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("RateLimitingQueue._cond")
         self._queue: List[Any] = []  # guarded-by: _cond
         self._dirty: Set[Any] = set()  # guarded-by: _cond
         self._processing: Set[Any] = set()  # guarded-by: _cond

@@ -27,6 +27,7 @@ from typing import Any, Callable, Dict, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import LABEL_GROUP_KEY
 from tpu_operator.client import errors
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -162,8 +163,8 @@ class FlakyClientset:
         self.max_latency = max(0.0, max_latency)
         # One lock around the RNG: verbs fire from every controller thread,
         # and an unguarded Random would shear its state (and determinism).
-        self._rng = rng or random.Random()
-        self._rng_lock = threading.Lock()
+        self._rng_lock = lockdep.lock("FlakyClientset._rng_lock")
+        self._rng = rng or random.Random()  # guarded-by: _rng_lock
         self.metrics = metrics
         self._sleep = sleep
         for resource in self.RESOURCES:

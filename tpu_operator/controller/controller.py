@@ -61,6 +61,7 @@ from tpu_operator.trainer import elastic as elastic_mod
 from tpu_operator.trainer.training import TrainingJob, live_pod
 from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -140,7 +141,7 @@ class Controller:
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
         self.jobs: Dict[str, TrainingJob] = {}  # guarded-by: _jobs_lock
-        self._jobs_lock = threading.Lock()
+        self._jobs_lock = lockdep.lock("Controller._jobs_lock")
         # key -> heartbeat "time" of the last persist-enqueued heartbeat
         # (guarded by _jobs_lock; see record_heartbeat's coalescing).
         self._hb_persisted: Dict[str, float] = {}  # guarded-by: _jobs_lock
@@ -807,6 +808,18 @@ class Controller:
         gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
         cleared = False
         state = self._gang_cadence.get(key)
+        if state is not None and int(gen) < int(state.get("attempt", 0)):
+            # Stale beat from a generation OLDER than the one the
+            # detector already tracks: the record_heartbeat age gate only
+            # fires once the reconcile bumps status.attempt, so in the
+            # window between the new gang's first beat and that bump, a
+            # terminating pod's last beats used to RESET the detector
+            # back to the dead generation — wiping the live gang's
+            # accumulated cadence and force-persisting a spurious
+            # stragglers clear on every flip (found by the seeded
+            # interleaving schedule over fold-vs-attempt-reset). The
+            # detector only moves forward.
+            return False
         if state is None or state.get("attempt") != int(gen):
             # New attempt (or first beat): stale cadence from the previous
             # generation must not flag the new gang — and a flag the OLD

@@ -23,9 +23,9 @@ relative delay crosses into queue time.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Any, Callable, Dict, Optional
+from tpu_operator.util import lockdep
 
 # Scheduling slack added to every wakeup so the reconcile runs just *after*
 # the obligation (a wakeup landing a hair early would see nothing due,
@@ -40,7 +40,7 @@ class DeadlineManager:
                  clock: Callable[[], float] = time.time) -> None:
         self._queue = queue
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("DeadlineManager._lock")
         # key -> pending wakeup epoch (best-effort view; the queue owns the
         # actual timers, which are never cancelled — a stale wakeup just
         # causes one cheap no-op reconcile).

@@ -15,11 +15,11 @@ from __future__ import annotations
 import collections
 import datetime
 import logging
-import threading
 from typing import Any, Optional, Tuple
 
 from tpu_operator.client import errors
 from tpu_operator.util.util import rand_string
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -47,7 +47,7 @@ class EventRecorder:
         self.component = component
         self.metrics = metrics
         self._seen_cap = max(1, seen_cap)
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("EventRecorder._lock")
         # LRU: (ns, name, reason, message) -> (event_name, count)
         self._seen: "collections.OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
             collections.OrderedDict())  # guarded-by: _lock

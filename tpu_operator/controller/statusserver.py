@@ -51,6 +51,7 @@ from tpu_operator.payload.steptrace import (
 )
 from tpu_operator.util import tracing
 from tpu_operator.util.util import now_rfc3339, parse_rfc3339
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -155,7 +156,7 @@ class Metrics:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("Metrics._lock")
         self._families: Dict[str, _Family] = {}  # guarded-by: _lock
         for name in ("reconcile_total", "reconcile_errors_total",
                      "gc_deleted_total", "leader_elections_won_total"):
@@ -532,10 +533,10 @@ class StatusServer:
     def __init__(self, port: int, controller: Optional[Any] = None,
                  metrics: Optional[Metrics] = None, host: str = "") -> None:
         self.metrics = metrics if metrics is not None else Metrics()
-        self._controller_lock = threading.Lock()
+        self._controller_lock = lockdep.lock("StatusServer._controller_lock")
         self._controller = controller  # guarded-by: _controller_lock
         self._leading = threading.Event()
-        self._heartbeats_lock = threading.Lock()
+        self._heartbeats_lock = lockdep.lock("StatusServer._heartbeats_lock")
         # (namespace, name) -> last heartbeat dict (+ receivedAt epoch)
         self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded-by: _heartbeats_lock
         outer = self

@@ -78,6 +78,7 @@ import threading
 from typing import Any, Callable, Dict, Optional, Tuple
 
 from tpu_operator.payload.bootstrap import EXIT_RETRYABLE
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -176,7 +177,13 @@ class Checkpointer:
         # its (step, error-or-None) outcome is applied by _reap_verify on
         # the step-loop thread (where escalation is allowed to raise).
         self._verify_thread: Optional[threading.Thread] = None
-        self._verify_outcome: Optional[Tuple[int, Optional[Exception]]] = None
+        # The worker's (step, error) handoff: written on the verify
+        # thread, swapped out on the step-loop thread. The thread-join
+        # ordering made the unlocked version *mostly* safe, but the
+        # non-blocking reap path read it concurrently with the worker's
+        # store (escape-analyzer finding) — now explicitly guarded.
+        self._verify_lock = lockdep.lock("Checkpointer._verify_lock")
+        self._verify_outcome: Optional[Tuple[int, Optional[Exception]]] = None  # guarded-by: _verify_lock
         # Steps already condemned this process (quarantine attempted): never
         # reconsidered, so a failing rename cannot loop the restore walk.
         self._condemned: set = set()
@@ -335,9 +342,11 @@ class Checkpointer:
                 # verification.
                 log.warning("checkpoint step %d: manifest write failed: %s",
                             step, e)
-            self._verify_outcome = (step, None)
+            with self._verify_lock:
+                self._verify_outcome = (step, None)
         except Exception as e:  # noqa: BLE001 — applied by _reap_verify
-            self._verify_outcome = (step, e)
+            with self._verify_lock:
+                self._verify_outcome = (step, e)
 
     def _reap_verify(self, block: bool) -> None:
         """Apply the verify worker's outcome on the calling (step-loop)
@@ -351,7 +360,8 @@ class Checkpointer:
         elif t.is_alive():
             return
         self._verify_thread = None
-        outcome, self._verify_outcome = self._verify_outcome, None
+        with self._verify_lock:
+            outcome, self._verify_outcome = self._verify_outcome, None
         if outcome is None:  # worker died before recording: count it
             self._record_save_failure(-1, CheckpointError(
                 "verification worker died without an outcome"))

@@ -28,9 +28,10 @@ drag jax into the control plane.
 from __future__ import annotations
 
 import contextlib
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+
+from tpu_operator.util import lockdep
 
 # Startup stages, in nominal order. COMPILE and RESTORE overlap in the
 # fast path; PREFETCH (the remote warm-start store download) overlaps
@@ -59,16 +60,22 @@ STAGE_FIELDS = {
 # (the payload's train_loop builds one much later) — recorded at module
 # level and seeded into every new tracker of this process. The store
 # prefetch runs in the same window, so it is recorded the same way.
-_rendezvous_seconds: Optional[float] = None
-_prefetch_seconds: Optional[float] = None
-_prefetch_hit: Optional[bool] = None
+# One lock guards all of this module's mutable globals: the writers are
+# the main thread (bootstrap), the overlapped-prologue compile worker
+# (via JAX's monitoring callback), and the heartbeat thread reading the
+# breakdown — the escape analyzer flagged the unlocked mix.
+_state_lock = lockdep.lock("startup._state_lock")
+_rendezvous_seconds: Optional[float] = None  # guarded-by: _state_lock
+_prefetch_seconds: Optional[float] = None  # guarded-by: _state_lock
+_prefetch_hit: Optional[bool] = None  # guarded-by: _state_lock
 # The persistent compilation cache dir bootstrap enabled ("" = cold).
-_cache_dir: str = ""
+_cache_dir: str = ""  # guarded-by: _state_lock
 
 
 def record_rendezvous(seconds: float) -> None:
     global _rendezvous_seconds
-    _rendezvous_seconds = float(seconds)
+    with _state_lock:
+        _rendezvous_seconds = float(seconds)
 
 
 def record_prefetch(seconds: float, hit: Optional[bool]) -> None:
@@ -77,58 +84,77 @@ def record_prefetch(seconds: float, hit: Optional[bool]) -> None:
     (0.0 = fully hidden), ``hit`` whether it delivered anything (a
     checkpoint step or cache entries); None = store not configured."""
     global _prefetch_seconds, _prefetch_hit
-    _prefetch_seconds = float(seconds)
-    _prefetch_hit = None if hit is None else bool(hit)
+    with _state_lock:
+        _prefetch_seconds = float(seconds)
+        _prefetch_hit = None if hit is None else bool(hit)
 
 
 def reset_prefetch() -> None:
     """Test hook: clear the module-level prefetch record."""
     global _prefetch_seconds, _prefetch_hit
-    _prefetch_seconds = None
-    _prefetch_hit = None
+    with _state_lock:
+        _prefetch_seconds = None
+        _prefetch_hit = None
 
 
 def set_cache_dir(path: str) -> None:
     global _cache_dir
-    _cache_dir = str(path or "")
+    with _state_lock:
+        _cache_dir = str(path or "")
 
 
 def cache_dir() -> str:
-    return _cache_dir
+    with _state_lock:
+        return _cache_dir
 
 
 # Persistent-cache hit counting via jax.monitoring (the same event stream
 # jax's own telemetry uses). Registered lazily from the payload side —
-# importing this module must never import jax.
-_cache_hits = 0
-_listener_registered = False
+# importing this module must never import jax. The counter is bumped by
+# the monitoring callback — which fires on whichever thread compiles,
+# including the overlapped prologue's AOT worker — and read by the
+# heartbeat thread: an unlocked += there was a classic lost-update race
+# (surfaced by the escape analyzer's first run).
+_cache_hits = 0  # guarded-by: _state_lock
+_listener_registered = False  # guarded-by: _state_lock
 
 
 def ensure_cache_listener() -> bool:
     """Idempotently subscribe to JAX's compilation-cache events; returns
-    False when the monitoring API is unavailable (config drift)."""
+    False when the monitoring API is unavailable (config drift).
+
+    Claim-then-register: the registered flag flips under the lock BEFORE
+    the registration (and rolls back on failure), so two concurrent
+    callers can never both register and double-count every cache hit —
+    while the foreign jax.monitoring call itself runs outside the lock
+    (``_state_lock`` is a leaf per the lock-order policy)."""
     global _listener_registered
-    if _listener_registered:
-        return True
+    with _state_lock:
+        if _listener_registered:
+            return True
+        _listener_registered = True
     try:
         from jax import monitoring
 
         def _on_event(event: str, **_kw: Any) -> None:
             global _cache_hits
             if event == "/jax/compilation_cache/cache_hits":
-                _cache_hits += 1
+                with _state_lock:
+                    _cache_hits += 1
 
         monitoring.register_event_listener(_on_event)
-        _listener_registered = True
         return True
     except Exception:  # noqa: BLE001 — best-effort telemetry
+        with _state_lock:
+            _listener_registered = False  # un-claim: a later call retries
         return False
 
 
 def cache_hit_count() -> int:
     """Persistent compilation-cache hits observed so far this process
     (0 until :func:`ensure_cache_listener` ran and a compile hit)."""
-    return _cache_hits
+    with _state_lock:
+        return _cache_hits
 
 
 class StartupTracker:
@@ -137,17 +163,18 @@ class StartupTracker:
 
     def __init__(self, clock: Callable[[], float] = time.perf_counter):
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("StartupTracker._lock")
         self._active: List[str] = []  # innermost last
         self.durations: Dict[str, float] = {}
         self.cache_hit: Optional[bool] = None
-        self.prefetch_hit: Optional[bool] = _prefetch_hit
         # Absolute clock() stamp of first-step completion (TTFS fences).
         self.first_step_done_at: Optional[float] = None
-        if _rendezvous_seconds is not None:
-            self.durations[RENDEZVOUS] = _rendezvous_seconds
-        if _prefetch_seconds is not None:
-            self.durations[PREFETCH] = _prefetch_seconds
+        with _state_lock:
+            self.prefetch_hit: Optional[bool] = _prefetch_hit
+            if _rendezvous_seconds is not None:
+                self.durations[RENDEZVOUS] = _rendezvous_seconds
+            if _prefetch_seconds is not None:
+                self.durations[PREFETCH] = _prefetch_seconds
 
     @contextlib.contextmanager
     def stage(self, name: str):

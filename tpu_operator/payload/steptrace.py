@@ -56,9 +56,9 @@ import json
 import logging
 import math
 import os
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -137,7 +137,7 @@ class StepRecorder:
                  clock: Callable[[], float] = time.perf_counter):
         self._clock = clock
         self.capacity = max(8, int(capacity))
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("StepRecorder._lock")
         # Last-N completed step records: {"step": i, "seconds": total,
         # DATA: dt, ...} with raw phase-name keys.
         self._ring: collections.deque = collections.deque(

@@ -41,6 +41,7 @@ import time
 from typing import Any, Dict, Optional
 
 from tpu_operator.payload import startup as startup_mod
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -123,7 +124,7 @@ def uploader_from_env(env: Optional[Dict[str, str]] = None,
 
 # --- rendezvous-overlapped prefetch ------------------------------------------
 
-_prefetch_lock = threading.Lock()
+_prefetch_lock = lockdep.lock("warmstore._prefetch_lock")
 _prefetch_thread: Optional[threading.Thread] = None  # guarded-by: _prefetch_lock
 _prefetch_result: Dict[str, Any] = {}  # guarded-by: _prefetch_lock
 

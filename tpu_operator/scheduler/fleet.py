@@ -43,13 +43,13 @@ Exported metrics (registered in controller/statusserver.py):
 from __future__ import annotations
 
 import logging
-import threading
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_operator.apis.tpujob.v1alpha1.types import DEFAULT_SCHEDULING_QUEUE
 from tpu_operator.scheduler.inventory import SliceInventory
+from tpu_operator.util import lockdep
 
 log = logging.getLogger(__name__)
 
@@ -103,7 +103,7 @@ class FleetScheduler:
         self._enqueue = enqueue
         self._metrics = metrics
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("FleetScheduler._lock")
         self._inventory = inventory or SliceInventory()  # guarded-by: _lock
         self._admitted: Dict[str, _Entry] = {}  # guarded-by: _lock
         self._pending: Dict[str, _Entry] = {}  # guarded-by: _lock

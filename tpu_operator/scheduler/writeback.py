@@ -18,9 +18,9 @@ not queue behind telemetry.
 
 from __future__ import annotations
 
-import threading
 import time
 from typing import Callable
+from tpu_operator.util import lockdep
 
 
 class WritebackLimiter:
@@ -37,7 +37,7 @@ class WritebackLimiter:
         self._qps = float(qps)
         self._burst = float(burst if burst > 0 else max(1.0, qps))
         self._clock = clock
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("WritebackLimiter._lock")
         self._tokens = self._burst  # guarded-by: _lock
         self._last = clock()  # guarded-by: _lock
 

@@ -28,10 +28,10 @@ does not ship (the container constraint: stub or gate missing deps).
 from __future__ import annotations
 
 import os
-import threading
 import time
 import urllib.parse
 from typing import Callable, Dict, List, Optional
+from tpu_operator.util import lockdep
 
 # Longest key accepted (object stores cap around 1024; ours are short).
 _MAX_KEY = 512
@@ -182,7 +182,7 @@ class FakeBackend(BlobBackend):
         self.latency = latency
         self.fault_hook = fault_hook
         self._sleep = sleep
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("FakeBackend._lock")
         self._objects: Dict[str, bytes] = {}  # guarded-by: _lock
         self._corrupt_once: set = set()  # guarded-by: _lock
         self.op_counts: Dict[str, int] = {}  # guarded-by: _lock
@@ -243,12 +243,12 @@ class FakeBackend(BlobBackend):
 # Named in-process fake backends: fake://<name> resolves to one shared
 # instance per name, so a payload and the test driving it can see the same
 # "remote" store without any filesystem.
-_fake_lock = threading.Lock()
+_fake_lock = lockdep.lock("blob._fake_lock")
 _fake_registry: Dict[str, FakeBackend] = {}  # guarded-by: _fake_lock
 
 # Deployment-registered schemes (the cloud-SDK hook): scheme -> factory
 # taking the full URI.
-_scheme_lock = threading.Lock()
+_scheme_lock = lockdep.lock("blob._scheme_lock")
 _scheme_registry: Dict[str, Callable[[str], BlobBackend]] = {}  # guarded-by: _scheme_lock
 
 

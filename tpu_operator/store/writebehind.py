@@ -36,6 +36,7 @@ import threading
 from typing import Any, Dict, Optional
 
 from tpu_operator.store.warmstart import WarmStartStore
+from tpu_operator.util import lockdep, yieldpoints
 
 log = logging.getLogger(__name__)
 
@@ -62,7 +63,7 @@ class WriteBehindUploader:
         # upload time (bootstrap enables the cache after the uploader may
         # already exist); None/"" = no cache sync.
         self._cache_dir_fn = cache_dir_fn
-        self._cond = threading.Condition()
+        self._cond = lockdep.condition("WriteBehindUploader._cond")
         # kind -> pending task; "checkpoint" holds (step, dir) last-wins,
         # "corrupt" holds a set of steps to mark, "artifacts" maps remote
         # name -> local path (postmortem step traces; last-wins per name).
@@ -84,17 +85,22 @@ class WriteBehindUploader:
 
     # -- step-loop side (never blocks on the backend) --------------------------
 
-    def enqueue(self, step: int, step_dir: str) -> None:
+    def enqueue(self, step: int, step_dir: str) -> bool:
         """Queue one verified step for upload. Non-blocking by
-        construction: a pending older step is superseded (dropped)."""
+        construction: a pending older step is superseded (dropped).
+        Returns False when the uploader is closed (the step was REFUSED,
+        not queued) — before the explicit refusal, a caller racing
+        ``close()`` could not tell a stranded enqueue from an accepted
+        one (seeded-schedule finding)."""
         with self._cond:
             if self._closed:
-                return
+                return False
             if self._pending_step is not None \
                     and self._pending_step[0] != int(step):
                 self.dropped_superseded += 1
             self._pending_step = (int(step), step_dir)
             self._cond.notify()
+            return True
 
     def mark_corrupt(self, step: int) -> None:
         """Queue a remote quarantine mark (restore-path hook); async so a
@@ -160,12 +166,25 @@ class WriteBehindUploader:
 
     def close(self, flush: bool = False,
               timeout: float = DEFAULT_FLUSH_TIMEOUT) -> None:
-        """Stop accepting work; optionally drain first (bounded)."""
-        if flush:
-            self.flush(timeout)
+        """Stop accepting work; optionally drain what was accepted
+        (bounded).
+
+        The close mark lands BEFORE the drain, not after: the original
+        drain-then-mark order had a window — flush() observes an empty
+        queue, the checkpoint verify thread enqueues the final verified
+        step, close() marks closed and returns — where an ACCEPTED
+        enqueue was stranded behind a returned close, and the process
+        exit tore down the daemon worker mid-upload. Found by the
+        deterministic interleaving harness (writebehind close/enqueue
+        schedule); with mark-first, a racing enqueue either lands before
+        the mark (the flush below waits for its upload) or is refused
+        outright (enqueue returns False) — never silently stranded."""
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+        yieldpoints.pause("writebehind.close.marked")
+        if flush:
+            self.flush(timeout)
 
     # -- worker ----------------------------------------------------------------
 
@@ -187,6 +206,10 @@ class WriteBehindUploader:
                 artifacts = dict(self._pending_artifacts)
                 self._pending_artifacts.clear()
                 self._busy = True
+            # Scheduling-sensitive window: the task is popped (queue looks
+            # empty) but not yet uploaded — the interleaving harness
+            # parks the worker here to drive enqueue/close through it.
+            yieldpoints.pause("writebehind.popped")
             try:
                 for step in sorted(corrupt):
                     try:

@@ -1,0 +1,361 @@
+"""Deterministic interleaving harness: permuted schedules over named steps
+and yield points.
+
+The operator's concurrency bugs live in *interleavings* — admission vs.
+teardown-release vs. restart rebuild, write-behind enqueue vs.
+close()-drain — that a soak only hits by luck. This module makes the
+schedule the test input (the CHESS idea, sized for this repo):
+
+- :func:`merge_orders` enumerates every interleaving of per-thread step
+  sequences, and :func:`run_order` executes one — single-threaded,
+  which is exact for steps that are atomic under the subsystem's lock
+  (every public FleetScheduler/Controller entry point is). A triple
+  with 2–3 steps per logical thread is a few dozen schedules: cheap
+  enough to run exhaustively in a unit test.
+- :class:`InterleavingScheduler` runs steps on REAL threads, one
+  runnable at a time, choosing the next thread at every boundary with a
+  seeded RNG — for scenarios where thread identity matters (reentrant
+  locks, thread-local state) or where production threads participate
+  via yield points (:mod:`tpu_operator.util.yieldpoints`): a production
+  thread hitting ``pause(...)`` is adopted into the schedule. A step
+  that blocks on real synchronization is detected by timeout and the
+  token moves on; it rejoins at its next yield point — schedules stay
+  reproducible whenever steps don't block, and merely lose strictness
+  (never correctness) when they do.
+- :class:`PointGate` is the scalpel: hold any named yield point, let
+  the test thread interleave operations into the exposed window, then
+  release — the way to pin a race whose window is *inside* one method.
+
+Yield points are cheap no-ops in production (util/yieldpoints.py); only
+harness-installed hooks give them meaning.
+"""
+
+from __future__ import annotations
+
+import itertools
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, Iterator, List, Optional, Sequence, \
+    Tuple
+
+from tpu_operator.util import yieldpoints
+
+Step = Callable[[], Any]
+
+
+# --- exhaustive, single-threaded schedules -----------------------------------
+
+def merge_orders(*lengths: int) -> Iterator[Tuple[int, ...]]:
+    """Every interleaving of sequences with the given lengths, as tuples
+    of sequence indexes (``(0, 1, 0)`` = seq0 step, seq1 step, seq0
+    step). The count is the multinomial coefficient — callers keep
+    per-thread step counts small on purpose."""
+    labels: List[int] = []
+    for idx, n in enumerate(lengths):
+        labels.extend([idx] * n)
+    seen = set()
+    for perm in itertools.permutations(labels):
+        if perm not in seen:
+            seen.add(perm)
+            yield perm
+
+
+def run_order(threads: Sequence[Sequence[Step]],
+              order: Sequence[int]) -> List[Any]:
+    """Execute one merge order over per-thread step lists; returns each
+    step's return value in execution order."""
+    cursors = [0] * len(threads)
+    results: List[Any] = []
+    for tid in order:
+        step = threads[tid][cursors[tid]]
+        cursors[tid] += 1
+        results.append(step())
+    for tid, cur in enumerate(cursors):
+        if cur != len(threads[tid]):
+            raise ValueError(f"order {order!r} leaves thread {tid} with "
+                             f"{len(threads[tid]) - cur} unexecuted steps")
+    return results
+
+
+def exhaustive(scenario: Callable[[], Sequence[Sequence[Step]]],
+               check: Optional[Callable[[Sequence[int]], None]] = None
+               ) -> int:
+    """Run ``scenario()`` (which builds FRESH state and returns the
+    per-thread step lists) under every merge order; ``check(order)``
+    runs after each schedule against the state the steps closed over.
+    Returns the number of schedules executed."""
+    first = scenario()
+    lengths = [len(t) for t in first]
+    count = 0
+    for order in merge_orders(*lengths):
+        # The probe build runs the first schedule; later schedules each
+        # get a fresh one (scenario() can be an expensive setup).
+        threads = first if first is not None else scenario()
+        first = None
+        run_order(threads, order)
+        if check is not None:
+            check(order)
+        count += 1
+    return count
+
+
+# --- seeded cooperative scheduler over real threads --------------------------
+
+class _Task:
+    __slots__ = ("name", "steps", "thread", "go", "parked", "done",
+                 "adopted", "error")
+
+    def __init__(self, name: str, steps: Sequence[Step],
+                 adopted: bool = False):
+        self.name = name
+        self.steps = list(steps)
+        self.thread: Optional[threading.Thread] = None
+        self.go = threading.Event()       # token grant
+        self.parked = threading.Event()   # task is waiting at a boundary
+        self.done = False
+        self.adopted = adopted
+        self.error: Optional[BaseException] = None
+
+
+class InterleavingScheduler:
+    """One-runnable-at-a-time token scheduler with seeded choices.
+
+    ``add(name, *steps)`` registers a logical thread; ``run()`` executes
+    all of them, passing the token per the seeded RNG at every step
+    boundary and every ``yieldpoints.pause`` a running thread hits.
+    Production threads (started by the code under test) that reach a
+    yield point while the scheduler is installed are ADOPTED: they park
+    like any task and get scheduled by name. The decision trace is
+    recorded in ``trace`` so a failing seed prints its schedule."""
+
+    def __init__(self, seed: int = 0, step_timeout: float = 5.0):
+        self._rng = random.Random(seed)
+        self.seed = seed
+        self._timeout = step_timeout
+        self._lock = threading.Lock()
+        self._tasks: Dict[str, _Task] = {}
+        self.trace: List[str] = []
+        self._running = False
+
+    def add(self, name: str, *steps: Step) -> None:
+        if name in self._tasks:
+            raise ValueError(f"duplicate task {name!r}")
+        self._tasks[name] = _Task(name, steps)
+
+    # -- yield-point integration ----------------------------------------------
+
+    def _on_pause(self, point: str) -> None:
+        me = threading.current_thread()
+        with self._lock:
+            if not self._running:
+                return
+            task = next((t for t in self._tasks.values()
+                         if t.thread is me), None)
+            if task is None:
+                # A production thread surfaced at a yield point: adopt it
+                # under the point's name so the seeded choice includes it.
+                # Uniquified — a SECOND thread at the same point must not
+                # overwrite the first's task (which would orphan that
+                # thread at go.wait() with nothing left to wake it).
+                name = f"@{point}"
+                n = 2
+                while name in self._tasks:
+                    name = f"@{point}#{n}"
+                    n += 1
+                task = _Task(name, [], adopted=True)
+                task.thread = me
+                self._tasks[task.name] = task
+        if task is None:
+            return
+        self.trace.append(f"{task.name} paused at {point}")
+        self._park(task)
+
+    def _park(self, task: "_Task") -> None:
+        """Park until granted — with a teardown handshake: if run()'s
+        finally already declared the schedule over, the blanket wakeup it
+        issued may have raced our go.clear(), so re-check under the lock
+        and self-wake rather than waiting forever on a dead scheduler."""
+        task.go.clear()
+        task.parked.set()
+        with self._lock:
+            if not self._running:
+                task.go.set()
+        task.go.wait()
+
+    # -- task bodies ----------------------------------------------------------
+
+    def _body(self, task: _Task) -> None:
+        task.go.wait()
+        try:
+            for step in task.steps:
+                step()
+                # Boundary between steps: park and hand the token back.
+                self._park(task)
+        except BaseException as e:  # noqa: BLE001 — reported by run()
+            task.error = e
+        finally:
+            task.done = True
+            task.parked.set()
+
+    # -- the schedule loop -----------------------------------------------------
+
+    def run(self) -> None:
+        """Execute every registered task to completion. Raises the first
+        task error (with the schedule trace attached) and RuntimeError on
+        a harness-level deadlock (no task can make progress)."""
+        yieldpoints.install(self._on_pause)
+        self._running = True
+        try:
+            for task in self._tasks.values():
+                t = threading.Thread(target=self._body, args=(task,),
+                                     daemon=True,
+                                     name=f"sched-{task.name}")
+                task.thread = t
+                task.parked.set()  # ready for its first grant
+                t.start()
+            stalled: set = set()
+            while True:
+                with self._lock:
+                    candidates = sorted(
+                        name for name, t in self._tasks.items()
+                        if not t.done and t.parked.is_set())
+                    # Adopted production threads (daemon loops) never
+                    # "finish" — only spawned tasks gate termination.
+                    live = [name for name, t in self._tasks.items()
+                            if not t.done and not t.adopted]
+                if not live:
+                    break
+                if not candidates:
+                    # Nobody parked: every live task is running free or
+                    # blocked on real sync. Wait for one to park/finish.
+                    if not self._wait_any_parked(live):
+                        raise RuntimeError(
+                            f"schedule deadlock (seed {self.seed}): live "
+                            f"tasks {live} never reached a boundary; "
+                            f"trace: {self.trace}")
+                    continue
+                name = (candidates[0] if len(candidates) == 1
+                        else self._rng.choice(candidates))
+                task = self._tasks[name]
+                self.trace.append(f"grant {name}")
+                task.parked.clear()
+                task.go.set()
+                if not task.parked.wait(self._timeout):
+                    # The step blocked on real synchronization: release
+                    # the token elsewhere; the task rejoins when whatever
+                    # it waits on is released by a later-scheduled task.
+                    stalled.add(name)
+                    self.trace.append(f"{name} stalled (blocked in step)")
+            errors = [t for t in self._tasks.values() if t.error is not None]
+            if errors:
+                first = errors[0]
+                raise AssertionError(
+                    f"task {first.name!r} failed under seed {self.seed} "
+                    f"(schedule: {self.trace})") from first.error
+        finally:
+            # Teardown handshake with _park: flip _running and snapshot
+            # the task set under the lock, so a thread adopted
+            # concurrently is either in the snapshot (woken below) or
+            # observes _running=False in _park and self-wakes — and the
+            # iteration can't race an adoption insert.
+            with self._lock:
+                self._running = False
+                tasks = list(self._tasks.values())
+            yieldpoints.uninstall()
+            for task in tasks:
+                task.go.set()
+
+    def _wait_any_parked(self, names: List[str],
+                         timeout: Optional[float] = None) -> bool:
+        deadline = (timeout if timeout is not None else self._timeout)
+        interval = 0.002
+        waited = 0.0
+        while waited < deadline:
+            for name in names:
+                t = self._tasks.get(name)
+                if t is not None and (t.done or t.parked.is_set()):
+                    return True
+            time.sleep(interval)
+            waited += interval
+        return False
+
+
+def run_seeds(build: Callable[[InterleavingScheduler], None],
+              seeds: Sequence[int] = range(16),
+              step_timeout: float = 5.0) -> int:
+    """Run a scenario under many seeds: ``build(sched)`` registers tasks
+    against FRESH state per seed (closures own the state and assert in
+    their final steps). Returns the number of schedules run."""
+    for seed in seeds:
+        sched = InterleavingScheduler(seed=seed, step_timeout=step_timeout)
+        build(sched)
+        sched.run()
+    return len(list(seeds))
+
+
+# --- surgical yield-point gating ---------------------------------------------
+
+class PointGate:
+    """Hold real threads at named yield points; release them on cue.
+
+    The tool for races whose window is INSIDE one method: hold the
+    point that exposes the window, drive the racing operation from the
+    test thread, release, and assert. Use as a context manager (installs
+    and uninstalls the global yield-point hook)."""
+
+    def __init__(self):
+        self._cond = threading.Condition()
+        self._held: set = set()          # guarded-by: _cond
+        self._blocked: Dict[str, int] = {}  # point -> waiter count; guarded-by: _cond
+        self._passed: Dict[str, int] = {}  # point -> pass-throughs; guarded-by: _cond
+
+    def __enter__(self) -> "PointGate":
+        yieldpoints.install(self._on_pause)
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release_all()
+        yieldpoints.uninstall()
+
+    def _on_pause(self, name: str) -> None:
+        with self._cond:
+            self._passed[name] = self._passed.get(name, 0) + 1
+            if name not in self._held:
+                self._cond.notify_all()
+                return
+            self._blocked[name] = self._blocked.get(name, 0) + 1
+            self._cond.notify_all()
+            while name in self._held:
+                self._cond.wait()
+            self._blocked[name] -= 1
+            self._cond.notify_all()
+
+    def hold(self, name: str) -> None:
+        """Arm the gate: the next thread reaching ``name`` parks."""
+        with self._cond:
+            self._held.add(name)
+
+    def wait_blocked(self, name: str, timeout: float = 5.0) -> bool:
+        """Wait until a thread is parked at ``name``."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._blocked.get(name, 0) > 0, timeout)
+
+    def wait_passed(self, name: str, count: int = 1,
+                    timeout: float = 5.0) -> bool:
+        """Wait until ``name`` has been reached ``count`` times in total
+        (parked or passed through)."""
+        with self._cond:
+            return self._cond.wait_for(
+                lambda: self._passed.get(name, 0) >= count, timeout)
+
+    def release(self, name: str) -> None:
+        with self._cond:
+            self._held.discard(name)
+            self._cond.notify_all()
+
+    def release_all(self) -> None:
+        with self._cond:
+            self._held.clear()
+            self._cond.notify_all()

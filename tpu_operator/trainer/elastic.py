@@ -34,7 +34,6 @@ sizes) in payload/checkpoint.py.
 
 from __future__ import annotations
 
-import threading
 from typing import Any, Dict, List, Optional, Set, Tuple
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
@@ -42,6 +41,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobSpec,
     TPUReplicaType,
 )
+from tpu_operator.util import lockdep
 
 
 def elastic_range(spec: TPUJobSpec) -> Optional[Tuple[int, int]]:
@@ -161,7 +161,7 @@ class RemediationTracker:
     """
 
     def __init__(self) -> None:
-        self._lock = threading.Lock()
+        self._lock = lockdep.lock("RemediationTracker._lock")
         # key -> {"attempt": n, "since": {pid: first-flag epoch},
         #         "done": set(pid remediated this attempt)}
         self._jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock

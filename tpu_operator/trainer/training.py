@@ -100,6 +100,7 @@ from tpu_operator.trainer import labels as labels_mod
 from tpu_operator.trainer import replicas as replicas_mod
 from tpu_operator.trainer.snapshot import ReplicaSnapshot
 from tpu_operator.util.tracing import traced
+from tpu_operator.util import lockdep
 from tpu_operator.util.util import (
     format_rfc3339,
     now_rfc3339,
@@ -190,7 +191,7 @@ class TrainingJob:
         # Straggler-remediation handoff from the controller's heartbeat
         # thread to the (single-threaded per key) reconcile: one pending
         # (processId, policy, attempt) slot, latest wins.
-        self._rem_lock = threading.Lock()
+        self._rem_lock = lockdep.lock("TrainingJob._rem_lock")
         self._pending_remediation: Optional[Tuple[int, str, int]] = None  # guarded-by: _rem_lock
         # Nodes a replaced straggler's replacement must avoid, per
         # (role, index) of the CURRENT attempt (cleared on teardown —
@@ -415,7 +416,7 @@ class TrainingJob:
         env_ctx = replicas_mod.EnvContext(
             self.name, self.job_spec.runtime_id, self.job_spec)
         created: List[tuple] = []  # (role, index, pod_name)
-        created_lock = threading.Lock()
+        created_lock = lockdep.lock("training.created_lock")
 
         def create_one(rs: replicas_mod.TPUReplicaSet, role: str,
                        index: int) -> None:

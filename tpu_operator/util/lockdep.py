@@ -1,0 +1,326 @@
+"""Runtime lockdep witness: instrumented locks that prove order discipline.
+
+Linux lockdep's core idea, stdlib-only: every lock the operator creates
+goes through the factories here (``lock`` / ``rlock`` / ``condition``)
+with a stable *class key* ("FleetScheduler._lock"). When the witness is
+enabled, acquiring lock B while holding lock A records the directed edge
+A→B in one process-global order graph; the first acquisition that would
+close a cycle (B held somewhere while A is acquired) is a potential
+deadlock and raises :class:`LockOrderError` carrying BOTH witness
+stacks — the acquisition that recorded the forward edge and the one
+attempting the inversion — so the report reads like a lockdep splat,
+not a post-mortem guess.
+
+Keys name lock *classes*, not instances (all ``FleetScheduler`` objects
+share one node), which is what makes the graph meaningful across a
+fleet of per-job objects — the same choice lockdep makes. Consequences:
+
+- Re-acquiring the *same object* is reentrancy (fine for rlocks; an
+  immediate self-deadlock error for plain locks — the thread would
+  block on itself forever).
+- Acquiring a *different instance* of the same key while one is held
+  records the self-edge ``K→K``: nesting two instances of one lock
+  class has no defined order and deadlocks the moment two threads nest
+  them oppositely, so it is reported as an inversion outright.
+- ``Condition.wait`` releases the underlying lock: the witness pops it
+  from the thread's held set for the duration of the wait and re-checks
+  order on re-acquisition, so parking in a wait never fabricates edges.
+
+Cost model: **disabled (default), the factories return the raw
+``threading`` primitives** — zero per-acquisition overhead, the only
+cost is one branch at construction. Enabled (``TPUJOB_LOCKDEP=1``, or
+``enable()`` before the locks are constructed — tests/conftest.py does
+this for the whole suite), every acquisition pays a thread-local list
+scan plus, for never-before-seen edges only, a stack capture and a
+cycle check. The chaos soak, the fleet bench harnesses, and every unit
+test thereby double as deadlock detectors at the cost of a few percent
+of test wall time.
+
+Violations both raise at the offending acquisition *and* accumulate in
+a process-global list (``violations()``): controller worker threads
+catch broad exceptions by design (a reconcile error is a requeue, not a
+crash), so the raise alone could be swallowed — the conftest fixture
+asserts the list stayed empty after every test.
+"""
+
+from __future__ import annotations
+
+import os
+import threading
+import traceback
+from typing import Any, Dict, List, Optional, Tuple
+
+
+class LockOrderError(AssertionError):
+    """A lock acquisition that inverts the witnessed global order."""
+
+
+_enabled = os.environ.get("TPUJOB_LOCKDEP", "") not in ("", "0", "false")
+
+# The witness's own state is guarded by one RAW lock (never witnessed:
+# the watcher must not watch itself).
+_state_lock = threading.Lock()
+_edges: Dict[Tuple[str, str], str] = {}   # (held, acquired) -> witness stack
+_violations: List[str] = []               # guarded-by: _state_lock
+
+_tls = threading.local()                  # .held: List[[key, obj_id, count]]
+
+
+def enable(on: bool = True) -> None:
+    """Turn the witness on for locks constructed AFTER this call."""
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def reset() -> None:
+    """Test hook: drop the recorded order graph and violations (held
+    sets are per-thread and drain as their with-blocks exit)."""
+    with _state_lock:
+        _edges.clear()
+        del _violations[:]
+
+
+def violations() -> List[str]:
+    with _state_lock:
+        return list(_violations)
+
+
+def violation_count() -> int:
+    with _state_lock:
+        return len(_violations)
+
+
+def report() -> str:
+    """Human-readable dump of every recorded violation."""
+    with _state_lock:
+        if not _violations:
+            return "lockdep: no lock-order violations"
+        return "\n\n".join(_violations)
+
+
+def edges() -> List[Tuple[str, str]]:
+    """The witnessed order graph (introspection/tests)."""
+    with _state_lock:
+        return sorted(_edges)
+
+
+def held_keys() -> List[str]:
+    """Lock keys the CURRENT thread holds, outermost first."""
+    held = getattr(_tls, "held", None)
+    return [ent[0] for ent in held] if held else []
+
+
+# --- factories ---------------------------------------------------------------
+
+def lock(name: str) -> Any:
+    """A mutex named ``name`` — ``threading.Lock()`` when the witness is
+    off, an instrumented wrapper when it is on."""
+    if not _enabled:
+        return threading.Lock()
+    return _WitnessLock(threading.Lock(), name)
+
+
+def rlock(name: str) -> Any:
+    if not _enabled:
+        return threading.RLock()
+    return _WitnessRLock(threading.RLock(), name)
+
+
+def condition(name: str) -> Any:
+    """A condition variable whose underlying lock is witnessed under
+    ``name`` (waits release it; notify/wait ordering is unchanged)."""
+    if not _enabled:
+        return threading.Condition()
+    return threading.Condition(_WitnessRLock(threading.RLock(), name))
+
+
+# --- held-set bookkeeping ----------------------------------------------------
+
+def _held() -> List[list]:
+    held = getattr(_tls, "held", None)
+    if held is None:
+        held = []
+        _tls.held = held
+    return held
+
+
+def _find_path_locked(src: str, dst: str) -> Optional[List[str]]:
+    """DFS: a path src →* dst in the recorded edge graph (call with
+    _state_lock held)."""
+    if src == dst:
+        return [src]
+    adj: Dict[str, List[str]] = {}
+    for a, b in _edges:
+        adj.setdefault(a, []).append(b)
+    stack = [(src, [src])]
+    seen = {src}
+    while stack:
+        node, path = stack.pop()
+        for nxt in adj.get(node, ()):
+            if nxt == dst:
+                return path + [nxt]
+            if nxt not in seen:
+                seen.add(nxt)
+                stack.append((nxt, path + [nxt]))
+    return None
+
+
+def _note_acquired(obj: Any, key: str, count: int = 1) -> Optional[str]:
+    """Record that the current thread now holds ``obj`` (witness key
+    ``key``); called AFTER the real acquisition succeeded. Returns an
+    inversion report when this acquisition closed a cycle (the caller
+    decides whether to raise — it may need to unwind the inner lock
+    first), None otherwise."""
+    held = _held()
+    for ent in held:
+        if ent[1] == id(obj):
+            ent[2] += count
+            return None
+    error: Optional[str] = None
+    if held:
+        # Fast path: every (held, key) edge already witnessed — no stack
+        # capture, no graph walk. First sightings pay both, once.
+        with _state_lock:
+            new_pairs = [(h[0], key) for h in held
+                         if (h[0], key) not in _edges]
+        if new_pairs:
+            here = "".join(traceback.format_stack(limit=16)[:-2])
+            with _state_lock:
+                for pair in new_pairs:
+                    if pair in _edges:
+                        continue  # another thread witnessed it first
+                    held_key = pair[0]
+                    # A cycle exists iff the graph already orders
+                    # key before held_key. held_key == key (two
+                    # *instances* of one lock class nested) is the
+                    # trivial cycle: _find_path_locked(key, key)
+                    # returns [key] immediately.
+                    path = _find_path_locked(key, held_key)
+                    _edges[pair] = here
+                    if path is not None and error is None:
+                        first_hop = (path[0], path[1]) if len(path) > 1 \
+                            else (key, key)
+                        prior = _edges.get(first_hop,
+                                           "(no recorded stack)")
+                        error = (
+                            f"lockdep: lock-order inversion — acquiring "
+                            f"{key!r} while holding {held_key!r}, but "
+                            f"the witnessed order already requires "
+                            f"{' -> '.join(path)} -> {held_key}\n"
+                            f"--- this acquisition ({held_key} held, "
+                            f"taking {key}):\n{here}\n"
+                            f"--- prior witness ({first_hop[0]} held, "
+                            f"taking {first_hop[1]}):\n{prior}"
+                        )
+                        _violations.append(error)
+    held.append([key, id(obj), count])
+    return error
+
+
+def _note_released(obj: Any, count: int = 1) -> int:
+    """Forget ``count`` holds of ``obj`` (0 = all); returns how many
+    were recorded."""
+    held = _held()
+    for i in range(len(held) - 1, -1, -1):
+        if held[i][1] == id(obj):
+            had = held[i][2]
+            if count and had > count:
+                held[i][2] = had - count
+                return count
+            del held[i]
+            return had
+    return 0
+
+
+def _holds(obj: Any) -> bool:
+    return any(ent[1] == id(obj) for ent in _held())
+
+
+# --- instrumented primitives -------------------------------------------------
+
+class _WitnessLock:
+    """Plain (non-reentrant) lock with order witnessing."""
+
+    reentrant = False
+
+    def __init__(self, inner: Any, key: str):
+        self._inner = inner
+        self.key = key
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        if blocking and _holds(self):
+            err = (f"lockdep: self-deadlock — thread re-acquiring the "
+                   f"non-reentrant lock {self.key!r} it already holds")
+            with _state_lock:
+                _violations.append(err)
+            raise LockOrderError(err)
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            err = _note_acquired(self, self.key)
+            if err is not None:
+                # Unwind before raising: acquire() raising from a `with`
+                # statement means __exit__ never runs, and a lock left
+                # held would wedge every later test behind this one.
+                _note_released(self)
+                self._inner.release()
+                raise LockOrderError(err)
+        return ok
+
+    def release(self) -> None:
+        _note_released(self)
+        self._inner.release()
+
+    def locked(self) -> bool:
+        return self._inner.locked()
+
+    def __enter__(self) -> "_WitnessLock":
+        self.acquire()
+        return self
+
+    def __exit__(self, *exc: Any) -> None:
+        self.release()
+
+    def __repr__(self) -> str:
+        return f"<lockdep {self.key} {self._inner!r}>"
+
+
+class _WitnessRLock(_WitnessLock):
+    """Reentrant lock with order witnessing. Also implements the
+    ``_release_save``/``_acquire_restore``/``_is_owned`` trio
+    ``threading.Condition`` borrows from its lock, keeping the held-set
+    honest across ``wait()`` (which releases all recursion levels)."""
+
+    reentrant = True
+
+    def acquire(self, blocking: bool = True, timeout: float = -1) -> bool:
+        ok = self._inner.acquire(blocking, timeout)
+        if ok:
+            err = _note_acquired(self, self.key)
+            if err is not None:
+                _note_released(self)
+                self._inner.release()
+                raise LockOrderError(err)
+        return ok
+
+    # -- Condition integration -------------------------------------------------
+
+    def _is_owned(self) -> bool:
+        return self._inner._is_owned()
+
+    def _release_save(self) -> Tuple[Any, int]:
+        count = _note_released(self, 0)  # wait() drops every level
+        return self._inner._release_save(), count
+
+    def _acquire_restore(self, state: Tuple[Any, int]) -> None:
+        inner_state, count = state
+        self._inner._acquire_restore(inner_state)
+        # Re-acquisition after a wait is a fresh acquisition: the held
+        # set may have changed while parked, so the order is re-checked.
+        # A violation here is recorded (the conftest guard fails the
+        # test) but NOT raised: unwinding mid-restore would leave the
+        # Condition believing it holds a lock it released.
+        _note_acquired(self, self.key, max(1, count))

@@ -36,16 +36,25 @@ import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, TypeVar
 
+from tpu_operator.util import lockdep
+
 F = TypeVar("F", bound=Callable[..., Any])
 
 _local = threading.local()
+# Single-writer bool flipped by CLI wiring before threads start; reads
+# are racy-but-benign (a span logged one tick late), so it carries no
+# lock by design.
 _enabled = False
 _logger = logging.getLogger("tpu_operator.trace")
 
 DEFAULT_SPAN_BUFFER = 512
 
-_spans_lock = threading.Lock()
-_spans: "collections.deque" = collections.deque(maxlen=DEFAULT_SPAN_BUFFER)
+_spans_lock = lockdep.lock("tracing._spans_lock")
+# Every thread's completed spans funnel here (reconcile workers, HTTP
+# handlers, informer threads) — the one cross-thread structure in this
+# module; _local holds everything per-thread.
+_spans: "collections.deque" = collections.deque(
+    maxlen=DEFAULT_SPAN_BUFFER)  # guarded-by: _spans_lock
 
 
 def enable(on: bool = True) -> None:

@@ -1,0 +1,48 @@
+"""Named yield points for deterministic interleaving tests.
+
+Production code marks the handful of scheduling-sensitive spots —
+"worker popped its task", "close drained the queue" — with
+``pause("module.point")``. In production the call is a single global
+read and a falsy branch (no lock, no allocation); under the
+deterministic interleaving harness (``tpu_operator/testing/schedules.py``)
+an installed hook turns each point into a scheduling decision, so a
+test can drive two real threads through every interleaving of the
+marked windows instead of hoping a soak happens to hit the bad one.
+
+Kept in util/ (stdlib-only, zero dependencies) so payload- and
+store-side modules can carry yield points without importing the test
+harness; only the harness ever installs a hook.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Optional
+
+Hook = Callable[[str], None]
+
+_hook: Optional[Hook] = None
+
+
+def pause(name: str) -> None:
+    """Yield point ``name``: a no-op unless a harness installed a hook."""
+    hook = _hook
+    if hook is not None:
+        hook(name)
+
+
+def install(hook: Hook) -> None:
+    """Install the harness hook. One at a time: overlapping harnesses
+    would interleave each other's schedules into nonsense."""
+    global _hook
+    if _hook is not None:
+        raise RuntimeError("a yield-point hook is already installed")
+    _hook = hook
+
+
+def uninstall() -> None:
+    global _hook
+    _hook = None
+
+
+def installed() -> bool:
+    return _hook is not None
