@@ -105,6 +105,15 @@ def parse_args(argv=None):
                         "windows; exits nonzero if recorder-on steady "
                         "step time exceeds recorder-off by more than 1% "
                         "(50 µs absolute floor)")
+    p.add_argument("--dataplane", action="store_true",
+                   help="run ONLY the self-tuning data-plane rows "
+                        "(CPU-hostable): the autotune controller must "
+                        "converge within 5%% of the best static prefetch "
+                        "depth found by sweep inside the window budget, "
+                        "the async host path must shave the measured "
+                        "HOST-phase time, and recorder+autotune together "
+                        "must hold the 1% overhead budget — exits nonzero "
+                        "on regression")
     p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
@@ -1487,6 +1496,229 @@ def _steptrace_ok(rows: list) -> bool:
     return False
 
 
+# --- self-tuning data plane rows ------------------------------------------------
+
+def bench_dataplane(quick: bool) -> list:
+    """The --dataplane gate, three rows:
+
+    1. ``dataplane_autotune_convergence`` — the REAL controller
+       (payload/autotune.py) drives a deterministic plant where the DATA
+       wait shrinks as prefetch depth covers the host's generation burst
+       while every depth unit costs fixed per-step host work, so the
+       plant has an interior optimum. A static sweep over the full depth
+       range finds the best static step time; the controller starts at
+       minDepth and must settle within 5% of it inside the window
+       budget. The plant is modeled (no sleeps): the row asserts the
+       CONTROLLER's convergence property, which timing noise on a shared
+       CI host would otherwise dominate; the loop rows below measure the
+       real step path.
+
+    2. ``dataplane_async_host_shave`` — the same CPU step loop the
+       steptrace guard uses, recorder ON in both arms, every step
+       posting a heartbeat through a poster that costs ~1 ms (a status
+       server one POST-timeout hop away). Sync arm: the POST rides the
+       step thread and lands in the recorder's HOST phase. Async arm:
+       the AsyncHost worker pays it, the step thread pays an enqueue.
+       The measured HOST-phase p50 must shave by at least half.
+
+    3. ``dataplane_overhead`` — the PR 9 budget, extended: baseline arm
+       is recorder OFF + inert runtime (the production loop shape);
+       loaded arm is recorder ON + the autotune controller attached as
+       the commit observer (float adds per step, one window evaluation
+       per ``window_steps``). Interleaved windows, min-of-pairwise-delta
+       (the steptrace guard's method, same rationale), budget ≤ 1% with
+       the 50 µs absolute floor.
+    """
+    from tpu_operator.payload import autotune as autotune_mod
+    from tpu_operator.payload import heartbeat as heartbeat_mod
+    from tpu_operator.payload import steptrace as steptrace_mod
+
+    rows = []
+
+    # -- row 1: convergence vs the best static depth --------------------------
+    min_depth, max_depth, window = 1, 6, 16
+    compute_s, burst_s, cover_s, cost_s = 0.010, 0.006, 0.002, 0.0005
+
+    def plant(depth: int) -> dict:
+        # DATA wait: the host generation burst minus what the in-flight
+        # window hides; each depth unit costs fixed host work that lands
+        # device-side (placement/dispatch), i.e. outside the residue the
+        # controller can see — the interior optimum a greedy
+        # depth-always-helps heuristic would overshoot.
+        data = max(0.0, burst_s - cover_s * (depth - min_depth))
+        other = compute_s + cost_s * (depth - min_depth)
+        return {"seconds": data + other, steptrace_mod.DATA: data,
+                steptrace_mod.COMPUTE: other}
+
+    static_times = {d: plant(d)["seconds"]
+                    for d in range(min_depth, max_depth + 1)}
+    best_depth = min(static_times, key=static_times.get)
+    control = autotune_mod.PrefetchControl(min_depth)
+    controller = autotune_mod.DataPlaneController(
+        control, min_depth=min_depth, max_depth=max_depth,
+        window_steps=window)
+    # Budget: one climb needs a change window + a verdict window, so the
+    # worst case is 2x the depth range, plus settle margin. The loop
+    # deliberately OVERRUNS the budget: a controller still flapping at
+    # the boundary shows up as settled_at > budget_windows in the gate,
+    # instead of being clamped to the budget by loop construction.
+    budget_windows = 2 * (max_depth - min_depth) + 4
+    settled_at = 0
+    for w in range(budget_windows + 4):
+        before = control.depth
+        for _ in range(window):
+            controller.on_step(plant(control.depth))
+        if control.depth != before:
+            settled_at = w + 1
+    achieved = static_times[control.depth]
+    best = static_times[best_depth]
+    rows.append({
+        "metric": "dataplane_autotune_convergence",
+        "converged_depth": control.depth,
+        "best_static_depth": best_depth,
+        "achieved_step_ms": round(achieved * 1e3, 4),
+        "best_static_step_ms": round(best * 1e3, 4),
+        "within_pct": round(100.0 * (achieved / best - 1.0), 2),
+        "windows_to_settle": settled_at,
+        "budget_windows": budget_windows,
+        "adjustments": controller.adjustments(),
+        "unit": "pct",
+        "value": round(100.0 * (achieved / best - 1.0), 2),
+    })
+
+    # -- shared CPU step loop for rows 2 + 3 ----------------------------------
+    import jax
+
+    from tpu_operator.payload import cifar, data as data_mod
+
+    if quick:
+        batch, steps, windows = 32, 60, 5
+        cfg = ["--blocks", "1", "--widths", "8", "8", "8"]
+    else:
+        batch, steps, windows = 64, 120, 7
+        cfg = ["--blocks", "1", "--widths", "8", "16", "32"]
+    cargs = cifar.parse_args(["--batch", str(batch), *cfg])
+    mesh, _model, state, step_fn, batches = cifar.build(cargs)
+    pregen = [data_mod.put_global_batch(mesh, *b)
+              for b in itertools.islice(batches, 4)]
+    cycled = itertools.cycle(pregen)
+
+    def run_window(rec, on_host=None):
+        nonlocal state
+        t0 = time.perf_counter()
+        metrics = fence = None
+        for i in range(steps):
+            if rec is not None:
+                rec.begin(i)
+            args = next(cycled)
+            if rec is not None:
+                rec.lap(steptrace_mod.DATA)
+            state, metrics = step_fn(state, *args)
+            if rec is not None:
+                rec.lap(steptrace_mod.DISPATCH)
+                if fence is not None:
+                    jax.block_until_ready(fence)
+                rec.lap(steptrace_mod.COMPUTE)
+                fence = metrics
+                if on_host is not None:
+                    on_host(i)
+                rec.lap(steptrace_mod.HOST)
+                rec.commit()
+        jax.device_get(metrics["loss"])
+        return (time.perf_counter() - t0) / steps
+
+    for _ in range(3):
+        state, metrics = step_fn(state, *next(cycled))
+    jax.device_get(metrics["loss"])
+
+    # -- row 2: the async host path shaves measured HOST time -----------------
+    post_s = 0.001
+
+    def slow_poster(_url, _body):
+        time.sleep(post_s)
+
+    def host_arm(use_async: bool) -> float:
+        rec = steptrace_mod.StepRecorder(capacity=4096)
+        reporter = heartbeat_mod.HeartbeatReporter(
+            "http://bench", "dp", poster=slow_poster, interval=0.0)
+        host = autotune_mod.AsyncHost(capacity=256)
+        if use_async:
+            reporter.async_sink = host.submit
+        for _ in range(max(2, windows // 2)):
+            run_window(rec, on_host=lambda i: reporter.report(
+                i, {"loss": 0.0}))
+        host.close()
+        summary = rec.summary()
+        return summary["phases"]["host"]["p50Seconds"]
+
+    sync_host = host_arm(False)
+    async_host = host_arm(True)
+    rows.append({
+        "metric": "dataplane_async_host_shave",
+        "sync_host_p50_ms": round(sync_host * 1e3, 4),
+        "async_host_p50_ms": round(async_host * 1e3, 4),
+        "post_ms": post_s * 1e3,
+        "shave_pct": round(100.0 * (1.0 - async_host / max(sync_host, 1e-12)),
+                           1),
+        "unit": "pct",
+        "value": round(100.0 * (1.0 - async_host / max(sync_host, 1e-12)), 1),
+    })
+
+    # -- row 3: recorder + autotune stay inside the PR 9 budget ---------------
+    recorder = steptrace_mod.StepRecorder(capacity=4096)
+    control3 = autotune_mod.PrefetchControl(2)
+    controller3 = autotune_mod.DataPlaneController(
+        control3, min_depth=1, max_depth=8, window_steps=32)
+    recorder.on_commit = controller3.on_step
+    off_times, on_times = [], []
+    for _ in range(windows):
+        off_times.append(run_window(None))
+        on_times.append(run_window(recorder))
+    off = min(off_times)
+    deltas = [on_t - off_t for off_t, on_t in zip(off_times, on_times)]
+    overhead = max(0.0, min(deltas))
+    on = off + overhead
+    rows.append({
+        "metric": "dataplane_overhead",
+        "off_step_ms": round(off * 1e3, 4),
+        "on_step_ms": round(on * 1e3, 4),
+        "overhead_pct": round(100.0 * overhead / off, 2),
+        "overhead_us_per_step": round(overhead * 1e6, 2),
+        "windows_evaluated": controller3.windows_evaluated,
+        "windows": windows,
+        "unit": "pct",
+        "value": round(100.0 * overhead / off, 2),
+    })
+    return rows
+
+
+def _dataplane_ok(rows: list) -> bool:
+    conv, shave, over = rows
+    ok = True
+    if conv["within_pct"] > 5.0 or \
+            conv["windows_to_settle"] > conv["budget_windows"]:
+        print(f"dataplane convergence FAILED: settled depth "
+              f"{conv['converged_depth']} is {conv['within_pct']}% off the "
+              f"best static depth {conv['best_static_depth']} (budget 5%), "
+              f"settled at window {conv['windows_to_settle']} of "
+              f"{conv['budget_windows']}", file=sys.stderr)
+        ok = False
+    if shave["async_host_p50_ms"] > 0.5 * shave["sync_host_p50_ms"]:
+        print(f"dataplane async host path FAILED to shave HOST time: "
+              f"p50 {shave['async_host_p50_ms']} ms async vs "
+              f"{shave['sync_host_p50_ms']} ms sync (must at least halve)",
+              file=sys.stderr)
+        ok = False
+    over_abs = (over["on_step_ms"] - over["off_step_ms"]) / 1e3
+    if not (over["overhead_pct"] <= 1.0 or over_abs <= 50e-6):
+        print(f"dataplane overhead budget EXCEEDED: recorder+autotune step "
+              f"{over['on_step_ms']} ms vs off {over['off_step_ms']} ms "
+              f"({over['overhead_pct']:.2f}% > 1% and "
+              f"{over_abs * 1e6:.1f} µs > 50 µs)", file=sys.stderr)
+        ok = False
+    return ok
+
+
 # --- warm-restart startup rows --------------------------------------------------
 
 def startup_worker_main(cfg_json: str) -> int:
@@ -1871,6 +2103,12 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         rows = [_emit(row) for row in bench_steptrace(args.quick)]
         return 0 if _steptrace_ok(rows) else 1
+    if args.dataplane:
+        # Same rationale as --steptrace: the budgets guard host-side
+        # µs-scale costs, which the TPU tunnel's RTT would swamp.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = [_emit(row) for row in bench_dataplane(args.quick)]
+        return 0 if _dataplane_ok(rows) else 1
     if args.quick:
         # Force CPU even when a TPU plugin pinned the platform at boot
         # (backend clients initialize lazily, so this override wins).
@@ -1905,6 +2143,14 @@ def main(argv=None) -> int:
             st_rows = [_emit(row) for row in bench_steptrace(args.quick)]
             rows.extend(st_rows)
             if not _steptrace_ok(st_rows):
+                return 1
+            # The data-plane budgets guard the same µs-scale host costs
+            # — CPU-only for the same reason as the steptrace row; the
+            # verify.sh standalone gate (`--dataplane --quick`) owns
+            # them either way.
+            dp_rows = [_emit(row) for row in bench_dataplane(args.quick)]
+            rows.extend(dp_rows)
+            if not _dataplane_ok(dp_rows):
                 return 1
         for row in bench_startup(args.quick):
             rows.append(_emit(row))

@@ -94,6 +94,17 @@ python -m pytest tests/test_steptrace.py -x -q
 # 1% of recorder-off (50 µs absolute floor) — the near-zero-cost claim
 # as an enforced budget, exits nonzero on regression.
 python bench.py --steptrace --quick
+# Standalone self-tuning data-plane gate: the autotune controller (hill
+# climb, hysteresis, regression backoff, clamps), dynamic prefetch-depth
+# resize (byte-identical stream order), the background host pipeline,
+# the async host path, the spec.dataPlane/env wiring, and the dataPlane
+# heartbeat chain (sanitization → status fold → metrics → describe).
+python -m pytest tests/test_autotune.py -x -q
+# And its measured form: the autotuner must converge within 5% of the
+# best static prefetch depth inside the window budget, the async host
+# path must shave measured HOST-phase time, and recorder+autotune must
+# hold the 1% overhead budget — exits nonzero on regression.
+python bench.py --dataplane --quick
 # Standalone elastic-gangs gate: inventory-sized attempts (grant in
 # [minSlices, maxSlices], shrink-don't-queue, re-expand, granted — not
 # spec — accounting), the reshard-aware restore through the remote
@@ -129,6 +140,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_store.py \
   --ignore=tests/test_fleet_scheduler.py \
   --ignore=tests/test_steptrace.py \
+  --ignore=tests/test_autotune.py \
   --ignore=tests/test_elastic.py \
   --ignore=tests/test_lockdep.py \
   --ignore=tests/test_schedules.py

@@ -1,0 +1,819 @@
+"""Self-tuning data plane: the autotune controller (hill climb,
+hysteresis, regression backoff, clamps), dynamic prefetch-depth resize,
+the background host pipeline, the async host path, the spec.dataPlane
+wiring (types/schema/validation/env), and the dataPlane heartbeat chain
+(payload → statusserver sanitization → controller fold → CRD status /
+metrics / describe).
+
+The e2e section drives the REAL operator over the in-process HTTP
+apiserver (strict status-subresource schema admission) with a payload
+reporter posting knob state, and asserts status.dataPlane, the
+``job_prefetch_depth`` gauge, the ``job_autotune_adjustments_total``
+counters, and the ``tpujobctl describe`` DataPlane lines.
+"""
+
+import contextlib
+import io
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+from tpu_operator.apis.tpujob.v1alpha1 import types
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.apis.tpujob.validation import (
+    ValidationError,
+    validate_tpujob_spec,
+)
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import autotune
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.payload import steptrace
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.trainer.training import TrainingJob
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+
+def worker_job(name, replicas=1, spec_extra=None):
+    spec = {"replicaSpecs": [{
+        "replicas": replicas, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+        "template": {"spec": {"containers": [{"name": "tpu",
+                                              "image": "x"}]}}}]}
+    spec.update(spec_extra or {})
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": spec,
+    }
+
+
+def feed_window(ctl_, steps, data=0.0, host=0.0, ckpt=0.0, compute=0.010):
+    """Drive one controller window of identical synthetic step records."""
+    for _ in range(steps):
+        ctl_.on_step({"seconds": compute + data + host + ckpt,
+                      steptrace.DATA: data, steptrace.HOST: host,
+                      steptrace.CHECKPOINT: ckpt,
+                      steptrace.COMPUTE: compute})
+
+
+# --- depth convention --------------------------------------------------------
+
+def test_resolve_prefetch_depth_convention():
+    assert autotune.resolve_prefetch_depth(0) == \
+        autotune.DEFAULT_PREFETCH_DEPTH
+    assert autotune.resolve_prefetch_depth(5) == 5
+    with pytest.raises(ValueError):
+        autotune.resolve_prefetch_depth(-1)
+
+
+def test_device_prefetch_rejects_negative_depth():
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh = train.make_mesh()
+    with pytest.raises(ValueError):
+        list(data_mod.device_prefetch(mesh, iter([]), depth=-2))
+
+
+def test_from_env_gating():
+    # no env → inert: caller's depth verbatim (0 stays unbuffered for
+    # direct train_loop callers), no controller, no pipeline
+    rt = autotune.from_env(prefetch=0, env={})
+    assert rt.depth == 0 and not rt.active and not rt.pipeline
+    assert rt.controller is None and rt.wire() is None
+    # spec block without autotune → static depth + pipeline + wire
+    rt = autotune.from_env(prefetch=0, env={
+        autotune.ENV_PREFETCH_DEPTH: "5"})
+    assert rt.depth == 5 and rt.active and rt.pipeline
+    assert rt.controller is None
+    assert rt.wire() == {"prefetchDepth": 5, "hostAsync": False}
+    # autotune on: controller with env bounds, auto depth resolves
+    rt = autotune.from_env(prefetch=0, env={
+        autotune.ENV_PREFETCH_DEPTH: "0",
+        autotune.ENV_AUTOTUNE: "1",
+        autotune.ENV_MIN_DEPTH: "2",
+        autotune.ENV_MAX_DEPTH: "6",
+        autotune.ENV_WINDOW_STEPS: "16"})
+    assert rt.controller is not None and rt.control is not None
+    assert rt.controller.min_depth == 2 and rt.controller.max_depth == 6
+    assert rt.controller.window_steps == 16
+    assert rt.control.depth == autotune.DEFAULT_PREFETCH_DEPTH
+    # an explicit --prefetch-depth wins over the env value
+    rt = autotune.from_env(prefetch=3, env={
+        autotune.ENV_PREFETCH_DEPTH: "5"})
+    assert rt.depth == 3
+
+
+def test_add_prefetch_argument_defaults_from_env():
+    import argparse
+
+    p = argparse.ArgumentParser()
+    autotune.add_prefetch_argument(p, env={
+        autotune.ENV_PREFETCH_DEPTH: "7"})
+    assert p.parse_args([]).prefetch_depth == 7
+    assert p.parse_args(["--prefetch-depth", "2"]).prefetch_depth == 2
+    # malformed env never kills the payload at arg-parse time
+    p2 = argparse.ArgumentParser()
+    autotune.add_prefetch_argument(p2, env={
+        autotune.ENV_PREFETCH_DEPTH: "lots"})
+    assert p2.parse_args([]).prefetch_depth == 0
+
+
+# --- controller --------------------------------------------------------------
+
+def test_controller_converges_up_on_data_bound_digests():
+    """DATA-bound windows climb the depth until the (synthetic) data wait
+    stops dominating — the plant rewards depth, so the controller keeps
+    each move and converges without a single revert."""
+    control = autotune.PrefetchControl(1)
+    c = autotune.DataPlaneController(control, min_depth=1, max_depth=8,
+                                     window_steps=8)
+    for _ in range(16):
+        d = control.depth
+        # data wait shrinks as depth covers the burst; compute 10 ms
+        data = max(0.0, 0.006 - (d - 1) * 0.002)
+        feed_window(c, 8, data=data)
+    assert control.depth == 4  # climbed until DATA fell under the floor
+    adj = c.adjustments()
+    assert adj["prefetchUp"] == 3 and adj["prefetchDown"] == 0
+
+
+def test_controller_backs_off_on_regression():
+    control = autotune.PrefetchControl(2)
+    c = autotune.DataPlaneController(control, window_steps=8)
+    feed_window(c, 8, data=0.005)            # DATA dominant → depth 3
+    assert control.depth == 3
+    feed_window(c, 8, data=0.005, compute=0.025)  # step time regressed
+    assert control.depth == 2                # reverted
+    adj = c.adjustments()
+    assert adj["prefetchUp"] == 1 and adj["prefetchDown"] == 1
+    # the knob is now held: the same DATA-bound signal does not re-climb
+    # within the hold window
+    feed_window(c, 8, data=0.005)
+    assert control.depth == 2
+
+
+def test_controller_hysteresis_no_flap():
+    """Steady digests with sub-hysteresis noise after convergence make NO
+    adjustments — the no-flap contract."""
+    control = autotune.PrefetchControl(2)
+    c = autotune.DataPlaneController(control, window_steps=8)
+    feed_window(c, 8, data=0.005)
+    feed_window(c, 8, data=0.001)            # improved → accepted
+    settled = dict(c.adjustments())
+    for i in range(12):
+        # ±1% step-time noise, residue under the materiality floor
+        feed_window(c, 8, data=0.00005,
+                    compute=0.010 * (1.0 + (0.01 if i % 2 else -0.01)))
+    assert c.adjustments() == settled
+    assert control.depth == 3
+
+
+def test_controller_verdict_ignores_gang_wide_noise():
+    """The verdict is the LOCAL share (step minus compute wait): a
+    modest whole-step slowdown during the verdict window — a peer
+    hiccup, equalized into COMPUTE by the gang's collectives — must not
+    revert a change that improved the knob's own signal, else recurring
+    gang noise pins every member's knobs. A large whole-step regression
+    still reverts via the coarse step guard (the backs-off test)."""
+    control = autotune.PrefetchControl(2)
+    c = autotune.DataPlaneController(control, window_steps=8)
+    feed_window(c, 8, data=0.005)                 # climb -> depth 3
+    feed_window(c, 8, data=0.001, compute=0.010 * 1.05)
+    assert control.depth == 3                     # kept
+    adj = c.adjustments()
+    assert adj["prefetchUp"] == 1 and adj["prefetchDown"] == 0
+
+
+def test_controller_clamps_to_min_max():
+    control = autotune.PrefetchControl(1)
+    c = autotune.DataPlaneController(control, min_depth=1, max_depth=3,
+                                     window_steps=8)
+    for _ in range(10):
+        feed_window(c, 8, data=0.008)        # permanently DATA-bound
+    assert control.depth == 3                # never past maxDepth
+    assert c.adjustments()["prefetchUp"] == 2
+    # construction clamps an out-of-range starting depth too
+    control2 = autotune.PrefetchControl(9)
+    autotune.DataPlaneController(control2, min_depth=2, max_depth=4)
+    assert control2.depth == 4
+
+
+def test_controller_falls_through_to_next_knob_when_capped():
+    """A clamped dominant knob must not dead-end the climb: the
+    next-most-material phase's knob gets the window's action."""
+    control = autotune.PrefetchControl(3)
+    calls = []
+    c = autotune.DataPlaneController(control, min_depth=1, max_depth=3,
+                                     window_steps=8,
+                                     enable_host_async=calls.append)
+    # DATA dominates but depth is already at max; HOST is material too.
+    feed_window(c, 8, data=0.006, host=0.004)
+    assert control.depth == 3 and calls == [True]
+    adj = c.adjustments()
+    assert adj["hostUp"] == 1 and adj["prefetchUp"] == 0
+
+
+def test_controller_host_knob_enables_async_path():
+    control = autotune.PrefetchControl(2)
+    calls = []
+    c = autotune.DataPlaneController(control, window_steps=8,
+                                     enable_host_async=calls.append)
+    feed_window(c, 8, host=0.004)            # HOST dominates the residue
+    assert c.host_async and calls == [True]
+    feed_window(c, 8, host=0.0001)           # improved → accepted
+    assert c.host_async
+    adj = c.adjustments()
+    assert adj["hostUp"] == 1 and adj["hostDown"] == 0
+
+
+def test_controller_checkpoint_cadence_stretches_within_cap(tmp_path):
+    from tpu_operator.payload import checkpoint
+
+    class _State:
+        pass
+
+    ck = checkpoint.Checkpointer(str(tmp_path / "ck"), save_every=10)
+    control = autotune.PrefetchControl(2)
+    c = autotune.DataPlaneController(control, window_steps=8,
+                                     checkpointer=ck)
+    for _ in range(8):
+        feed_window(c, 8, ckpt=0.004)        # CHECKPOINT-stall bound
+    assert ck.cadence_multiplier == autotune.CHECKPOINT_CADENCE_CAP
+    assert c.adjustments()["checkpointUp"] == 2  # 1 → 2 → 4, capped
+    # maybe_save honors the stretched cadence: only every mult'th
+    # interval boundary saves
+    saved = []
+    ck._save = lambda step, state, force: saved.append(step) or True
+    ck.maybe_save(10, None)
+    ck.maybe_save(20, None)
+    ck.maybe_save(40, None)
+    assert saved == [40]
+
+
+def test_controller_survives_observer_exceptions():
+    rec = steptrace.StepRecorder(capacity=16)
+
+    def boom(_record):
+        raise RuntimeError("observer bug")
+
+    rec.on_commit = boom
+    rec.begin(0)
+    rec.lap(steptrace.COMPUTE)
+    rec.commit()                              # must not raise
+    assert rec.on_commit is None              # detached after the failure
+    assert rec.steps_recorded == 1
+
+
+# --- dynamic resize + pipeline ----------------------------------------------
+
+def _byte_stream(n, rows=8):  # rows divisible by the 8-device test mesh
+    rng = np.random.default_rng(7)
+    for _ in range(n):
+        yield (rng.normal(size=(rows, 3)).astype(np.float32),)
+
+
+def test_dynamic_depth_resize_preserves_order_byte_identically():
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh = train.make_mesh()
+    static = [b[0].tobytes() for b in _byte_stream(20)]
+    control = autotune.PrefetchControl(1)
+    out = []
+    for i, b in enumerate(data_mod.device_prefetch(
+            mesh, _byte_stream(20), depth=1, control=control)):
+        out.append(np.asarray(b[0]).tobytes())
+        if i == 3:
+            control.set_depth(7)              # grow mid-stream
+        if i == 11:
+            control.set_depth(1)              # shrink mid-stream
+    assert out == static
+
+
+def test_pipeline_preserves_order_and_propagates_errors():
+    fed = list(range(10))
+
+    def failing():
+        for v in fed:
+            yield v
+        raise RuntimeError("stream died")
+
+    it = iter(failing())
+    pl = autotune.HostPipeline(fill=lambda: next(it), depth=3)
+    got = [pl.get() for _ in range(10)]
+    assert got == fed
+    with pytest.raises(RuntimeError, match="stream died"):
+        pl.get()
+    pl.close()
+
+    # clean end-of-stream raises StopIteration, close() never hangs
+    it2 = iter([1, 2])
+    pl2 = autotune.HostPipeline(fill=lambda: next(it2), depth=2)
+    assert pl2.get() == 1 and pl2.get() == 2
+    with pytest.raises(StopIteration):
+        pl2.get()
+    pl2.close()
+
+
+def test_pipelined_device_prefetch_matches_sync_stream():
+    from tpu_operator.payload import data as data_mod, train
+
+    mesh = train.make_mesh()
+    sync = [np.asarray(b[0]).tobytes() for b in data_mod.device_prefetch(
+        mesh, _byte_stream(16), depth=2)]
+    piped = [np.asarray(b[0]).tobytes() for b in data_mod.device_prefetch(
+        mesh, _byte_stream(16), depth=2, pipeline=True)]
+    assert piped == sync
+
+
+def test_pipeline_thread_stops_when_consumer_abandons():
+    it = iter(range(1000))
+    gen_closed = threading.Event()
+
+    def fill():
+        try:
+            return next(it)
+        except StopIteration:
+            gen_closed.set()
+            raise
+
+    pl = autotune.HostPipeline(fill=fill, depth=2)
+    assert pl.get() == 0
+    pl.close()
+    assert not pl._thread.is_alive()
+    # A post-close get() must raise, not park on a condition no worker
+    # will ever signal (buffered leftovers still drain first).
+    while True:
+        try:
+            pl.get()
+        except StopIteration:
+            break
+
+
+# --- async host path ---------------------------------------------------------
+
+def test_async_host_runs_work_in_order_and_bounds_queue():
+    host = autotune.AsyncHost(capacity=64)
+    ran = []
+    done = threading.Event()
+    for i in range(10):
+        assert host.submit(ran.append, i)
+    host.submit(lambda: done.set())
+    assert done.wait(5)
+    assert ran == list(range(10))
+    host.close()
+    assert not host.submit(ran.append, 99)    # closed → refused
+
+    # a wedged worker bounds the queue and counts drops
+    gate = threading.Event()
+    slow = autotune.AsyncHost(capacity=2)
+    slow.submit(gate.wait)                    # parks the worker
+    time.sleep(0.05)
+    assert slow.submit(lambda: None)
+    assert slow.submit(lambda: None)
+    assert not slow.submit(lambda: None)      # over capacity → dropped
+    assert slow.dropped == 1
+    gate.set()
+    slow.close()
+
+
+def test_heartbeat_async_sink_defers_posts_but_not_startup():
+    posts = []
+    gate = threading.Event()
+
+    def poster(_url, body):
+        gate.wait(5)
+        posts.append(body)
+
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://x", "j", poster=poster, clock=lambda: 0.0)
+    host = autotune.AsyncHost()
+    reporter.async_sink = host.submit
+    # steady beat: accepted for async delivery, nothing posted yet
+    assert reporter.report(5, {"loss": 1.0})
+    assert posts == []
+    # startup-carrying beat: synchronous (its ACK protocol needs the
+    # real verdict) — the poster runs on THIS thread once ungated
+    gate.set()
+    assert reporter.report(6, {"loss": 0.9},
+                           startup={"compileSeconds": 1.0})
+    assert any("startup" in p for p in posts)
+    host.close()
+    assert len(posts) == 2                    # the deferred beat drained
+    assert any(p.get("startup") == {"compileSeconds": 1.0} for p in posts)
+
+
+def test_interval_of_is_the_single_cadence_source():
+    class _NoInterval:
+        pass
+
+    class _Bad:
+        interval = "soon"
+
+    class _Neg:
+        interval = -3
+
+    assert heartbeat_mod.interval_of(None) == heartbeat_mod.DEFAULT_INTERVAL
+    assert heartbeat_mod.interval_of(_NoInterval()) == \
+        heartbeat_mod.DEFAULT_INTERVAL
+    assert heartbeat_mod.interval_of(_Bad()) == heartbeat_mod.DEFAULT_INTERVAL
+    assert heartbeat_mod.interval_of(_Neg()) == heartbeat_mod.DEFAULT_INTERVAL
+    reporter = heartbeat_mod.HeartbeatReporter("http://x", "j",
+                                               interval=3.5)
+    assert heartbeat_mod.interval_of(reporter) == 3.5
+
+
+def test_attach_withholds_checkpoint_knob_in_multiprocess():
+    """A gang's save is a collective: the cadence knob must not be wired
+    when the gang has more than one process (a unilaterally stretched
+    maybe_save gate wedges the save barrier); the per-process-local
+    knobs stay available."""
+    class _Ck:
+        cadence_multiplier = 1
+        save_every = 10
+
+    for procs, wired in ((1, True), (4, False)):
+        rt = autotune.from_env(prefetch=0, env={
+            autotune.ENV_PREFETCH_DEPTH: "0", autotune.ENV_AUTOTUNE: "1"})
+        ck = _Ck()
+        rt.attach(recorder=steptrace.StepRecorder(capacity=8),
+                  checkpointer=ck, processes=procs)
+        assert (rt.controller._checkpointer is ck) is wired, procs
+        assert rt.controller._enable_host_async is not None
+        rt.close()
+
+
+def test_runtime_wire_and_host_toggle():
+    rt = autotune.from_env(prefetch=0, env={
+        autotune.ENV_PREFETCH_DEPTH: "0", autotune.ENV_AUTOTUNE: "1",
+        autotune.ENV_WINDOW_STEPS: "8"})
+    posts = []
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://x", "j", poster=lambda _u, b: posts.append(b),
+        clock=lambda: 0.0)
+    rec = steptrace.StepRecorder(capacity=16)
+    rt.attach(recorder=rec, heartbeat=reporter)
+    assert rec.on_commit == rt.controller.on_step
+    wire = rt.wire()
+    assert wire["prefetchDepth"] == autotune.DEFAULT_PREFETCH_DEPTH
+    assert wire["hostAsync"] is False
+    assert wire["adjustments"]["prefetchUp"] == 0
+    # the controller's host knob swaps the reporter's sink live
+    rt._apply_host_async(True)
+    assert reporter.async_sink is not None
+    rt._apply_host_async(False)
+    assert reporter.async_sink is None
+    rt.close()
+
+
+# --- spec wiring -------------------------------------------------------------
+
+def test_dataplane_spec_roundtrip_defaults_validation():
+    doc = worker_job("t", spec_extra={
+        "dataPlane": {"prefetchDepth": 4,
+                      "autotune": {"minDepth": 2, "maxDepth": 6,
+                                   "windowSteps": 16}}})
+    spec = types.TPUJobSpec.from_dict(doc["spec"])
+    assert spec.data_plane.prefetch_depth == 4
+    assert spec.data_plane.autotune.enabled is True
+    assert spec.data_plane.autotune.min_depth == 2
+    assert spec.to_dict()["dataPlane"] == {
+        "prefetchDepth": 4,
+        "autotune": {"enabled": True, "minDepth": 2, "maxDepth": 6,
+                     "windowSteps": 16}}
+    validate_tpujob_spec(set_defaults(spec))
+
+    # absent block round-trips absent (None = static shipped config)
+    bare = types.TPUJobSpec.from_dict(worker_job("t")["spec"])
+    assert bare.data_plane is None and "dataPlane" not in bare.to_dict()
+
+    # strict schema admits the block and rejects unknown keys inside it
+    ok, _ = schema_mod.validate_tpujob_strict(doc)
+    assert ok
+    bad = worker_job("t", spec_extra={"dataPlane": {"prefetchDeep": 1}})
+    ok, msg = schema_mod.validate_tpujob_strict(bad)
+    assert not ok and "prefetchDeep" in msg
+
+    # explicit junk reaches validation and fails loudly (never clamped)
+    for block in ({"prefetchDepth": -1},
+                  {"autotune": {"minDepth": 5, "maxDepth": 2}},
+                  {"autotune": {"windowSteps": 4}},
+                  {"prefetchDepth": 9, "autotune": {"maxDepth": 8}}):
+        junk = types.TPUJobSpec.from_dict(
+            worker_job("t", spec_extra={"dataPlane": block})["spec"])
+        with pytest.raises(ValidationError):
+            validate_tpujob_spec(set_defaults(junk))
+    # …but a pinned depth outside the range is fine with autotune OFF
+    pinned = types.TPUJobSpec.from_dict(worker_job("t", spec_extra={
+        "dataPlane": {"prefetchDepth": 9,
+                      "autotune": {"enabled": False}}})["spec"])
+    validate_tpujob_spec(set_defaults(pinned))
+
+
+def test_dataplane_env_injection():
+    from tpu_operator.trainer.replicas import build_replica_env
+
+    spec = types.TPUJobSpec.from_dict(worker_job("j", spec_extra={
+        "dataPlane": {"prefetchDepth": 3,
+                      "autotune": {"minDepth": 1, "maxDepth": 5,
+                                   "windowSteps": 64}}})["spec"])
+    set_defaults(spec)
+    env = build_replica_env("j", "rt1", spec, types.TPUReplicaType.WORKER,
+                            0, 0)
+    assert env["TPUJOB_DATAPLANE_PREFETCH_DEPTH"] == "3"
+    assert env["TPUJOB_DATAPLANE_AUTOTUNE"] == "1"
+    assert env["TPUJOB_DATAPLANE_MIN_DEPTH"] == "1"
+    assert env["TPUJOB_DATAPLANE_MAX_DEPTH"] == "5"
+    assert env["TPUJOB_DATAPLANE_WINDOW_STEPS"] == "64"
+
+    # depth-only block: no autotune vars (payload runtime stays static)
+    spec2 = types.TPUJobSpec.from_dict(worker_job("j", spec_extra={
+        "dataPlane": {"prefetchDepth": 2}})["spec"])
+    env2 = build_replica_env("j", "rt1", spec2,
+                             types.TPUReplicaType.WORKER, 0, 0)
+    assert env2["TPUJOB_DATAPLANE_PREFETCH_DEPTH"] == "2"
+    assert "TPUJOB_DATAPLANE_AUTOTUNE" not in env2
+
+    # no block → no injection (inert runtime, pre-dataplane behavior)
+    bare = types.TPUJobSpec.from_dict(worker_job("j")["spec"])
+    env3 = build_replica_env("j", "rt1", bare,
+                             types.TPUReplicaType.WORKER, 0, 0)
+    assert not any(k.startswith("TPUJOB_DATAPLANE") for k in env3)
+
+
+# --- statusserver door -------------------------------------------------------
+
+class _ControllerStub:
+    class _Store:
+        def get(self, _ns, name):
+            return {"metadata": {"namespace": "default", "name": name}} \
+                if name == "jb" else None
+
+        def list(self):
+            return []
+
+    class _Informer:
+        def __init__(self):
+            self.store = _ControllerStub._Store()
+
+    def __init__(self):
+        self.job_informer = self._Informer()
+        self.heartbeats = []
+
+    def record_heartbeat(self, _ns, _name, hb):
+        self.heartbeats.append(hb)
+        return True
+
+
+@pytest.fixture()
+def sanitizing_server():
+    server = StatusServer(0)
+    server.start()
+    stub = _ControllerStub()
+    server.set_controller(stub)
+    try:
+        yield server, stub
+    finally:
+        server.stop()
+
+
+def test_dataplane_sanitization_rejects_bad_knob_reports(sanitizing_server):
+    server, _stub = sanitizing_server
+    base = {"namespace": "default", "name": "jb", "step": 1}
+    for bad, frag in (
+            ("deep", "must be an object"),
+            ({"prefetchDepth": -1}, "prefetchDepth"),
+            ({"prefetchDepth": float("nan")}, "prefetchDepth"),
+            ({"prefetchDepth": float("inf")}, "prefetchDepth"),
+            ({"prefetchDepth": True}, "prefetchDepth"),
+            ({"checkpointIntervalSteps": 0}, "checkpointIntervalSteps"),
+            ({"hostDropped": -1}, "hostDropped"),
+            ({"hostAsync": "false"}, "hostAsync"),
+            ({"adjustments": "three"}, "adjustments"),
+            ({"adjustments": {"prefetchUp": -1}}, "prefetchUp"),
+            ({"adjustments": {"hostUp": float("nan")}}, "hostUp")):
+        ok, msg = server.record_heartbeat({**base, "dataPlane": bad})
+        assert not ok and frag in msg, (bad, msg)
+
+
+def test_dataplane_sanitization_keeps_known_drops_unknown(sanitizing_server):
+    server, stub = sanitizing_server
+    ok, _ = server.record_heartbeat({
+        "namespace": "default", "name": "jb", "step": 1,
+        "dataPlane": {"prefetchDepth": 3, "hostAsync": True,
+                      "checkpointIntervalSteps": 200, "hostDropped": 4,
+                      "adjustments": {"prefetchUp": 2,
+                                      "quantumKnob": 9}}})
+    assert ok
+    (hb,) = stub.heartbeats
+    assert hb["dataPlane"] == {
+        "prefetchDepth": 3, "hostAsync": True,
+        "checkpointIntervalSteps": 200, "hostDropped": 4,
+        "adjustments": {"prefetchUp": 2}}
+
+
+# --- controller fold ---------------------------------------------------------
+
+def _controller_with_job(name="dj", attempt=0):
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=3600.0)
+    job = types.TPUJob.from_dict(worker_job(name))
+    job.metadata["uid"] = "u1"
+    job.status.attempt = attempt
+    controller.jobs[f"default/{name}"] = TrainingJob(
+        cs, controller.recorder, job)
+    return cs, controller, controller.jobs[f"default/{name}"]
+
+
+def _dp_beat(step, dataplane, attempt=0,
+             time_="2026-08-04T00:00:00.000000Z"):
+    return {"time": time_, "step": step, "attempt": attempt,
+            "processId": 0, "dataPlane": dataplane}
+
+
+def test_dataplane_folds_into_status_gauge_and_counters():
+    _cs, controller, tj = _controller_with_job()
+    assert controller.record_heartbeat("default", "dj", _dp_beat(
+        10, {"prefetchDepth": 3, "hostAsync": False,
+             "adjustments": {"prefetchUp": 1}}))
+    dp = tj.job.status.data_plane
+    assert dp["prefetchDepth"] == 3 and dp["attempt"] == 0
+    assert dp["adjustments"] == {"prefetchUp": 1}
+    labels = {"namespace": "default", "name": "dj"}
+    assert controller.metrics.counter_value("job_prefetch_depth",
+                                            labels=labels) == 3
+    assert controller.metrics.counter_value(
+        "job_autotune_adjustments_total",
+        labels={**labels, "knob": "prefetch", "direction": "up"}) == 1
+
+    # delta accounting: lifetime totals accumulate against the baseline
+    assert controller.record_heartbeat("default", "dj", _dp_beat(
+        20, {"prefetchDepth": 4,
+             "adjustments": {"prefetchUp": 3, "hostUp": 1}}))
+    dp = tj.job.status.data_plane
+    assert dp["adjustments"] == {"prefetchUp": 3, "hostUp": 1}
+    assert controller.metrics.counter_value(
+        "job_autotune_adjustments_total",
+        labels={**labels, "knob": "prefetch", "direction": "up"}) == 3
+    assert controller.metrics.counter_value(
+        "job_autotune_adjustments_total",
+        labels={**labels, "knob": "host", "direction": "up"}) == 1
+
+    # attempt bump: the payload's counters reset; deltas count in full
+    # and the lifetime totals keep growing (never double, never lost)
+    tj.job.status.attempt = 1
+    assert controller.record_heartbeat("default", "dj", _dp_beat(
+        5, {"prefetchDepth": 2, "adjustments": {"prefetchUp": 2}},
+        attempt=1))
+    dp = tj.job.status.data_plane
+    assert dp["adjustments"]["prefetchUp"] == 5
+    assert dp["attemptAdjustments"]["prefetchUp"] == 2
+    assert controller.metrics.counter_value(
+        "job_autotune_adjustments_total",
+        labels={**labels, "knob": "prefetch", "direction": "up"}) == 5
+
+
+def test_dataplane_per_job_series_removed_on_job_deletion():
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0))
+    labels = {"namespace": "default", "name": "gone"}
+    controller.metrics.set_gauge("job_prefetch_depth", 4, labels=labels)
+    controller.metrics.inc("job_autotune_adjustments_total", 2, labels={
+        **labels, "knob": "prefetch", "direction": "up"})
+    controller.metrics.inc("job_autotune_adjustments_total", 1, labels={
+        **labels, "knob": "checkpoint", "direction": "down"})
+    rendered = "\n".join(controller.metrics.render_lines())
+    assert 'name="gone"' in rendered
+    assert controller.sync_tpujob("default/gone") is True
+    rendered = "\n".join(controller.metrics.render_lines())
+    assert 'name="gone"' not in rendered
+
+
+# --- e2e over the in-process apiserver --------------------------------------
+
+@pytest.fixture()
+def harness():
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def _get(port, path):
+    import urllib.request
+
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+def test_e2e_dataplane_status_metrics_describe(harness):
+    api, cs, _controller, server = harness
+    cs.tpujobs.create("default", worker_job("tuned", spec_extra={
+        "dataPlane": {"prefetchDepth": 0,
+                      "autotune": {"minDepth": 1, "maxDepth": 8,
+                                   "windowSteps": 16}}}))
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) == 1)
+    for pod in api.clientset.pods.list("default"):
+        # the env contract reached the pod spec
+        tpu = [c for c in pod["spec"]["containers"] if c["name"] == "tpu"][0]
+        env = {e["name"]: e.get("value") for e in tpu.get("env", [])}
+        assert env["TPUJOB_DATAPLANE_PREFETCH_DEPTH"] == "0"
+        assert env["TPUJOB_DATAPLANE_AUTOTUNE"] == "1"
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", "tuned")
+                    .get("status", {}).get("phase") == "Running")
+
+    # a payload reporter posts knob state through the REAL status server
+    env = {"TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+           "TPUJOB_NAME": "tuned", "TPUJOB_NAMESPACE": "default",
+           "TPUJOB_ATTEMPT": "0", "JAX_PROCESS_ID": "0"}
+    reporter = heartbeat_mod.from_env(env)
+    assert reporter.report(
+        100, {"loss": 1.5},
+        dataplane={"prefetchDepth": 5, "hostAsync": True,
+                   "checkpointIntervalSteps": 200, "hostDropped": 2,
+                   "adjustments": {"prefetchUp": 3, "hostUp": 1}})
+
+    # → status.dataPlane through the strict status schema
+    def dp():
+        return (cs.tpujobs.get("default", "tuned").get("status", {})
+                .get("dataPlane") or {})
+    assert wait_for(lambda: dp().get("prefetchDepth") == 5,
+                    describe=lambda: cs.tpujobs.get(
+                        "default", "tuned").get("status"))
+    assert dp()["adjustments"] == {"prefetchUp": 3, "hostUp": 1}
+    assert dp()["hostAsync"] is True
+    assert dp()["hostDropped"] == 2
+
+    # → /metrics: the depth gauge and the adjustment counters
+    body = _get(server.port, "/metrics")
+    assert ('tpu_operator_job_prefetch_depth'
+            '{name="tuned",namespace="default"} 5' in body)
+    assert ('tpu_operator_job_autotune_adjustments_total'
+            '{direction="up",knob="prefetch",name="tuned",'
+            'namespace="default"} 3' in body)
+
+    # → tpujobctl describe prints the DataPlane + Autotuned lines
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--master", api.url, "describe", "tuned"])
+    assert rc == 0
+    text = out.getvalue()
+    assert "DataPlane:  prefetch depth 5 (auto" in text
+    assert "host path async" in text and "ckpt every 200" in text
+    assert "host drops 2" in text
+    assert "Autotuned:  prefetch +3/-0, host +1/-0" in text
+
+
+# --- train_loop integration --------------------------------------------------
+
+@pytest.mark.slow
+def test_train_loop_with_active_dataplane_posts_knobs_and_tunes():
+    from tpu_operator.payload import train
+    from tpu_operator.payload.cifar import build, parse_args
+
+    args = parse_args(["--steps", "6", "--batch", "16",
+                       "--blocks", "1", "--widths", "8", "8", "8",
+                       "--log-every", "0"])
+    mesh, _m, state, step, batches = build(args)
+    rec = steptrace.StepRecorder(capacity=32)
+    posts = []
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://x", "lj", poster=lambda _u, b: posts.append(b),
+        interval=0.0)  # every step is due
+    runtime = autotune.from_env(prefetch=0, env={
+        autotune.ENV_PREFETCH_DEPTH: "0", autotune.ENV_AUTOTUNE: "1",
+        autotune.ENV_WINDOW_STEPS: "8"})
+    train.train_loop(mesh, step, state, batches, steps=5,
+                     heartbeat=reporter, steptrace=rec, overlap=False,
+                     dataplane=runtime)
+    carried = [p["dataPlane"] for p in posts if "dataPlane" in p]
+    assert carried and carried[0]["prefetchDepth"] >= 1
+    assert "adjustments" in carried[0]
+    assert rec.steps_recorded == 5

@@ -51,6 +51,7 @@ SCAN = (
     ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "util"),
+    ("tpu_operator", "payload", "autotune.py"),
     ("tpu_operator", "payload", "checkpoint.py"),
     ("tpu_operator", "payload", "startup.py"),
     ("tpu_operator", "payload", "steptrace.py"),

@@ -12,6 +12,9 @@ untestable without a full TrainingJob).
 from __future__ import annotations
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_AUTOTUNE_MAX_DEPTH,
+    DEFAULT_AUTOTUNE_MIN_DEPTH,
+    DEFAULT_AUTOTUNE_WINDOW_STEPS,
     DEFAULT_CACHE_PATH,
     DEFAULT_SCHEDULING_QUEUE,
     DEFAULT_STEPTRACE_BUFFER,
@@ -36,6 +39,14 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 # clamp silently masks the validation error it duplicates). The sanity
 # check pins the shipped defaults inside validation's own bounds.
 assert DEFAULT_STEPTRACE_BUFFER >= 8 and DEFAULT_STRAGGLER_RATIO >= 1.0
+
+# Self-tuning data plane (``data_plane``): same discipline — the block
+# stays optional (None = the static shipped config), from_dict fills
+# absent fields (prefetchDepth 0 = auto by convention, never rewritten
+# here so the wire round-trips what the user wrote), and explicit junk
+# (minDepth > maxDepth, tiny windowSteps) reaches validation.py loudly.
+assert 0 < DEFAULT_AUTOTUNE_MIN_DEPTH <= DEFAULT_AUTOTUNE_MAX_DEPTH
+assert DEFAULT_AUTOTUNE_WINDOW_STEPS >= 8
 
 
 def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:

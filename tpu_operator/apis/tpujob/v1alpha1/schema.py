@@ -40,6 +40,13 @@ from tpu_operator.payload.steptrace import (
     DIGEST_KEYS as STEP_DIGEST_KEYS,
     PHASE_FIELDS as STEP_PHASE_FIELDS,
 )
+# Per-knob adjustment-counter keys of the self-tuning data plane
+# (payload/autotune.py, stdlib-only as well): the keys of
+# dataPlane.adjustments.
+from tpu_operator.payload.autotune import (
+    ADJUSTMENT_KEYS,
+    MIN_WINDOW_STEPS,
+)
 
 
 def _str(**kw) -> Dict[str, Any]:
@@ -146,6 +153,17 @@ def spec_schema() -> Dict[str, Any]:
             "bufferSteps": _int(minimum=8),
             "stragglerRatio": _num(minimum=1),
         }),
+        # Self-tuning data plane: prefetch depth (0 = auto) + the
+        # closed-loop autotuner's bounds and evaluation window.
+        "dataPlane": _obj({
+            "prefetchDepth": _int(minimum=0),
+            "autotune": _obj({
+                "enabled": {"type": "boolean"},
+                "minDepth": _int(minimum=0),
+                "maxDepth": _int(minimum=1),
+                "windowSteps": _int(minimum=MIN_WINDOW_STEPS),
+            }),
+        }),
         # Elastic gangs: each attempt's world size is picked from the
         # live slice inventory within [minSlices, maxSlices] (maxSlices
         # 0 = defaulted to numSlices), and persistently flagged
@@ -204,6 +222,38 @@ def steptiming_schema() -> Dict[str, Any]:
     })
 
 
+def dataplane_knobs_schema(status: bool = False) -> Dict[str, Any]:
+    """The self-tuning data plane's knob report: shared by
+    ``status.lastHeartbeat.dataPlane`` (as posted — live values +
+    per-attempt adjustment counters) and ``status.dataPlane`` (as folded
+    in by the controller, which adds lifetime totals, the per-attempt
+    delta baselines, attempt, and time)."""
+    counters = _obj({key: _int(minimum=0) for key in ADJUSTMENT_KEYS})
+    out = {
+        # Live device-prefetch depth (in-flight batch window).
+        "prefetchDepth": _int(minimum=0),
+        # Heartbeat/log work on the async host worker vs the step thread.
+        "hostAsync": {"type": "boolean"},
+        # Effective checkpoint save interval after any autotune stretch.
+        "checkpointIntervalSteps": _int(minimum=1),
+        # Telemetry work shed by the async host worker (lossy by
+        # contract, but never invisibly).
+        "hostDropped": _int(minimum=0),
+        "adjustments": counters,
+    }
+    if status:
+        out.update({
+            # Per-attempt baselines of the delta accounting (the payload
+            # counters reset on whole-group restart; lifetime totals in
+            # ``adjustments`` accumulate deltas against these).
+            "attemptAdjustments": _obj(
+                {key: _int(minimum=0) for key in ADJUSTMENT_KEYS}),
+            "attempt": _int(minimum=0),
+            "time": _str(),
+        })
+    return _obj(out)
+
+
 def status_schema() -> Dict[str, Any]:
     phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
               types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
@@ -257,6 +307,8 @@ def status_schema() -> Dict[str, Any]:
             "startup": startup_breakdown_schema(),
             # Data-plane phase digest (flight recorder window summary).
             "stepTiming": steptiming_schema(),
+            # Self-tuning data plane knob report (live values).
+            "dataPlane": dataplane_knobs_schema(),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -297,6 +349,9 @@ def status_schema() -> Dict[str, Any]:
         # Data-plane phase timing: where step time goes (per-phase
         # p50/p95/max over the newest digest window from process 0).
         "stepTiming": steptiming_schema(),
+        # Self-tuning data plane roll-up: live knob values + lifetime
+        # adjustment totals with the per-attempt delta baselines.
+        "dataPlane": dataplane_knobs_schema(status=True),
         # Gang straggler roll-up: members whose p95 step time exceeds the
         # gang median by spec.stepTrace.stragglerRatio (absent = healthy).
         "stragglers": _arr(_obj({

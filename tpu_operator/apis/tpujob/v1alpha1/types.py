@@ -210,6 +210,22 @@ class StoreBackend:
 DEFAULT_STORE_UPLOAD_PARALLELISM = 4
 
 
+# --- Self-tuning data plane (adaptive prefetch + autotune) --------------------
+
+# ONE definition with the runtime (payload/autotune.py is stdlib-only;
+# schema.py already imports its ADJUSTMENT_KEYS the same direction):
+# the depth ``dataPlane.prefetchDepth: 0`` (auto) resolves to, and the
+# autotune bounds/window defaults ``from_dict`` fills — the spec layer
+# and the env-driven runtime cannot drift apart.
+from tpu_operator.payload.autotune import (  # noqa: E402
+    MIN_WINDOW_STEPS as MIN_AUTOTUNE_WINDOW_STEPS,
+    DEFAULT_MAX_DEPTH as DEFAULT_AUTOTUNE_MAX_DEPTH,
+    DEFAULT_MIN_DEPTH as DEFAULT_AUTOTUNE_MIN_DEPTH,
+    DEFAULT_PREFETCH_DEPTH as DEFAULT_DATAPLANE_PREFETCH_DEPTH,
+    DEFAULT_WINDOW_STEPS as DEFAULT_AUTOTUNE_WINDOW_STEPS,
+)
+
+
 # --- Data-plane flight recorder (step phase timing + straggler policy) -------
 
 # Ring-buffer capacity default: last N steps retained for the postmortem
@@ -432,6 +448,79 @@ class StoreSpec:
             upload_parallelism=int(d.get("uploadParallelism",
                                          DEFAULT_STORE_UPLOAD_PARALLELISM)),
             prefetch=bool(d.get("prefetch", True)),
+        )
+
+
+@dataclass
+class AutotuneSpec:
+    """Closed-loop tuning knobs (``spec.dataPlane.autotune``).
+
+    When enabled, the payload's controller (payload/autotune.py) reads
+    the flight recorder's per-step phase digests every ``windowSteps``
+    steps and hill-climbs the live data-plane knobs with hysteresis —
+    prefetch depth within ``[minDepth, maxDepth]``, the async host path,
+    and checkpoint cadence (coarsening only, bounded) — converging
+    toward minimal non-COMPUTE residue and backing a change out when the
+    next window shows regression.
+    """
+
+    enabled: bool = True
+    min_depth: int = DEFAULT_AUTOTUNE_MIN_DEPTH
+    max_depth: int = DEFAULT_AUTOTUNE_MAX_DEPTH
+    window_steps: int = DEFAULT_AUTOTUNE_WINDOW_STEPS
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"enabled": self.enabled, "minDepth": self.min_depth,
+                "maxDepth": self.max_depth,
+                "windowSteps": self.window_steps}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["AutotuneSpec"]:
+        if d is None:
+            return None
+        return cls(
+            enabled=bool(d.get("enabled", True)),
+            min_depth=int(d.get("minDepth", DEFAULT_AUTOTUNE_MIN_DEPTH)),
+            max_depth=int(d.get("maxDepth", DEFAULT_AUTOTUNE_MAX_DEPTH)),
+            window_steps=int(d.get("windowSteps",
+                                   DEFAULT_AUTOTUNE_WINDOW_STEPS)),
+        )
+
+
+@dataclass
+class DataPlaneSpec:
+    """Self-tuning data plane (``spec.dataPlane``).
+
+    ``prefetchDepth`` is the input pipeline's in-flight batch window:
+    ``0`` (the default) means AUTO — the runtime starts at the shipped
+    default and, with ``autotune`` enabled, tunes it live per job; a
+    positive value pins a static depth (settable without autotune). The
+    block's presence also turns on the background host pipeline thread
+    (batch generation runs ahead of consumption instead of serialized
+    into the step's DATA phase). Knob state rides the heartbeat into
+    ``status.dataPlane``, the ``job_prefetch_depth`` gauge, and the
+    ``job_autotune_adjustments_total{knob,direction}`` counters.
+    """
+
+    # 0 = auto (runtime-resolved; tuned live when autotune is enabled).
+    prefetch_depth: int = 0
+    autotune: Optional[AutotuneSpec] = None
+
+    def to_dict(self) -> Dict[str, Any]:
+        d: Dict[str, Any] = {"prefetchDepth": self.prefetch_depth}
+        if self.autotune is not None:
+            d["autotune"] = self.autotune.to_dict()
+        return d
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["DataPlaneSpec"]:
+        if d is None:
+            return None
+        return cls(
+            prefetch_depth=int(d.get("prefetchDepth", 0)),
+            autotune=AutotuneSpec.from_dict(d.get("autotune")),
         )
 
 
@@ -660,6 +749,10 @@ class TPUJobSpec:
     # straggler threshold (None = the defaults — recorder on, ratio 2.0;
     # kept absent so specs round-trip unchanged).
     step_trace: Optional[StepTraceSpec] = None
+    # Self-tuning data plane: adaptive prefetch depth + the closed-loop
+    # autotuner over the flight recorder's phase digests (None = the
+    # static shipped config, the pre-dataplane behavior).
+    data_plane: Optional[DataPlaneSpec] = None
     # Elastic gangs: each attempt's world size is picked from the live
     # slice inventory within [minSlices, maxSlices] instead of being
     # pinned to numSlices, and persistently flagged stragglers are
@@ -706,6 +799,8 @@ class TPUJobSpec:
             d["store"] = self.store.to_dict()
         if self.step_trace is not None:
             d["stepTrace"] = self.step_trace.to_dict()
+        if self.data_plane is not None:
+            d["dataPlane"] = self.data_plane.to_dict()
         if self.elastic is not None:
             d["elastic"] = self.elastic.to_dict()
         return d
@@ -737,6 +832,7 @@ class TPUJobSpec:
             scheduling=SchedulingSpec.from_dict(d.get("scheduling")),
             store=StoreSpec.from_dict(d.get("store")),
             step_trace=StepTraceSpec.from_dict(d.get("stepTrace")),
+            data_plane=DataPlaneSpec.from_dict(d.get("dataPlane")),
             elastic=ElasticSpec.from_dict(d.get("elastic")),
         )
 
@@ -871,6 +967,13 @@ class TPUJobStatus:
     # (empty/absent = gang healthy). Each entry: {processId, p95Seconds,
     # gangMedianSeconds, ratio, step, time}.
     stragglers: List[Dict[str, Any]] = field(default_factory=list)
+    # Self-tuning data plane, folded in from process 0's heartbeat
+    # ``dataPlane`` knob reports: live prefetch depth, host-path mode,
+    # effective checkpoint cadence, lifetime per-knob adjustment totals
+    # (delta-accumulated like the checkpoint counters, with per-attempt
+    # baselines persisted IN status so operator restarts never
+    # double-count), attempt, and time.
+    data_plane: Optional[Dict[str, Any]] = None
     # Elastic-gang state, written by the controller per attempt: the
     # granted world size ({slices, workers}), the effective range, a
     # lifetime resize counter + last direction, the one-attempt shed cap
@@ -926,6 +1029,8 @@ class TPUJobStatus:
             d["stepTiming"] = dict(self.step_timing)
         if self.stragglers:
             d["stragglers"] = [dict(s) for s in self.stragglers]
+        if self.data_plane:
+            d["dataPlane"] = dict(self.data_plane)
         if self.elastic:
             d["elastic"] = dict(self.elastic)
         if self.scheduling:
@@ -967,6 +1072,8 @@ class TPUJobStatus:
             step_timing=(dict(d["stepTiming"])
                          if d.get("stepTiming") else None),
             stragglers=[dict(s) for s in d.get("stragglers", [])],
+            data_plane=(dict(d["dataPlane"])
+                        if d.get("dataPlane") else None),
             elastic=(dict(d["elastic"]) if d.get("elastic") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),

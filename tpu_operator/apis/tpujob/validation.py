@@ -21,6 +21,7 @@ from typing import List
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
     MAX_SCHEDULING_PRIORITY,
+    MIN_AUTOTUNE_WINDOW_STEPS,
     CacheMedium,
     RestartPolicy,
     StoreBackend,
@@ -190,6 +191,45 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
                 "stepTrace.stragglerRatio must be >= 1.0 (below the gang "
                 "median, every healthy member would be flagged)"
             )
+
+    # Self-tuning data plane. prefetchDepth 0 = AUTO by convention (the
+    # runtime resolves it; payload/autotune.resolve_prefetch_depth), so
+    # only negatives are invalid; an explicit positive depth under an
+    # ENABLED autotuner must sit inside the tuning range — starting the
+    # hill climb outside its own clamp would either snap the depth the
+    # user pinned or dead-band the controller, both silently.
+    dp = spec.data_plane
+    if dp is not None:
+        if dp.prefetch_depth < 0:
+            raise ValidationError(
+                "dataPlane.prefetchDepth must be >= 0 (0 = auto)"
+            )
+        at = dp.autotune
+        if at is not None:
+            if at.min_depth < 0:
+                raise ValidationError(
+                    "dataPlane.autotune.minDepth must be >= 0"
+                )
+            if at.max_depth < max(1, at.min_depth):
+                raise ValidationError(
+                    f"dataPlane.autotune.maxDepth ({at.max_depth}) must "
+                    f"be >= minDepth ({at.min_depth}) and >= 1"
+                )
+            if at.window_steps < MIN_AUTOTUNE_WINDOW_STEPS:
+                raise ValidationError(
+                    f"dataPlane.autotune.windowSteps must be >= "
+                    f"{MIN_AUTOTUNE_WINDOW_STEPS} (a smaller window's "
+                    f"phase means are noise, and the hill climb would "
+                    f"chase it)"
+                )
+            if at.enabled and dp.prefetch_depth > 0 and not (
+                    at.min_depth <= dp.prefetch_depth <= at.max_depth):
+                raise ValidationError(
+                    f"dataPlane.prefetchDepth ({dp.prefetch_depth}) must "
+                    f"lie within autotune [minDepth, maxDepth] = "
+                    f"[{at.min_depth}, {at.max_depth}] when autotune is "
+                    f"enabled"
+                )
 
     # Elastic gangs: the sizing range must be a usable sub-range of the
     # spec'd world — the worker template provisions one slice's worth of

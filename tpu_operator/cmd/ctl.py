@@ -24,6 +24,11 @@ import time
 from typing import Any, Dict, List
 
 from tpu_operator import version as version_mod
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_AUTOTUNE_MAX_DEPTH,
+    DEFAULT_AUTOTUNE_MIN_DEPTH,
+    DEFAULT_AUTOTUNE_WINDOW_STEPS,
+)
 from tpu_operator.client import errors
 
 
@@ -264,6 +269,42 @@ def cmd_describe(cs, opts) -> int:
                 print(f"  {key:<12}  {d.get('p50Seconds', 0):>9.6f}s  "
                       f"{d.get('p95Seconds', 0):>9.6f}s  "
                       f"{d.get('maxSeconds', 0):>9.6f}s")
+    # Self-tuning data plane: the live knob values (spec half = the
+    # requested config, status half = what the runtime is actually doing)
+    # and the lifetime adjustment trail.
+    dp_spec = spec.get("dataPlane") or {}
+    dp = status.get("dataPlane") or {}
+    if dp_spec or dp:
+        at = dp_spec.get("autotune") or {}
+        depth = dp.get("prefetchDepth",
+                       dp_spec.get("prefetchDepth", "?"))
+        mode = ("auto" if at.get("enabled", bool(at)) or
+                dp_spec.get("prefetchDepth", 0) == 0 else "static")
+        line = f"DataPlane:  prefetch depth {depth} ({mode}"
+        if at:
+            # Sparse autotune blocks round-trip what the user wrote, so
+            # the display fallbacks must be THE spec defaults (one
+            # definition via types.py), not restated literals.
+            line += (f", range {at.get('minDepth', DEFAULT_AUTOTUNE_MIN_DEPTH)}-"
+                     f"{at.get('maxDepth', DEFAULT_AUTOTUNE_MAX_DEPTH)}, window "
+                     f"{at.get('windowSteps', DEFAULT_AUTOTUNE_WINDOW_STEPS)} steps")
+        line += ")"
+        if dp.get("hostAsync") is not None:
+            line += (", host path "
+                     + ("async" if dp["hostAsync"] else "inline"))
+        if dp.get("checkpointIntervalSteps") is not None:
+            line += f", ckpt every {dp['checkpointIntervalSteps']}"
+        if dp.get("hostDropped"):
+            line += f", host drops {dp['hostDropped']}"
+        print(line)
+        adj = dp.get("adjustments") or {}
+        if any(adj.values()):
+            trail = ", ".join(
+                f"{knob} +{adj.get(knob + 'Up', 0)}/-"
+                f"{adj.get(knob + 'Down', 0)}"
+                for knob in ("prefetch", "host", "checkpoint")
+                if adj.get(knob + "Up", 0) or adj.get(knob + "Down", 0))
+            print(f"Autotuned:  {trail} (attempt {dp.get('attempt', 0)})")
     for s in status.get("stragglers") or []:
         print(f"Straggler:  process {s.get('processId', '?')} p95 "
               f"{s.get('p95Seconds', 0):.3f}s vs gang median "

@@ -370,6 +370,7 @@ class Controller:
             for series in ("job_goodput_ratio",
                            "job_straggler_ratio",
                            "job_world_size",
+                           "job_prefetch_depth",
                            "job_checkpoint_save_failures_total",
                            "job_checkpoint_restore_fallbacks_total",
                            "job_store_upload_failures_total",
@@ -378,6 +379,14 @@ class Controller:
                            "store_prefetch_misses_total"):
                 self.metrics.remove_series(
                     series, labels={"namespace": namespace, "name": name})
+            # The autotune adjustment counters carry {knob,direction} on
+            # top of the job identity: drop every combination.
+            from tpu_operator.payload.autotune import KNOB_OF
+            for knob, direction in set(KNOB_OF.values()):
+                self.metrics.remove_series(
+                    "job_autotune_adjustments_total",
+                    labels={"namespace": namespace, "name": name,
+                            "knob": knob, "direction": direction})
             return True
 
         job = TPUJob.from_dict(cached)
@@ -510,7 +519,7 @@ class Controller:
                               "checkpointRestoreFallbacks",
                               "storeLastUploadedStep",
                               "storeUploadFailures",
-                              "stepTiming"):
+                              "stepTiming", "dataPlane"):
                     if field not in merged and field in prev:
                         merged[field] = prev[field]
         tj.job.status.last_heartbeat = merged
@@ -522,6 +531,8 @@ class Controller:
                                       hb_attempt)
         self._apply_goodput_heartbeat(tj, namespace, name, heartbeat,
                                       hb_attempt)
+        self._apply_dataplane_heartbeat(tj, namespace, name, heartbeat,
+                                        hb_attempt)
         # Compare against the last *persisted* stamp, not the last
         # received one — a steady sub-interval cadence would otherwise
         # keep resetting the baseline and never persist again. A
@@ -735,6 +746,73 @@ class Controller:
                 float(gp.get("usefulStepSeconds", 0.0))
                 + float(new["firstStepSeconds"]), 6)
             tj.job.status.goodput = gp
+
+    def _apply_dataplane_heartbeat(self, tj: TrainingJob, namespace: str,
+                                   name: str, heartbeat: Dict[str, Any],
+                                   hb_attempt: Optional[int]) -> None:
+        """Fold a heartbeat's self-tuning data-plane knob report into
+        ``status.dataPlane`` (called under _jobs_lock). Live values
+        (prefetch depth, host path, effective checkpoint cadence) are
+        taken as reported and ``job_prefetch_depth`` tracks the depth;
+        the per-knob adjustment counters follow the checkpoint fold's
+        delta discipline — the payload's counters are per-attempt (reset
+        on whole-group restart), status keeps lifetime totals by
+        accumulating deltas against a per-attempt baseline persisted IN
+        status, and each delta ticks
+        ``job_autotune_adjustments_total{knob,direction}``."""
+        from tpu_operator.payload.autotune import ADJUSTMENT_KEYS, KNOB_OF
+
+        dp = heartbeat.get("dataPlane")
+        if not isinstance(dp, dict) or not dp:
+            return
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        cur = dict(tj.job.status.data_plane or {})
+        same_attempt = cur.get("attempt") == gen
+        new: Dict[str, Any] = {}
+        for field in ("prefetchDepth", "checkpointIntervalSteps",
+                      "hostDropped"):
+            if dp.get(field) is not None:
+                new[field] = int(dp[field])
+        if isinstance(dp.get("hostAsync"), bool):
+            # The statusserver door rejects non-bools; direct callers of
+            # record_heartbeat get the same strictness, not a coercion
+            # that turns "false" into True.
+            new["hostAsync"] = dp["hostAsync"]
+        totals = dict(cur.get("adjustments") or {})
+        baselines = dict(cur.get("attemptAdjustments") or {}) \
+            if same_attempt else {}
+        reported_adj = dp.get("adjustments") or {}
+        for key in ADJUSTMENT_KEYS:
+            reported = reported_adj.get(key)
+            if reported is None:
+                continue
+            reported = int(reported)
+            baseline = int(baselines.get(key, 0))
+            # Below-baseline means the payload's counters reset
+            # (unexpected mid-attempt); count it all — the checkpoint
+            # fold's convention.
+            delta = reported if reported < baseline else reported - baseline
+            if delta > 0:
+                totals[key] = int(totals.get(key, 0)) + delta
+                knob, direction = KNOB_OF[key]
+                self.metrics.inc("job_autotune_adjustments_total", delta,
+                                 labels={"namespace": namespace,
+                                         "name": name, "knob": knob,
+                                         "direction": direction})
+            baselines[key] = reported
+        if totals:
+            new["adjustments"] = totals
+        if baselines:
+            new["attemptAdjustments"] = baselines
+        new["attempt"] = int(gen)
+        if heartbeat.get("time"):
+            new["time"] = str(heartbeat["time"])
+        tj.job.status.data_plane = new
+        if new.get("prefetchDepth") is not None:
+            self.metrics.set_gauge("job_prefetch_depth",
+                                   new["prefetchDepth"],
+                                   labels={"namespace": namespace,
+                                           "name": name})
 
     def _apply_steptiming_heartbeat(self, tj: TrainingJob, pid: int,
                                     heartbeat: Dict[str, Any],

@@ -44,6 +44,7 @@ import time
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from typing import Any, Dict, List, Optional, Tuple
 
+from tpu_operator.payload.autotune import ADJUSTMENT_KEYS
 from tpu_operator.payload.startup import STAGE_FIELDS, STAGES as STARTUP_STAGES
 from tpu_operator.payload.steptrace import (
     DIGEST_KEYS as STEP_DIGEST_KEYS,
@@ -264,6 +265,18 @@ class Metrics:
                       "flight recorder's windowed digests — each digest's "
                       "p95 observed once per disjoint step window.",
                       STEP_PHASE_BUCKETS)
+        self.register("job_prefetch_depth", "gauge",
+                      "Live device-prefetch depth of the job's data "
+                      "plane (in-flight batch window), from process 0's "
+                      "dataPlane knob reports — static spec value or "
+                      "the autotuner's current choice.")
+        self.register("job_autotune_adjustments_total", "counter",
+                      "Data-plane autotune knob adjustments, by "
+                      "{knob,direction}: prefetch (depth step), host "
+                      "(async host path toggle), checkpoint (cadence "
+                      "stretch); direction down = a regression-triggered "
+                      "revert. Delta-accumulated per job from heartbeat "
+                      "counter reports.")
         self.register("job_straggler_ratio", "gauge",
                       "Worst p95-step-time-to-gang-median ratio across the "
                       "job's gang (1.0 = perfectly even; above "
@@ -432,6 +445,26 @@ class Metrics:
         return lines
 
 
+def _int_field(value: Any, minimum: int, label: str
+               ) -> Tuple[Optional[int], str]:
+    """Shared strict integer door for heartbeat count/knob fields:
+    bool is an int subclass but a True depth/count is a payload bug,
+    not 1; float NaN/Inf fail the cast; below-minimum rejects (persisted,
+    it would wedge every later status write against a real apiserver's
+    schema minimums). One definition so the stepTiming and dataPlane
+    doors cannot drift into different policies for the same defect."""
+    if isinstance(value, bool):
+        return None, f"bad heartbeat: non-numeric {label}"
+    try:
+        value = int(value)
+    except (TypeError, ValueError, OverflowError):
+        return None, f"bad heartbeat: non-numeric {label}"
+    if value < minimum:
+        detail = "negative" if minimum == 0 else f"below {minimum}"
+        return None, f"bad heartbeat: {label} {detail}"
+    return value, ""
+
+
 def _sanitize_steptiming(st: Any) -> Tuple[Optional[Dict[str, Any]], str]:
     """Sanitize a heartbeat's ``stepTiming`` phase digest down to exactly
     the CRD schema's shape: (clean-or-None, error). Same door discipline
@@ -446,12 +479,9 @@ def _sanitize_steptiming(st: Any) -> Tuple[Optional[Dict[str, Any]], str]:
     clean: Dict[str, Any] = {}
     for field in ("steps",):
         if st.get(field) is not None:
-            try:
-                value = int(st[field])
-            except (TypeError, ValueError):
-                return None, f"bad heartbeat: non-numeric stepTiming.{field}"
-            if value < 0:
-                return None, f"bad heartbeat: negative stepTiming.{field}"
+            value, err = _int_field(st[field], 0, f"stepTiming.{field}")
+            if err:
+                return None, err
             clean[field] = value
     for field in ("stepP50Seconds", "stepP95Seconds", "stepMaxSeconds",
                   "stepLocalP95Seconds"):
@@ -492,6 +522,52 @@ def _sanitize_steptiming(st: Any) -> Tuple[Optional[Dict[str, Any]], str]:
                 clean_phases[name] = clean_stats
         if clean_phases:
             clean["phases"] = clean_phases
+    return (clean or None), ""
+
+
+def _sanitize_dataplane(dp: Any) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Sanitize a heartbeat's ``dataPlane`` knob report down to exactly
+    the CRD schema's shape: (clean-or-None, error). Door discipline per
+    the stepTiming sanitizer — a non-finite/negative knob value rejects
+    the beat (persisted, it would wedge every later status write against
+    a real apiserver's schema minimums), while UNKNOWN adjustment keys
+    are dropped silently (a newer payload tuning a knob this operator
+    doesn't know must not lose the whole beat — forward compat, like the
+    unknown-phase drop)."""
+    if not isinstance(dp, dict):
+        return None, "bad heartbeat: dataPlane must be an object"
+    clean: Dict[str, Any] = {}
+    for field, minimum in (("prefetchDepth", 0),
+                           ("checkpointIntervalSteps", 1),
+                           ("hostDropped", 0)):
+        if dp.get(field) is not None:
+            value, err = _int_field(dp[field], minimum,
+                                    f"dataPlane.{field}")
+            if err:
+                return None, err
+            clean[field] = value
+    if dp.get("hostAsync") is not None:
+        if not isinstance(dp["hostAsync"], bool):
+            # Same strict door as the numeric knobs: bool("false") is
+            # True, so coercing would persist the opposite of what a
+            # stringly-typed payload meant.
+            return None, "bad heartbeat: non-boolean dataPlane.hostAsync"
+        clean["hostAsync"] = dp["hostAsync"]
+    adj = dp.get("adjustments")
+    if adj is not None:
+        if not isinstance(adj, dict):
+            return None, "bad heartbeat: dataPlane.adjustments must be an object"
+        clean_adj: Dict[str, int] = {}
+        for key in ADJUSTMENT_KEYS:
+            if adj.get(key) is None:
+                continue
+            value, err = _int_field(adj[key], 0,
+                                    f"dataPlane.adjustments.{key}")
+            if err:
+                return None, err
+            clean_adj[key] = value
+        if clean_adj:
+            clean["adjustments"] = clean_adj
     return (clean or None), ""
 
 
@@ -725,6 +801,13 @@ class StatusServer:
                 return False, err
             if clean_st:
                 hb["stepTiming"] = clean_st
+        dp = body.get("dataPlane")
+        if dp is not None:
+            clean_dp, err = _sanitize_dataplane(dp)
+            if err:
+                return False, err
+            if clean_dp:
+                hb["dataPlane"] = clean_dp
         su = body.get("startup")
         if su is not None:
             if not isinstance(su, dict):

@@ -159,6 +159,18 @@ class Checkpointer:
         self._ocp = ocp
         self.directory = os.path.abspath(directory)
         self.save_every = max(1, int(save_every))
+        # Live cadence knob (payload/autotune.py): the effective interval
+        # is ``save_every * cadence_multiplier``. Autotune may only
+        # COARSEN cadence — the multiplier starts at 1 (exactly the
+        # configured interval) and is bounded by the controller's cap —
+        # so durability never silently tightens below what the payload
+        # asked for, and a regression reverts the stretch. Read at save
+        # boundaries on the step-loop thread. In a gang the save is a
+        # COLLECTIVE, so a stretched gate must be gang-uniform: train_loop
+        # wires the checkpointer into the controller only when
+        # process_count == 1 — any caller moving this off 1 in a
+        # multi-process job must gang-agree the value first.
+        self.cadence_multiplier = 1
         self.fail_after = max(1, int(fail_after))
         # Injectable for tests; default is the real allgather-min.
         self._agree = agree_fn or gang_agree_step
@@ -252,6 +264,13 @@ class Checkpointer:
         ``fail_after`` consecutive failures."""
         step = int(step)
         self._check_upload_escalation()
+        mult = max(1, int(self.cadence_multiplier))
+        if mult > 1 and step % (self.save_every * mult) != 0:
+            # Autotune stretched the cadence: only every mult'th interval
+            # boundary saves (orbax's own policy still gates below, so a
+            # stretch can never make saves MORE frequent than configured).
+            self._finalize_pending(block=False)
+            return False
         try:
             due = bool(self.manager.should_save(step))
         except Exception:  # noqa: BLE001 — conservative: try the save

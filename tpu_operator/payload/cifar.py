@@ -47,6 +47,9 @@ def parse_args(argv=None):
     p.add_argument("--profile-dir",
                    default=os.environ.get("TPU_PROFILE_DIR", ""),
                    help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
+    from tpu_operator.payload import autotune
+
+    autotune.add_prefetch_argument(p)
     return p.parse_args(argv)
 
 
@@ -80,7 +83,7 @@ def build(args, mesh=None, num_slices: int = 1):
 
 
 def run(info: bootstrap.ProcessInfo, args=None) -> dict:
-    from tpu_operator.payload import checkpoint, train
+    from tpu_operator.payload import autotune, checkpoint, train
 
     args = args or parse_args([])
     mesh, _model, state, step, batches = build(
@@ -101,6 +104,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> dict:
                 "step %d loss %.4f acc %.3f", i, m["loss"], m["accuracy"]),
             checkpointer=ckpt,
             profile_dir=args.profile_dir,
+            prefetch=autotune.resolve_prefetch_depth(args.prefetch_depth),
         )
     finally:
         if ckpt is not None:

@@ -34,6 +34,24 @@ DEFAULT_INTERVAL = 10.0  # seconds between posts (per process)
 POST_TIMEOUT = 2.0       # socket timeout: never stall a training step
 
 
+def interval_of(heartbeat: Any) -> float:
+    """THE posting cadence of a reporter-shaped object — the single
+    definition every consumer shares (the reporter's own ``due()``, the
+    train-loop startup ticker, the autotune runtime's host-budget
+    pacing). Each used to re-derive it with its own hardcoded fallback
+    (``getattr(hb, "interval", 10.0)``), which only agreed with
+    DEFAULT_INTERVAL by coincidence; a reporter with a malformed or
+    negative interval now resolves identically everywhere (0 stays 0 —
+    the explicit every-step cadence tests and benches use)."""
+    try:
+        interval = float(getattr(heartbeat, "interval", DEFAULT_INTERVAL))
+    except (TypeError, ValueError):
+        return DEFAULT_INTERVAL
+    if not math.isfinite(interval) or interval < 0:
+        return DEFAULT_INTERVAL
+    return interval
+
+
 def _http_post(url: str, body: Dict[str, Any]) -> None:
     import urllib.request
 
@@ -77,15 +95,25 @@ class HeartbeatReporter:
         self._last_post: Optional[float] = None
         self._last_step: Optional[int] = None
         self._failed_once = False
+        # Async host path (payload/autotune.py): when set (an
+        # ``AsyncHost.submit``-shaped callable) steady-state posts hand
+        # their serialization + socket round-trip to the worker thread
+        # and the step thread pays an enqueue. Beats carrying the
+        # one-shot ``startup`` breakdown still post synchronously: their
+        # ACK/retry protocol (the 503-until-reconciled dance) needs the
+        # real result.
+        self.async_sink: Optional[Callable[..., bool]] = None
 
     def due(self, _step: int) -> bool:
         now = self._clock()
-        return self._last_post is None or now - self._last_post >= self.interval
+        return self._last_post is None \
+            or now - self._last_post >= interval_of(self)
 
     def report(self, step: int, metrics: Optional[Dict[str, Any]] = None,
                checkpoint: Optional[Dict[str, Any]] = None,
                startup: Optional[Dict[str, Any]] = None,
-               steptiming: Optional[Dict[str, Any]] = None) -> bool:
+               steptiming: Optional[Dict[str, Any]] = None,
+               dataplane: Optional[Dict[str, Any]] = None) -> bool:
         """Post one heartbeat; returns True when the post succeeded. Step
         time is averaged over the steps since the previous post, so it is
         meaningful at any reporting interval.
@@ -106,7 +134,14 @@ class HeartbeatReporter:
         (``StepRecorder.summary()``) — per-phase p50/p95/max since the
         previous digest. The operator folds process 0's into
         ``status.stepTiming`` + the ``job_step_phase_seconds`` histograms
-        and feeds EVERY process's into the gang straggler detector."""
+        and feeds EVERY process's into the gang straggler detector.
+
+        ``dataplane`` is the self-tuning data plane's current knob state
+        (``DataPlaneRuntime.wire()``): live prefetch depth, host-path
+        mode, effective checkpoint cadence, and the per-knob adjustment
+        counters — the operator folds it into ``status.dataPlane`` +
+        the ``job_prefetch_depth`` gauge and the
+        ``job_autotune_adjustments_total`` counters."""
         now = self._clock()
         body: Dict[str, Any] = {
             "namespace": self.namespace,
@@ -119,6 +154,10 @@ class HeartbeatReporter:
             body["stepTiming"] = dict(steptiming)
         if startup and not self.cadence_only:
             body["startup"] = dict(startup)
+        if dataplane and not self.cadence_only:
+            # Knob state is process 0's stream (one controller per job
+            # worth reporting); cadence beats stay minimal.
+            body["dataPlane"] = dict(dataplane)
         if self._last_post is not None and self._last_step is not None \
                 and step > self._last_step:
             per_step = (now - self._last_post) / (step - self._last_step)
@@ -160,7 +199,17 @@ class HeartbeatReporter:
 
     def _post(self, body: Dict[str, Any]) -> bool:
         """Best-effort POST shared by every report flavor: never raises,
-        logs the first failure of a streak rather than a stream."""
+        logs the first failure of a streak rather than a stream. With an
+        ``async_sink`` wired (the autotune host worker), steady posts are
+        handed off — enqueue-and-return, True = accepted for delivery —
+        while ``startup``-carrying beats keep the synchronous path: their
+        one-shot retry protocol needs the server's actual verdict."""
+        sink = self.async_sink
+        if sink is not None and "startup" not in body:
+            return bool(sink(self._post_now, body))
+        return self._post_now(body)
+
+    def _post_now(self, body: Dict[str, Any]) -> bool:
         try:
             self._poster(self.url, body)
             self._failed_once = False

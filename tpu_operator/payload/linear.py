@@ -30,6 +30,9 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1e-3,
                    help="exit nonzero unless final MSE is below this")
+    from tpu_operator.payload import autotune
+
+    autotune.add_prefetch_argument(p)
     p.add_argument("--profile-dir",
                    default=os.environ.get("TPU_PROFILE_DIR", ""),
                    help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
@@ -40,6 +43,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> float:
     import jax
     import optax
 
+    from tpu_operator.payload import autotune
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import models, train
 
@@ -60,6 +64,7 @@ def run(info: bootstrap.ProcessInfo, args=None) -> float:
         log_every=max(1, args.steps // 10),
         log_fn=lambda i, m: log.info("step %d loss %.6f", i, m["loss"]),
         profile_dir=args.profile_dir,
+        prefetch=autotune.resolve_prefetch_depth(args.prefetch_depth),
     )
     loss = float(metrics["loss"])
     log.info("final loss %.6f over %d devices", loss, len(mesh.devices.flat))

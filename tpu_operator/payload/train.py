@@ -32,8 +32,10 @@ import numpy as np
 import optax
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
+from tpu_operator.payload import autotune as autotune_mod
 from tpu_operator.payload import bootstrap as bootstrap_mod
 from tpu_operator.payload import data as data_mod
+from tpu_operator.payload import heartbeat as heartbeat_mod
 from tpu_operator.payload import models as models_mod
 from tpu_operator.payload import startup as startup_mod
 from tpu_operator.payload import steptrace as steptrace_mod
@@ -685,8 +687,13 @@ def _startup_heartbeat_ticker(tracker: startup_mod.StartupTracker,
     indistinguishable from a hang — the stall watchdog (PR 2) would
     restart the group into a loop that never escapes compilation. Posting
     the in-flight ``startupStage`` on the heartbeat cadence keeps the
-    watchdog's baseline fresh while startup makes progress."""
-    while not stop.wait(max(0.01, getattr(heartbeat, "interval", 10.0))):
+    watchdog's baseline fresh while startup makes progress. The cadence
+    is ``heartbeat.interval_of`` — the one shared definition (the
+    reporter's due() interval, this ticker, and the autotune runtime's
+    host-budget pacing can never disagree; the old per-tick
+    ``getattr(..., 10.0)`` re-derivation only matched DEFAULT_INTERVAL
+    by coincidence)."""
+    while not stop.wait(max(0.01, heartbeat_mod.interval_of(heartbeat))):
         stage = tracker.current_stage()
         if stage is not None:
             heartbeat.report_startup(stage)
@@ -702,7 +709,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                prefetch: int = 2,
                heartbeat="auto", startup=None,
                overlap: bool = True,
-               steptrace="auto") -> Tuple[TrainState, dict]:
+               steptrace="auto",
+               dataplane="auto") -> Tuple[TrainState, dict]:
     """Drive the loop to ``steps`` total steps; returns (state, last_metrics).
     Host↔device traffic is one batch in, one scalar dict out per logging
     interval — and the batch transfers run ``prefetch`` deep ahead of the
@@ -757,11 +765,34 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     COMPUTE fence is deferred one step (see the ``fence`` comment below)
     so dispatch pipelining survives — bench.py --steptrace enforces the
     <1% overhead budget.
+
+    ``dataplane`` is the self-tuning data plane
+    (payload/autotune.py): ``"auto"`` (default) builds a runtime from
+    the env contract — inert (the static ``prefetch`` depth, zero new
+    cost) unless the operator injected ``TPUJOB_DATAPLANE_*`` for
+    ``spec.dataPlane`` — or pass a DataPlaneRuntime / None explicitly.
+    An active runtime runs the host batch generation on a background
+    pipeline thread; with autotune enabled it also hill-climbs the live
+    prefetch depth, moves heartbeat/log work off the step thread when
+    HOST dominates, and stretches checkpoint cadence within its bound —
+    converging toward minimal non-COMPUTE residue, backing off on
+    regression (bench.py --dataplane enforces the budgets).
     """
     if heartbeat == "auto":
-        from tpu_operator.payload import heartbeat as heartbeat_mod
         heartbeat = heartbeat_mod.from_env()
     recorder = steptrace_mod.from_env() if steptrace == "auto" else steptrace
+    if dataplane == "auto":
+        runtime = autotune_mod.from_env(prefetch=prefetch)
+    elif dataplane is None:
+        runtime = autotune_mod.DataPlaneRuntime.static(prefetch)
+    else:
+        runtime = dataplane
+    # processes gates the checkpoint-cadence knob: a gang's save is a
+    # collective, so only a single-process job may stretch the
+    # maybe_save gate unilaterally (see DataPlaneRuntime.attach).
+    runtime.attach(recorder=recorder, heartbeat=heartbeat,
+                   checkpointer=checkpointer,
+                   processes=jax.process_count())
     tracker = startup if startup is not None else startup_mod.new_tracker()
     ticker_stop = threading.Event()
     # Startup-liveness beats are process 0's job (the watchdog baseline is
@@ -794,9 +825,14 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     # Prefetch wraps the stream only after the resume fast-forward above,
     # so a restarted attempt still sees exactly the batches it would have.
     # The fill's H2D transfers are async, so they overlap whatever compile
-    # work the first step still has to do.
+    # work the first step still has to do. The data-plane runtime resolves
+    # the depth (0=auto convention; negative fails loudly in
+    # device_prefetch) and, when active, supplies the live control and the
+    # background host pipeline.
     dev_batches = data_mod.device_prefetch(mesh, batches, spec=spec,
-                                           depth=max(0, prefetch))
+                                           depth=runtime.depth,
+                                           control=runtime.control,
+                                           pipeline=runtime.pipeline)
     pending_startup: Optional[dict] = None
     metrics = {}
     tracing = profiled = False
@@ -940,7 +976,11 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             telemetry = metrics if recorder is None or ready is None \
                 else ready
             if log_every and log_fn and (i + 1) % log_every == 0:
-                log_fn(i + 1, jax.device_get(telemetry))
+                # The device_get of fenced metrics is a scalar copy and
+                # stays on the step thread; formatting + emission move to
+                # the async host worker when the data plane enabled it.
+                runtime.submit_host(log_fn, i + 1,
+                                    jax.device_get(telemetry))
             # The first step's report is forced (not just when due): it
             # carries the startup breakdown the operator folds into
             # status.startup; thereafter the breakdown rides along on due
@@ -966,7 +1006,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                                     and not cadence else None),
                         startup=pending_startup,
                         steptiming=(recorder.summary()
-                                    if recorder is not None else None)):
+                                    if recorder is not None else None),
+                        dataplane=runtime.wire()):
                     pending_startup = None
             if recorder is not None:
                 recorder.lap(steptrace_mod.HOST)
@@ -985,6 +1026,12 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     finally:
         ticker_stop.set()
         bootstrap_mod.exit_step_loop()
+        # Deterministic data-plane teardown: close the prefetch generator
+        # (stops the host pipeline thread, if any) and drain the async
+        # host worker's queued telemetry (bounded — a wedged poster can't
+        # park the exit).
+        dev_batches.close()
+        runtime.close()
         if tracing:
             # Close the trace on EVERY exit path — normal completion with the
             # window open, SIGTERM drain (SystemExit above), or a step error —

@@ -281,6 +281,20 @@ def build_replica_env(
         # rides the pod env.
         env["TPUJOB_STEPTRACE_ENABLED"] = "1" if trace.enabled else "0"
         env["TPUJOB_STEPTRACE_BUFFER"] = str(trace.buffer_steps)
+    dp = spec.data_plane
+    if dp is not None:
+        # Self-tuning data plane (payload/autotune.py consumes): the
+        # block's presence activates the runtime (background host
+        # pipeline + knob reporting); prefetchDepth 0 = auto. The
+        # autotune sub-block additionally wires the closed-loop
+        # controller's bounds and window.
+        env["TPUJOB_DATAPLANE_PREFETCH_DEPTH"] = str(dp.prefetch_depth)
+        at = dp.autotune
+        if at is not None:
+            env["TPUJOB_DATAPLANE_AUTOTUNE"] = "1" if at.enabled else "0"
+            env["TPUJOB_DATAPLANE_MIN_DEPTH"] = str(at.min_depth)
+            env["TPUJOB_DATAPLANE_MAX_DEPTH"] = str(at.max_depth)
+            env["TPUJOB_DATAPLANE_WINDOW_STEPS"] = str(at.window_steps)
 
     if replica_type == TPUReplicaType.WORKER and workers:
         num_slices = max(1, spec.num_slices)
