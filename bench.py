@@ -114,6 +114,13 @@ def parse_args(argv=None):
                         "HOST-phase time, and recorder+autotune together "
                         "must hold the 1% overhead budget — exits nonzero "
                         "on regression")
+    p.add_argument("--serve", action="store_true",
+                   help="run ONLY the serving-mode rows (CPU-hostable): "
+                        "the batched decode service under the synthetic "
+                        "load generator, and the rolling weight reload "
+                        "under sustained load — exits nonzero if any "
+                        "decode step fails or the reload does not "
+                        "complete")
     p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
@@ -2019,6 +2026,129 @@ def _store_ok(rows: list, quick: bool) -> bool:
     return ok
 
 
+def bench_serve(quick: bool) -> list:
+    """The --serve rows (CPU-hostable): the batched decode service under
+    the synthetic load generator, and the rolling reload under sustained
+    load.
+
+    Row 1 (serve-decode): a fixed requests/sec schedule against one
+    replica; reports served req/s and p50/p95 request latency.
+    Row 2 (serve-rolling-reload): mid-run, the "trainer" commits a newer
+    verified snapshot to the (fake) remote store; the replica must
+    observe it, drop readiness, reload, and return — with ZERO failed
+    decode steps and requests still completing across the window."""
+    import tempfile
+    import threading as threading_mod
+    import time as time_mod
+
+    from tpu_operator.payload import bootstrap as bootstrap_mod
+    from tpu_operator.payload import checkpoint as checkpoint_mod
+    from tpu_operator.payload import serve as serve_mod
+    from tpu_operator.store import WarmStartStore
+    from tpu_operator.store.blob import from_uri
+
+    def serve_args(tmp: str, load: str):
+        argv = ["--load", load, "--checkpoint-dir", f"{tmp}/serve",
+                "--reload-poll", "0.2", "--reload-stagger", "0"]
+        if quick:
+            argv += ["--batch", "2", "--decode-tokens", "2", "--window",
+                     "16", "--vocab", "32", "--dim", "16", "--heads", "2",
+                     "--kv-heads", "1", "--layers", "1"]
+        else:
+            argv += ["--batch", "8", "--decode-tokens", "8", "--window",
+                     "64", "--vocab", "128", "--dim", "64", "--heads",
+                     "4", "--kv-heads", "2", "--layers", "2"]
+        return serve_mod.parse_args(argv)
+
+    info = bootstrap_mod.ProcessInfo(
+        coordinator_address="", process_id=0, num_processes=1,
+        worker_id=0, worker_hostnames=(), job_name="bench-serve")
+
+    def commit(store, args, step, tmp):
+        trainer_dir = f"{tmp}/trainer-{step}"
+        _m, _mod, state, _fn, _spec = serve_mod.build_decode(args)
+        state = state.replace(step=state.step + step)
+        ck = checkpoint_mod.Checkpointer(trainer_dir, save_every=1)
+        try:
+            ck.save(step, state)
+            ck.flush()
+        finally:
+            ck.close()
+        store.upload_checkpoint(f"{trainer_dir}/{step}", step)
+
+    rows = []
+    # Row 1: plain decode under load.
+    with tempfile.TemporaryDirectory() as tmp:
+        load = "30:3" if quick else "60:8"
+        loop = serve_mod.ServeLoop(serve_args(tmp, load), info,
+                                   heartbeat=None, store=None,
+                                   recorder=None)
+        t0 = time_mod.perf_counter()
+        summary = loop.run()
+        elapsed = time_mod.perf_counter() - t0
+        stats = loop.window.drain()  # leftovers of the final window
+        rows.append({
+            "bench": "serve", "metric": "serve_decode_rps",
+            "value": round(summary["completed"] / max(1e-9, elapsed), 2),
+            "arrivals": summary["arrivals"],
+            "completed": summary["completed"],
+            "failed_steps": summary["failedSteps"],
+            "p50_ms": round(1000 * stats.get("p50", 0.0), 3),
+            "p95_ms": round(1000 * stats.get("p95", 0.0), 3),
+            "steps": summary["steps"],
+        })
+    # Row 2: rolling reload under sustained load.
+    with tempfile.TemporaryDirectory() as tmp:
+        load = "30:5" if quick else "60:12"
+        args = serve_args(tmp, load)
+        backend = from_uri(f"fake://bench-serve-{os.getpid()}")
+        store = WarmStartStore(backend, prefix="bench/serve")
+        commit(store, args, 10, tmp)
+        loop = serve_mod.ServeLoop(args, info, heartbeat=None,
+                                   store=store, recorder=None)
+
+        def trainer():
+            time_mod.sleep(1.5)
+            commit(store, args, 20, tmp)
+
+        th = threading_mod.Thread(target=trainer, daemon=True)
+        th.start()
+        summary = loop.run()
+        th.join()
+        rows.append({
+            "bench": "serve", "metric": "serve_rolling_reload",
+            "value": summary["reloads"],
+            "loaded_step": summary["loadedStep"],
+            "failed_steps": summary["failedSteps"],
+            "completed": summary["completed"],
+            "arrivals": summary["arrivals"],
+        })
+    return rows
+
+
+def _serve_ok(rows: list) -> bool:
+    """The CI contract (hack/verify.sh runs --serve --quick): the decode
+    service must actually serve, and the rolling reload must complete
+    under load with ZERO failed decode steps."""
+    ok = True
+    for row in rows:
+        if row.get("failed_steps", 0) != 0:
+            print(f"FAIL: {row['metric']} had {row['failed_steps']} failed "
+                  f"decode steps (budget: 0)", file=sys.stderr)
+            ok = False
+        if row.get("completed", 0) <= 0:
+            print(f"FAIL: {row['metric']} completed no requests ({row})",
+                  file=sys.stderr)
+            ok = False
+    reload_row = next(r for r in rows
+                      if r["metric"] == "serve_rolling_reload")
+    if reload_row["value"] < 1 or reload_row.get("loaded_step", 0) != 20:
+        print(f"FAIL: rolling reload did not complete under load "
+              f"({reload_row})", file=sys.stderr)
+        ok = False
+    return ok
+
+
 def _startup_ok(rows: list, quick: bool) -> bool:
     """The CI contract (hack/verify.sh runs --startup --quick): the warm
     attempt must hit the persistent compilation cache, beat cold TTFS by
@@ -2109,6 +2239,14 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         rows = [_emit(row) for row in bench_dataplane(args.quick)]
         return 0 if _dataplane_ok(rows) else 1
+    if args.serve:
+        # The decode model is tiny and the budgets are correctness-shaped
+        # (zero failed steps, reload completes) — CPU-pinned like the
+        # other host-side gates; real decode throughput belongs to the
+        # TPU suite run.
+        os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = [_emit(row) for row in bench_serve(args.quick)]
+        return 0 if _serve_ok(rows) else 1
     if args.quick:
         # Force CPU even when a TPU plugin pinned the platform at boot
         # (backend clients initialize lazily, so this override wins).
@@ -2151,6 +2289,14 @@ def main(argv=None) -> int:
             dp_rows = [_emit(row) for row in bench_dataplane(args.quick)]
             rows.extend(dp_rows)
             if not _dataplane_ok(dp_rows):
+                return 1
+            # Serving rows: correctness-shaped budgets (zero failed
+            # decode steps, reload completes) — CPU-only in the suite
+            # for the same tunnel rationale; the verify.sh standalone
+            # gate (`--serve --quick`) owns them either way.
+            sv_rows = [_emit(row) for row in bench_serve(args.quick)]
+            rows.extend(sv_rows)
+            if not _serve_ok(sv_rows):
                 return 1
         for row in bench_startup(args.quick):
             rows.append(_emit(row))

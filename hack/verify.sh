@@ -105,6 +105,18 @@ python -m pytest tests/test_autotune.py -x -q
 # path must shave measured HOST-phase time, and recorder+autotune must
 # hold the 1% overhead budget — exits nonzero on regression.
 python bench.py --dataplane --quick
+# Standalone serving-mode gate: spec.mode serve end to end — the
+# mode/serving spec wiring, readiness-gated per-replica Services (no
+# endpoints before the ready beat; removed and restored around a
+# reload), the serving heartbeat chain (sanitization → controller fold
+# → status.serving → metrics → describe), traffic-driven replica
+# scaling through the fleet scheduler, and the hot-reload acceptance
+# e2e (loadedStep advances, attempt does not).
+python -m pytest tests/test_serving.py -x -q
+# And its measured form: the batched decode service under the synthetic
+# load generator, and the rolling reload under sustained load — zero
+# failed decode steps or the gate exits nonzero.
+python bench.py --serve --quick
 # Standalone elastic-gangs gate: inventory-sized attempts (grant in
 # [minSlices, maxSlices], shrink-don't-queue, re-expand, granted — not
 # spec — accounting), the reshard-aware restore through the remote
@@ -142,6 +154,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_steptrace.py \
   --ignore=tests/test_autotune.py \
   --ignore=tests/test_elastic.py \
+  --ignore=tests/test_serving.py \
   --ignore=tests/test_lockdep.py \
   --ignore=tests/test_schedules.py
 python hack/e2e_smoke.py --timeout 120

@@ -817,3 +817,114 @@ def test_train_loop_with_active_dataplane_posts_knobs_and_tunes():
     assert carried and carried[0]["prefetchDepth"] >= 1
     assert "adjustments" in carried[0]
     assert rec.steps_recorded == 5
+
+
+# --- gang-agreed checkpoint cadence (the PR-12 knob-table future work) -------
+
+
+def _tiny_state(step=0):
+    import jax.numpy as jnp
+
+    return {"step": jnp.int32(step), "w": jnp.arange(16, dtype=jnp.float32)}
+
+
+def test_gang_agreed_cadence_disagreeing_gang(tmp_path):
+    """The disagreeing-gang regression: one member's controller proposes
+    a 4x stretch while a peer still proposes 1 — the allgather-min
+    agreement lands on 1, every member saves at the base interval, and
+    the save barrier never mismatches. The collective runs ONLY at
+    base-interval boundaries (spec-uniform), so participation is
+    identical on every process regardless of local proposals."""
+    from tpu_operator.payload import checkpoint
+
+    calls = []
+
+    def peer_agrees_one(mult):  # a gang peer still proposes 1 → min 1
+        calls.append(mult)
+        return min(int(mult), 1)
+
+    ck = checkpoint.Checkpointer(str(tmp_path / "a"), save_every=10,
+                                 agree_fn=peer_agrees_one)
+    try:
+        ck.cadence_multiplier = 4
+        # Without gang mode the local proposal applies directly and the
+        # collective NEVER runs (single-process back-compat).
+        assert ck._effective_cadence_multiplier(20) == 4
+        assert calls == []
+        ck.enable_gang_cadence()
+        # Non-boundary steps skip the collective on every process alike.
+        assert ck._effective_cadence_multiplier(25) == 4
+        assert calls == []
+        # Boundary: agreement → the gang saves at the base cadence.
+        assert ck._effective_cadence_multiplier(20) == 1
+        assert calls == [4]
+        # The un-withheld knob is live end to end: maybe_save at a base
+        # boundary SAVES despite the local 4x proposal.
+        assert ck.maybe_save(10, _tiny_state(10)) is True
+        ck.flush()
+        assert ck.last_verified_step() == 10
+    finally:
+        ck.close()
+
+
+def test_gang_agreed_cadence_uniform_gang_stretches(tmp_path):
+    """The agreeing gang actually gets the stretch: every member proposes
+    2, the min is 2, and only every 2nd base boundary saves."""
+    from tpu_operator.payload import checkpoint
+
+    ck = checkpoint.Checkpointer(str(tmp_path / "u"), save_every=10,
+                                 agree_fn=lambda m: m)
+    try:
+        ck.enable_gang_cadence()
+        ck.cadence_multiplier = 2
+        assert ck.maybe_save(10, _tiny_state(10)) is False  # stretched away
+        assert ck.maybe_save(20, _tiny_state(20)) is True
+        ck.flush()
+        assert ck.last_verified_step() == 20
+    finally:
+        ck.close()
+
+
+def test_attach_unwithholds_cadence_knob_via_gang_agreement():
+    """DataPlaneRuntime.attach: a multi-process job's checkpointer is no
+    longer withheld — it is switched into gang-agreed cadence mode; an
+    object WITHOUT the agreement surface stays withheld (the pre-PR
+    behavior, never a wedged barrier)."""
+
+    class AgreedCk:
+        cadence_multiplier = 1
+        save_every = 10
+        enabled = False
+
+        def enable_gang_cadence(self):
+            self.enabled = True
+
+    class LegacyCk:
+        cadence_multiplier = 1
+        save_every = 10
+
+    control = autotune.PrefetchControl(2)
+    ctl_ = autotune.DataPlaneController(control)
+    runtime = autotune.DataPlaneRuntime(2, control=control,
+                                        controller=ctl_, pipeline=True,
+                                        active=True)
+    ck = AgreedCk()
+    runtime.attach(checkpointer=ck, processes=4)
+    assert ck.enabled is True
+    assert ctl_._checkpointer is ck
+    # Single-process: wired directly, gang mode NOT flipped on.
+    ck2 = AgreedCk()
+    ctl2 = autotune.DataPlaneController(autotune.PrefetchControl(2))
+    runtime2 = autotune.DataPlaneRuntime(2, control=runtime.control,
+                                         controller=ctl2, pipeline=True,
+                                         active=True)
+    runtime2.attach(checkpointer=ck2, processes=1)
+    assert ck2.enabled is False
+    assert ctl2._checkpointer is ck2
+    # No agreement surface: withheld in a gang (barrier safety first).
+    ctl3 = autotune.DataPlaneController(autotune.PrefetchControl(2))
+    runtime3 = autotune.DataPlaneRuntime(2, control=runtime.control,
+                                         controller=ctl3, pipeline=True,
+                                         active=True)
+    runtime3.attach(checkpointer=LegacyCk(), processes=4)
+    assert ctl3._checkpointer is None

@@ -1,0 +1,1102 @@
+"""Serving mode: long-lived inference gangs under the TPUJob CRD.
+
+``spec.mode: serve`` — each WORKER replica is an independent batched
+decode server: per-replica Services exist only while the replica's
+payload posts ``ready`` serving beats; weights hot-reload from the
+remote store (newer VERIFIED snapshot → rolling reload, loadedStep
+advances, NO attempt bump); the replica count follows the requests/sec
+signal within ``spec.serving`` through the fleet scheduler's queue.
+
+The e2es at the bottom are the acceptance flows over the in-process
+apiserver: a serve gang reaches ``replicasReady == replicas`` with real
+decode loops posting through the real status server, hot-reloads a
+newer snapshot with ``status.serving.loadedStep`` advancing while
+``status.attempt`` and ``job_elastic_resizes_total`` stay untouched,
+and scales up then down on a traffic change through the admission
+queue. The strict-schema apiserver validates every status write.
+"""
+
+import contextlib
+import io
+import os
+import threading
+import time
+
+import pytest
+
+from tpu_operator.apis.tpujob import validation
+from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import serve as serve_mod
+from tpu_operator.scheduler.inventory import slice_key
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.trainer import replicas as replicas_mod
+from tpu_operator.trainer.training import TrainingJob
+
+V4 = "cloud-tpus.google.com/v4"
+KEY = slice_key(V4, "2x2x2")
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+
+def make_template(tpu_chips=0):
+    c = {"name": "tpu", "image": "x"}
+    if tpu_chips:
+        c["resources"] = {"requests": {V4: str(tpu_chips)}}
+    return {"spec": {"containers": [c]}}
+
+
+def serve_job(name="sv", replicas=3, min_replicas=1, max_replicas=0,
+              target=2.0, num_slices=1, tpu_chips=0, uid=None,
+              policy=t.StragglerPolicy.NONE, **spec_kw):
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=replicas, template=make_template(tpu_chips),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="sv01",
+        mode=t.JobMode.SERVE,
+        num_slices=num_slices,
+        serving=t.ServingSpec(
+            min_replicas=min_replicas, max_replicas=max_replicas,
+            target_requests_per_second_per_replica=target,
+            straggler_policy=policy),
+        **spec_kw,
+    )
+    if tpu_chips:
+        spec.tpu_topology = "2x2x2"
+    return t.TPUJob(metadata={"name": name, "namespace": "default",
+                              "uid": uid or f"uid-{name}"}, spec=spec)
+
+
+def pod_env(pod):
+    return {e["name"]: e["value"]
+            for e in pod["spec"]["containers"][0]["env"]}
+
+
+def live_pods(cs):
+    return [p for p in cs.pods.list("default")
+            if (p.get("status") or {}).get("phase") not in ("Succeeded",
+                                                            "Failed")]
+
+
+def service_names(cs):
+    return {s["metadata"]["name"] for s in cs.services.list("default")}
+
+
+# --- spec plumbing -----------------------------------------------------------
+
+
+def test_serving_spec_roundtrip():
+    job = serve_job(min_replicas=2, max_replicas=6, target=50.0)
+    wire = job.to_dict()
+    assert wire["spec"]["mode"] == "serve"
+    assert wire["spec"]["serving"] == {
+        "minReplicas": 2, "maxReplicas": 6,
+        "targetRequestsPerSecondPerReplica": 50.0,
+        "reloadPollSeconds": t.DEFAULT_SERVE_RELOAD_POLL,
+        "stragglerPolicy": "none",
+        "stragglerPatienceSeconds": t.DEFAULT_STRAGGLER_PATIENCE}
+    back = t.TPUJob.from_dict(wire)
+    assert back.spec.mode == t.JobMode.SERVE
+    assert back.spec.serving.max_replicas == 6
+    assert back.spec.serving.target_requests_per_second_per_replica == 50.0
+    # Absent mode/serving stay absent (train specs round-trip unchanged).
+    bare = t.TPUJobSpec.from_dict({"replicaSpecs": []})
+    assert bare.mode == "" and bare.serving is None
+    assert "mode" not in bare.to_dict() and "serving" not in bare.to_dict()
+
+
+def test_store_keep_snapshots_roundtrip():
+    spec = t.TPUJobSpec.from_dict({
+        "replicaSpecs": [],
+        "store": {"backend": "fake", "uri": "fake://t",
+                  "keepSnapshots": 3}})
+    assert spec.store.keep_snapshots == 3
+    assert spec.to_dict()["store"]["keepSnapshots"] == 3
+    # Default 0 = keep everything, kept off the wire.
+    spec2 = t.TPUJobSpec.from_dict({
+        "replicaSpecs": [], "store": {"backend": "fake",
+                                      "uri": "fake://t"}})
+    assert spec2.store.keep_snapshots == 0
+    assert "keepSnapshots" not in spec2.to_dict()["store"]
+
+
+def test_serving_strict_schema():
+    job = serve_job()
+    set_defaults(job.spec)
+    ok, msg = schema_mod.validate_tpujob_strict(job.to_dict())
+    assert ok, msg
+    # Unknown serving field rejected (the typo-catching contract).
+    wire = job.to_dict()
+    wire["spec"]["serving"]["replicasMax"] = 5
+    ok, msg = schema_mod.validate_tpujob_strict(wire)
+    assert not ok and "replicasMax" in msg
+    # status.serving round-trips the controller's roll-up shape.
+    wire = job.to_dict()
+    wire["status"] = {"phase": "Running", "reason": "", "state": "Running",
+                      "replicaStatuses": [], "attempt": 0,
+                      "serving": {"replicas": 3, "desiredReplicas": 2,
+                                  "replicasReady": 3,
+                                  "requestsPerSecond": 5.5,
+                                  "p50LatencySeconds": 0.01,
+                                  "p95LatencySeconds": 0.02,
+                                  "loadedStep": 40, "reloads": 2,
+                                  "attemptReloads": {"0": 1, "1": 1},
+                                  "attempt": 0,
+                                  "time": "2026-08-04T00:00:00Z"}}
+    ok, msg = schema_mod.validate_tpujob_strict(wire)
+    assert ok, msg
+
+
+def test_serve_defaults():
+    job = serve_job(replicas=4)
+    set_defaults(job.spec)
+    # maxReplicas fills from the WORKER count; the restart policy is
+    # PerPod — independent servers, never whole-fleet restarts.
+    assert job.spec.serving.max_replicas == 4
+    assert job.spec.restart_policy == t.RestartPolicy.PER_POD
+    # Mode case-normalizes.
+    job2 = serve_job()
+    job2.spec.mode = "Serve"
+    set_defaults(job2.spec)
+    assert job2.spec.mode == "serve"
+
+
+def test_serve_validation():
+    def invalid(mutate, fragment):
+        job = serve_job(replicas=2, max_replicas=4)
+        mutate(job.spec)
+        set_defaults(job.spec)
+        with pytest.raises(validation.ValidationError) as e:
+            validation.validate_tpujob_spec(job.spec)
+        assert fragment in str(e.value), str(e.value)
+
+    def valid(mutate=lambda s: None):
+        job = serve_job(replicas=2, max_replicas=4)
+        mutate(job.spec)
+        set_defaults(job.spec)
+        validation.validate_tpujob_spec(job.spec)
+
+    valid()
+    invalid(lambda s: setattr(s, "mode", "inference"), "mode")
+    invalid(lambda s: setattr(s, "mode", ""), "only meaningful under")
+    invalid(lambda s: setattr(s, "restart_policy",
+                              t.RestartPolicy.WHOLE_GROUP),
+            "requires restartPolicy PerPod")
+    invalid(lambda s: setattr(s, "elastic", t.ElasticSpec()),
+            "excludes spec.elastic")
+    invalid(lambda s: setattr(s.serving, "min_replicas", 0),
+            "minReplicas")
+    invalid(lambda s: setattr(s.serving, "max_replicas", 1),
+            "must lie within")
+    invalid(lambda s: setattr(
+        s.serving, "target_requests_per_second_per_replica", 0.0),
+        "targetRequestsPerSecondPerReplica")
+    invalid(lambda s: setattr(s.serving, "reload_poll_seconds", 0),
+            "reloadPollSeconds")
+    invalid(lambda s: setattr(s.serving, "straggler_policy", "shed"),
+            "stragglerPolicy")
+    # Slice-per-replica: numSlices > 1 requires replicas == numSlices.
+    job = serve_job(replicas=4, num_slices=2, tpu_chips=4)
+    set_defaults(job.spec)
+    with pytest.raises(validation.ValidationError) as e:
+        validation.validate_tpujob_spec(job.spec)
+    assert "numSlices" in str(e.value)
+    # keepSnapshots must be >= 0.
+    job = serve_job()
+    job.spec.store = t.StoreSpec(backend="fake", uri="fake://t",
+                                 keep_snapshots=-1)
+    set_defaults(job.spec)
+    with pytest.raises(validation.ValidationError) as e:
+        validation.validate_tpujob_spec(job.spec)
+    assert "keepSnapshots" in str(e.value)
+
+
+# --- env contract ------------------------------------------------------------
+
+
+def test_serve_env_injection():
+    job = serve_job(replicas=3)
+    job.spec.store = t.StoreSpec(backend="fake", uri="fake://sv",
+                                 keep_snapshots=2)
+    set_defaults(job.spec)
+    env = replicas_mod.build_replica_env(
+        "sv", "sv01", job.spec, t.TPUReplicaType.WORKER, 1)
+    assert env["TPUJOB_SERVE"] == "1"
+    assert env["TPUJOB_SERVE_RELOAD_POLL"] == \
+        str(t.DEFAULT_SERVE_RELOAD_POLL)
+    assert env["TPUJOB_STORE_KEEP"] == "2"
+    # Independent servers: no cross-replica process group, identity kept.
+    assert env["JAX_NUM_PROCESSES"] == "1"
+    assert env["JAX_PROCESS_ID"] == "1"
+    assert env["TPU_WORKER_ID"] == "0"
+    assert "," not in env["TPU_WORKER_HOSTNAMES"]
+    assert not any(k.startswith("MEGASCALE_") for k in env)
+
+
+def test_train_mode_env_byte_inert():
+    """A spec without mode injects NO serving env and the worker contract
+    is byte-identical to the pre-serving build."""
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=4, template=make_template(),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="tr01")
+    set_defaults(spec)
+    env = replicas_mod.build_replica_env(
+        "tr", "tr01", spec, t.TPUReplicaType.WORKER, 1)
+    assert not any(k.startswith("TPUJOB_SERVE") for k in env)
+    assert "TPUJOB_STORE_KEEP" not in env
+    assert env["JAX_NUM_PROCESSES"] == "4"
+    assert env["TPU_WORKER_HOSTNAMES"].count(",") == 3
+
+
+# --- statusserver door -------------------------------------------------------
+
+
+def serving_body(**kw):
+    body = {"ready": True, "requestsPerSecond": 2.5,
+            "p50LatencySeconds": 0.01, "p95LatencySeconds": 0.02,
+            "loadedStep": 10, "reloads": 1}
+    body.update(kw)
+    return body
+
+
+def test_statusserver_serving_door():
+    from tpu_operator.controller.statusserver import _sanitize_serving
+
+    clean, err = _sanitize_serving(serving_body())
+    assert err == "" and clean["ready"] is True
+    assert clean["loadedStep"] == 10
+    for bad in (serving_body(ready="false"),      # bool("false") is True
+                serving_body(ready=1),
+                serving_body(requestsPerSecond=-1.0),
+                serving_body(p95LatencySeconds=float("nan")),
+                serving_body(loadedStep=True),
+                serving_body(reloads=-2),
+                "not-an-object"):
+        clean, err = _sanitize_serving(bad)
+        assert clean is None and err, bad
+    # Unknown keys drop silently (forward compat), known ones survive.
+    clean, err = _sanitize_serving(serving_body(futureKnob=7))
+    assert err == "" and "futureKnob" not in clean
+
+
+def test_statusserver_rejects_bad_serving_beat():
+    srv = StatusServer(0)
+    try:
+        cs = FakeClientset()
+        controller = Controller(cs,
+                                SharedInformerFactory(cs, resync_period=0),
+                                heartbeat_persist_interval=0.0)
+        srv.set_controller(controller)
+        ok, msg = srv.record_heartbeat({
+            "name": "sv", "namespace": "default", "step": 1,
+            "serving": serving_body(ready="yes")})
+        assert not ok and "serving.ready" in msg
+    finally:
+        # Never start()ed: close the socket directly (shutdown() would
+        # wait on a serve_forever loop that never ran).
+        srv.server.server_close()
+
+
+# --- controller fold ---------------------------------------------------------
+
+
+def serving_harness(replicas=3, min_replicas=1, max_replicas=0, target=2.0,
+                    num_slices=1, tpu_chips=0, capacity=0, **spec_kw):
+    now = [1000.0]
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=0.0,
+                            wall_clock=lambda: now[0])
+    if capacity:
+        controller.scheduler.update_inventory({KEY: capacity})
+    job = serve_job(replicas=replicas, min_replicas=min_replicas,
+                    max_replicas=max_replicas, target=target,
+                    num_slices=num_slices, tpu_chips=tpu_chips, **spec_kw)
+    cs.tpujobs.create("default", job.to_dict())
+    tj = TrainingJob(cs, controller.recorder, job,
+                     metrics=controller.metrics,
+                     scheduler=controller.scheduler if capacity else None)
+    controller.jobs["default/sv"] = tj
+    tj.reconcile()
+
+    def beat(pid, sv=None, attempt=None, step=50):
+        body = {"time": "2026-08-04T00:00:00.000000Z", "step": step,
+                "attempt": (attempt if attempt is not None
+                            else tj.job.status.attempt),
+                "processId": pid}
+        if sv is not None:
+            body["serving"] = sv
+        return controller.record_heartbeat("default", "sv", body)
+
+    return cs, controller, tj, now, beat
+
+
+def test_serving_fold_aggregates():
+    cs, controller, tj, now, beat = serving_harness(replicas=3)
+    beat(0, serving_body(requestsPerSecond=1.0, p95LatencySeconds=0.02,
+                         loadedStep=10))
+    beat(1, serving_body(requestsPerSecond=2.0, p95LatencySeconds=0.05,
+                         loadedStep=12))
+    beat(2, serving_body(ready=False, requestsPerSecond=0.5,
+                         loadedStep=8))
+    sv = tj.job.status.serving
+    assert sv["replicasReady"] == 2
+    assert sv["requestsPerSecond"] == pytest.approx(3.5)
+    # Tail = the WORST ready replica; the not-ready one is excluded.
+    assert sv["p95LatencySeconds"] == pytest.approx(0.05)
+    # loadedStep = the MINIMUM over ready replicas (the fleet floor).
+    assert sv["loadedStep"] == 10
+    # desired = ceil(3.5 / 2.0) = 2, within [1, 3].
+    assert sv["desiredReplicas"] == 2
+    m = controller.metrics
+    labels = {"namespace": "default", "name": "sv"}
+    assert m.counter_value("job_serving_replicas_ready", labels) == 2
+    assert m.counter_value("job_serving_requests_per_second",
+                           labels) == pytest.approx(3.5)
+    assert m.counter_value("job_serving_latency_seconds",
+                           {**labels, "quantile": "0.95"}) \
+        == pytest.approx(0.05)
+
+
+def test_serving_reload_delta_accounting():
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    beat(0, serving_body(reloads=1))
+    beat(1, serving_body(reloads=1))
+    assert tj.job.status.serving["reloads"] == 2
+    labels = {"namespace": "default", "name": "sv"}
+    assert controller.metrics.counter_value("job_weight_reloads_total",
+                                            labels) == 2
+    # Re-reporting the same counters adds nothing (baselines held).
+    beat(0, serving_body(reloads=1))
+    assert tj.job.status.serving["reloads"] == 2
+    # A replica restart resets ITS counter; the lifetime total survives.
+    beat(0, serving_body(reloads=0))
+    beat(0, serving_body(reloads=1))
+    assert tj.job.status.serving["reloads"] == 3
+    assert controller.metrics.counter_value("job_weight_reloads_total",
+                                            labels) == 3
+
+
+def test_partial_fleet_report_never_scales_down():
+    """The real-binary drive regression: the FIRST replica to post after
+    a deploy must not shrink the fleet under the still-silent peers —
+    a partial fleet report under-counts the aggregate traffic, so a
+    scale-DOWN decision waits until every current replica reports
+    (scale-UP still acts on partial data: over-provisioning is the safe
+    direction for serving)."""
+    cs, controller, tj, now, beat = serving_harness(replicas=3, target=2.0)
+    # One replica of three posts 1.5 req/s → naive desired would be 1.
+    beat(0, serving_body(requestsPerSecond=1.5))
+    assert tj.job.status.serving["desiredReplicas"] == 3  # held
+    # Partial data may still scale UP.
+    beat(0, serving_body(requestsPerSecond=9.0))
+    assert tj.job.status.serving["desiredReplicas"] == 3  # ceil(9/2)=5→max 3
+    # Every replica reporting: the scale-down is now evidence, not silence.
+    beat(0, serving_body(requestsPerSecond=0.5))
+    beat(1, serving_body(requestsPerSecond=0.5))
+    beat(2, serving_body(requestsPerSecond=0.5))
+    assert tj.job.status.serving["desiredReplicas"] == 1
+
+
+def test_serving_readiness_expiry():
+    """A replica that stops posting drops from the ready set after the
+    expiry window — a wedged replica must leave routing without posting
+    anything."""
+    from tpu_operator.controller.controller import SERVING_EXPIRY_SECONDS
+
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    beat(0, serving_body())
+    beat(1, serving_body())
+    assert tj.job.status.serving["replicasReady"] == 2
+    now[0] += SERVING_EXPIRY_SECONDS + 1
+    beat(0, serving_body())
+    assert tj.job.status.serving["replicasReady"] == 1
+
+
+def test_serving_series_pruned_on_deletion():
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    beat(0, serving_body(reloads=1))
+    cs.tpujobs.delete("default", "sv")
+    # The informer cache is empty in this harness (no informer started),
+    # so the sync sees a deleted job and prunes.
+    assert controller.sync_tpujob("default/sv") is True
+    labels = {"namespace": "default", "name": "sv"}
+    m = controller.metrics
+    assert m.counter_value("job_serving_replicas_ready", labels) == 0
+    assert m.counter_value("job_serving_requests_per_second", labels) == 0
+    assert m.counter_value("job_weight_reloads_total", labels) == 0
+    assert m.counter_value("job_serving_latency_seconds",
+                           {**labels, "quantile": "0.95"}) == 0
+    assert "default/sv" not in controller._serving
+
+
+# --- readiness-gated services ------------------------------------------------
+
+
+def test_service_gated_on_ready_beat():
+    """A per-replica Service must not exist before the replica's ready
+    beat; a replica that loses readiness (reload in flight) has its
+    Service REMOVED and restored on the next ready beat."""
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    # Pods exist, but no serving beats yet: only the headless Service.
+    assert len(live_pods(cs)) == 2
+    headless = service_names(cs)
+    assert len(headless) == 1  # the job-scoped headless backbone
+    svc0, svc1 = (replicas_mod.gen_general_name("sv", "WORKER", "sv01", i)
+                  for i in (0, 1))
+
+    beat(0, serving_body())
+    tj.reconcile()
+    assert svc0 in service_names(cs) and svc1 not in service_names(cs)
+    beat(1, serving_body())
+    tj.reconcile()
+    assert {svc0, svc1} <= service_names(cs)
+
+    # Reload in flight: readiness drops → the Service goes with it.
+    beat(0, serving_body(ready=False))
+    tj.reconcile()
+    assert svc0 not in service_names(cs)
+    assert svc1 in service_names(cs)
+
+    # Reload done: readiness returns → the Service is restored.
+    beat(0, serving_body())
+    tj.reconcile()
+    assert svc0 in service_names(cs)
+
+
+def test_readiness_gating_over_strict_apiserver():
+    """The same protocol against the strict-schema apiserver: every
+    status write validates, and the Service set follows readiness."""
+    with ApiServerHarness() as api:
+        cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+        controller = Controller(cs,
+                                SharedInformerFactory(cs, resync_period=0),
+                                heartbeat_persist_interval=0.0)
+        job = serve_job(replicas=2)
+        cs.tpujobs.create("default", job.to_dict())
+        tj = TrainingJob(cs, controller.recorder, job,
+                         metrics=controller.metrics)
+        controller.jobs["default/sv"] = tj
+        tj.reconcile()
+        assert len(cs.pods.list("default")) == 2
+        svc0 = replicas_mod.gen_general_name("sv", "WORKER", "sv01", 0)
+        names = {s["metadata"]["name"]
+                 for s in cs.services.list("default")}
+        assert svc0 not in names  # no endpoints before the ready beat
+        controller.record_heartbeat("default", "sv", {
+            "time": "2026-08-04T00:00:00.000000Z", "step": 1,
+            "attempt": 0, "processId": 0, "serving": serving_body()})
+        tj.reconcile()
+        names = {s["metadata"]["name"]
+                 for s in cs.services.list("default")}
+        assert svc0 in names
+        status = cs.tpujobs.get("default", "sv")["status"]
+        assert status["serving"]["replicasReady"] == 1
+
+
+# --- traffic-driven scaling --------------------------------------------------
+
+
+def test_scale_up_then_down_through_scheduler():
+    """Traffic above target grows the fleet (delta admitted through the
+    scheduler's resize — slice-per-replica accounting); traffic falling
+    away shrinks it back, trimming pods and services past the target.
+    The attempt counter never moves."""
+    cs, controller, tj, now, beat = serving_harness(
+        replicas=2, min_replicas=1, max_replicas=4, target=2.0,
+        num_slices=2, tpu_chips=4, capacity=4)
+    assert len(live_pods(cs)) == 2
+    assert controller.scheduler.granted_slices("default/sv") == 2
+
+    # 7 req/s against target 2/replica → desired ceil(3.5) = 4.
+    beat(0, serving_body(requestsPerSecond=3.0))
+    beat(1, serving_body(requestsPerSecond=4.0))
+    assert tj.job.status.serving["desiredReplicas"] == 4
+    tj.reconcile()
+    assert tj.job.status.serving["replicas"] == 4
+    assert controller.scheduler.granted_slices("default/sv") == 4
+    tj.reconcile()  # the scaled replica sets create the new pods
+    assert len(live_pods(cs)) == 4
+    env = pod_env(live_pods(cs)[-1])
+    assert env["TPUJOB_SERVE"] == "1"
+
+    # Traffic falls to ~1 req/s → desired 1; pods+services trim.
+    beat(0, serving_body(requestsPerSecond=0.5))
+    beat(1, serving_body(requestsPerSecond=0.5))
+    beat(2, serving_body(requestsPerSecond=0.0))
+    beat(3, serving_body(requestsPerSecond=0.0))
+    assert tj.job.status.serving["desiredReplicas"] == 1
+    tj.reconcile()
+    assert tj.job.status.serving["replicas"] == 1
+    assert controller.scheduler.granted_slices("default/sv") == 1
+    assert len(live_pods(cs)) == 1
+    assert tj.job.status.attempt == 0
+    assert tj.job.status.restart_counts == {}
+
+
+def test_scale_up_capped_by_inventory():
+    """The delta goes through the admission queue: a full inventory
+    grants LESS than desired instead of over-committing."""
+    cs, controller, tj, now, beat = serving_harness(
+        replicas=2, min_replicas=1, max_replicas=4, target=1.0,
+        num_slices=2, tpu_chips=4, capacity=3)
+    beat(0, serving_body(requestsPerSecond=5.0))
+    beat(1, serving_body(requestsPerSecond=5.0))
+    assert tj.job.status.serving["desiredReplicas"] == 4
+    tj.reconcile()
+    # Only 3 slices exist: the grant stops there.
+    assert tj.job.status.serving["replicas"] == 3
+    assert controller.scheduler.granted_slices("default/sv") == 3
+
+
+# --- serve payload (decode loop, load generator, hot reload) -----------------
+
+
+def serve_args(**kw):
+    argv = []
+    defaults = {"load": "50:1", "batch": 2, "decode_tokens": 2,
+                "window": 16, "vocab": 32, "dim": 16, "heads": 2,
+                "kv_heads": 1, "layers": 1, "reload_poll": 0.1,
+                "reload_stagger": 0.0}
+    defaults.update(kw)
+    for key, value in defaults.items():
+        argv.extend([f"--{key.replace('_', '-')}", str(value)])
+    return serve_mod.parse_args(argv)
+
+
+def make_info(pid=0, replica_index=0):
+    from tpu_operator.payload import bootstrap
+
+    return bootstrap.ProcessInfo(
+        coordinator_address="", process_id=pid, num_processes=1,
+        worker_id=0, worker_hostnames=(), job_name="sv",
+        replica_index=replica_index)
+
+
+def test_load_schedule_and_generator():
+    sched = serve_mod.LoadSchedule.parse("10:2,0:1,4:0")
+    assert sched.rate_at(0.5) == 10.0
+    assert sched.rate_at(2.5) == 0.0
+    assert sched.rate_at(100.0) == 4.0  # zero-duration tail holds
+    assert sched.duration() is None
+    finite = serve_mod.LoadSchedule.parse("5:2")
+    assert finite.duration() == 2.0
+    assert finite.rate_at(3.0) is None
+    gen = serve_mod.LoadGenerator(finite)
+    assert gen.due(0.0) == 0
+    assert gen.due(1.0) == 5
+    assert gen.due(2.1) is None  # schedule over
+    with pytest.raises(ValueError):
+        serve_mod.LoadSchedule.parse("-1:5")
+
+
+def test_decode_loop_serves_requests():
+    loop = serve_mod.ServeLoop(serve_args(load="40:1.5"), make_info(),
+                               heartbeat=None, store=None, recorder=None)
+    summary = loop.run()
+    assert summary["failedSteps"] == 0
+    assert summary["completed"] > 0
+    assert summary["completed"] == summary["arrivals"]
+
+
+def test_ready_beats_and_serving_wire():
+    posts = []
+
+    class FakeReporter:
+        cadence_only = False
+
+        def due(self, _step):
+            return False  # only forced beats land
+
+        def report(self, step, metrics=None, serving=None, **kw):
+            posts.append(dict(serving))
+            return True
+
+    loop = serve_mod.ServeLoop(serve_args(load="20:0.5"), make_info(),
+                               heartbeat=FakeReporter(), store=None,
+                               recorder=None)
+    loop.run()
+    # First forced beat = ready (post-compile); last = the teardown
+    # not-ready beat.
+    assert posts[0]["ready"] is True
+    assert posts[-1]["ready"] is False
+    assert all("loadedStep" in p and "requestsPerSecond" in p
+               for p in posts)
+
+
+def _commit_snapshot(store, args, step, tmpdir):
+    """Trainer-side: save a verified checkpoint at ``step`` and upload it
+    as a committed remote snapshot (manifest last)."""
+    from tpu_operator.payload import checkpoint as checkpoint_mod
+
+    trainer_dir = os.path.join(tmpdir, f"trainer-{step}")
+    _mesh, _model, state, _fn, _spec = serve_mod.build_decode(args)
+    state = state.replace(step=state.step + step)
+    ck = checkpoint_mod.Checkpointer(trainer_dir, save_every=1)
+    try:
+        assert ck.save(step, state)
+        ck.flush()
+        assert ck.last_verified_step() == step
+    finally:
+        ck.close()
+    store.upload_checkpoint(os.path.join(trainer_dir, str(step)), step)
+
+
+def test_hot_reload_under_load(tmp_path):
+    """The payload half of the acceptance: a serving loop under sustained
+    load observes a newer VERIFIED snapshot, drops readiness, reloads,
+    and returns — zero failed decode steps, loadedStep advanced, and the
+    requests in flight during the reload still complete."""
+    from tpu_operator.store.blob import from_uri
+
+    backend = from_uri("fake://serve-reload-test")
+    from tpu_operator.store import WarmStartStore
+
+    store = WarmStartStore(backend, prefix="default/sv")
+    args = serve_args(load="30:4", checkpoint_dir=str(tmp_path / "sv"))
+    _commit_snapshot(store, args, 10, str(tmp_path))
+    # The production path prefetches during bootstrap (TPUJOB_STORE_*);
+    # mirror it so the INITIAL load is step 10, not a counted reload.
+    store.prefetch_checkpoint(str(tmp_path / "sv"))
+
+    posts = []
+
+    class FakeReporter:
+        cadence_only = False
+
+        def due(self, _step):
+            return False
+
+        def report(self, step, metrics=None, serving=None, **kw):
+            posts.append(dict(serving))
+            return True
+
+    loop = serve_mod.ServeLoop(args, make_info(), heartbeat=FakeReporter(),
+                               store=store, recorder=None)
+
+    committed = threading.Event()
+
+    def trainer():
+        time.sleep(1.0)
+        _commit_snapshot(store, args, 20, str(tmp_path))
+        committed.set()
+
+    th = threading.Thread(target=trainer, daemon=True)
+    th.start()
+    summary = loop.run()
+    th.join()
+    assert committed.is_set()
+    assert summary["failedSteps"] == 0
+    assert summary["reloads"] == 1
+    assert summary["loadedStep"] == 20
+    assert summary["completed"] > 0
+    # The reload dropped readiness then restored it: ready=False posted
+    # mid-run, ready=True after.
+    readies = [p["ready"] for p in posts]
+    assert False in readies[1:-1]
+    assert readies[0] is True
+    loaded = [p["loadedStep"] for p in posts]
+    assert loaded[0] == 10 and 20 in loaded
+
+
+# --- acceptance e2e over the in-process apiserver ----------------------------
+
+
+@pytest.fixture()
+def harness():
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop),
+                          daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+@pytest.mark.slow
+def test_e2e_serve_gang_ready_and_hot_reload(harness, tmp_path):
+    """Acceptance: a ``mode: serve`` gang reaches ``replicasReady ==
+    replicas`` with REAL decode loops posting through the real status
+    server; a newer verified snapshot hot-reloads with
+    ``status.serving.loadedStep`` advancing while ``status.attempt`` and
+    ``job_elastic_resizes_total`` stay unchanged (no restart)."""
+    from tpu_operator.store import WarmStartStore
+    from tpu_operator.payload import heartbeat as heartbeat_mod
+    from tpu_operator.store.blob import from_uri
+
+    api, cs, controller, server = harness
+    replicas = 2
+    job = serve_job(replicas=replicas, min_replicas=1, max_replicas=2,
+                    target=1000.0)
+    cs.tpujobs.create("default", job.to_dict())
+    assert wait_for(
+        lambda: len(api.clientset.pods.list("default")) == replicas)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: (cs.tpujobs.get("default", "sv")["status"]
+                             .get("phase")) == "Running")
+
+    backend = from_uri("fake://serve-e2e")
+    store = WarmStartStore(backend, prefix="default/sv")
+    args = serve_args(load="20:0", checkpoint_dir="")  # per-replica dirs
+    _commit_snapshot(store, serve_args(
+        load="20:0", checkpoint_dir=str(tmp_path / "seed")), 10,
+        str(tmp_path))
+
+    loops, threads = [], []
+    for pid in range(replicas):
+        rargs = serve_args(load="20:0",
+                           checkpoint_dir=str(tmp_path / f"replica-{pid}"))
+        # Bootstrap-path prefetch: the initial load is step 10, so the
+        # reload counter counts exactly the HOT reloads below.
+        store.prefetch_checkpoint(str(tmp_path / f"replica-{pid}"))
+        reporter = heartbeat_mod.HeartbeatReporter(
+            f"http://127.0.0.1:{server.port}", "sv", namespace="default",
+            process_id=pid, attempt=0, interval=0.2,
+            cadence_only=pid != 0)
+        loop = serve_mod.ServeLoop(rargs, make_info(pid, pid),
+                                   heartbeat=reporter, store=store,
+                                   recorder=None)
+        loops.append(loop)
+        th = threading.Thread(target=loop.run, daemon=True)
+        threads.append(th)
+        th.start()
+    try:
+        def serving_status():
+            return (cs.tpujobs.get("default", "sv")["status"]
+                    .get("serving") or {})
+
+        assert wait_for(lambda: serving_status()
+                        .get("replicasReady") == replicas,
+                        describe=serving_status)
+        assert serving_status().get("loadedStep") == 10
+
+        resizes_before = sum(
+            controller.metrics.counter_value(
+                "job_elastic_resizes_total", labels={"direction": d})
+            for d in ("up", "down"))
+
+        # Training commits a newer verified snapshot → rolling reload.
+        _commit_snapshot(store, serve_args(
+            load="20:0", checkpoint_dir=str(tmp_path / "seed2")), 30,
+            str(tmp_path))
+        assert wait_for(lambda: serving_status().get("loadedStep") == 30,
+                        describe=serving_status)
+        assert wait_for(lambda: serving_status()
+                        .get("replicasReady") == replicas)
+        status = cs.tpujobs.get("default", "sv")["status"]
+        assert status["attempt"] == 0
+        assert status["serving"]["reloads"] == replicas
+        resizes_after = sum(
+            controller.metrics.counter_value(
+                "job_elastic_resizes_total", labels={"direction": d})
+            for d in ("up", "down"))
+        assert resizes_after == resizes_before
+        for loop in loops:
+            assert loop.failed_steps == 0
+    finally:
+        for loop in loops:
+            loop.stop()
+        for th in threads:
+            th.join(timeout=10)
+
+
+def test_e2e_scale_on_traffic_through_queue(harness):
+    """Acceptance sibling: a serve gang scales up then down on a traffic
+    change, the delta admitted through the fleet scheduler (synthetic
+    serving beats through the real status server)."""
+    api, cs, controller, server = harness
+    controller.scheduler.update_inventory({KEY: 4})
+    job = serve_job(replicas=2, min_replicas=1, max_replicas=4,
+                    target=2.0, num_slices=2, tpu_chips=4)
+    cs.tpujobs.create("default", job.to_dict())
+    assert wait_for(
+        lambda: len(api.clientset.pods.list("default")) == 2)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: (cs.tpujobs.get("default", "sv")["status"]
+                             .get("phase")) == "Running")
+
+    def post(pid, rps):
+        ok, msg = server.record_heartbeat({
+            "name": "sv", "namespace": "default", "step": 10,
+            "attempt": 0, "processId": pid,
+            "serving": serving_body(requestsPerSecond=rps)})
+        assert ok, msg
+
+    def live():
+        return [p for p in api.clientset.pods.list("default")
+                if (p.get("status") or {}).get("phase")
+                not in ("Succeeded", "Failed")]
+
+    # Traffic 8 req/s, target 2 → desired 4: scale up through the queue.
+    post(0, 4.0)
+    post(1, 4.0)
+    assert wait_for(lambda: len(live()) == 4,
+                    describe=lambda: (cs.tpujobs.get("default", "sv")
+                                      ["status"].get("serving")))
+    assert controller.scheduler.granted_slices("default/sv") == 4
+    status = cs.tpujobs.get("default", "sv")["status"]
+    assert status["serving"]["replicas"] == 4
+    assert status["attempt"] == 0
+
+    # Traffic collapses → desired 1: scale down, slices released.
+    for pid in range(4):
+        post(pid, 0.25)
+    assert wait_for(lambda: len(live()) == 1,
+                    describe=lambda: (cs.tpujobs.get("default", "sv")
+                                      ["status"].get("serving")))
+    assert controller.scheduler.granted_slices("default/sv") == 1
+    status = cs.tpujobs.get("default", "sv")["status"]
+    assert status["attempt"] == 0
+
+
+# --- describe ----------------------------------------------------------------
+
+
+def test_describe_shows_serving_section():
+    with ApiServerHarness() as srv:
+        cs = Clientset(RestConfig(host=srv.url, timeout=5.0))
+        job = serve_job(replicas=3, min_replicas=1, max_replicas=4)
+        set_defaults(job.spec)
+        job.status.phase = t.TPUJobPhase.RUNNING
+        job.status.serving = {
+            "replicas": 3, "desiredReplicas": 2, "replicasReady": 3,
+            "requestsPerSecond": 5.5, "p50LatencySeconds": 0.01,
+            "p95LatencySeconds": 0.025, "loadedStep": 40, "reloads": 2,
+            "attempt": 0, "time": "2026-08-04T00:00:00Z"}
+        cs.tpujobs.create("default", job.to_dict())
+        out = io.StringIO()
+        with contextlib.redirect_stdout(out):
+            rc = ctl.main(["--master", srv.url, "describe", "sv"])
+        assert rc == 0
+        text = out.getvalue()
+        assert "Serving:    3/3 ready" in text
+        assert "desired 2" in text and "range 1-4" in text
+        assert "5.5 req/s" in text
+        assert "p95 25.0 ms" in text
+        assert "loaded step 40" in text and "2 reload(s)" in text
+
+
+# --- code-review regressions -------------------------------------------------
+
+
+def test_serve_rejects_non_worker_roles():
+    """Readiness gating maps process ids onto WORKER indices 1:1 and
+    gates every per-index Service — a compat SCHEDULER/SERVER role would
+    shift the mapping and lose its own Service, so serve specs are
+    WORKER-only by validation."""
+    job = serve_job(replicas=2, max_replicas=2)
+    job.spec.replica_specs.append(t.TPUReplicaSpec(
+        replicas=1, template=make_template(),
+        tpu_replica_type=t.TPUReplicaType.SCHEDULER))
+    set_defaults(job.spec)
+    with pytest.raises(validation.ValidationError) as e:
+        validation.validate_tpujob_spec(job.spec)
+    assert "WORKER-only" in str(e.value)
+
+
+def test_scaled_spec_single_slice_never_mints_slice_demand():
+    """A numSlices=1 job's scaling never touches slice accounting
+    (slice_per_replica is False), so the scaled view must keep
+    numSlices at 1 — bumping it would mint slice demand admission never
+    granted."""
+    from tpu_operator.trainer import serving as serving_lib
+
+    job = serve_job(replicas=1, max_replicas=4, num_slices=1)
+    set_defaults(job.spec)
+    eff = serving_lib.scaled_spec(job.spec, 3)
+    assert eff.replica_specs[0].replicas == 3
+    assert eff.num_slices == 1
+    # Slice-per-replica DOES follow (replica delta == slice delta).
+    job2 = serve_job(replicas=2, max_replicas=4, num_slices=2, tpu_chips=4)
+    set_defaults(job2.spec)
+    eff2 = serving_lib.scaled_spec(job2.spec, 4)
+    assert eff2.num_slices == 4
+
+
+def test_burst_backlog_drains_after_arrivals_stop():
+    """Requests queued past the slot count during a burst must drain as
+    slots free — even after the arrival stream pauses (the old loop only
+    pulled the backlog on NEW arrivals, so a burst + silence starved the
+    queue forever)."""
+    # 2 slots, 2-token requests; a 1s burst at 60 rps queues far past
+    # the slots, then a silent window (0 rps) before the schedule ends —
+    # the backlog must drain during the silence, and the end-of-schedule
+    # exit must wait for the queue, not just the in-flight slots.
+    loop = serve_mod.ServeLoop(serve_args(load="60:1,0:3"), make_info(),
+                               heartbeat=None, store=None, recorder=None)
+    summary = loop.run()
+    assert summary["failedSteps"] == 0
+    # Every burst arrival completed — none stranded in the backlog.
+    assert summary["completed"] == summary["arrivals"]
+    assert summary["arrivals"] >= 50
+
+
+def test_failed_warmup_never_goes_ready():
+    """A replica whose warm-up decode failed must not post ready — and a
+    persistent failure streak exits instead of blackholing requests."""
+    posts = []
+
+    class FakeReporter:
+        cadence_only = False
+
+        def due(self, _step):
+            return False
+
+        def report(self, step, metrics=None, serving=None, **kw):
+            posts.append(dict(serving))
+            return True
+
+    loop = serve_mod.ServeLoop(serve_args(load="50:5"), make_info(),
+                               heartbeat=FakeReporter(), store=None,
+                               recorder=None)
+
+    def boom(*_a, **_k):
+        raise RuntimeError("poisoned device")
+
+    loop._decode = boom
+    with pytest.raises(RuntimeError):
+        loop.run()
+    assert not any(p.get("ready") for p in posts)
+
+
+def test_wedged_replica_swept_without_beats():
+    """The reconcile-time sweep: a sole replica posts ready then goes
+    fully silent — the expiry obligation wakes a reconcile, the sweep
+    drops it from the ready set, and its Service is removed, all without
+    a single further beat."""
+    from tpu_operator.controller.controller import SERVING_EXPIRY_SECONDS
+
+    cs, controller, tj, now, beat = serving_harness(replicas=1)
+    beat(0, serving_body())
+    tj.reconcile()
+    svc0 = replicas_mod.gen_general_name("sv", "WORKER", "sv01", 0)
+    assert svc0 in service_names(cs)
+    # The expiry wakeup is armed for exactly the beat's staleness epoch.
+    obligation = tj.next_time_obligation()
+    assert obligation is not None
+    assert obligation <= now[0] + SERVING_EXPIRY_SECONDS + 1
+    # The replica wedges: NO further beats. Time passes; the woken
+    # reconcile sweeps and ungates.
+    now[0] += SERVING_EXPIRY_SECONDS + 1
+    with controller._jobs_lock:
+        controller._sweep_serving_locked("default/sv", tj)
+    tj.reconcile()
+    assert svc0 not in service_names(cs)
+    assert tj.job.status.serving["replicasReady"] == 0
+
+
+def test_trim_removes_all_stale_services_wide_scale_down():
+    """Scale-down service cleanup walks the SNAPSHOT, not a probed index
+    range — a 70→2 trim must remove every stale per-index Service (the
+    old probe cap leaked everything past keep+64)."""
+    cs, controller, tj, now, beat = serving_harness(replicas=70,
+                                                    min_replicas=1)
+    rs = tj.replica_sets[0]
+    for index in range(70):
+        rs.create_service_with_index(index, emit_event=False)
+    assert len(service_names(cs)) >= 70
+    tj.gang.trim_replicas(2, tj.build_snapshot())
+    names = service_names(cs)
+    assert rs.gen_name(0) in names and rs.gen_name(1) in names
+    assert not any(rs.gen_name(i) in names for i in range(2, 70))
+
+
+def test_operator_restart_keeps_services_until_evidence():
+    """Restart-blackout regression: a freshly restarted operator has an
+    EMPTY in-memory serving map while every replica may be healthy — the
+    reconcile must leave the Service set untouched until the first beat
+    (or sweep) provides evidence, never ungate on absence."""
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    beat(0, serving_body())
+    beat(1, serving_body())
+    tj.reconcile()
+    svc0, svc1 = (replicas_mod.gen_general_name("sv", "WORKER", "sv01", i)
+                  for i in (0, 1))
+    assert {svc0, svc1} <= service_names(cs)
+
+    # Operator restart: a fresh controller + TrainingJob, no beats yet.
+    controller2 = Controller(cs, SharedInformerFactory(cs,
+                                                       resync_period=0),
+                             heartbeat_persist_interval=0.0)
+    job2 = t.TPUJob.from_dict(cs.tpujobs.get("default", "sv"))
+    tj2 = TrainingJob(cs, controller2.recorder, job2,
+                      metrics=controller2.metrics)
+    controller2.jobs["default/sv"] = tj2
+    tj2.reconcile()
+    # No serving evidence: both Services survive the reconcile.
+    assert {svc0, svc1} <= service_names(cs)
+    # First beat arrives: gating resumes with real evidence.
+    controller2.record_heartbeat("default", "sv", {
+        "time": "2026-08-04T00:00:00.000000Z", "step": 60, "attempt": 0,
+        "processId": 0, "serving": serving_body(ready=False)})
+    tj2.reconcile()
+    assert svc0 not in service_names(cs)
+    assert svc1 in service_names(cs)
+
+
+def test_late_appearing_pod_trimmed_on_next_pass():
+    """Stale-snapshot trim regression: a pod created during a scale-up
+    that the watch cache echoes only AFTER the scale-down pass must
+    still be deleted — the trim is level-triggered on every serve
+    reconcile, not a one-shot against one snapshot."""
+    cs, controller, tj, now, beat = serving_harness(replicas=2)
+    tj.reconcile()
+    assert len(live_pods(cs)) == 2
+    # A pod of a wider world appears late (as if the cache lagged its
+    # create past the scale-down that should have removed it). Built by
+    # hand: the CURRENT (narrow) world's env table can't describe it.
+    rs = tj.replica_sets[0]
+    stray = {"apiVersion": "v1", "kind": "Pod",
+             "metadata": {"name": "sv-worker-sv01-5-zzzzz",
+                          "labels": rs.index_labels(5, 0)},
+             "spec": {"containers": [{"name": "tpu", "image": "x"}]},
+             "status": {"phase": "Running", "containerStatuses": [
+                 {"name": "tpu", "state": {"running": {}}}]}}
+    cs.pods.create("default", stray)
+    assert len(live_pods(cs)) == 3
+    tj.reconcile()
+    assert len(live_pods(cs)) == 2
+    assert not any(
+        (p["metadata"]["labels"] or {}).get("task_index") == "5"
+        for p in live_pods(cs))
+
+
+def test_serve_slice_mismatch_rejected_without_serving_block():
+    """The replicas==numSlices consistency check guards the MODE, not
+    only the serving block: a serve job without one still runs
+    independent slice servers."""
+    job = serve_job(replicas=3, num_slices=2, tpu_chips=4)
+    job.spec.serving = None
+    set_defaults(job.spec)
+    with pytest.raises(validation.ValidationError) as e:
+        validation.validate_tpujob_spec(job.spec)
+    assert "numSlices" in str(e.value)

@@ -1052,3 +1052,94 @@ def test_store_chaos_fresh_node_resume(tmp_path):
     assert start == step
     assert int(restored["step"]) == step
     reader.close()
+
+
+# --- retention GC (spec.store.keepSnapshots) ---------------------------------
+
+
+def test_retain_keeps_newest_n_marker_first(tmp_path):
+    """retain(2) removes every verified snapshot but the newest two —
+    condemn-then-delete, MARKER-FIRST (the PR-8 ordering): the victim's
+    .corrupt marker must land before any of its objects is deleted, and
+    the marker itself is removed once the tree is gone (a GC'd step is
+    absence, not quarantine — markers must not accumulate)."""
+    step_dir = str(tmp_path / "sd")
+    write_tree(step_dir, SAMPLE)
+    be = FakeBackend()
+    ws = WarmStartStore(be, prefix="p", chunk_size=4096)
+    for step in (1, 2, 3, 4):
+        ws.upload_checkpoint(step_dir, step)
+    removed = ws.retain(2)
+    assert removed == 2
+    assert ws.checkpoint_steps() == [3, 4]
+    # No stray markers: a later prefetch sees clean absence.
+    assert not [k for k in be.list("p/checkpoints/")
+                if k.endswith(".corrupt")]
+    # Survivors intact: a fresh node prefetches the newest.
+    step, fallbacks = ws.prefetch_checkpoint(str(tmp_path / "fresh"))
+    assert (step, fallbacks) == (4, 0)
+    # Idempotent: nothing more to remove.
+    assert ws.retain(2) == 0
+    # keep <= 0 = keep everything (the default, pre-GC behavior).
+    assert ws.retain(0) == 0
+
+
+def test_retain_op_order_marker_before_delete(tmp_path):
+    """Op-count/op-order proof on the fake backend: for each victim the
+    marker PUT precedes every DELETE of the victim's objects, and the
+    final op on the victim is the marker's own delete."""
+    step_dir = str(tmp_path / "sd")
+    write_tree(step_dir, {"w.bin": b"x" * 100})
+    be = FakeBackend()
+    ws = WarmStartStore(be, prefix="p", chunk_size=4096)
+    ws.upload_checkpoint(step_dir, 1)
+    ws.upload_checkpoint(step_dir, 2)
+    puts_before = be.op_counts.get("put", 0)
+    deletes_before = be.op_counts.get("delete", 0)
+    objects_of_1 = [k for k in be.list("p/checkpoints/1/")]
+    assert ws.retain(1) == 1
+    # Exactly one marker put; deletes = victim's objects + the marker.
+    assert be.op_counts.get("put", 0) - puts_before == 1
+    assert (be.op_counts.get("delete", 0) - deletes_before
+            == len(objects_of_1) + 1)
+    assert be.list("p/checkpoints/1/") == []
+
+
+def test_writebehind_retention_runs_after_commit(tmp_path):
+    """The write-behind worker GCs AFTER each successful upload (never
+    on failure, never on the step loop): keepSnapshots=2 holds the
+    remote tree at the newest two as commits stream."""
+    step_dir = str(tmp_path / "sd")
+    write_tree(step_dir, {"w.bin": b"y" * 64})
+    be = FakeBackend()
+    ws = WarmStartStore(be, prefix="p", chunk_size=4096)
+    up = WriteBehindUploader(ws, keep_snapshots=2)
+    try:
+        for step in (10, 20, 30):
+            up.enqueue(step, step_dir)
+            assert up.flush(10.0)
+        assert ws.checkpoint_steps() == [20, 30]
+        assert up.gc_removed == 1
+    finally:
+        up.close()
+
+
+def test_uploader_from_env_wires_keep(tmp_path):
+    from tpu_operator.payload import warmstore
+
+    env = {"TPUJOB_STORE_URI": f"fake://keep-{os.getpid()}",
+           "TPUJOB_STORE_BACKEND": "fake",
+           "TPUJOB_STORE_KEEP": "3",
+           "TPUJOB_NAMESPACE": "default", "TPUJOB_NAME": "kj",
+           "JAX_PROCESS_ID": "0"}
+    up = warmstore.uploader_from_env(env)
+    try:
+        assert up is not None and up.keep_snapshots == 3
+    finally:
+        up.close()
+    # Malformed keep degrades to 0 (keep all), never kills the payload.
+    up2 = warmstore.uploader_from_env({**env, "TPUJOB_STORE_KEEP": "lots"})
+    try:
+        assert up2 is not None and up2.keep_snapshots == 0
+    finally:
+        up2.close()

@@ -53,6 +53,7 @@ SCAN = (
     ("tpu_operator", "util"),
     ("tpu_operator", "payload", "autotune.py"),
     ("tpu_operator", "payload", "checkpoint.py"),
+    ("tpu_operator", "payload", "serve.py"),
     ("tpu_operator", "payload", "startup.py"),
     ("tpu_operator", "payload", "steptrace.py"),
     ("tpu_operator", "payload", "train.py"),

@@ -22,6 +22,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_TPU_PORT,
     DEFAULT_TPU_REPLICAS,
     CacheMedium,
+    JobMode,
     RestartBackoffSpec,
     RestartPolicy,
     StoreBackend,
@@ -82,9 +83,22 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
             chief_replica_name=chief, chief_replica_index=0
         )
 
+    # Job mode: the wire value is case-normalized; "" stays "" (absent =
+    # train, kept unset so specs round-trip unchanged).
+    if spec.mode:
+        spec.mode = spec.mode.lower()
+
     if not spec.restart_policy:
         ps_mode = bool(roles & {TPUReplicaType.SCHEDULER, TPUReplicaType.SERVER})
-        spec.restart_policy = RestartPolicy.PER_POD if ps_mode else RestartPolicy.WHOLE_GROUP
+        if spec.mode == JobMode.SERVE:
+            # Serve replicas are independent decode servers: a member
+            # death must restart only that member, never the fleet — the
+            # opposite default from a training gang, whose JAX group
+            # cannot lose a member.
+            spec.restart_policy = RestartPolicy.PER_POD
+        else:
+            spec.restart_policy = RestartPolicy.PER_POD if ps_mode \
+                else RestartPolicy.WHOLE_GROUP
 
     if spec.max_restarts < 0:
         spec.max_restarts = 0
@@ -102,6 +116,18 @@ def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:
     # round-trip unchanged); a present block fills an unset/empty queue.
     if spec.scheduling is not None and not spec.scheduling.queue:
         spec.scheduling.queue = DEFAULT_SCHEDULING_QUEUE
+
+    # Serving mode: the block stays opt-in (None = serve at the spec'd
+    # replica count, no traffic scaling). A present block fills only the
+    # UNSET maxReplicas from the WORKER replica count — the natural
+    # ceiling when the user names none; explicitly written junk
+    # (min > max, zero target) reaches validation.py and fails loudly
+    # (the uploadParallelism lesson).
+    if spec.serving is not None and not spec.serving.max_replicas:
+        workers = sum(r.replicas for r in spec.replica_specs
+                      if r.tpu_replica_type == TPUReplicaType.WORKER)
+        spec.serving.max_replicas = max(workers, spec.serving.min_replicas,
+                                        1)
 
     # Elastic gangs: the block stays opt-in (None = rigid sizing). A
     # present block fills only the UNSET maxSlices from numSlices — the

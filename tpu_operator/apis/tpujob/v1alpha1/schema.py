@@ -145,6 +145,23 @@ def spec_schema() -> Dict[str, Any]:
             "uri": _str(),
             "uploadParallelism": _int(minimum=1),
             "prefetch": {"type": "boolean"},
+            # Retention GC: newest-N verified snapshots kept remotely
+            # (0 = keep everything), enforced by the write-behind worker.
+            "keepSnapshots": _int(minimum=0),
+        }),
+        # Job mode: absent/"train" = finite training job; "serve" =
+        # long-lived inference gang (readiness-gated Services, hot
+        # weight reload, traffic-driven replica scaling).
+        "mode": _str(enum=list(types.JobMode.ALL)),
+        # Serving-mode scaling + tail-latency policy (mode: serve).
+        "serving": _obj({
+            "minReplicas": _int(minimum=1),
+            "maxReplicas": _int(minimum=0),
+            "targetRequestsPerSecondPerReplica": _num(minimum=0),
+            "reloadPollSeconds": _int(minimum=1),
+            "stragglerPolicy": _str(enum=[types.StragglerPolicy.NONE,
+                                          types.StragglerPolicy.REPLACE]),
+            "stragglerPatienceSeconds": _int(minimum=1),
         }),
         # Data-plane flight recorder: per-step phase timing ring buffer
         # (payload side) + the controller's straggler-flagging threshold.
@@ -254,6 +271,49 @@ def dataplane_knobs_schema(status: bool = False) -> Dict[str, Any]:
     return _obj(out)
 
 
+def serving_beat_schema() -> Dict[str, Any]:
+    """One replica's serving heartbeat body (``lastHeartbeat.serving``,
+    as posted): readiness, its slice of the traffic, its latency
+    percentiles over the reporting window, the snapshot step it serves,
+    and its per-attempt weight-reload counter (the controller's delta
+    accounting aggregates these into ``status.serving``)."""
+    return _obj({
+        "ready": {"type": "boolean"},
+        "requestsPerSecond": _num(minimum=0),
+        "p50LatencySeconds": _num(minimum=0),
+        "p95LatencySeconds": _num(minimum=0),
+        "loadedStep": _int(minimum=0),
+        "reloads": _int(minimum=0),
+    })
+
+
+def serving_status_schema() -> Dict[str, Any]:
+    """The controller's serving roll-up (``status.serving``): the current
+    and traffic-desired replica counts, readiness, aggregate traffic and
+    tail latency, the gang-wide loaded snapshot step, and the lifetime
+    weight-reload total with its per-process delta baselines."""
+    return _obj({
+        "replicas": _int(minimum=0),
+        "desiredReplicas": _int(minimum=0),
+        "replicasReady": _int(minimum=0),
+        "requestsPerSecond": _num(minimum=0),
+        "p50LatencySeconds": _num(minimum=0),
+        "p95LatencySeconds": _num(minimum=0),
+        "loadedStep": _int(minimum=0),
+        "reloads": _int(minimum=0),
+        # Per-process reload-counter baselines of the delta accounting
+        # (payload counters reset on replica restart; lifetime ``reloads``
+        # accumulates deltas against these, persisted IN status so an
+        # operator restart never double-counts).
+        "attemptReloads": {
+            "type": "object",
+            "additionalProperties": _int(minimum=0),
+        },
+        "attempt": _int(minimum=0),
+        "time": _str(),
+    })
+
+
 def status_schema() -> Dict[str, Any]:
     phases = [types.TPUJobPhase.NONE, types.TPUJobPhase.CREATING,
               types.TPUJobPhase.RUNNING, types.TPUJobPhase.CLEANUP,
@@ -309,6 +369,8 @@ def status_schema() -> Dict[str, Any]:
             "stepTiming": steptiming_schema(),
             # Self-tuning data plane knob report (live values).
             "dataPlane": dataplane_knobs_schema(),
+            # Serving-mode beat (mode: serve replicas post these).
+            "serving": serving_beat_schema(),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -384,6 +446,9 @@ def status_schema() -> Dict[str, Any]:
                 "time": _str(),
             })),
         }),
+        # Serving-mode roll-up: readiness, aggregate traffic + tail
+        # latency, the gang's loaded snapshot step, reload accounting.
+        "serving": serving_status_schema(),
         # Fleet-scheduling state: effective queue/priority, and — while
         # phase is Queued — the admission-order position (0 = next).
         "scheduling": _obj({

@@ -148,6 +148,35 @@ class FailureKind:
 # own budget (application/stall restarts use spec.maxRestarts directly).
 PREEMPTION_BUDGET_FACTOR = 4
 
+
+# --- Job mode (training vs long-lived serving) -------------------------------
+
+class JobMode:
+    """What the gang runs for.
+
+    TRAIN (the default, and what an absent ``spec.mode`` means) is the
+    classic finite job: the gang steps to completion, the chief's exit 0
+    rolls the job up Done. SERVE is the long-lived inference shape: each
+    WORKER replica is an independent decode server (no cross-replica JAX
+    process group), Services route only to replicas whose payload posted
+    a ``ready`` serving beat, weights hot-reload from the remote store
+    without an attempt bump, and the replica count follows the traffic
+    signal within ``spec.serving`` — the job only ends by deletion,
+    suspension, or payload exit."""
+
+    TRAIN = "train"
+    SERVE = "serve"
+
+    ALL = (TRAIN, SERVE)
+
+
+# Traffic target a serve replica is sized for when spec.serving names none.
+DEFAULT_SERVE_TARGET_RPS = 100.0
+
+# How often a serve replica polls the remote store for a newer verified
+# snapshot (the hot-reload watch cadence).
+DEFAULT_SERVE_RELOAD_POLL = 10
+
 # Upper bound on retained status.failures entries (newest kept); the ledger
 # is a postmortem aid, not an unbounded event log.
 FAILURE_LEDGER_CAP = 32
@@ -208,6 +237,13 @@ class StoreBackend:
 
 
 DEFAULT_STORE_UPLOAD_PARALLELISM = 4
+
+# Remote-snapshot retention: how many newest verified snapshots the
+# write-behind worker keeps per job (0 = keep everything, the pre-GC
+# behavior). Older steps are condemned-then-deleted after each commit —
+# marker-first, so a half-deleted snapshot never looks healthy to a
+# fresh-node prefetch or the serve-mode hot-reload watcher.
+DEFAULT_STORE_KEEP_SNAPSHOTS = 0
 
 
 # --- Self-tuning data plane (adaptive prefetch + autotune) --------------------
@@ -431,11 +467,19 @@ class StoreSpec:
     uri: str = ""
     upload_parallelism: int = DEFAULT_STORE_UPLOAD_PARALLELISM
     prefetch: bool = True
+    # Retention GC: keep only the newest N verified snapshots remotely
+    # (0 = keep everything). Enforced by the write-behind worker after
+    # each commit — condemn-then-delete, marker-first — so the serve-mode
+    # hot-reload watcher never walks an unbounded snapshot tree.
+    keep_snapshots: int = DEFAULT_STORE_KEEP_SNAPSHOTS
 
     def to_dict(self) -> Dict[str, Any]:
-        return {"backend": self.backend, "uri": self.uri,
-                "uploadParallelism": self.upload_parallelism,
-                "prefetch": self.prefetch}
+        d: Dict[str, Any] = {"backend": self.backend, "uri": self.uri,
+                             "uploadParallelism": self.upload_parallelism,
+                             "prefetch": self.prefetch}
+        if self.keep_snapshots:
+            d["keepSnapshots"] = self.keep_snapshots
+        return d
 
     @classmethod
     def from_dict(cls, d: Optional[Dict[str, Any]]
@@ -448,6 +492,8 @@ class StoreSpec:
             upload_parallelism=int(d.get("uploadParallelism",
                                          DEFAULT_STORE_UPLOAD_PARALLELISM)),
             prefetch=bool(d.get("prefetch", True)),
+            keep_snapshots=int(d.get("keepSnapshots",
+                                     DEFAULT_STORE_KEEP_SNAPSHOTS)),
         )
 
 
@@ -644,6 +690,63 @@ class ElasticSpec:
 
 
 @dataclass
+class ServingSpec:
+    """Serving-mode scaling + tail-latency policy (``spec.serving``,
+    meaningful only under ``spec.mode: serve``).
+
+    The controller reads the gang's aggregate requests/sec from serving
+    heartbeats, computes a desired replica count within
+    ``[minReplicas, maxReplicas]`` sized for
+    ``targetRequestsPerSecondPerReplica``, and admits the delta through
+    the fleet scheduler's queue (slice-per-replica jobs renegotiate
+    their reservation exactly like an elastic resize — but with NO
+    attempt bump and no gang restart: serve replicas are independent).
+    ``reloadPollSeconds`` is the payload-side hot-reload watch cadence
+    (how often each replica polls the remote store for a newer verified
+    snapshot). ``stragglerPolicy`` routes the PR-9 straggler detector's
+    tail-latency flags into the PR-10 ``replace`` remediation path
+    (``shed`` is an elastic-gang concept and is not valid here)."""
+
+    min_replicas: int = 1
+    # 0 = unset → defaulted to the WORKER replica count (set_defaults).
+    max_replicas: int = 0
+    target_requests_per_second_per_replica: float = DEFAULT_SERVE_TARGET_RPS
+    reload_poll_seconds: int = DEFAULT_SERVE_RELOAD_POLL
+    straggler_policy: str = StragglerPolicy.NONE
+    straggler_patience_seconds: int = DEFAULT_STRAGGLER_PATIENCE
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"minReplicas": self.min_replicas,
+                "maxReplicas": self.max_replicas,
+                "targetRequestsPerSecondPerReplica":
+                    self.target_requests_per_second_per_replica,
+                "reloadPollSeconds": self.reload_poll_seconds,
+                "stragglerPolicy": self.straggler_policy,
+                "stragglerPatienceSeconds":
+                    self.straggler_patience_seconds}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["ServingSpec"]:
+        if d is None:
+            return None
+        return cls(
+            min_replicas=int(d.get("minReplicas", 1)),
+            max_replicas=int(d.get("maxReplicas", 0)),
+            target_requests_per_second_per_replica=float(
+                d.get("targetRequestsPerSecondPerReplica",
+                      DEFAULT_SERVE_TARGET_RPS)),
+            reload_poll_seconds=int(d.get("reloadPollSeconds",
+                                          DEFAULT_SERVE_RELOAD_POLL)),
+            straggler_policy=str(d.get("stragglerPolicy",
+                                       StragglerPolicy.NONE)),
+            straggler_patience_seconds=int(
+                d.get("stragglerPatienceSeconds",
+                      DEFAULT_STRAGGLER_PATIENCE)),
+        )
+
+
+@dataclass
 class TPUReplicaSpec:
     """One replica set: N pods of one role (ref: types.go:93-104).
 
@@ -759,6 +862,13 @@ class TPUJobSpec:
     # replaced or shed per stragglerPolicy (None = rigid sizing, the
     # pre-elastic behavior).
     elastic: Optional[ElasticSpec] = None
+    # Job mode: "" / "train" = the classic finite training job; "serve" =
+    # long-lived inference gang (readiness-gated Services, hot weight
+    # reload from the remote store, traffic-driven replica scaling).
+    mode: str = ""
+    # Serving-mode scaling + tail-latency policy (mode: serve only;
+    # None = the defaults — serve at the spec'd replica count).
+    serving: Optional[ServingSpec] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -803,6 +913,10 @@ class TPUJobSpec:
             d["dataPlane"] = self.data_plane.to_dict()
         if self.elastic is not None:
             d["elastic"] = self.elastic.to_dict()
+        if self.mode:
+            d["mode"] = self.mode
+        if self.serving is not None:
+            d["serving"] = self.serving.to_dict()
         return d
 
     @classmethod
@@ -834,6 +948,8 @@ class TPUJobSpec:
             step_trace=StepTraceSpec.from_dict(d.get("stepTrace")),
             data_plane=DataPlaneSpec.from_dict(d.get("dataPlane")),
             elastic=ElasticSpec.from_dict(d.get("elastic")),
+            mode=str(d.get("mode", "")),
+            serving=ServingSpec.from_dict(d.get("serving")),
         )
 
 
@@ -980,6 +1096,14 @@ class TPUJobStatus:
     # (capNextAttempt, consumed at the next sizing), and the bounded
     # straggler-remediation audit trail.
     elastic: Optional[Dict[str, Any]] = None
+    # Serving-mode roll-up (mode: serve), aggregated by the controller
+    # from every replica's serving heartbeats: {replicas (current target
+    # the reconcile runs), desiredReplicas (traffic-derived), replicasReady,
+    # requestsPerSecond, p50/p95LatencySeconds, loadedStep (the snapshot
+    # step every READY replica serves — the hot-reload progress signal),
+    # reloads (lifetime weight reloads, delta-accounted), attemptReloads
+    # (per-process baselines of that accounting), attempt, time}.
+    serving: Optional[Dict[str, Any]] = None
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -1033,6 +1157,8 @@ class TPUJobStatus:
             d["dataPlane"] = dict(self.data_plane)
         if self.elastic:
             d["elastic"] = dict(self.elastic)
+        if self.serving:
+            d["serving"] = dict(self.serving)
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -1075,6 +1201,7 @@ class TPUJobStatus:
             data_plane=(dict(d["dataPlane"])
                         if d.get("dataPlane") else None),
             elastic=(dict(d["elastic"]) if d.get("elastic") else None),
+            serving=(dict(d["serving"]) if d.get("serving") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),

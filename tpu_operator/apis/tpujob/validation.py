@@ -23,6 +23,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     MAX_SCHEDULING_PRIORITY,
     MIN_AUTOTUNE_WINDOW_STEPS,
     CacheMedium,
+    JobMode,
     RestartPolicy,
     StoreBackend,
     StragglerPolicy,
@@ -93,6 +94,85 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
         )
     if spec.num_slices < 1:
         raise ValidationError("numSlices must be >= 1")
+
+    # Serving mode: serve replicas are independent decode servers behind
+    # readiness-gated Services, so the mode constrains the restart and
+    # sizing machinery built for training gangs.
+    if spec.mode and spec.mode not in JobMode.ALL:
+        raise ValidationError(
+            f"mode {spec.mode!r} is not in {list(JobMode.ALL)}")
+    if spec.serving is not None and spec.mode != JobMode.SERVE:
+        raise ValidationError(
+            "spec.serving is only meaningful under mode: serve")
+    if spec.mode == JobMode.SERVE:
+        worker = next((r for r in spec.replica_specs
+                       if r.tpu_replica_type == TPUReplicaType.WORKER),
+                      None)
+        if worker is None:
+            raise ValidationError("mode serve requires a WORKER replicaSpec")
+        if any(r.tpu_replica_type != TPUReplicaType.WORKER
+               for r in spec.replica_specs):
+            # The readiness gate maps heartbeat process ids onto WORKER
+            # task indices 1:1 and gates EVERY per-index Service on it; a
+            # compat SCHEDULER/SERVER role would shift that mapping and
+            # have its own (never-serving-beat) Service deleted. Serve
+            # replicas are independent decode servers — the PS-compat
+            # roles have no meaning here.
+            raise ValidationError(
+                "mode serve requires WORKER-only replicaSpecs "
+                "(SCHEDULER/SERVER are parameter-server compat roles; "
+                "serve replicas are independent decode servers)")
+        if spec.restart_policy == RestartPolicy.WHOLE_GROUP:
+            raise ValidationError(
+                "mode serve requires restartPolicy PerPod: replicas are "
+                "independent servers, and a member death restarting the "
+                "whole fleet would drop every in-flight request")
+        if spec.elastic is not None:
+            raise ValidationError(
+                "mode serve excludes spec.elastic: serving owns its "
+                "replica count through spec.serving (traffic-driven "
+                "scaling), and elastic sizing requires the WholeGroup "
+                "gang boundary serve mode deliberately lacks")
+        if spec.num_slices > 1 and worker.replicas != spec.num_slices:
+            # Checked at the MODE level, not only under a serving block:
+            # a serve job without one still runs independent
+            # single-process servers, and replicas != numSlices would
+            # desynchronize pod count from slice accounting either way.
+            raise ValidationError(
+                f"mode serve with numSlices > 1 requires WORKER "
+                f"replicas ({worker.replicas}) == numSlices "
+                f"({spec.num_slices}): each serve replica is one "
+                f"independent slice server, so the scaling unit is "
+                f"one slice")
+        sv = spec.serving
+        if sv is not None:
+            if sv.min_replicas < 1:
+                raise ValidationError("serving.minReplicas must be >= 1")
+            if sv.max_replicas < sv.min_replicas:
+                raise ValidationError(
+                    "serving.maxReplicas must be >= minReplicas")
+            if not (sv.min_replicas <= worker.replicas
+                    <= sv.max_replicas):
+                raise ValidationError(
+                    f"WORKER replicas ({worker.replicas}) must lie within "
+                    f"serving [minReplicas, maxReplicas] = "
+                    f"[{sv.min_replicas}, {sv.max_replicas}]: the spec'd "
+                    f"count is the scaling start point")
+            if not (sv.target_requests_per_second_per_replica > 0):
+                raise ValidationError(
+                    "serving.targetRequestsPerSecondPerReplica must be > 0")
+            if sv.reload_poll_seconds < 1:
+                raise ValidationError(
+                    "serving.reloadPollSeconds must be >= 1")
+            if sv.straggler_policy not in (StragglerPolicy.NONE,
+                                           StragglerPolicy.REPLACE):
+                raise ValidationError(
+                    f"serving.stragglerPolicy {sv.straggler_policy!r} must "
+                    f"be 'none' or 'replace' (shed removes a slice from a "
+                    f"gang — an elastic-training concept)")
+            if sv.straggler_patience_seconds < 1:
+                raise ValidationError(
+                    "serving.stragglerPatienceSeconds must be >= 1")
 
     # Time-aware recovery fields (batch/v1 Job analogues).
     if spec.active_deadline_seconds is not None and spec.active_deadline_seconds < 1:
@@ -168,6 +248,10 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
             )
         if store.upload_parallelism < 1:
             raise ValidationError("store.uploadParallelism must be >= 1")
+        if store.keep_snapshots < 0:
+            raise ValidationError(
+                "store.keepSnapshots must be >= 0 (0 = keep every "
+                "verified snapshot, N = retain only the newest N)")
 
     # Data-plane flight recorder — validated UNCONDITIONALLY (unlike the
     # cache block): the generated CRD carries these minimums with no

@@ -187,6 +187,33 @@ def cmd_describe(cs, opts) -> int:
             print(f"Remediated: attempt {r.get('attempt', 0)}: "
                   f"{r.get('policy', '?')} process "
                   f"{r.get('processId', '?')}{node} ({r.get('time', '')})")
+    # Serving mode: readiness, traffic, tail latency, the loaded snapshot
+    # step, and the hot-reload trail (spec half = the scaling contract,
+    # status half = the controller's fleet aggregate).
+    sv_spec = spec.get("serving") or {}
+    sv = status.get("serving") or {}
+    if spec.get("mode") == "serve" or sv_spec or sv:
+        total = sv.get("replicas") or sum(
+            rs.get("replicas", 0) for rs in spec.get("replicaSpecs", [])
+            if str(rs.get("tpuReplicaType", "WORKER")).upper() == "WORKER")
+        line = f"Serving:    {sv.get('replicasReady', 0)}/{total} ready"
+        if sv.get("desiredReplicas") is not None:
+            line += f" (desired {sv['desiredReplicas']}"
+            if sv_spec:
+                line += (f", range {sv_spec.get('minReplicas', 1)}-"
+                         f"{sv_spec.get('maxReplicas', total)}")
+            line += ")"
+        if sv.get("requestsPerSecond") is not None:
+            line += f", {sv['requestsPerSecond']:.1f} req/s"
+        if sv.get("p95LatencySeconds") is not None:
+            line += f", p95 {sv['p95LatencySeconds'] * 1000:.1f} ms"
+        print(line)
+        if sv.get("loadedStep") is not None or sv.get("reloads"):
+            reload_s = f"{sv.get('reloads', 0)} reload(s)"
+            if sv.get("time") and sv.get("reloads"):
+                reload_s += f", last fold {sv['time']}"
+            print(f"Weights:    loaded step "
+                  f"{sv.get('loadedStep', '-')} ({reload_s})")
     # Fleet-scheduling state: effective queue/priority, the admission-order
     # position while parked in phase Queued, and — after a scheduler
     # eviction — the reason from the failure ledger.

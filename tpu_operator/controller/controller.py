@@ -29,7 +29,7 @@ from __future__ import annotations
 import logging
 import threading
 import time
-from typing import Any, Callable, Dict, Optional
+from typing import Any, Callable, Dict, List, Optional
 
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
@@ -58,6 +58,7 @@ from tpu_operator.scheduler.inventory import (
 from tpu_operator.scheduler.sharding import ShardedWorkQueue
 from tpu_operator.scheduler.writeback import WritebackLimiter
 from tpu_operator.trainer import elastic as elastic_mod
+from tpu_operator.trainer import serving as serving_mod
 from tpu_operator.trainer.training import TrainingJob, live_pod
 from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
@@ -73,6 +74,33 @@ log = logging.getLogger(__name__)
 # class HEARTBEAT_CAP and the queue-depth LRU bound elsewhere.
 CADENCE_EXPIRY_SECONDS = 300.0
 CADENCE_MAX_PROCS = 1024
+
+# Serving-readiness hygiene: a replica whose last serving beat is older
+# than this drops from the ready set (its Service is removed) even
+# without an explicit ready=false beat — a wedged replica must stop
+# receiving traffic. Much tighter than the cadence expiry: readiness is
+# a routing decision, not a statistics window.
+SERVING_EXPIRY_SECONDS = 60.0
+SERVING_MAX_PROCS = 1024
+
+
+def _expire_serving_procs(procs: Dict[int, Dict[str, Any]],
+                          now: float) -> List[int]:
+    """Mark serving entries whose last beat is older than the expiry as
+    STALE (not-ready, zero traffic) rather than deleting them: a stale
+    entry is still KNOWN, so the readiness gate removes its Service —
+    deleting it would make the replica *unknown*, and unknown indices
+    deliberately keep their Services (the operator-restart case: absence
+    of evidence is not evidence of not-ready). Returns newly staled
+    pids."""
+    staled: List[int] = []
+    for p, e in procs.items():
+        if not e.get("stale") and now - e["seen"] > SERVING_EXPIRY_SECONDS:
+            e["stale"] = True
+            e["ready"] = False
+            e["rps"] = 0.0
+            staled.append(p)
+    return staled
 
 
 class Controller:
@@ -151,6 +179,12 @@ class Controller:
         # operator restart — it is telemetry, not state); reset on attempt
         # change, dropped on job deletion.
         self._gang_cadence: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
+        # Serving-mode per-replica state, key -> {"attempt": n, "procs":
+        # {processId -> {"ready", "rps", "p50", "p95", "loadedStep",
+        # "reloads", "seen"}}}. In-memory like the cadence map (readiness
+        # re-earns itself from fresh beats after an operator restart; the
+        # reload delta baselines persist IN status.serving).
+        self._serving: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
         # Straggler-remediation pacing (spec.elastic.stragglerPolicy):
         # how long each flagged member has stayed flagged; crossing the
         # patience window hands the member to the TrainingJob's next
@@ -275,14 +309,18 @@ class Controller:
             # Elastic jobs re-reserve what their persisted
             # status.elastic says they actually hold (a gang shrunk to
             # 4 of 8 must not re-reserve 8 phantom slices) — the SAME
-            # derivation the live admission gate uses.
+            # derivation the live admission gate uses. Serve jobs
+            # likewise re-reserve their CURRENT traffic-scaled replica
+            # count (serving.sched_kwargs), never the spec's original.
             demand, kwargs = elastic_mod.sched_kwargs(
                 job.spec, job.status.elastic, job_demand(job.spec))
+            demand, serve_kwargs = serving_mod.sched_kwargs(
+                job.spec, job.status.serving, demand)
             self.scheduler.ensure_admitted(
                 f"{job.namespace}/{job.name}", uid=job.uid,
                 demand=demand,
                 priority=priority, queue=queue,
-                holds_hardware=True, **kwargs)
+                holds_hardware=True, **kwargs, **serve_kwargs)
 
     def _refresh_node_inventory(self) -> None:
         """Recompute slice capacity from the cached node objects and swap
@@ -351,6 +389,7 @@ class Controller:
                 self.jobs.pop(key, None)
                 self._hb_persisted.pop(key, None)
                 self._gang_cadence.pop(key, None)
+                self._serving.pop(key, None)
             self._remediation.forget(key)
             self.recorder.forget_object(namespace, name)
             self.deadlines.forget(key)
@@ -376,9 +415,19 @@ class Controller:
                            "job_store_upload_failures_total",
                            "compilation_cache_hits_total",
                            "store_prefetch_hits_total",
-                           "store_prefetch_misses_total"):
+                           "store_prefetch_misses_total",
+                           "job_serving_replicas_ready",
+                           "job_serving_requests_per_second",
+                           "job_weight_reloads_total"):
                 self.metrics.remove_series(
                     series, labels={"namespace": namespace, "name": name})
+            # The serving latency gauge carries a quantile label on top of
+            # the job identity: drop every combination.
+            for quantile in ("0.5", "0.95"):
+                self.metrics.remove_series(
+                    "job_serving_latency_seconds",
+                    labels={"namespace": namespace, "name": name,
+                            "quantile": quantile})
             # The autotune adjustment counters carry {knob,direction} on
             # top of the job identity: drop every combination.
             from tpu_operator.payload.autotune import KNOB_OF
@@ -404,11 +453,19 @@ class Controller:
             else:
                 tj.refresh(job)
 
+        # Serve mode: re-evaluate beat expiry BEFORE reconciling — the
+        # stale-pruning inside the serving fold only runs when another
+        # beat arrives, so without this sweep a wedged SOLE replica (or a
+        # fully wedged fleet) would hold its ready set — and its Services
+        # — forever. The expiry epoch below (next_time_obligation) is
+        # what wakes this reconcile on time.
+        with self._jobs_lock:
+            self._sweep_serving_locked(key, tj)
         tj.reconcile()
         # Arm (or clear) the exact-time wakeup for the job's next time
         # obligation — this is what makes deadline/stall/backoff/TTL
         # enforcement land at the configured second instead of the next
-        # resync.
+        # resync (and, for serve jobs, the serving-beat expiry).
         self.deadlines.sync(key, tj.next_time_obligation())
         return tj.job.status.phase in (
             TPUJobPhase.CLEANUP, TPUJobPhase.DONE, TPUJobPhase.FAILED
@@ -470,18 +527,24 @@ class Controller:
             # (recorder RPCs must never run under _jobs_lock).
             straggler_changed = self._apply_cadence_locked(
                 key, tj, pid, heartbeat, hb_attempt, straggler_events)
+            # Serving beats come from EVERY replica (each is its own
+            # server): the fold aggregates readiness/traffic/latency
+            # across the fleet regardless of process id.
+            serving_changed = self._apply_serving_locked(
+                key, tj, namespace, name, pid, heartbeat, hb_attempt)
             if pid != 0:
                 # Cadence-only beat from a non-zero gang member: it exists
-                # for the detector alone. status.lastHeartbeat and every
-                # other fold stay process 0's single stream; persistence
-                # is forced only when the straggler roll-up changed.
-                persist = straggler_changed
+                # for the detector (and, in serve mode, the serving fold)
+                # alone. status.lastHeartbeat and every other fold stay
+                # process 0's single stream; persistence is forced only
+                # when a roll-up changed.
+                persist = straggler_changed or serving_changed
             else:
                 self._apply_steptiming_heartbeat(tj, pid, heartbeat,
                                                  hb_attempt)
                 persist = self._fold_heartbeat_locked(
                     key, tj, namespace, name, heartbeat, hb_attempt, new_t
-                ) or straggler_changed
+                ) or straggler_changed or serving_changed
         for message in straggler_events:
             self.recorder.event(tj, "Warning", "StragglerDetected", message)
         if persist:
@@ -519,7 +582,7 @@ class Controller:
                               "checkpointRestoreFallbacks",
                               "storeLastUploadedStep",
                               "storeUploadFailures",
-                              "stepTiming", "dataPlane"):
+                              "stepTiming", "dataPlane", "serving"):
                     if field not in merged and field in prev:
                         merged[field] = prev[field]
         tj.job.status.last_heartbeat = merged
@@ -839,6 +902,190 @@ class Controller:
             if p95 is not None:
                 self.metrics.observe("job_step_phase_seconds", float(p95),
                                      labels={"phase": field})
+
+    def _apply_serving_locked(self, key: str, tj: TrainingJob,
+                              namespace: str, name: str, pid: int,
+                              heartbeat: Dict[str, Any],
+                              hb_attempt: Optional[int]) -> bool:
+        """Serving-mode fold (called under _jobs_lock): aggregate one
+        replica's serving beat into the per-job fleet view and rewrite
+        ``status.serving``. Every replica posts (each is an independent
+        server); the roll-up is:
+
+        - ``replicasReady``: replicas whose freshest beat says ``ready``
+          (stale beats expire after SERVING_EXPIRY_SECONDS — a wedged
+          replica must drop out of routing without posting anything);
+        - ``requestsPerSecond``: the fleet sum — the signal the scaler
+          divides by ``targetRequestsPerSecondPerReplica``;
+        - ``p50/p95LatencySeconds``: the WORST ready replica's value
+          (routing decisions care about the tail, and an average across
+          replicas would hide exactly the replica the straggler guard
+          wants visible);
+        - ``loadedStep``: the MINIMUM over ready replicas — the snapshot
+          step the whole fleet is guaranteed to serve; it advances only
+          once the rolling reload completes everywhere;
+        - ``reloads``: lifetime weight-reload total, delta-accounted per
+          process against baselines persisted IN status (the checkpoint-
+          counter convention: operator restarts never double-count) —
+          each delta ticks ``job_weight_reloads_total``;
+        - ``desiredReplicas``: the traffic-derived target within
+          ``spec.serving`` — consumed by the reconcile's scale sync.
+
+        Returns True when a MATERIAL field changed (readiness membership,
+        desired count, loadedStep, a reload landed): the caller forces a
+        persist + reconcile; rps/latency drift rides the coalescing
+        window like any other telemetry."""
+        sv_beat = heartbeat.get("serving")
+        if not isinstance(sv_beat, dict) or not sv_beat:
+            return False
+        if not serving_mod.is_serve(tj.job.spec):
+            return False
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        state = self._serving.get(key)
+        if state is not None and int(gen) < int(state.get("attempt", 0)):
+            return False  # stale beat from a dead generation
+        if state is None or state.get("attempt") != int(gen):
+            state = {"attempt": int(gen), "procs": {}}
+            self._serving[key] = state
+        now = self._wall_clock()
+        entry: Dict[str, Any] = {"seen": now, "stale": False}
+        entry["ready"] = bool(sv_beat.get("ready"))
+        for field, key_ in (("requestsPerSecond", "rps"),
+                            ("p50LatencySeconds", "p50"),
+                            ("p95LatencySeconds", "p95")):
+            if sv_beat.get(field) is not None:
+                entry[key_] = float(sv_beat[field])
+        for field in ("loadedStep", "reloads"):
+            if sv_beat.get(field) is not None:
+                entry[field] = int(sv_beat[field])
+        state["procs"][int(pid)] = entry
+        _expire_serving_procs(state["procs"], now)
+        while len(state["procs"]) > SERVING_MAX_PROCS:
+            del state["procs"][min(state["procs"],
+                                   key=lambda p: state["procs"][p]["seen"])]
+
+        procs = state["procs"]
+        ready_pids = {p for p, e in procs.items() if e.get("ready")}
+        cur = dict(tj.job.status.serving or {})
+        same_attempt = cur.get("attempt") == int(gen)
+        prev_ready = cur.get("replicasReady")
+        prev_desired = cur.get("desiredReplicas")
+        prev_loaded = cur.get("loadedStep")
+        new: Dict[str, Any] = {}
+        if cur.get("replicas"):
+            new["replicas"] = int(cur["replicas"])
+        new["replicasReady"] = len(ready_pids)
+        total_rps = sum(e.get("rps", 0.0) for e in procs.values())
+        new["requestsPerSecond"] = round(total_rps, 3)
+        for key_, field in (("p50", "p50LatencySeconds"),
+                            ("p95", "p95LatencySeconds")):
+            vals = [e[key_] for p, e in procs.items()
+                    if p in ready_pids and key_ in e]
+            if vals:
+                new[field] = round(max(vals), 6)
+        loaded = [e["loadedStep"] for p, e in procs.items()
+                  if p in ready_pids and "loadedStep" in e]
+        if loaded:
+            new["loadedStep"] = min(loaded)
+        # Reload delta accounting (per process, baselines in status).
+        totals = int(cur.get("reloads", 0))
+        baselines = {str(k): int(v)
+                     for k, v in (cur.get("attemptReloads") or {}).items()} \
+            if same_attempt else {}
+        reported = entry.get("reloads")
+        if reported is not None:
+            baseline = baselines.get(str(int(pid)), 0)
+            delta = reported if reported < baseline else reported - baseline
+            if delta > 0:
+                totals += delta
+                self.metrics.inc("job_weight_reloads_total", delta,
+                                 labels={"namespace": namespace,
+                                         "name": name})
+            baselines[str(int(pid))] = reported
+        if totals:
+            new["reloads"] = totals
+        if baselines:
+            new["attemptReloads"] = baselines
+        fresh = [e["seen"] for e in procs.values() if not e.get("stale")]
+        next_expiry = (min(fresh) + SERVING_EXPIRY_SECONDS) if fresh \
+            else None
+        desired = serving_mod.desired_replicas(total_rps, tj.job.spec)
+        current = int(cur.get("replicas") or 0) \
+            or serving_mod.base_replicas(tj.job.spec)
+        if len(fresh) < current and desired < current:
+            # Partial fleet report (startup, a replica mid-restart, its
+            # beats expired): the aggregate under-counts the real
+            # traffic, and acting on it would scale DOWN on silence —
+            # the first replica to post after a deploy shrank the fleet
+            # under everyone else (caught by the real-binary drive).
+            # Hold the current size; scale-up still acts on partial data
+            # (over-provisioning is the safe direction for serving).
+            desired = current
+        new["desiredReplicas"] = int(desired)
+        new["attempt"] = int(gen)
+        if heartbeat.get("time"):
+            new["time"] = str(heartbeat["time"])
+        tj.job.status.serving = new
+        tj.update_serving_ready(int(gen), ready_pids,
+                                known_pids=set(procs),
+                                next_expiry=next_expiry)
+        self.metrics.set_gauge("job_serving_replicas_ready",
+                               new["replicasReady"],
+                               labels={"namespace": namespace,
+                                       "name": name})
+        self.metrics.set_gauge("job_serving_requests_per_second",
+                               new["requestsPerSecond"],
+                               labels={"namespace": namespace,
+                                       "name": name})
+        for q, field in (("0.5", "p50LatencySeconds"),
+                         ("0.95", "p95LatencySeconds")):
+            if new.get(field) is not None:
+                self.metrics.set_gauge(
+                    "job_serving_latency_seconds", new[field],
+                    labels={"namespace": namespace, "name": name,
+                            "quantile": q})
+        return (new["replicasReady"] != prev_ready
+                or new["desiredReplicas"] != prev_desired
+                or new.get("loadedStep") != prev_loaded
+                or int(cur.get("reloads", 0)) != totals
+                or not same_attempt)
+
+    def _sweep_serving_locked(self, key: str, tj: TrainingJob) -> None:
+        """Reconcile-time serving-expiry sweep (called under _jobs_lock):
+        prune beats older than SERVING_EXPIRY_SECONDS and refresh the
+        readiness roll-up + handoff from what remains — the path that
+        drops a wedged replica (one that stopped posting ANYTHING) out of
+        routing. The serving fold does the same pruning per incoming
+        beat; this covers the no-beats-at-all case, woken exactly on time
+        by the expiry obligation."""
+        if not serving_mod.is_serve(tj.job.spec):
+            return
+        state = self._serving.get(key)
+        if state is None or state.get("attempt") != tj.job.status.attempt:
+            return
+        now = self._wall_clock()
+        procs = state["procs"]
+        staled = _expire_serving_procs(procs, now)
+        if not staled:
+            return
+        ready_pids = {p for p, e in procs.items() if e.get("ready")}
+        fresh = [e["seen"] for e in procs.values() if not e.get("stale")]
+        next_expiry = (min(fresh) + SERVING_EXPIRY_SECONDS) if fresh \
+            else None
+        cur = dict(tj.job.status.serving or {})
+        cur["replicasReady"] = len(ready_pids)
+        cur["requestsPerSecond"] = round(
+            sum(e.get("rps", 0.0) for e in procs.values()), 3)
+        tj.job.status.serving = cur
+        tj.update_serving_ready(tj.job.status.attempt, ready_pids,
+                                known_pids=set(procs),
+                                next_expiry=next_expiry)
+        self.metrics.set_gauge("job_serving_replicas_ready",
+                               len(ready_pids),
+                               labels={"namespace": tj.job.namespace,
+                                       "name": tj.job.name})
+        log.info("serving: %s expired %d stale replica beat(s); "
+                 "%d ready", key, len(staled), len(ready_pids))
 
     def _apply_cadence_locked(self, key: str, tj: TrainingJob, pid: int,
                               heartbeat: Dict[str, Any],

@@ -294,6 +294,24 @@ class Metrics:
                       "(down: the inventory could not host the previous "
                       "size or a straggler was shed; up: capacity returned "
                       "and the gang re-expanded toward maxSlices).")
+        self.register("job_serving_replicas_ready", "gauge",
+                      "Serve-mode replicas whose payload currently posts "
+                      "ready serving beats (their per-replica Services "
+                      "route; a reloading or wedged replica drops out).")
+        self.register("job_serving_requests_per_second", "gauge",
+                      "Aggregate requests/sec across the job's serve "
+                      "replicas, from serving heartbeats — the traffic "
+                      "signal the replica scaler divides by "
+                      "targetRequestsPerSecondPerReplica.")
+        self.register("job_serving_latency_seconds", "gauge",
+                      "Per-request decode latency of the WORST ready "
+                      "replica, by quantile label (0.5 / 0.95) — the "
+                      "tail the serve-mode straggler guard watches.")
+        self.register("job_weight_reloads_total", "counter",
+                      "Hot weight reloads completed by serve replicas "
+                      "(a newer verified snapshot observed in the remote "
+                      "store and rolled in with no attempt bump), "
+                      "delta-accumulated from serving heartbeats.")
         self.register("job_straggler_remediations_total", "counter",
                       "Straggler remediations executed per "
                       "spec.elastic.stragglerPolicy, by policy (replace: "
@@ -571,6 +589,41 @@ def _sanitize_dataplane(dp: Any) -> Tuple[Optional[Dict[str, Any]], str]:
     return (clean or None), ""
 
 
+def _sanitize_serving(sv: Any) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Sanitize a heartbeat's ``serving`` beat down to exactly the CRD
+    schema's shape: (clean-or-None, error). Door discipline per the
+    stepTiming/dataPlane sanitizers — a non-finite or negative value
+    rejects the beat (persisted, it would wedge every later status write
+    against a real apiserver's schema minimums), ``ready`` must be a real
+    boolean (bool("false") is True — a coercion would route traffic to a
+    replica that said it was NOT ready), and unknown keys are dropped
+    silently for forward compatibility."""
+    if not isinstance(sv, dict):
+        return None, "bad heartbeat: serving must be an object"
+    clean: Dict[str, Any] = {}
+    if sv.get("ready") is not None:
+        if not isinstance(sv["ready"], bool):
+            return None, "bad heartbeat: non-boolean serving.ready"
+        clean["ready"] = sv["ready"]
+    for field in ("requestsPerSecond", "p50LatencySeconds",
+                  "p95LatencySeconds"):
+        if sv.get(field) is not None:
+            try:
+                value = float(sv[field])
+            except (TypeError, ValueError):
+                return None, f"bad heartbeat: non-numeric serving.{field}"
+            if not math.isfinite(value) or value < 0:
+                return None, f"bad heartbeat: bad serving.{field}"
+            clean[field] = value
+    for field in ("loadedStep", "reloads"):
+        if sv.get(field) is not None:
+            value, err = _int_field(sv[field], 0, f"serving.{field}")
+            if err:
+                return None, err
+            clean[field] = value
+    return (clean or None), ""
+
+
 def _public_heartbeat(hb: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if not hb:
         return None
@@ -808,6 +861,13 @@ class StatusServer:
                 return False, err
             if clean_dp:
                 hb["dataPlane"] = clean_dp
+        sv = body.get("serving")
+        if sv is not None:
+            clean_sv, err = _sanitize_serving(sv)
+            if err:
+                return False, err
+            if clean_sv:
+                hb["serving"] = clean_sv
         su = body.get("startup")
         if su is not None:
             if not isinstance(su, dict):

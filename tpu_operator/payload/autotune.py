@@ -22,11 +22,14 @@ to pods applied to the data plane itself:
   * **checkpoint cadence**: when CHECKPOINT stalls dominate, the save
     interval stretches (×2 up to ``CHECKPOINT_CADENCE_CAP``× the
     payload's configured interval — never below it, so durability only
-    ever *coarsens* within the bound, and a regression reverts).
-    Single-process jobs only: a gang's save is a collective, so
-    train_loop withholds the checkpointer from the controller in
-    multi-process runs (a unilaterally stretched gate would wedge the
-    gang at the save barrier); the other knobs are per-process-local.
+    ever *coarsens* within the bound, and a regression reverts). A
+    gang's save is a collective, so in multi-process jobs the knob goes
+    through the checkpointer's GANG-AGREED mode (``enable_gang_cadence``
+    + the injectable ``agree_fn`` allgather-min): each base-interval
+    boundary takes the gang MINIMUM of the per-process proposals, so a
+    disagreeing gang saves at the most conservative member's cadence —
+    the stretch only takes effect once every member's controller agrees,
+    and the save barrier can never mismatch.
 
 - :class:`HostPipeline` is the direct residue elimination next to the
   feedback loop: a bounded background thread runs the host iterator's
@@ -602,20 +605,30 @@ class DataPlaneRuntime:
         cadence source the startup ticker uses too, so the autotuner's
         host-budget view and the ticker can never disagree.
 
-        ``processes`` is the gang's process count: the cadence knob is
-        withheld above 1 — a gang's save is a COLLECTIVE, and each
-        process's controller tunes from its own phase sums, so one
-        process stretching the maybe_save gate while a peer doesn't
-        would wedge the gang at the save barrier (multi-process cadence
-        needs a gang-agreed multiplier — future work); the
-        prefetch/host knobs are per-process-local and stay wired."""
+        ``processes`` is the gang's process count: a gang's save is a
+        COLLECTIVE and each process's controller tunes from its own
+        phase sums, so a unilaterally stretched maybe_save gate would
+        wedge the gang at the save barrier. Multi-process jobs therefore
+        get the knob only through the checkpointer's GANG-AGREED mode
+        (``enable_gang_cadence`` — each base-interval boundary
+        allgather-mins the proposals, so a disagreeing gang saves at the
+        most conservative member's cadence and the barrier stays
+        matched); a checkpointer without that surface is withheld, the
+        pre-agreement behavior. The prefetch/host knobs are
+        per-process-local and always stay wired."""
         self._heartbeat = heartbeat
         self._hb_interval = heartbeat_mod.interval_of(heartbeat)
         if self.controller is None:
             return
         self.controller._enable_host_async = self._apply_host_async
-        self.controller._checkpointer = (checkpointer
-                                         if int(processes) <= 1 else None)
+        ck = checkpointer
+        if ck is not None and int(processes) > 1:
+            enable = getattr(ck, "enable_gang_cadence", None)
+            if enable is not None:
+                enable()
+            else:
+                ck = None  # no agreement surface: withhold the knob
+        self.controller._checkpointer = ck
         if recorder is not None:
             recorder.on_commit = self.controller.on_step
         else:

@@ -166,11 +166,17 @@ class Checkpointer:
         # so durability never silently tightens below what the payload
         # asked for, and a regression reverts the stretch. Read at save
         # boundaries on the step-loop thread. In a gang the save is a
-        # COLLECTIVE, so a stretched gate must be gang-uniform: train_loop
-        # wires the checkpointer into the controller only when
-        # process_count == 1 — any caller moving this off 1 in a
-        # multi-process job must gang-agree the value first.
+        # COLLECTIVE, so a stretched gate must be gang-uniform: with
+        # ``enable_gang_cadence()`` the multiplier becomes a PROPOSAL —
+        # at each base-interval boundary every process contributes its
+        # local value to the injectable ``agree_fn`` (allgather-min, the
+        # restore-step pattern) and the gang-wide MINIMUM gates the save,
+        # so a process whose controller hasn't stretched yet keeps
+        # everyone saving (the conservative choice) and the barrier can
+        # never mismatch. Without the flag (single-process, or a caller
+        # that never attached autotune) the local value applies directly.
         self.cadence_multiplier = 1
+        self._cadence_gang_agreed = False
         self.fail_after = max(1, int(fail_after))
         # Injectable for tests; default is the real allgather-min.
         self._agree = agree_fn or gang_agree_step
@@ -264,7 +270,7 @@ class Checkpointer:
         ``fail_after`` consecutive failures."""
         step = int(step)
         self._check_upload_escalation()
-        mult = max(1, int(self.cadence_multiplier))
+        mult = self._effective_cadence_multiplier(step)
         if mult > 1 and step % (self.save_every * mult) != 0:
             # Autotune stretched the cadence: only every mult'th interval
             # boundary saves (orbax's own policy still gates below, so a
@@ -280,6 +286,35 @@ class Checkpointer:
             return False
         self._finalize_pending(block=True)
         return self._save(step, state, force=False)
+
+    def enable_gang_cadence(self) -> None:
+        """Make the cadence multiplier gang-agreed: from now on each
+        base-interval boundary routes the local proposal through
+        ``agree_fn`` (allgather-min) before gating the save. Called by
+        the autotune runtime when it attaches a multi-process job's
+        checkpointer — must be enabled on EVERY process of the gang
+        (attach runs from the same injected env on all of them, so the
+        collective's participation set is uniform by construction)."""
+        self._cadence_gang_agreed = True
+
+    def _effective_cadence_multiplier(self, step: int) -> int:
+        """The multiplier that gates this boundary. Gang-agreed mode runs
+        the agreement collective ONLY at base-interval boundaries
+        (``step % save_every == 0`` — spec-driven, identical on every
+        process, so all members join the allgather at the same steps
+        regardless of their local proposals) and takes the gang MINIMUM:
+        a disagreeing gang saves at the most conservative member's
+        cadence instead of wedging the save barrier."""
+        mult = max(1, int(self.cadence_multiplier))
+        if not self._cadence_gang_agreed or step % self.save_every != 0:
+            return mult
+        try:
+            agreed = self._agree(mult)
+        except Exception:  # noqa: BLE001 — agreement is best-effort
+            log.exception("gang cadence agreement failed; saving at the "
+                          "configured interval")
+            return 1
+        return max(1, int(agreed)) if agreed is not None else 1
 
     def save(self, step: int, state: Any) -> bool:
         """Unconditional save (end-of-run final state, drain); no-op if that

@@ -113,7 +113,8 @@ class HeartbeatReporter:
                checkpoint: Optional[Dict[str, Any]] = None,
                startup: Optional[Dict[str, Any]] = None,
                steptiming: Optional[Dict[str, Any]] = None,
-               dataplane: Optional[Dict[str, Any]] = None) -> bool:
+               dataplane: Optional[Dict[str, Any]] = None,
+               serving: Optional[Dict[str, Any]] = None) -> bool:
         """Post one heartbeat; returns True when the post succeeded. Step
         time is averaged over the steps since the previous post, so it is
         meaningful at any reporting interval.
@@ -152,6 +153,12 @@ class HeartbeatReporter:
         }
         if steptiming:
             body["stepTiming"] = dict(steptiming)
+        if serving:
+            # Serving beats come from EVERY replica (each is its own
+            # server), so — unlike loss/checkpoint/startup — they ride
+            # cadence-only reporters too: readiness and traffic are
+            # per-replica facts the controller aggregates.
+            body["serving"] = dict(serving)
         if startup and not self.cadence_only:
             body["startup"] = dict(startup)
         if dataplane and not self.cadence_only:
@@ -242,10 +249,12 @@ class HeartbeatReporter:
 
     def maybe_report(self, step: int,
                      metrics: Optional[Dict[str, Any]] = None,
-                     checkpoint: Optional[Dict[str, Any]] = None) -> bool:
+                     checkpoint: Optional[Dict[str, Any]] = None,
+                     serving: Optional[Dict[str, Any]] = None) -> bool:
         if not self.due(step):
             return False
-        return self.report(step, metrics, checkpoint=checkpoint)
+        return self.report(step, metrics, checkpoint=checkpoint,
+                           serving=serving)
 
 
 def from_env(env: Optional[Dict[str, str]] = None,

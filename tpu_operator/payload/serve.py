@@ -1,0 +1,629 @@
+"""Serving payload: long-lived batched transformer decode (``mode: serve``).
+
+``python -m tpu_operator.payload.serve`` — the inference half of the
+north star. Where every other payload steps to a finite ``--steps`` and
+exits, this one runs a **decode service**:
+
+- **Batched decode on the GQA path.** The model is the transformer
+  payload's decoder (``models.DecoderBlock`` with grouped-query
+  attention via ``--kv-heads``); on TPU the attention runs the fused
+  Pallas flash-attention kernel, exactly the decode-ready path
+  BENCH_SUITE measures. Each decode step is ONE jitted forward over the
+  whole ``[batch, window]`` request matrix — every active request slot
+  advances one token per step, so throughput scales with batch
+  occupancy, not request count.
+- **Synthetic load generator.** ``--load "rps:seconds,rps:seconds,…"``
+  drives open-loop arrivals at a piecewise-constant requests/sec
+  schedule; each request asks for ``--decode-tokens`` tokens and its
+  latency is measured admission-to-completion. Per-window p50/p95 and
+  requests/sec ride the heartbeat's ``serving`` body into
+  ``status.serving`` and the ``job_serving_*`` metrics.
+- **Readiness protocol.** A replica posts ``ready: true`` only after its
+  weights are loaded AND the first decode step compiled; readiness drops
+  (an immediate forced beat) for the duration of a weight reload — the
+  operator deletes the replica's Service for exactly that window.
+- **Hot weight reload.** A watcher thread polls the remote warm-start
+  store for a newer VERIFIED snapshot (presence of a committed manifest
+  — the PR-8 invariant, so a torn upload can never be "newer"); on
+  observation the loop drops readiness at a step boundary, prefetches
+  the snapshot into the local checkpoint dir, restores through the PR-4
+  verified walk, swaps the params in place, and re-posts ready — no
+  process restart, no attempt bump. Replicas stagger their reloads by
+  ``--reload-stagger × replicaIndex`` so the fleet rolls instead of
+  dropping all capacity at once.
+
+Env contract (trainer/replicas.py injects under ``spec.mode: serve``):
+``TPUJOB_SERVE`` (the mode flag) and ``TPUJOB_SERVE_RELOAD_POLL`` (the
+store watch cadence). The remote store rides the ordinary
+``TPUJOB_STORE_*`` contract; serve replicas are READERS — they never
+attach a write-behind uploader.
+"""
+
+from __future__ import annotations
+
+import argparse
+import logging
+import os
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpu_operator.payload import bootstrap
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.payload import steptrace as steptrace_mod
+from tpu_operator.util import lockdep
+
+log = logging.getLogger(__name__)
+
+# Operator env contract (injected when spec.mode is serve).
+ENV_SERVE = "TPUJOB_SERVE"
+ENV_RELOAD_POLL = "TPUJOB_SERVE_RELOAD_POLL"
+
+# Idle poll when no request slot is active: the loop must not spin.
+IDLE_SLEEP = 0.002
+
+# Consecutive decode failures after which the service gives up: a step
+# that fails persistently (bad mesh, poisoned device) would otherwise
+# spin the loop forever against requests it can never complete — a
+# permanent payload error (exit 1) hands the replica to the operator's
+# per-pod restart machinery instead.
+MAX_CONSECUTIVE_FAILURES = 8
+
+# Default stagger between replica reloads (× replicaIndex): the fleet
+# rolls through a reload instead of dropping every Service at once.
+DEFAULT_RELOAD_STAGGER = 0.5
+
+
+def parse_args(argv=None):
+    p = argparse.ArgumentParser()
+    p.add_argument("--load", default="5:30",
+                   help="requests/sec schedule, 'rps:seconds[,rps:seconds"
+                        "...]' — piecewise-constant open-loop arrivals; "
+                        "the service exits when the schedule ends "
+                        "(0 duration segment = hold forever)")
+    p.add_argument("--batch", type=int, default=4,
+                   help="decode slots: concurrent requests per step")
+    p.add_argument("--decode-tokens", type=int, default=8,
+                   help="tokens generated per request")
+    p.add_argument("--window", type=int, default=64,
+                   help="context window the decode forward runs over")
+    p.add_argument("--vocab", type=int, default=128)
+    p.add_argument("--dim", type=int, default=64)
+    p.add_argument("--heads", type=int, default=4)
+    p.add_argument("--kv-heads", type=int, default=2,
+                   help="grouped-query attention K/V heads (the GQA "
+                        "decode path; 0 = MHA)")
+    p.add_argument("--layers", type=int, default=2)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--checkpoint-dir", default="",
+                   help="weight source (default: $TPU_CHECKPOINT_DIR); "
+                        "restored through the verified walk, hot-reloaded "
+                        "when the remote store commits a newer snapshot")
+    p.add_argument("--reload-poll", type=float,
+                   default=float(os.environ.get(ENV_RELOAD_POLL) or 0) or 10.0,
+                   help="seconds between remote-store newer-snapshot "
+                        "polls (defaults from the operator-injected "
+                        "$TPUJOB_SERVE_RELOAD_POLL)")
+    p.add_argument("--reload-stagger", type=float,
+                   default=DEFAULT_RELOAD_STAGGER,
+                   help="seconds × replicaIndex to delay a reload so the "
+                        "fleet rolls (0 = reload immediately)")
+    return p.parse_args(argv)
+
+
+# --- load generation ----------------------------------------------------------
+
+
+class LoadSchedule:
+    """Piecewise-constant requests/sec over time: ``[(rps, seconds), …]``.
+    A zero-duration final segment holds its rate forever (a real service
+    has no natural end; tests and the bench give finite schedules)."""
+
+    def __init__(self, segments: List[Tuple[float, float]]):
+        if not segments:
+            raise ValueError("load schedule needs at least one segment")
+        for rps, seconds in segments:
+            if rps < 0 or seconds < 0:
+                raise ValueError(
+                    f"load segment ({rps}:{seconds}) must be non-negative")
+        self.segments = list(segments)
+
+    @classmethod
+    def parse(cls, text: str) -> "LoadSchedule":
+        segments = []
+        for part in str(text).split(","):
+            part = part.strip()
+            if not part:
+                continue
+            rate, _, seconds = part.partition(":")
+            segments.append((float(rate), float(seconds or 0)))
+        return cls(segments)
+
+    def rate_at(self, t: float) -> Optional[float]:
+        """Requests/sec at elapsed time ``t``; None = schedule over."""
+        at = 0.0
+        for rps, seconds in self.segments:
+            if seconds <= 0:  # hold forever
+                return rps
+            if t < at + seconds:
+                return rps
+            at += seconds
+        return None
+
+    def duration(self) -> Optional[float]:
+        """Total schedule length, or None for a hold-forever schedule."""
+        total = 0.0
+        for _rps, seconds in self.segments:
+            if seconds <= 0:
+                return None
+            total += seconds
+        return total
+
+
+class LoadGenerator:
+    """Open-loop arrivals at the schedule's rate: deterministic fractional
+    accumulation (rate × elapsed), so a 5 rps segment delivers exactly 5
+    requests per second of wall time regardless of poll cadence."""
+
+    def __init__(self, schedule: LoadSchedule):
+        self.schedule = schedule
+        self._t0: Optional[float] = None
+        self._last: Optional[float] = None
+        self._accum = 0.0
+        self.total_arrivals = 0
+
+    def due(self, now: float) -> Optional[int]:
+        """Arrivals since the previous call; None once the schedule is
+        over (drain what's in flight and exit)."""
+        if self._t0 is None:
+            self._t0 = self._last = now
+            return 0
+        rate = self.schedule.rate_at(now - self._t0)
+        if rate is None:
+            return None
+        self._accum += max(0.0, now - self._last) * rate
+        self._last = now
+        n = int(self._accum)
+        self._accum -= n
+        self.total_arrivals += n
+        return n
+
+
+class LatencyWindow:
+    """Per-request latency samples since the last drain (bounded), plus
+    arrival accounting — the heartbeat's serving body is built from one
+    drain per beat, so each window is disjoint (the steptrace digest
+    discipline)."""
+
+    CAP = 4096
+
+    def __init__(self, clock: Callable[[], float] = time.monotonic):
+        self._clock = clock
+        self._lock = lockdep.lock("LatencyWindow._lock")
+        self._samples: List[float] = []  # guarded-by: _lock
+        self._arrivals = 0  # guarded-by: _lock
+        self._since = clock()  # guarded-by: _lock
+
+    def arrived(self, n: int = 1) -> None:
+        with self._lock:
+            self._arrivals += n
+
+    def record(self, seconds: float) -> None:
+        with self._lock:
+            if len(self._samples) < self.CAP:
+                self._samples.append(float(seconds))
+
+    def drain(self) -> Dict[str, float]:
+        """{requestsPerSecond (offered), p50, p95, completed} over the
+        window since the previous drain; resets the window."""
+        now = self._clock()
+        with self._lock:
+            samples = sorted(self._samples)
+            arrivals, since = self._arrivals, self._since
+            self._samples, self._arrivals, self._since = [], 0, now
+        elapsed = max(1e-9, now - since)
+        out: Dict[str, float] = {
+            "requestsPerSecond": arrivals / elapsed,
+            "completed": float(len(samples)),
+        }
+        if samples:
+            out["p50"] = samples[min(len(samples) - 1,
+                                     int(0.50 * len(samples)))]
+            out["p95"] = samples[min(len(samples) - 1,
+                                     int(0.95 * len(samples)))]
+        return out
+
+
+# --- the decode engine --------------------------------------------------------
+
+
+def build_decode(args, mesh=None):
+    """(mesh, model, template_state, decode_fn, token_spec): the decode
+    forward — the transformer payload's decoder on the flash-attention
+    GQA path — jitted over the whole request matrix. ``template_state``
+    is a full TrainState (optimizer state included) so trainer-written
+    checkpoints restore through the unchanged verified walk; decode only
+    ever reads ``params``."""
+    import jax
+    import jax.numpy as jnp
+    import optax
+
+    from tpu_operator.payload import train
+    from tpu_operator.payload import transformer
+
+    mesh = mesh or train.make_mesh(axis_names=("data", "model"))
+    shim = argparse.Namespace(
+        vocab=args.vocab, dim=args.dim, heads=args.heads,
+        kv_heads=args.kv_heads, layers=args.layers, seq_len=args.window,
+        seq_parallel=1, tensor_parallel=1, split_qkv="auto",
+        sp_mode="ring", sp_layout="contiguous", remat=False)
+    model = transformer._build_model(shim, mesh)
+    tx = optax.adam(1e-3)
+    sample = jnp.zeros((args.batch, args.window), jnp.int32)
+    state = train.create_train_state(model, jax.random.key(args.seed),
+                                     sample, tx)
+    shardings = train.state_shardings(mesh, state)
+    state = train.place_state(mesh, state, shardings)
+
+    from jax.sharding import NamedSharding, PartitionSpec as P
+
+    # The request matrix shards over data only when the slot count
+    # divides the axis; tiny batches (or test meshes wider than the
+    # batch) replicate — decode correctness never depends on it.
+    if args.batch % mesh.shape["data"] == 0:
+        token_sharding = NamedSharding(mesh, P("data", None))
+    else:
+        token_sharding = NamedSharding(mesh, P(None, None))
+
+    def decode(params, tokens):
+        logits = model.apply({"params": params}, tokens)
+        return jnp.argmax(logits[:, -1, :].astype(jnp.float32), axis=-1)
+
+    decode_fn = jax.jit(decode,
+                        in_shardings=(shardings.params, token_sharding),
+                        out_shardings=None)
+    return mesh, model, state, decode_fn, token_sharding
+
+
+class ServeLoop:
+    """One replica's decode service: request slots, the load generator,
+    readiness + reload orchestration, and serving heartbeats.
+
+    Single-threaded decode (the step loop owns the params); the reload
+    WATCHER is the only other thread and it communicates through one
+    flag — the loop performs the actual reload at a step boundary, so
+    the decode forward never races a params swap."""
+
+    def __init__(self, args, info: bootstrap.ProcessInfo,
+                 heartbeat: Optional[Any] = "auto",
+                 store: Optional[Any] = "auto",
+                 recorder: Optional[Any] = "auto",
+                 clock: Callable[[], float] = time.monotonic):
+        import numpy as np
+
+        self.args = args
+        self.info = info
+        self._clock = clock
+        self._np = np
+        if heartbeat == "auto":
+            heartbeat = heartbeat_mod.from_env()
+        self.heartbeat = heartbeat
+        self.recorder = steptrace_mod.from_env() if recorder == "auto" \
+            else recorder
+        if store == "auto":
+            from tpu_operator.payload import warmstore
+
+            store = warmstore.store_from_env() \
+                if os.environ.get(ENV_SERVE) else None
+        self.store = store
+        (self.mesh, self.model, self._state, self._decode,
+         self._token_sharding) = build_decode(args)
+        self.window = LatencyWindow(clock=clock)
+        self.ready = False
+        self.reloads = 0
+        self.failed_steps = 0
+        self._consecutive_failures = 0
+        self.completed = 0
+        self.steps = 0
+        # Request slots: remaining-token budget (<=0 idle) + arrival time.
+        self._budget = [0] * args.batch
+        self._arrived = [0.0] * args.batch
+        self._queue: List[float] = []  # arrival times awaiting a slot
+        self._tokens = np.zeros((args.batch, args.window), np.int32)
+        # Reload handshake between the decode loop (owner of the params)
+        # and the store watcher thread: the loaded step and the pending
+        # target share one lock — the watcher compares-and-arms, the loop
+        # consumes at a step boundary.
+        self._reload_lock = lockdep.lock("ServeLoop._reload_lock")
+        self._loaded_step = 0  # guarded-by: _reload_lock
+        self._reload_target: Optional[int] = None  # guarded-by: _reload_lock
+        self._stop = threading.Event()
+        self._watcher: Optional[threading.Thread] = None
+
+    @property
+    def loaded_step(self) -> int:
+        with self._reload_lock:
+            return self._loaded_step
+
+    def _set_loaded_step(self, step: int) -> None:
+        with self._reload_lock:
+            self._loaded_step = int(step)
+
+    # -- weights ---------------------------------------------------------------
+
+    def _restore_weights(self) -> int:
+        """Restore the newest verified checkpoint into the template state
+        (params swap; the decode fn takes params per call so no
+        recompile). Returns the restored step (0 = fresh init weights)."""
+        from tpu_operator.payload import checkpoint as checkpoint_mod
+
+        directory = self.args.checkpoint_dir \
+            or os.environ.get(checkpoint_mod.ENV_VAR, "")
+        if not directory:
+            return 0
+        # A fresh Checkpointer per (re)load: serve replicas are READERS —
+        # no uploader, no save-side state worth caching across reloads.
+        ck = checkpoint_mod.Checkpointer(directory, save_every=1)
+        try:
+            state, step = ck.restore(self._state)
+        finally:
+            ck.close()
+        self._state = state
+        return int(step)
+
+    def _prefetch_newer(self) -> None:
+        """Materialize the newest healthy remote snapshot into the local
+        checkpoint dir (where the verified walk finds it). Best-effort:
+        a broken store degrades the reload to a no-op, never kills the
+        service."""
+        if self.store is None:
+            return
+        from tpu_operator.payload import checkpoint as checkpoint_mod
+
+        directory = self.args.checkpoint_dir \
+            or os.environ.get(checkpoint_mod.ENV_VAR, "")
+        if not directory:
+            return
+        try:
+            self.store.prefetch_checkpoint(directory)
+        except Exception as e:  # noqa: BLE001 — reload is best-effort
+            log.warning("serve: snapshot prefetch failed: %s", e)
+
+    # -- readiness + heartbeats ------------------------------------------------
+
+    def serving_wire(self) -> Dict[str, Any]:
+        stats = self.window.drain()
+        out: Dict[str, Any] = {
+            "ready": bool(self.ready),
+            "requestsPerSecond": round(stats["requestsPerSecond"], 3),
+            "loadedStep": int(self.loaded_step),
+            "reloads": int(self.reloads),
+        }
+        if "p50" in stats:
+            out["p50LatencySeconds"] = round(stats["p50"], 6)
+            out["p95LatencySeconds"] = round(stats["p95"], 6)
+        return out
+
+    def _post_beat(self, force: bool = False) -> None:
+        hb = self.heartbeat
+        if hb is None:
+            return
+        if force or hb.due(self.steps):
+            hb.report(self.steps, serving=self.serving_wire(),
+                      steptiming=(self.recorder.summary()
+                                  if self.recorder is not None else None))
+
+    def _set_ready(self, ready: bool) -> None:
+        """Readiness transitions post a FORCED beat: the operator's
+        Service gate must learn a reload started NOW, not at the next
+        due interval."""
+        if self.ready == ready:
+            return
+        self.ready = ready
+        self._post_beat(force=True)
+
+    # -- hot reload ------------------------------------------------------------
+
+    def _watch_store(self) -> None:
+        """Watcher thread: a newer VERIFIED remote snapshot (committed
+        manifest — the PR-8 invariant) arms the reload flag; the decode
+        loop executes it at a step boundary."""
+        while not self._stop.wait(max(0.1, float(self.args.reload_poll))):
+            try:
+                newest = self.store.last_uploaded_step()
+            except Exception as e:  # noqa: BLE001 — watch is best-effort
+                log.warning("serve: store poll failed: %s", e)
+                continue
+            if newest is not None and newest > self.loaded_step:
+                with self._reload_lock:
+                    self._reload_target = int(newest)
+
+    def _maybe_reload(self) -> bool:
+        """Step-boundary reload: drop readiness (Service removed),
+        stagger, prefetch + verified restore, swap params, re-post
+        ready. Returns True when a reload ran."""
+        with self._reload_lock:
+            target = self._reload_target
+            self._reload_target = None
+        if target is None:
+            return False
+        log.info("serve: newer verified snapshot (step %d > loaded %d); "
+                 "rolling reload", target, self.loaded_step)
+        self._set_ready(False)
+        stagger = float(self.args.reload_stagger) * self.info.replica_index
+        if stagger > 0:
+            # The roll: replica k waits k×stagger so the fleet never
+            # loses every Service at once.
+            self._stop.wait(stagger)
+        self._prefetch_newer()
+        try:
+            step = self._restore_weights()
+        except Exception:  # noqa: BLE001 — keep serving the old weights
+            log.exception("serve: reload restore failed; continuing on "
+                          "loaded step %d", self.loaded_step)
+            self._set_ready(True)
+            return False
+        if step > self.loaded_step:
+            self._set_loaded_step(step)
+            self.reloads += 1
+            log.info("serve: weights hot-reloaded at step %d "
+                     "(reload %d, no restart)", step, self.reloads)
+        self._set_ready(True)
+        return True
+
+    # -- the decode loop -------------------------------------------------------
+
+    def _admit(self, n: int, now: float) -> None:
+        """Enqueue ``n`` new arrivals, then fill free slots from the
+        BACKLOG — which must happen even with zero new arrivals, or
+        requests queued during an overload burst would starve once the
+        arrival stream pauses (slots free up, nothing pulls the queue)."""
+        if n:
+            self.window.arrived(n)
+            self._queue.extend([now] * n)
+        for slot in range(self.args.batch):
+            if not self._queue:
+                return
+            if self._budget[slot] <= 0:
+                self._arrived[slot] = self._queue.pop(0)
+                self._budget[slot] = int(self.args.decode_tokens)
+                # A fresh request gets a seeded context (request id mixed
+                # in so batches aren't degenerate); a real service would
+                # place the prompt here.
+                self._tokens[slot] = (self._np.arange(self.args.window)
+                                      + self.steps + slot) % self.args.vocab
+
+    def _decode_step(self) -> None:
+        import jax
+
+        rec = self.recorder
+        if rec is not None:
+            rec.begin(self.steps)
+            rec.lap(steptrace_mod.DATA)
+        try:
+            next_tokens = self._decode(self._state.params,
+                                       jax.device_put(
+                                           self._tokens,
+                                           self._token_sharding))
+            next_tokens = self._np.asarray(
+                jax.device_get(next_tokens)).astype(self._np.int32)
+        except Exception:  # noqa: BLE001 — a failed step must be visible
+            self.failed_steps += 1
+            self._consecutive_failures += 1
+            log.exception("serve: decode step failed")
+            if rec is not None:
+                rec.abandon()
+            if self._consecutive_failures >= MAX_CONSECUTIVE_FAILURES:
+                # Persistent failure: this replica can never complete its
+                # requests — spinning against them forever would pin a
+                # core and hide the breakage. Permanent exit; the per-pod
+                # restart path recreates the replica.
+                raise RuntimeError(
+                    f"serve: {self._consecutive_failures} consecutive "
+                    f"decode failures; giving up")
+            return
+        self._consecutive_failures = 0
+        if rec is not None:
+            rec.lap(steptrace_mod.COMPUTE)
+        now = self._clock()
+        for slot in range(self.args.batch):
+            if self._budget[slot] <= 0:
+                continue
+            self._tokens[slot, :-1] = self._tokens[slot, 1:]
+            self._tokens[slot, -1] = next_tokens[slot]
+            self._budget[slot] -= 1
+            if self._budget[slot] <= 0:
+                self.completed += 1
+                self.window.record(now - self._arrived[slot])
+        if rec is not None:
+            rec.lap(steptrace_mod.HOST)
+            rec.commit()
+
+    def run(self, duration: Optional[float] = None) -> Dict[str, Any]:
+        """Serve until the load schedule ends (or ``duration`` caps it);
+        returns a summary the bench asserts on."""
+        schedule = LoadSchedule.parse(self.args.load)
+        gen = LoadGenerator(schedule)
+        self._set_loaded_step(self._restore_weights())
+        # First decode compiled BEFORE readiness: a Service must never
+        # route to a replica that would stall its first request on XLA —
+        # and a replica whose warm-up step FAILED must not go ready
+        # either (the loop below re-earns readiness on its first
+        # successful decode instead of blackholing routed requests).
+        self._decode_step()
+        self.steps += 1
+        self._set_ready(self._consecutive_failures == 0)
+        if self.store is not None:
+            self._watcher = threading.Thread(target=self._watch_store,
+                                             daemon=True,
+                                             name="serve-reload-watch")
+            self._watcher.start()
+        t0 = self._clock()
+        try:
+            while not self._stop.is_set():
+                now = self._clock()
+                if duration is not None and now - t0 >= duration:
+                    break
+                arrivals = gen.due(now)
+                if (arrivals is None and not self._queue
+                        and not any(b > 0 for b in self._budget)):
+                    break  # schedule over, queue + in-flight drained
+                # Fill slots from the backlog EVERY iteration (not only
+                # on new arrivals): a burst queues past the slot count,
+                # and the queued requests must drain as slots free even
+                # after the arrival stream pauses or ends.
+                self._admit(arrivals or 0, now)
+                self._maybe_reload()
+                if any(b > 0 for b in self._budget):
+                    self._decode_step()
+                    self.steps += 1
+                    if not self.ready and self._consecutive_failures == 0:
+                        # A replica whose warm-up (or a transient streak)
+                        # failed re-earns readiness on its first
+                        # successful decode.
+                        self._set_ready(True)
+                else:
+                    time.sleep(IDLE_SLEEP)
+                self._post_beat()
+        finally:
+            self._stop.set()
+            self._set_ready(False)
+            if self._watcher is not None:
+                self._watcher.join(timeout=2.0)
+        return {
+            "steps": self.steps,
+            "completed": self.completed,
+            "arrivals": gen.total_arrivals,
+            "failedSteps": self.failed_steps,
+            "reloads": self.reloads,
+            "loadedStep": self.loaded_step,
+        }
+
+    def stop(self) -> None:
+        self._stop.set()
+
+
+def run(info: bootstrap.ProcessInfo, args=None) -> Dict[str, Any]:
+    args = args or parse_args([])
+    loop = ServeLoop(args, info)
+    summary = loop.run()
+    log.info("serve: %d steps, %d/%d requests completed, %d reloads, "
+             "%d failed steps", summary["steps"], summary["completed"],
+             summary["arrivals"], summary["reloads"],
+             summary["failedSteps"])
+    return summary
+
+
+def main() -> None:
+    """Serve replicas are independent servers: no process group is formed
+    (the operator injects JAX_NUM_PROCESSES=1 under mode: serve, so even
+    bootstrap.initialize would be a single-process no-op) — the
+    run_payload wrapper still owns the exit-code contract: SIGTERM
+    (preemption of one replica) exits 143 → the per-pod restart path
+    recreates exactly that replica."""
+    args = parse_args()
+    bootstrap.main_wrapper(lambda info: run(info, args))
+
+
+if __name__ == "__main__":
+    main()

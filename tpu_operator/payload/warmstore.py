@@ -50,6 +50,9 @@ ENV_BACKEND = "TPUJOB_STORE_BACKEND"
 ENV_URI = "TPUJOB_STORE_URI"
 ENV_PARALLELISM = "TPUJOB_STORE_PARALLELISM"
 ENV_PREFETCH = "TPUJOB_STORE_PREFETCH"
+# Retention GC (spec.store.keepSnapshots > 0): the write-behind worker
+# keeps only the newest N verified snapshots remotely.
+ENV_KEEP = "TPUJOB_STORE_KEEP"
 
 # How long finish_prefetch will wait for the download tail after
 # rendezvous before proceeding cold (the store must never hang startup;
@@ -113,13 +116,19 @@ def uploader_from_env(env: Optional[Dict[str, str]] = None,
         return None
     from tpu_operator.store import writebehind
 
+    try:
+        keep = int(e.get(ENV_KEEP) or 0)
+    except ValueError:
+        log.warning("ignoring malformed %s=%r", ENV_KEEP, e.get(ENV_KEEP))
+        keep = 0
     return writebehind.WriteBehindUploader(
         store,
         fail_after=(fail_after if fail_after is not None
                     else writebehind.DEFAULT_FAIL_AFTER),
         # Resolved at upload time: bootstrap enables the cache after the
         # checkpointer (and thus this uploader) may already exist.
-        cache_dir_fn=startup_mod.cache_dir)
+        cache_dir_fn=startup_mod.cache_dir,
+        keep_snapshots=keep)
 
 
 # --- rendezvous-overlapped prefetch ------------------------------------------

@@ -98,6 +98,36 @@ class WarmStartStore:
         self.backend.delete(self._key(CHECKPOINT_PREFIX,
                                       f"{step}{CORRUPT_SUFFIX}"))
 
+    def retain(self, keep: int) -> int:
+        """Retention GC: condemn-then-delete verified snapshots beyond the
+        newest ``keep`` (0/negative = keep everything). Returns steps
+        removed.
+
+        Ordering is the PR-8 marker-first discipline: the ``.corrupt``
+        marker lands BEFORE any chunk of the victim is deleted, so there
+        is no window in which a half-deleted snapshot looks committed to
+        a fresh-node prefetch or the serve-mode hot-reload watcher. Once
+        the tree (manifest included) is gone the marker itself is removed
+        — a GC'd step is *absence*, not quarantine: leaving the marker
+        would grow an unbounded marker tree, the very thing this GC
+        exists to prevent. A crash between tree-delete and marker-delete
+        leaves a stray marker over nothing, which the next retain() pass
+        ignores (the step is no longer in checkpoint_steps)."""
+        if keep < 1:
+            return 0
+        steps = self.checkpoint_steps()
+        victims = steps[:-keep] if len(steps) > keep else []
+        removed = 0
+        for step in victims:
+            marker = self._key(CHECKPOINT_PREFIX, f"{step}{CORRUPT_SUFFIX}")
+            self.backend.put(marker, b"retention gc")
+            transfer.delete_tree(self.backend, self._step_prefix(step))
+            self.backend.delete(marker)
+            removed += 1
+            log.info("remote store: retention GC removed snapshot step %d "
+                     "(keeping newest %d)", step, keep)
+        return removed
+
     def mark_corrupt(self, step: int, reason: str = "") -> None:
         """Condemn a remote step: marker first (no healthy-looking
         window), then the snapshot itself. Idempotent and best-effort on

@@ -56,9 +56,18 @@ class WriteBehindUploader:
 
     def __init__(self, store: WarmStartStore,
                  fail_after: int = DEFAULT_FAIL_AFTER,
-                 cache_dir_fn: Optional[Any] = None):
+                 cache_dir_fn: Optional[Any] = None,
+                 keep_snapshots: int = 0):
         self.store = store
         self.fail_after = max(1, int(fail_after))
+        # Retention GC (spec.store.keepSnapshots): after each successful
+        # commit the worker condemns-then-deletes verified snapshots
+        # beyond the newest N (0 = keep everything). Runs on the worker
+        # thread, after the commit — the step loop never pays it, and a
+        # failed upload never GCs (the newest durable step must not lose
+        # older fallbacks to a retention pass it didn't earn).
+        self.keep_snapshots = max(0, int(keep_snapshots))
+        self.gc_removed = 0  # guarded-by: _cond
         # Zero-arg callable resolving the live compilation-cache dir at
         # upload time (bootstrap enables the cache after the uploader may
         # already exist); None/"" = no cache sync.
@@ -254,6 +263,16 @@ class WriteBehindUploader:
             self.consecutive_failures = 0
             self.last_uploaded_step = int(step)
         log.info("remote store: uploaded checkpoint step %d", step)
+        if self.keep_snapshots:
+            try:
+                n = self.store.retain(self.keep_snapshots)
+            except Exception as e:  # noqa: BLE001 — GC is best-effort
+                log.warning("retention GC after step %d failed: %s",
+                            step, e)
+                return
+            if n:
+                with self._cond:
+                    self.gc_removed += n
 
     def _sync_cache(self) -> None:
         cache_dir = ""

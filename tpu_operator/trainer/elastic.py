@@ -139,12 +139,20 @@ def sched_kwargs(spec: TPUJobSpec,
 
 def straggler_policy(spec: TPUJobSpec) -> Tuple[str, float]:
     """(policy, patienceSeconds) of the spec's remediation contract —
-    ``("none", 0.0)`` when no elastic block (or an explicit none) makes
-    every flag informational only."""
+    ``("none", 0.0)`` when no elastic/serving block (or an explicit none)
+    makes every flag informational only. Serve jobs carry theirs on
+    ``spec.serving`` (validation restricts it to none/replace — the PR-9
+    detector doubles as the tail-latency guard, and a persistently slow
+    replica is replaced without touching the rest of the fleet)."""
     el = spec.elastic
-    if el is None or el.straggler_policy in ("", StragglerPolicy.NONE):
-        return StragglerPolicy.NONE, 0.0
-    return el.straggler_policy, float(el.straggler_patience_seconds)
+    if el is not None and el.straggler_policy not in ("",
+                                                     StragglerPolicy.NONE):
+        return el.straggler_policy, float(el.straggler_patience_seconds)
+    sv = spec.serving
+    if sv is not None and sv.straggler_policy not in ("",
+                                                      StragglerPolicy.NONE):
+        return sv.straggler_policy, float(sv.straggler_patience_seconds)
+    return StragglerPolicy.NONE, 0.0
 
 
 class RemediationTracker:

@@ -1,0 +1,375 @@
+"""GangRuntime: the mode-agnostic attempt lifecycle of one TPUJob's gang.
+
+Extracted from ``trainer/training.py`` (which had grown the whole child-
+management layer inline) so that BOTH job modes drive one runtime:
+
+- **train** (the classic finite job): whole-gang all-or-none pod creation
+  with rollback, coordinator-first service ordering, per-generation
+  teardown under a bumped attempt;
+- **serve** (``spec.mode: serve``): the same create/teardown machinery,
+  plus readiness-gated per-replica Services (a Service routes only while
+  its replica's payload posts ``ready`` serving beats) and replica
+  trimming for traffic-driven scale-down — no attempt bump, because serve
+  replicas are independent servers, not one JAX process group.
+
+The runtime owns exactly the pieces that are about *children of one
+generation* — replica sets, the per-reconcile read snapshot, client-go
+style create expectations, gang creation/rollback, service sync, node
+exclusions for straggler replacement, and deletion — while the
+:class:`~tpu_operator.trainer.training.TrainingJob` keeps what is about
+the *job*: the phase machine, failure classification and retry budgets,
+scheduling/elastic/serving policy, and status writeback. This split is
+also what unblocks live elastic resize (ROADMAP item 3): resizing is a
+gang-runtime operation (trim/grow a generation) the policy layer can now
+invoke without threading through the phase machine.
+
+``owner`` is the policy-layer object (the TrainingJob): it provides
+``name``/``namespace``/``uid``/``metadata``/``job_spec`` (the EFFECTIVE —
+elastic- or serving-scaled — spec), ``config``, and ``excluded_node``,
+exactly the surface :class:`~tpu_operator.trainer.replicas.TPUReplicaSet`
+already consumes.
+"""
+
+from __future__ import annotations
+
+import logging
+import time
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpu_operator.client import errors
+from tpu_operator.trainer import labels as labels_mod
+from tpu_operator.trainer import replicas as replicas_mod
+from tpu_operator.trainer.snapshot import ReplicaSnapshot
+from tpu_operator.util import lockdep
+from tpu_operator.util.tracing import traced
+
+log = logging.getLogger(__name__)
+
+# Lifetime of an in-flight create expectation (client-go's
+# ControllerExpectations TTL idiom): a pod we created but whose watch event
+# hasn't reached the cache yet is expected — not re-created — for this long.
+# Past the TTL the normal create-if-absent logic takes over again (covers
+# the pathological created-then-deleted-before-ever-observed race).
+EXPECTATION_TTL_SECONDS = 60.0
+
+
+class GangRuntime:
+    """Child management for one job's current generation (mode-agnostic)."""
+
+    def __init__(self, clientset: Any, recorder: Any, owner: Any,
+                 listers: Optional[Any] = None):
+        self.clientset = clientset
+        self.recorder = recorder
+        self.owner = owner
+        self.listers = listers
+        self.replica_sets: List[replicas_mod.TPUReplicaSet] = []
+        # In-flight pod-create expectations (client-go ControllerExpectations):
+        # (role, index, attempt) -> (pod_name, monotonic expiry). Pod names
+        # carry a random suffix, so a stale cache can't be allowed to trigger
+        # a duplicate create the way 409s neutralize it for Services —
+        # instead, a created-but-not-yet-observed pod suppresses re-creation
+        # until the cache shows it (or the attempt moves on / TTL expires).
+        self.expected_pods: Dict[Tuple[str, int, int], Tuple[str, float]] = {}
+        # Nodes a replaced straggler's replacement must avoid, per
+        # (role, index) of the CURRENT attempt (cleared on teardown —
+        # the next generation re-places freely).
+        self.avoid_nodes: Dict[Tuple[str, int], str] = {}
+
+    # -- replica sets ----------------------------------------------------------
+
+    def setup_replicas(self) -> None:
+        """Build TPUReplicaSet instances once (ref: training.go:289-303)
+        from the owner's EFFECTIVE spec (elastic grant / serving scale),
+        so every replica count downstream is the generation's actual one;
+        the policy layer calls :meth:`reset_replicas` when a new grant or
+        scale changes the world."""
+        if self.replica_sets:
+            return
+        for rs_spec in self.owner.job_spec.replica_specs:
+            self.replica_sets.append(
+                replicas_mod.TPUReplicaSet(self.clientset, self.recorder,
+                                           self.owner, rs_spec))
+
+    def reset_replicas(self) -> None:
+        """Drop the cached replica sets (the world changed: new elastic
+        grant, serving scale, or a spec edit)."""
+        self.replica_sets = []
+
+    # -- the per-reconcile read snapshot ---------------------------------------
+
+    def build_snapshot(self) -> ReplicaSnapshot:
+        """One view of this job's children for the whole reconcile pass:
+        from the informer caches via the owner-UID index when the
+        controller attached them (zero RPCs), else from exactly two
+        label-selected LISTs."""
+        if self.listers is not None:
+            return ReplicaSnapshot.from_listers(self.listers,
+                                                self.owner.uid)
+        selector = labels_mod.to_selector(
+            labels_mod.job_labels(self.owner.name,
+                                  self.owner.job_spec.runtime_id))
+        return ReplicaSnapshot.from_clientset(
+            self.clientset, self.owner.namespace, selector)
+
+    def prune_expectations(self, snapshot: ReplicaSnapshot,
+                           attempt: int) -> None:
+        """Drop create expectations that are observed (the cache now shows
+        the pod), obsolete (older generation), or expired."""
+        now = time.monotonic()
+        observed = set(snapshot.pod_names())
+        for key in list(self.expected_pods):
+            name, expires = self.expected_pods[key]
+            if key[2] != attempt or name in observed or now > expires:
+                del self.expected_pods[key]
+
+    def soonest_expectation(self) -> Optional[float]:
+        """Monotonic expiry of the soonest pending create expectation, or
+        None — the policy layer arms a wakeup just past it."""
+        if not self.expected_pods:
+            return None
+        return min(exp for _name, exp in self.expected_pods.values())
+
+    # -- gang pod creation -----------------------------------------------------
+
+    @traced
+    def sync_pods_gang(self, attempt: int,
+                       snapshot: Optional[ReplicaSnapshot] = None) -> None:
+        """Create every missing pod of this generation, all-or-none, fanned
+        across the bounded create pool (``createParallelism``, default 16).
+
+        If any creation fails, the pods created *in this call* are rolled
+        back and the error propagates (→ rate-limited requeue). Without
+        this, two jobs contending for one TPU pod slice each grab part of
+        it and deadlock (SURVEY.md §7 hard part (a)). Serve mode reuses
+        the path verbatim: the replica sets already describe the
+        serving-scaled world, so "missing" is scale-aware for free.
+        """
+        snap = snapshot or self.build_snapshot()
+        self.prune_expectations(snap, attempt)
+        work: List[tuple] = []
+        for rs in self.replica_sets:
+            role = rs.replica_type.lower()
+            for index in rs.missing_pod_indices(attempt, snap):
+                if (role, index, attempt) in self.expected_pods:
+                    continue  # created earlier; cache just hasn't shown it
+                work.append((rs, role, index))
+        if not work:
+            return
+        env_ctx = replicas_mod.EnvContext(
+            self.owner.name, self.owner.job_spec.runtime_id,
+            self.owner.job_spec)
+        created: List[tuple] = []  # (role, index, pod_name)
+        created_lock = lockdep.lock("gang.created_lock")
+
+        def create_one(rs: replicas_mod.TPUReplicaSet, role: str,
+                       index: int) -> None:
+            pod = rs.create_pod_with_index(index, attempt, env_ctx=env_ctx,
+                                           emit_event=False)
+            with created_lock:
+                created.append((role, index, pod["metadata"]["name"]))
+
+        try:
+            replicas_mod.run_creates(
+                [lambda rs=rs, role=role, i=i: create_one(rs, role, i)
+                 for rs, role, i in work],
+                int(getattr(self.owner.config, "create_parallelism",
+                            replicas_mod.DEFAULT_CREATE_PARALLELISM)),
+            )
+        except Exception:
+            # Roll back on ANY failure — API rejection (quota, forbidden) or
+            # a local pod-build error — never leave a partial generation
+            # holding part of a slice.
+            expires = time.monotonic() + EXPECTATION_TTL_SECONDS
+            for role, index, pod_name in created:
+                try:
+                    self.clientset.pods.delete(self.owner.namespace,
+                                               pod_name)
+                except errors.ApiError as e:
+                    if errors.is_not_found(e):
+                        continue
+                    # Delete failed: the pod is STILL LIVE, and the cache may
+                    # not show it yet — an expectation must cover this index
+                    # or the requeued pass would create a duplicate gang
+                    # member for it off the stale snapshot.
+                    log.warning("gang rollback: freeing pod %s failed: %s",
+                                pod_name, e)
+                    self.expected_pods[(role, index, attempt)] = (
+                        pod_name, expires)
+            if self.recorder:
+                self.recorder.event(
+                    self.owner, "Warning", "GangCreateFailed",
+                    f"rolled back {len(created)} pods of attempt {attempt}",
+                )
+            raise
+        expires = time.monotonic() + EXPECTATION_TTL_SECONDS
+        for role, index, pod_name in created:
+            self.expected_pods[(role, index, attempt)] = (pod_name, expires)
+        if self.recorder and created:
+            # ONE aggregated event per gang sync, not one per pod — at 256
+            # workers the per-pod events were their own write storm.
+            self.recorder.event(
+                self.owner, "Normal", "SuccessfulCreate",
+                f"Created {len(created)} pods (gang, attempt {attempt})",
+            )
+
+    # -- services --------------------------------------------------------------
+
+    def sync_headless_service(
+            self, snapshot: Optional[ReplicaSnapshot] = None) -> None:
+        """The job-scoped headless Service (per-pod DNS backbone) — always
+        present in both modes: serve replicas still need stable hostnames
+        for the store watch and the operator's env contract; readiness
+        gates only the per-replica ClusterIP routing."""
+        svc = replicas_mod.headless_service_spec(self.owner)
+        name = svc["metadata"]["name"]
+        if snapshot is not None:
+            exists = snapshot.has_service(name)
+        else:
+            try:
+                self.clientset.services.get(self.owner.namespace, name)
+                exists = True
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    raise
+                exists = False
+        if exists:
+            return
+        try:
+            self.clientset.services.create(self.owner.namespace, svc)
+        except errors.ApiError as e:
+            # Stale snapshot double-create: deterministic name → benign.
+            if not errors.is_already_exists(e):
+                raise
+
+    def sync_services(self, snapshot: ReplicaSnapshot,
+                      ready_indices: Optional[Set[int]] = None,
+                      known_indices: Optional[Set[int]] = None) -> None:
+        """Per-replica Services, coordinator-first ordering preserved by
+        the caller. ``ready_indices`` is the serve-mode readiness gate:
+        when given, a WORKER index's Service is created while the index
+        is ready and DELETED when it is KNOWN not-ready (reload in
+        flight, explicit not-ready beat, expired beats) — an index
+        absent from ``known_indices`` keeps its Service, so evidence
+        gaps (operator restart, a peer's beat not yet arrived) never
+        ungate a healthy fleet. ``None`` (train mode) keeps the
+        unconditional create-if-absent path byte-identical to the
+        pre-serving behavior."""
+        for rs in self.replica_sets:
+            if ready_indices is None:
+                rs.sync_services(snapshot)
+            else:
+                rs.sync_services_gated(
+                    snapshot, ready_indices,
+                    known_indices if known_indices is not None
+                    else ready_indices)
+
+    # -- serve-mode scale-down -------------------------------------------------
+
+    def trim_replicas(self, keep: int,
+                      snapshot: Optional[ReplicaSnapshot] = None) -> int:
+        """Serve-mode scale-down: delete WORKER pods (any attempt) and
+        Services whose task index is ``>= keep``. Returns pods deleted.
+        Safe for independent serve replicas only — the policy layer never
+        calls this on a training gang (losing one member kills the JAX
+        group)."""
+        snap = snapshot or self.build_snapshot()
+        deleted = 0
+        for pod in snap.all_pods():
+            md = pod.get("metadata") or {}
+            lab = md.get("labels") or {}
+            try:
+                index = int(lab.get("task_index", -1))
+            except (TypeError, ValueError):
+                continue
+            if index < keep:
+                continue
+            phase = (pod.get("status") or {}).get("phase")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            try:
+                self.clientset.pods.delete(self.owner.namespace,
+                                           md.get("name", ""))
+                deleted += 1
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("trim: deleting pod %s failed: %s",
+                                md.get("name"), e)
+        # Leftover per-index Services of the old (wider) world: the
+        # snapshot already lists every service, so walk IT rather than
+        # probing a guessed index range (a probe cap leaked services on
+        # scale-downs wider than the cap). Kept: indices below the new
+        # width plus the headless backbone; anything else matching this
+        # job's per-index naming goes.
+        keep_names = {rs.gen_name(index)
+                      for rs in self.replica_sets
+                      for index in range(keep)}
+        keep_names.add(replicas_mod.headless_service_name(
+            self.owner.name, self.owner.job_spec.runtime_id))
+        prefixes = tuple(
+            rs.gen_name(0).rsplit("-", 1)[0] + "-"
+            for rs in self.replica_sets)
+        for name in snap.service_names():
+            if name in keep_names or not name.startswith(prefixes):
+                continue
+            try:
+                self.clientset.services.delete(self.owner.namespace, name)
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("trim: deleting service %s failed: %s",
+                                name, e)
+        # Trimmed indices' in-flight expectations are moot.
+        for key in list(self.expected_pods):
+            if key[1] >= keep:
+                del self.expected_pods[key]
+        return deleted
+
+    # -- teardown --------------------------------------------------------------
+
+    def delete_pods_for_attempt(self, attempt: int) -> None:
+        """Whole-group restart support: delete one generation's pods, keep
+        services (their selectors span attempts). Clears the generation's
+        expectations and node exclusions — the next gang places freely."""
+        for rs in self.replica_sets:
+            rs.delete_pods_for_attempt(attempt)
+        self.expected_pods.clear()
+        self.avoid_nodes.clear()
+
+    def delete_live_pods(self) -> None:
+        """Teardown path: read LIVE state (one job-scoped LIST — not the
+        snapshot, which may miss pods created moments ago) so no live pod
+        survives on cache staleness. Rare by construction (fail/suspend),
+        so the single read doesn't dent the zero-read steady state."""
+        selector = labels_mod.to_selector(
+            labels_mod.job_labels(self.owner.name,
+                                  self.owner.job_spec.runtime_id))
+        for pod in self.clientset.pods.list(self.owner.namespace,
+                                            label_selector=selector):
+            phase = (pod.get("status") or {}).get("phase", "")
+            if phase in ("Succeeded", "Failed"):
+                continue
+            try:
+                self.clientset.pods.delete(
+                    self.owner.namespace, pod["metadata"]["name"]
+                )
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("freeing pod %s: %s",
+                                pod["metadata"]["name"], e)
+        # The pods above died by our own hand: their expectations must not
+        # suppress the re-gang after a resume.
+        self.expected_pods.clear()
+
+    @traced
+    def delete_resources(self) -> None:
+        """Delete children (ref: deleteResources via each replica set's
+        Delete, training.go:423-430 → replicas.go:279-342)."""
+        self.setup_replicas()
+        for rs in self.replica_sets:
+            rs.delete()
+        name = replicas_mod.headless_service_name(
+            self.owner.name, self.owner.job_spec.runtime_id)
+        try:
+            self.clientset.services.delete(self.owner.namespace, name)
+        except errors.ApiError as e:
+            if not errors.is_not_found(e):
+                log.warning("deleting headless service %s: %s", name, e)

@@ -46,8 +46,10 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 from tpu_operator.apis.tpujob import helper
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
+    DEFAULT_SERVE_RELOAD_POLL,
     CacheMedium,
     FailureKind,
+    JobMode,
     RestartPolicy,
     ReplicaState,
     TPUJobSpec,
@@ -272,6 +274,19 @@ def build_replica_env(
         env["TPUJOB_STORE_URI"] = store.uri
         env["TPUJOB_STORE_PARALLELISM"] = str(store.upload_parallelism)
         env["TPUJOB_STORE_PREFETCH"] = "1" if store.prefetch else "0"
+        if store.keep_snapshots:
+            # Retention GC: the write-behind worker keeps only the newest
+            # N verified snapshots remotely (payload/warmstore.py reads).
+            env["TPUJOB_STORE_KEEP"] = str(store.keep_snapshots)
+    if spec.mode == JobMode.SERVE:
+        # Serving mode (payload/serve.py consumes): the mode flag and the
+        # hot-reload watch cadence. Scaling knobs (min/max/target) stay
+        # controller-side — the payload only reports traffic.
+        env["TPUJOB_SERVE"] = "1"
+        sv = spec.serving
+        env["TPUJOB_SERVE_RELOAD_POLL"] = str(
+            sv.reload_poll_seconds if sv is not None
+            else DEFAULT_SERVE_RELOAD_POLL)
     trace = spec.step_trace
     if trace is not None:
         # Data-plane flight recorder (payload/steptrace.py consumes): the
@@ -297,6 +312,18 @@ def build_replica_env(
             env["TPUJOB_DATAPLANE_WINDOW_STEPS"] = str(at.window_steps)
 
     if replica_type == TPUReplicaType.WORKER and workers:
+        if spec.mode == JobMode.SERVE:
+            # Serve replicas are INDEPENDENT decode servers: no
+            # cross-replica JAX process group, no MEGASCALE discovery.
+            # JAX_PROCESS_ID keeps the global index (the replica's
+            # heartbeat identity); JAX_NUM_PROCESSES=1 makes any
+            # bootstrap.initialize a single-process no-op, and the
+            # worker-hostname view collapses to the replica itself.
+            env["JAX_NUM_PROCESSES"] = "1"
+            env["TPU_WORKER_ID"] = "0"
+            env["TPU_WORKER_HOSTNAMES"] = \
+                gen_general_name(job_name, replica_type, runtime_id, index)
+            return env
         num_slices = max(1, spec.num_slices)
         per_slice = max(1, len(workers) // num_slices)
         slice_id = index // per_slice
@@ -469,6 +496,50 @@ class TPUReplicaSet:
                 f"Created {len(created)} {self.replica_type.lower()} "
                 f"service(s)",
             )
+
+    @traced
+    def sync_services_gated(self, snapshot: ReplicaSnapshot,
+                            ready_indices: set,
+                            known_indices: set) -> None:
+        """Serve-mode readiness gating: an index's Service is created
+        while the index is READY (its payload posted a ``ready`` serving
+        beat) and deleted only when it is KNOWN not-ready — an explicit
+        not-ready beat (reload in flight) or expired beats (wedged
+        replica); an index with NO evidence (absent from ``known``)
+        keeps whatever Service it has, so an operator restart — or one
+        replica's beat landing before its peers' — never drops a healthy
+        fleet out of routing. Train mode never calls this
+        (sync_services keeps the unconditional path)."""
+        create = [i for i in self.missing_service_indices(snapshot)
+                  if i in ready_indices]
+        created: List[int] = []
+
+        def create_one(i: int) -> None:
+            if self.create_service_with_index(i, emit_event=False) is not None:
+                created.append(i)
+
+        run_creates([lambda i=i: create_one(i) for i in create],
+                    self._create_parallelism())
+        removed = 0
+        for index in range(self.spec.replicas):
+            if index in ready_indices or index not in known_indices:
+                continue
+            name = self.gen_name(index)
+            if not snapshot.has_service(name):
+                continue
+            try:
+                self.clientset.services.delete(self.job.namespace, name)
+                removed += 1
+            except errors.ApiError as e:
+                if not errors.is_not_found(e):
+                    log.warning("readiness gate: deleting service %s: %s",
+                                name, e)
+        if self.recorder and (created or removed):
+            self.recorder.event(
+                self.job, "Normal", "ServingEndpoints",
+                f"readiness gate: {len(created)} service(s) added, "
+                f"{removed} removed ({len(ready_indices)} replica(s) "
+                f"ready)")
 
     def _create_parallelism(self) -> int:
         config = getattr(self.job, "config", None)

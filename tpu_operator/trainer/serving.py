@@ -1,0 +1,144 @@
+"""Serving-mode policy helpers (``spec.mode: serve``).
+
+The pure half of the serving subsystem, mirroring ``trainer/elastic.py``
+for elastic gangs: replica-count scaling math, the serving-scaled spec
+view the child-management layer consumes, and the readiness bookkeeping
+the controller hands the reconcile.
+
+Scaling model: the controller aggregates every replica's serving
+heartbeats (requests/sec, readiness, latency percentiles, loaded
+snapshot step) into ``status.serving`` and computes a traffic-desired
+replica count within ``spec.serving {minReplicas, maxReplicas,
+targetRequestsPerSecondPerReplica}``; the TrainingJob's reconcile then
+renegotiates its slice reservation through the fleet scheduler (exactly
+the elastic ``resize`` path for slice-per-replica jobs) and runs the
+gang runtime against a SERVING-SCALED spec view — WORKER replicas (and,
+for slice-per-replica jobs, ``numSlices``) reflect the granted count.
+No attempt bump and no gang restart anywhere in the path: serve
+replicas are independent servers, so scaling is pod set arithmetic, not
+a group lifecycle event.
+"""
+
+from __future__ import annotations
+
+import math
+from typing import Any, Dict, Optional, Set, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    DEFAULT_SERVE_TARGET_RPS,
+    JobMode,
+    ServingSpec,
+    TPUJobSpec,
+    TPUReplicaType,
+)
+
+
+def is_serve(spec: TPUJobSpec) -> bool:
+    return spec.mode == JobMode.SERVE
+
+
+def base_replicas(spec: TPUJobSpec) -> int:
+    """The spec'd WORKER replica count — the scaling start point."""
+    return sum(r.replicas for r in spec.replica_specs
+               if r.tpu_replica_type == TPUReplicaType.WORKER)
+
+
+def replica_range(spec: TPUJobSpec) -> Tuple[int, int]:
+    """``(minReplicas, maxReplicas)`` — the spec'd replica count for both
+    bounds when no serving block asks for scaling."""
+    sv: Optional[ServingSpec] = spec.serving
+    base = max(1, base_replicas(spec))
+    if sv is None:
+        return base, base
+    lo = max(1, int(sv.min_replicas))
+    hi = int(sv.max_replicas) or base
+    return lo, max(lo, hi)
+
+
+def target_rps(spec: TPUJobSpec) -> float:
+    sv = spec.serving
+    if sv is None:
+        return DEFAULT_SERVE_TARGET_RPS
+    return float(sv.target_requests_per_second_per_replica)
+
+
+def desired_replicas(total_rps: float, spec: TPUJobSpec) -> int:
+    """Traffic-derived replica target: enough replicas that each serves at
+    most ``targetRequestsPerSecondPerReplica``, clamped to the range.
+    Zero traffic floors at ``minReplicas`` — a serve job never scales to
+    nothing (cold-start latency is the point of keeping it resident)."""
+    lo, hi = replica_range(spec)
+    per = target_rps(spec)
+    if per <= 0:
+        return lo
+    want = int(math.ceil(max(0.0, float(total_rps)) / per))
+    return max(lo, min(hi, want))
+
+
+def serving_replicas(spec: TPUJobSpec,
+                     status_serving: Optional[Dict[str, Any]]
+                     ) -> Optional[int]:
+    """The recorded serving scale that makes the current world differ
+    from the spec'd one, or None when the spec applies as written."""
+    if not is_serve(spec) or not status_serving:
+        return None
+    r = status_serving.get("replicas")
+    if not r:
+        return None
+    r = int(r)
+    if r < 1 or r == max(1, base_replicas(spec)):
+        return None
+    return r
+
+
+def slice_per_replica(spec: TPUJobSpec) -> bool:
+    """True when one serve replica is one whole slice — the configuration
+    whose scaling renegotiates the fleet-scheduler reservation (replica
+    delta == slice delta). ``numSlices == 1`` single-slice jobs scale
+    pods without touching slice accounting."""
+    return spec.num_slices > 1 and spec.num_slices == base_replicas(spec)
+
+
+def scaled_spec(spec: TPUJobSpec, replicas: int) -> TPUJobSpec:
+    """A deep copy of ``spec`` whose WORKER replica count is the serving
+    scale; for slice-per-replica jobs ``numSlices`` follows, so slice
+    demand and the scheduler's accounting stay one-slice-per-replica —
+    EXACTLY the :func:`slice_per_replica` configuration whose scaling
+    renegotiates the reservation (a ``numSlices == 1`` single-worker job
+    must keep ``numSlices`` at 1: its scaling never touches slice
+    accounting, and bumping the view would mint slice demand admission
+    never granted). The persisted spec is never touched: scaling is a
+    per-reconcile view (the elastic discipline)."""
+    eff = TPUJobSpec.from_dict(spec.to_dict())
+    for rs in eff.replica_specs:
+        if rs.tpu_replica_type == TPUReplicaType.WORKER:
+            rs.replicas = max(1, int(replicas))
+    if slice_per_replica(spec):
+        eff.num_slices = max(1, int(replicas))
+    return eff
+
+
+def sched_kwargs(spec: TPUJobSpec,
+                 status_serving: Optional[Dict[str, Any]],
+                 demand: Optional[Tuple[str, int]]
+                 ) -> Tuple[Optional[Tuple[str, int]], Dict[str, Any]]:
+    """(demand, extra ensure_admitted kwargs) for a serve job: once the
+    traffic loop has scaled the replica count, the slice demand is the
+    CURRENT scale — the live admission gate and the controller's restart
+    rebuild must both re-reserve what the job actually runs, never the
+    spec's original count (the elastic ``sched_kwargs`` discipline, one
+    home for the derivation). Non-serve / non-slice-per-replica jobs
+    pass through unchanged."""
+    if not is_serve(spec) or demand is None or not slice_per_replica(spec):
+        return demand, {}
+    key, slices = demand
+    cur = int((status_serving or {}).get("replicas") or 0) or slices
+    return (key, cur), {"held_slices": cur}
+
+
+def ready_indices(spec: TPUJobSpec, ready_pids: Set[int]) -> Set[int]:
+    """Map ready heartbeat process ids onto WORKER task indices. Serve
+    jobs are WORKER-only by validation and the process table orders
+    replica sets in spec order, so for the WORKER set the global process
+    id IS the task index; non-WORKER compat roles never gate."""
+    return {int(p) for p in ready_pids if int(p) >= 0}

@@ -104,6 +104,9 @@ class ReplicaSnapshot:
     def has_service(self, name: str) -> bool:
         return name in self._services
 
+    def service_names(self) -> List[str]:
+        return list(self._services)
+
     def service(self, name: str) -> Optional[Dict[str, Any]]:
         return self._services.get(name)
 

@@ -72,7 +72,6 @@ from __future__ import annotations
 
 import copy
 import logging
-import threading
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
@@ -96,8 +95,9 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
 from tpu_operator.client import errors
 from tpu_operator.scheduler.inventory import job_demand, scheduling_params
 from tpu_operator.trainer import elastic as elastic_mod
-from tpu_operator.trainer import labels as labels_mod
 from tpu_operator.trainer import replicas as replicas_mod
+from tpu_operator.trainer import serving as serving_mod
+from tpu_operator.trainer.gang import EXPECTATION_TTL_SECONDS, GangRuntime
 from tpu_operator.trainer.snapshot import ReplicaSnapshot
 from tpu_operator.util.tracing import traced
 from tpu_operator.util import lockdep
@@ -118,12 +118,8 @@ _now = now_rfc3339
 # "pod ran long enough, forget the backoff" idiom.
 BACKOFF_RESET_SECONDS = 300.0
 
-# Lifetime of an in-flight create expectation (client-go's
-# ControllerExpectations TTL idiom): a pod we created but whose watch event
-# hasn't reached the cache yet is expected — not re-created — for this long.
-# Past the TTL the normal create-if-absent logic takes over again (covers
-# the pathological created-then-deleted-before-ever-observed race).
-EXPECTATION_TTL_SECONDS = 60.0
+# EXPECTATION_TTL_SECONDS now lives with the gang runtime (trainer/gang.py);
+# re-exported above for existing importers.
 
 
 def live_pod(pod: Dict[str, Any]) -> bool:
@@ -164,7 +160,13 @@ class TrainingJob:
         # True while a rate-limited status write is parked in memory; the
         # next_time_obligation arms a retry so it always lands.
         self._writeback_deferred = False
-        self.replica_sets: List[replicas_mod.TPUReplicaSet] = []
+        # The mode-agnostic gang runtime (trainer/gang.py): replica sets,
+        # the per-reconcile snapshot, create expectations, gang creation
+        # with rollback, service sync (readiness-gated in serve mode),
+        # per-generation teardown, and serve-mode replica trimming. This
+        # object is what both train and serve reconciles drive; the
+        # TrainingJob keeps the phase machine and policy.
+        self.gang = GangRuntime(clientset, recorder, self, listers=listers)
         # True only while setup's spec mutations (defaults, runtimeId) await
         # persistence; status writebacks must not overwrite user spec edits.
         self._spec_dirty = False
@@ -172,31 +174,49 @@ class TrainingJob:
         # may echo the object for a few more reconciles, and re-arming the
         # (already past) TTL obligation would hot-loop the reap path.
         self._reaped = False
-        # In-flight pod-create expectations (client-go ControllerExpectations):
-        # (role, index, attempt) -> (pod_name, monotonic expiry). Pod names
-        # carry a random suffix, so a stale cache can't be allowed to trigger
-        # a duplicate create the way 409s neutralize it for Services —
-        # instead, a created-but-not-yet-observed pod suppresses re-creation
-        # until the cache shows it (or the attempt moves on / TTL expires).
-        self._expected_pods: Dict[Tuple[str, int, int], Tuple[str, float]] = {}
         # The full object our own last status write returned: the freshest
         # base we know for the next write (the informer cache may lag it —
         # crucially including the spec persisted by setup's _spec_dirty
         # write, which a stale cached base would silently revert).
         self._last_applied: Optional[Dict[str, Any]] = None
-        # Elastic world view cache: (spec object, granted) -> scaled spec.
+        # Effective world view cache: (spec object, scale) -> scaled spec.
         # Invalidates whenever refresh() swaps the spec object or a new
-        # attempt is granted a different size.
+        # attempt/scale changes the size (elastic grant or serving scale —
+        # exclusive by validation, so one cache serves both).
         self._eff_cache: Optional[Tuple[Any, int, TPUJobSpec]] = None
         # Straggler-remediation handoff from the controller's heartbeat
         # thread to the (single-threaded per key) reconcile: one pending
         # (processId, policy, attempt) slot, latest wins.
         self._rem_lock = lockdep.lock("TrainingJob._rem_lock")
         self._pending_remediation: Optional[Tuple[int, str, int]] = None  # guarded-by: _rem_lock
-        # Nodes a replaced straggler's replacement must avoid, per
-        # (role, index) of the CURRENT attempt (cleared on teardown —
-        # the next generation re-places freely).
-        self._avoid_nodes: Dict[Tuple[str, int], str] = {}
+        # Serving readiness handoff (controller heartbeat thread → the
+        # reconcile's service gating): (attempt, frozenset of READY pids,
+        # frozenset of KNOWN pids — replicas with any serving evidence,
+        # ready or not; an index outside KNOWN keeps its Service, which
+        # is what makes an operator restart routing-neutral — and the
+        # epoch of the earliest beat expiry, the exact-time wakeup that
+        # lets a wedged replica drop out of routing WITHOUT posting
+        # anything; None = no live beats to expire).
+        self._serving_ready: Optional[Tuple[int, frozenset, frozenset,
+                                            Optional[float]]] = None  # guarded-by: _rem_lock
+
+    # -- gang-runtime passthrough (the pre-extraction public surface) ----------
+
+    @property
+    def replica_sets(self) -> List[replicas_mod.TPUReplicaSet]:
+        return self.gang.replica_sets
+
+    @replica_sets.setter
+    def replica_sets(self, value: List[replicas_mod.TPUReplicaSet]) -> None:
+        self.gang.replica_sets = value
+
+    @property
+    def _expected_pods(self) -> Dict[Tuple[str, int, int], Tuple[str, float]]:
+        return self.gang.expected_pods
+
+    @property
+    def _avoid_nodes(self) -> Dict[Tuple[str, int], str]:
+        return self.gang.avoid_nodes
 
     # -- phase transitions (observability: status.phaseTimeline) ---------------
 
@@ -271,12 +291,20 @@ class TrainingJob:
     def effective_spec(self) -> TPUJobSpec:
         spec = self.job.spec
         granted = elastic_mod.granted_slices(spec, self.job.status.elastic)
+        scaler = elastic_mod.scaled_spec
+        if granted is None:
+            # Serving scale (mode: serve; exclusive with elastic by
+            # validation): the recorded replica target reshapes the
+            # WORKER set the same way an elastic grant reshapes a gang.
+            granted = serving_mod.serving_replicas(spec,
+                                                   self.job.status.serving)
+            scaler = serving_mod.scaled_spec
         if granted is None:
             return spec
         cached = self._eff_cache
         if cached is not None and cached[0] is spec and cached[1] == granted:
             return cached[2]
-        eff = elastic_mod.scaled_spec(spec, granted)
+        eff = scaler(spec, granted)
         self._eff_cache = (spec, granted, eff)
         return eff
 
@@ -333,17 +361,12 @@ class TrainingJob:
 
     @traced
     def setup_replicas(self) -> None:
-        """Build TPUReplicaSet instances once (ref: training.go:289-303).
-        Built from the EFFECTIVE spec (elastic jobs: the granted world),
-        so every replica count downstream is the attempt's actual one;
-        ``_sync_elastic`` clears the cached sets when a new attempt's
-        grant changes the world."""
-        if self.replica_sets:
-            return
-        for rs_spec in self.job_spec.replica_specs:
-            self.replica_sets.append(
-                replicas_mod.TPUReplicaSet(self.clientset, self.recorder, self, rs_spec)
-            )
+        """Build TPUReplicaSet instances once (ref: training.go:289-303)
+        via the gang runtime — from the EFFECTIVE spec (elastic grant or
+        serving scale), so every replica count downstream is the
+        attempt's actual one; ``_sync_elastic``/``_sync_serving`` reset
+        the cached sets when the world changes."""
+        self.gang.setup_replicas()
 
     # -- cluster spec (ref: training.go:103-118) -------------------------------
 
@@ -360,113 +383,20 @@ class TrainingJob:
     # -- the per-reconcile read snapshot --------------------------------------
 
     def build_snapshot(self) -> ReplicaSnapshot:
-        """One view of this job's children for the whole reconcile pass:
-        from the informer caches via the owner-UID index when the controller
-        attached them (zero RPCs), else from exactly two label-selected
-        LISTs (the informer-less fallback — still constant, where the seed
-        paid ~4·N per-index reads)."""
-        if self.listers is not None:
-            return ReplicaSnapshot.from_listers(self.listers, self.uid)
-        selector = labels_mod.to_selector(
-            labels_mod.job_labels(self.name, self.job_spec.runtime_id))
-        return ReplicaSnapshot.from_clientset(
-            self.clientset, self.namespace, selector)
-
-    def _prune_expectations(self, snapshot: ReplicaSnapshot,
-                            attempt: int) -> None:
-        """Drop create expectations that are observed (the cache now shows
-        the pod), obsolete (older generation), or expired."""
-        now = time.monotonic()
-        observed = set(snapshot.pod_names())
-        for key in list(self._expected_pods):
-            name, expires = self._expected_pods[key]
-            if key[2] != attempt or name in observed or now > expires:
-                del self._expected_pods[key]
+        """One view of this job's children for the whole reconcile pass
+        (gang runtime: informer indexes when attached — zero RPCs — else
+        two label-selected LISTs)."""
+        return self.gang.build_snapshot()
 
     # -- gang pod creation ----------------------------------------------------
 
     @traced
     def sync_pods_gang(self, attempt: int,
                        snapshot: Optional[ReplicaSnapshot] = None) -> None:
-        """Create every missing pod of this generation, all-or-none, fanned
-        across the bounded create pool (``createParallelism``, default 16):
-        a 256-pod gang costs ~N/16 create round trips instead of N.
-
-        If any creation fails, the pods created *in this call* are rolled
-        back and the error propagates (→ rate-limited requeue). Without this,
-        two jobs contending for one TPU pod slice each grab part of it and
-        deadlock (SURVEY.md §7 hard part (a); BASELINE.md config 5).
-
-        Missing-index classification runs against the snapshot; pods this
-        TrainingJob already created but the cache hasn't echoed yet are
-        covered by the create expectations, so a lagging cache never
-        double-creates a gang member.
-        """
-        snap = snapshot or self.build_snapshot()
-        self._prune_expectations(snap, attempt)
-        work: List[tuple] = []
-        for rs in self.replica_sets:
-            role = rs.replica_type.lower()
-            for index in rs.missing_pod_indices(attempt, snap):
-                if (role, index, attempt) in self._expected_pods:
-                    continue  # created earlier; cache just hasn't shown it
-                work.append((rs, role, index))
-        if not work:
-            return
-        env_ctx = replicas_mod.EnvContext(
-            self.name, self.job_spec.runtime_id, self.job_spec)
-        created: List[tuple] = []  # (role, index, pod_name)
-        created_lock = lockdep.lock("training.created_lock")
-
-        def create_one(rs: replicas_mod.TPUReplicaSet, role: str,
-                       index: int) -> None:
-            pod = rs.create_pod_with_index(index, attempt, env_ctx=env_ctx,
-                                           emit_event=False)
-            with created_lock:
-                created.append((role, index, pod["metadata"]["name"]))
-
-        try:
-            replicas_mod.run_creates(
-                [lambda rs=rs, role=role, i=i: create_one(rs, role, i)
-                 for rs, role, i in work],
-                int(getattr(self.config, "create_parallelism",
-                            replicas_mod.DEFAULT_CREATE_PARALLELISM)),
-            )
-        except Exception:
-            # Roll back on ANY failure — API rejection (quota, forbidden) or
-            # a local pod-build error — never leave a partial generation
-            # holding part of a slice.
-            expires = time.monotonic() + EXPECTATION_TTL_SECONDS
-            for role, index, pod_name in created:
-                try:
-                    self.clientset.pods.delete(self.namespace, pod_name)
-                except errors.ApiError as e:
-                    if errors.is_not_found(e):
-                        continue
-                    # Delete failed: the pod is STILL LIVE, and the cache may
-                    # not show it yet — an expectation must cover this index
-                    # or the requeued pass would create a duplicate gang
-                    # member for it off the stale snapshot.
-                    log.warning("gang rollback: freeing pod %s failed: %s",
-                                pod_name, e)
-                    self._expected_pods[(role, index, attempt)] = (
-                        pod_name, expires)
-            if self.recorder:
-                self.recorder.event(
-                    self, "Warning", "GangCreateFailed",
-                    f"rolled back {len(created)} pods of attempt {attempt}",
-                )
-            raise
-        expires = time.monotonic() + EXPECTATION_TTL_SECONDS
-        for role, index, pod_name in created:
-            self._expected_pods[(role, index, attempt)] = (pod_name, expires)
-        if self.recorder and created:
-            # ONE aggregated event per gang sync, not one per pod — at 256
-            # workers the per-pod events were their own write storm.
-            self.recorder.event(
-                self, "Normal", "SuccessfulCreate",
-                f"Created {len(created)} pods (gang, attempt {attempt})",
-            )
+        """Create every missing pod of this generation, all-or-none with
+        rollback, via the gang runtime (see GangRuntime.sync_pods_gang —
+        the machinery is mode-agnostic; serve mode reuses it verbatim)."""
+        self.gang.sync_pods_gang(attempt, snapshot)
 
     # -- status (ref: training.go:132-168) -------------------------------------
 
@@ -790,6 +720,13 @@ class TrainingJob:
         if not finished_despite_eviction and not self._sync_elastic():
             self.update_crd_status()
             return
+        # Serving scale (mode: serve; exclusive with elastic): follow the
+        # controller's traffic-derived desired replica count, renegotiating
+        # the slice reservation through the scheduler — no attempt bump,
+        # no gang restart; scale-down trims pods/services past the target.
+        if not finished_despite_eviction and not self._sync_serving():
+            self.update_crd_status()
+            return
         self.setup_replicas()
 
         # ONE cache snapshot for the whole pass: every classification below
@@ -815,9 +752,30 @@ class TrainingJob:
 
         # Services first: the coordinator's DNS name must resolve before any
         # worker calls jax.distributed.initialize (SURVEY.md hard part (c)).
+        # Serve mode gates the per-replica Services on readiness — a
+        # Service exists only while its replica's payload posts ``ready``
+        # serving beats (created on the ready beat, deleted when readiness
+        # is lost, restored on return); with NO serving evidence yet for
+        # this generation (fresh job, or a freshly restarted operator
+        # whose in-memory map is empty while the fleet serves) the
+        # Service set is left untouched. Train mode keeps the
+        # unconditional path byte-identical.
         self._sync_headless_service(snap)
-        for rs in self.replica_sets:
-            rs.sync_services(snap)
+        if serving_mod.is_serve(self.job.spec):
+            gate = self._serving_gate()
+            if gate is not None:
+                ready, known = gate
+                self.gang.sync_services(snap, ready_indices=ready,
+                                        known_indices=known)
+            # Level-triggered scale-down: pods the watch cache hadn't
+            # echoed when the scale-down pass trimmed appear later (their
+            # create events re-enqueue this job) and must still go — a
+            # one-shot trim against a stale snapshot leaked them forever
+            # (review finding). No-op at the current width.
+            self.gang.trim_replicas(
+                max(1, serving_mod.base_replicas(self.job_spec)), snap)
+        else:
+            self.gang.sync_services(snap)
         self.sync_pods_gang(attempt, snap)
 
         state, statuses = self.get_status(snap)
@@ -912,28 +870,9 @@ class TrainingJob:
         self._release_slices()
 
     def _delete_live_pods(self) -> None:
-        """Teardown path: read LIVE state (one job-scoped LIST — not the
-        snapshot, which may miss pods created moments ago) so no live pod
-        survives on cache staleness. Rare by construction (fail/suspend),
-        so the single read doesn't dent the zero-read steady state."""
-        selector = labels_mod.to_selector(
-            labels_mod.job_labels(self.name, self.job_spec.runtime_id))
-        for pod in self.clientset.pods.list(self.namespace,
-                                            label_selector=selector):
-            phase = (pod.get("status") or {}).get("phase", "")
-            if phase in ("Succeeded", "Failed"):
-                continue
-            try:
-                self.clientset.pods.delete(
-                    self.namespace, pod["metadata"]["name"]
-                )
-            except errors.ApiError as e:
-                if not errors.is_not_found(e):
-                    log.warning("freeing pod %s: %s",
-                                pod["metadata"]["name"], e)
-        # The pods above died by our own hand: their expectations must not
-        # suppress the re-gang after a resume.
-        self._expected_pods.clear()
+        """Teardown path (gang runtime): delete LIVE pods off a fresh
+        job-scoped LIST so no live pod survives on cache staleness."""
+        self.gang.delete_live_pods()
 
     def _record_failure(self, attempt: int, kind: str, reason: str) -> None:
         """Record one classified failure: an entry in the ``status.failures``
@@ -1048,14 +987,10 @@ class TrainingJob:
         self._record_failure(attempt, kind, reason)
         if not self._within_restart_budget(kind, reason):
             return False
-        for rs in self.replica_sets:
-            rs.delete_pods_for_attempt(attempt)
-        # The torn-down generation's in-flight create expectations are
-        # moot; the next attempt's creates register their own. Node
-        # exclusions from replace-remediations die with the generation
-        # too — the next gang places freely (and may be sized anew).
-        self._expected_pods.clear()
-        self._avoid_nodes.clear()
+        # Gang runtime: delete the generation's pods and drop its
+        # in-flight create expectations + replace-remediation node
+        # exclusions — the next gang places freely (and may be sized anew).
+        self.gang.delete_pods_for_attempt(attempt)
         self.job.status.attempt = attempt + 1
         return True
 
@@ -1103,8 +1038,10 @@ class TrainingJob:
         demand, kwargs = elastic_mod.sched_kwargs(
             self.job.spec, self.job.status.elastic,
             job_demand(self.job.spec))
+        demand, serve_kwargs = serving_mod.sched_kwargs(
+            self.job.spec, self.job.status.serving, demand)
         return {"demand": demand, "priority": priority, "queue": queue,
-                **kwargs}
+                **kwargs, **serve_kwargs}
 
     def _holds_hardware(self) -> bool:
         """Rebuild signal for the scheduler's restart path: this job's
@@ -1288,6 +1225,116 @@ class TrainingJob:
             # The world changed: the cached replica sets (and with them
             # every pod count and env build) describe the old size.
             self.replica_sets = []
+        return True
+
+    # -- serving mode (readiness gating + traffic-driven scaling) --------------
+
+    def _serving_gate(self) -> Optional[Tuple[set, set]]:
+        """Serve-mode readiness gate for the per-replica Services:
+        ``(ready_indices, known_indices)`` — a Service is created for a
+        READY index and deleted only for a KNOWN-not-ready one; an index
+        with NO evidence keeps whatever Service it has. That per-replica
+        absence rule is what makes an operator restart routing-neutral:
+        a fresh in-memory serving map (or one replica's first beat
+        arriving before its peers') must never ungate the still-silent
+        rest of a healthy fleet (review finding). None = no evidence for
+        this generation at all — the reconcile skips gating entirely."""
+        with self._rem_lock:
+            handoff = self._serving_ready
+        if handoff is None:
+            return None
+        attempt, ready, known, _expiry = handoff
+        if attempt != self.job.status.attempt:
+            return None  # evidence belongs to a previous generation
+        return (serving_mod.ready_indices(self.job_spec, set(ready)),
+                serving_mod.ready_indices(self.job_spec, set(known)))
+
+    def update_serving_ready(self, attempt: int, ready_pids: set,
+                             known_pids: Optional[set] = None,
+                             next_expiry: Optional[float] = None) -> None:
+        """Controller handoff (heartbeat thread OR the reconcile-time
+        expiry sweep): the processes whose serving beats currently say
+        ``ready``, every process with ANY serving evidence (stale
+        included — a staled entry is known-not-ready, an absent one is
+        unknown), and the epoch at which the earliest live beat goes
+        stale — fed into ``next_time_obligation`` so the deadline
+        manager wakes a reconcile exactly then and a wedged replica's
+        Service is removed without it posting anything. One slot,
+        latest wins."""
+        with self._rem_lock:
+            self._serving_ready = (
+                int(attempt), frozenset(ready_pids),
+                frozenset(known_pids if known_pids is not None
+                          else ready_pids),
+                next_expiry)
+
+    def _serving_expiry_epoch(self) -> Optional[float]:
+        """Epoch of the next serving-beat expiry (serve mode only)."""
+        if not serving_mod.is_serve(self.job.spec):
+            return None
+        with self._rem_lock:
+            handoff = self._serving_ready
+        if handoff is None or handoff[0] != self.job.status.attempt:
+            return None
+        return handoff[3]
+
+    def _sync_serving(self) -> bool:
+        """Follow the controller's traffic-derived replica target
+        (``status.serving.desiredReplicas``) — serve mode only; train
+        mode no-ops True. Renegotiates the slice reservation through the
+        fleet scheduler for slice-per-replica jobs (the elastic resize
+        path — admission-queue arbitration, not a free grab), records the
+        granted count in ``status.serving.replicas``, trims pods and
+        Services past a scale-down target, and resets the cached replica
+        sets so the next sync builds the new world. NO attempt bump and
+        no restart anywhere: serve replicas are independent servers.
+        Returns False only when even ``minReplicas`` no longer fits the
+        inventory (the job parks in Queued, like an elastic floor miss)."""
+        spec = self.job.spec
+        if not serving_mod.is_serve(spec):
+            return True
+        status = self.job.status
+        sv = dict(status.serving or {})
+        lo, hi = serving_mod.replica_range(spec)
+        base = max(1, serving_mod.base_replicas(spec))
+        current = int(sv.get("replicas") or base)
+        desired = int(sv.get("desiredReplicas") or current)
+        desired = max(lo, min(hi, desired))
+        if desired == current and sv.get("replicas"):
+            return True
+        granted = desired
+        if (self.scheduler is not None
+                and serving_mod.slice_per_replica(spec)
+                and job_demand(spec) is not None):
+            g = self.scheduler.resize(self._sched_key(), uid=self.uid,
+                                      min_slices=min(lo, current),
+                                      max_slices=desired)
+            if g is None:
+                self._park_queued()
+                return False
+            granted = int(g)
+        sv["replicas"] = int(granted)
+        status.serving = sv
+        if granted != current:
+            direction = "down" if granted < current else "up"
+            # The recorded scale must land BEFORE the replica sets
+            # rebuild: they are built from the effective (serving-scaled)
+            # spec, and a trim against sets describing the OLD width
+            # would leave the runtime asking a shrunken world for the
+            # trimmed indices.
+            self.gang.reset_replicas()
+            self._eff_cache = None
+            if direction == "down":
+                # Independent servers: trimming is safe (and the point).
+                self.gang.setup_replicas()
+                self.gang.trim_replicas(granted, self.build_snapshot())
+            if self.recorder:
+                self.recorder.event(
+                    self, "Normal", "ServingScaled",
+                    f"serving replicas {current} -> {granted} "
+                    f"(desired {desired} from traffic, range {lo}-{hi})")
+            log.info("serving: %s scaled %d -> %d (desired %d)",
+                     self._sched_key(), current, granted, desired)
         return True
 
     def excluded_node(self, replica_type: str, index: int) -> Optional[str]:
@@ -1517,6 +1564,10 @@ class TrainingJob:
                     parse_rfc3339(self.job.status.backoff_until))
             candidates.append(self._stall_epoch())
             candidates.append(self._deadline_epoch())
+            # Serve mode: the earliest serving-beat expiry — the wakeup
+            # that removes a wedged replica's Service on time even when
+            # no event (beat, resync) would otherwise reconcile.
+            candidates.append(self._serving_expiry_epoch())
             if self._expected_pods:
                 # A pending create expectation is in-flight state: if the
                 # created pod dies before ANY watch event shows it (so the
@@ -1566,42 +1617,15 @@ class TrainingJob:
 
     def _sync_headless_service(
             self, snapshot: Optional[ReplicaSnapshot] = None) -> None:
-        svc = replicas_mod.headless_service_spec(self)
-        name = svc["metadata"]["name"]
-        if snapshot is not None:
-            exists = snapshot.has_service(name)
-        else:
-            try:
-                self.clientset.services.get(self.namespace, name)
-                exists = True
-            except errors.ApiError as e:
-                if not errors.is_not_found(e):
-                    raise
-                exists = False
-        if exists:
-            return
-        try:
-            self.clientset.services.create(self.namespace, svc)
-        except errors.ApiError as e:
-            # Stale snapshot double-create: deterministic name → benign.
-            if not errors.is_already_exists(e):
-                raise
+        self.gang.sync_headless_service(snapshot)
 
     # -- delete (ref: training.go:305-323) -------------------------------------
 
     @traced
     def delete_resources(self) -> None:
-        """Delete children (ref: deleteResources via each replica set's
-        Delete, training.go:423-430 → replicas.go:279-342)."""
-        self.setup_replicas()
-        for rs in self.replica_sets:
-            rs.delete()
-        name = replicas_mod.headless_service_name(self.name, self.job.spec.runtime_id)
-        try:
-            self.clientset.services.delete(self.namespace, name)
-        except errors.ApiError as e:
-            if not errors.is_not_found(e):
-                log.warning("deleting headless service %s: %s", name, e)
+        """Delete children (gang runtime; ref: deleteResources via each
+        replica set's Delete, training.go:423-430 → replicas.go:279-342)."""
+        self.gang.delete_resources()
 
     @traced
     def delete(self) -> None:
