@@ -78,6 +78,12 @@ def parse_args(argv=None):
                         "workers; exits nonzero if p99 reconcile latency, "
                         "the status-write budget, or the zero-read steady "
                         "state regresses (--quick: a few hundred jobs)")
+    p.add_argument("--churn", action="store_true",
+                   help="run the create-run-delete churn soak: >=200 "
+                        "cycles through the real operator with the "
+                        "joblife witness on — zero per-job state "
+                        "residue, flat /metrics series count, bounded "
+                        "RSS, or exit nonzero")
     p.add_argument("--checkpoint", action="store_true",
                    help="run ONLY the checkpoint durability micro-rows "
                         "(CPU-hostable): verified-save + restore latency vs "
@@ -1261,6 +1267,246 @@ def _fleet_ok(rows: list) -> bool:
     return ok
 
 
+def bench_churn(quick: bool) -> list:
+    """Create-run-delete churn soak: batches of jobs cycled through the
+    REAL operator (REST clientset over the in-process apiserver, kubelet
+    sim succeeding pods, status server attached) with the joblife
+    witness ON. Every job posts heartbeats (step/cadence/dataPlane) so
+    the per-job state paths — heartbeat stash, gang cadence, goodput/
+    prefetch/autotune series — are all populated before its deletion;
+    each deletion reconcile then sweeps every `# per-job:` container and
+    the metric registry for residue. The gate (ROADMAP item 5's "no
+    leaked metric series and bounded memory" as an enforced budget):
+    ZERO witness violations across >=200 create-delete cycles, a FLAT
+    registry series count after the warmup batches, and bounded RSS
+    growth."""
+    import copy as copy_mod
+    import gc
+    import threading
+
+    from tpu_operator.apis.tpujob.v1alpha1.types import ControllerConfig
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.client.informer import SharedInformerFactory
+    from tpu_operator.client.rest import Clientset, RestConfig
+    from tpu_operator.controller.controller import Controller
+    from tpu_operator.controller.statusserver import StatusServer
+    from tpu_operator.testing.apiserver import ApiServerHarness
+    from tpu_operator.util import joblife
+
+    joblife.enable()
+    joblife.reset()
+    batch = 8
+    batches = 27 if quick else 75   # 216 / 600 create-delete cycles
+    capacity = 4                    # half of each batch parks Queued first
+    warmup_batches = 2              # series/RSS baselines after this many
+    rss_budget_mb = 48.0 if quick else 80.0
+    batch_deadline_s = 30.0
+
+    def rss_mb() -> float:
+        try:
+            with open("/proc/self/status") as f:
+                for line in f:
+                    if line.startswith("VmRSS:"):
+                        return float(line.split()[1]) / 1024.0
+        except OSError:
+            pass
+        return 0.0
+
+    backing = FakeClientset()
+    series_base = rss_base = None
+    cycles = 0
+    t0 = time.perf_counter()
+    with ApiServerHarness(clientset=backing) as srv:
+        clientset = Clientset(RestConfig(host=srv.url, timeout=30.0))
+        config = ControllerConfig(
+            slice_inventory={FLEET_SLICE_KEY: capacity})
+        factory = SharedInformerFactory(clientset, "default",
+                                        resync_period=600.0)
+        controller = Controller(clientset, factory, config, "default",
+                                shards=2)
+        clientset.rest.metrics = controller.metrics
+        metrics = controller.metrics
+        status = StatusServer(0, controller=controller, metrics=metrics)
+        status.start()
+
+        stop = threading.Event()
+        runner = threading.Thread(target=controller.run, args=(2, stop),
+                                  daemon=True)
+        runner.start()
+
+        pod_watch = backing.pods.watch("default")
+
+        def kubelet_sim() -> None:
+            for event_type, pod in pod_watch:
+                if event_type not in ("ADDED", "MODIFIED"):
+                    continue
+                if (pod.get("status") or {}).get("phase"):
+                    continue
+                pod = copy_mod.deepcopy(pod)
+                pod["status"] = {
+                    "phase": "Succeeded",
+                    "containerStatuses": [{
+                        "name": "tpu",
+                        "state": {"terminated": {"exitCode": 0}}}]}
+                try:
+                    backing.pods.update("default", pod)
+                except Exception:
+                    continue  # raced a teardown
+
+        kubelet = threading.Thread(target=kubelet_sim, daemon=True)
+        kubelet.start()
+
+        def wait_until(cond, what: str) -> None:
+            end = time.monotonic() + batch_deadline_s
+            while time.monotonic() < end:
+                if cond():
+                    return
+                time.sleep(0.02)
+            phases: dict = {}
+            for j in backing.tpujobs.list("default"):
+                p = (j.get("status") or {}).get("phase") or "None"
+                phases[p] = phases.get(p, 0) + 1
+            raise RuntimeError(
+                f"churn soak stalled waiting for {what}; phases={phases}; "
+                f"scheduler={controller.scheduler.summary()}; "
+                f"queue_len={len(controller.queue)}")
+
+        try:
+            for b in range(batches):
+                names = [f"cj-{b:03d}-{i}" for i in range(batch)]
+                for i, name in enumerate(names):
+                    backing.tpujobs.create(
+                        "default",
+                        _fleet_job(name, queue=("a", "b")[i % 2]))
+
+                def all_done() -> bool:
+                    phases = {j["metadata"]["name"]:
+                              (j.get("status") or {}).get("phase")
+                              for j in backing.tpujobs.list("default")}
+                    return all(phases.get(n) == "Done" for n in names)
+
+                wait_until(all_done, f"batch {b} Done")
+                # Populate the per-job telemetry state for one member:
+                # process 0's full stream (heartbeat stash, goodput,
+                # prefetch gauge, autotune counters) plus a process-1
+                # cadence beat (gang-cadence map + straggler gauge).
+                for pid in (0, 1):
+                    ok, msg = status.record_heartbeat({
+                        "namespace": "default", "name": names[0],
+                        "processId": pid, "step": 10 + pid,
+                        "stepTimeSeconds": 0.1, "loss": 1.0,
+                        "stepTiming": {"steps": 10,
+                                       "stepP95Seconds": 0.1,
+                                       "stepLocalP95Seconds": 0.01},
+                        "dataPlane": {"prefetchDepth": 2,
+                                      "adjustments": {"prefetchUp": 1}},
+                    })
+                    if not ok:
+                        raise RuntimeError(f"churn heartbeat refused: {msg}")
+                for name in names:
+                    backing.tpujobs.delete("default", name)
+                wait_until(lambda: len(controller.jobs) == 0,
+                           f"batch {b} deletion reconciles")
+                wait_until(lambda: not any(
+                    metrics.job_series("default", n) for n in names),
+                    f"batch {b} metric prune")
+                controller.run_gc_once()  # orphaned pods/services
+                cycles += batch
+                if joblife.violation_count():
+                    break  # fail fast; the rows below carry the report
+                if b + 1 == warmup_batches:
+                    gc.collect()
+                    series_base = metrics.series_count()
+                    rss_base = rss_mb()
+        finally:
+            stop.set()
+            pod_watch.stop()
+            status.stop()
+            runner.join(timeout=10.0)
+            kubelet.join(timeout=5.0)
+
+    gc.collect()
+    wall_s = time.perf_counter() - t0
+    violations = joblife.violation_count()
+    residual = joblife.total_entries()
+    series_growth = (metrics.series_count() - series_base
+                     if series_base is not None else None)
+    rss_growth = (rss_mb() - rss_base if rss_base is not None else None)
+    return [
+        {
+            "metric": "churn_create_delete_cycles",
+            "value": cycles,
+            "unit": "cycles",
+            "batches": batches,
+            "batch": batch,
+            "slice_capacity": capacity,
+            "wall_s": round(wall_s, 1),
+            "transport": "in-process apiserver over HTTP (REST clientset)",
+        },
+        {
+            "metric": "churn_joblife_violations",
+            "value": violations,
+            "unit": "violations",
+            "budget": 0,
+            "note": (joblife.report()[:2000] if violations else
+                     "every deletion sweep came back clean"),
+        },
+        {
+            "metric": "churn_joblife_residual_entries",
+            "value": residual,
+            "unit": "entries",
+            "budget": 0,
+            "counts": {k: v for k, v in joblife.counts().items() if v},
+        },
+        {
+            "metric": "churn_metric_series_growth",
+            "value": series_growth,
+            "unit": "series",
+            "budget": 0,
+            "baseline_series": series_base,
+        },
+        {
+            "metric": "churn_rss_growth_mb",
+            "value": round(rss_growth, 1) if rss_growth is not None else None,
+            "unit": "MB",
+            "budget_mb": rss_budget_mb,
+            "baseline_mb": round(rss_base, 1) if rss_base else None,
+        },
+    ]
+
+
+def _churn_ok(rows: list) -> bool:
+    """The CI contract (hack/verify.sh runs --churn --quick): >=200
+    create-delete cycles with zero joblife violations, zero residual
+    tracked entries, a flat registry series count, and RSS growth under
+    budget — any miss exits nonzero."""
+    ok = True
+    for row in rows:
+        metric, value = row["metric"], row["value"]
+        if metric == "churn_create_delete_cycles" and value < 200:
+            print(f"FAIL: churn soak ran only {value} cycles (>=200 "
+                  f"required)", file=sys.stderr)
+            ok = False
+        if metric in ("churn_joblife_violations",
+                      "churn_joblife_residual_entries") \
+                and (value is None or value != 0):
+            print(f"FAIL: {metric} = {value} (budget 0): "
+                  f"{row.get('note') or row.get('counts')}",
+                  file=sys.stderr)
+            ok = False
+        if metric == "churn_metric_series_growth" \
+                and (value is None or value != 0):
+            print(f"FAIL: /metrics series count grew by {value} across "
+                  f"the churn soak (budget 0)", file=sys.stderr)
+            ok = False
+        if metric == "churn_rss_growth_mb" \
+                and (value is None or value > row["budget_mb"]):
+            print(f"FAIL: RSS grew {value} MB across the churn soak "
+                  f"(budget {row['budget_mb']} MB)", file=sys.stderr)
+            ok = False
+    return ok
+
+
 # --- checkpoint durability micro-rows ------------------------------------------
 
 def _ckpt_state(size_mb: float):
@@ -2216,6 +2462,10 @@ def main(argv=None) -> int:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_fleet(args.quick)]
         return 0 if _fleet_ok(rows) else 1
+    if args.churn:
+        # Operator-only rows: no JAX import, runs anywhere (the CI gate).
+        rows = [_emit(row) for row in bench_churn(args.quick)]
+        return 0 if _churn_ok(rows) else 1
     if args.control_plane:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_control_plane(args.quick)]

@@ -9,7 +9,10 @@ set -euo pipefail
 
 cd "$(dirname "$0")/.."
 
-# Contract-analysis gate, first and fail-fast: spec-drift across
+# Contract-analysis gate, first and fail-fast: the per-job state
+# lifecycle contract (# per-job: annotations — every job-keyed
+# container declares + proves its removal path, every job-identity
+# metric family has a deletion prune site), spec-drift across
 # types/schema/defaults/validation/CRD, the env-var contract between
 # trainer/replicas.py and the payload, the heartbeat-key chain, lock
 # discipline (# guarded-by annotations), the cross-module lock-order
@@ -26,13 +29,21 @@ python hack/analyze.py
 # both witness stacks. Zero overhead outside verify (factories return
 # raw threading primitives when unset).
 export TPUJOB_LOCKDEP=1
+# Job-lifecycle witness ON the same way: every `# per-job:` container
+# constructs through joblife.track, the controller's deletion reconcile
+# sweeps the registry + the metric registry, and the conftest guard
+# fails any test on whose watch per-job state outlived a deleted job.
+export TPUJOB_JOBLIFE=1
 
-# The witness's own contract, then the deterministic interleaving
+# The witnesses' own contracts, then the deterministic interleaving
 # harness + the four seeded-schedule races (fleet admission/release/
 # rebuild, writeback defer/critical bypass, straggler fold/attempt
 # reset, write-behind enqueue/close-drain) — standalone so a
 # concurrency regression fails by name, before the broad suites.
 python -m pytest tests/test_lockdep.py -x -q
+# The lifecycle contract's own suite: rule fixtures with seeded
+# violations, the joblife witness, and the deletion-prune regressions.
+python -m pytest tests/test_lifecycle.py -x -q
 python -m pytest tests/test_schedules.py -x -q
 # Lint gate (pinned in the pyproject `dev` extra). Skipped with a warning
 # when ruff is not installed — the stdlib-only analyzer above always runs.
@@ -124,6 +135,13 @@ python bench.py --serve --quick
 # on the preemption budget), and the acceptance e2es over the
 # in-process apiserver.
 python -m pytest tests/test_elastic.py -x -q
+# Standalone lifecycle gate, measured form: >=200 create-run-delete
+# cycles through the real operator with the joblife witness on — any
+# per-job container or metric series outliving a deleted job, any
+# /metrics series-count growth, or RSS growth past budget exits
+# nonzero (ROADMAP item 5's "no leaked metric series and bounded
+# memory", enforced per PR).
+python bench.py --churn --quick
 # Standalone fleet-scheduler gate: slice-inventory admission (whole-gang
 # fit or phase Queued), fair-share + priority ordering, preemption victim
 # selection + the preemption-budget requeue, inventory release on
@@ -156,6 +174,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_elastic.py \
   --ignore=tests/test_serving.py \
   --ignore=tests/test_lockdep.py \
+  --ignore=tests/test_lifecycle.py \
   --ignore=tests/test_schedules.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

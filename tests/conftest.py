@@ -38,11 +38,20 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 # module creates its module-level locks — i.e. here, at conftest import.
 import pytest  # noqa: E402
 
-from tpu_operator.util import lockdep  # noqa: E402
+from tpu_operator.util import joblife, lockdep  # noqa: E402
 
 if os.environ.get("TPUJOB_LOCKDEP", "") not in ("0", "false"):
     os.environ["TPUJOB_LOCKDEP"] = "1"
     lockdep.enable()
+
+# Job-lifecycle witness ON for the whole suite (TPUJOB_JOBLIFE=0 opts
+# out), the lockdep pattern for the per-job-state leak class: every
+# `# per-job:` container constructs through joblife.track, and the
+# controller's deletion reconcile sweeps the registry — so every test
+# that deletes a job doubles as a leak detector.
+if os.environ.get("TPUJOB_JOBLIFE", "") not in ("0", "false"):
+    os.environ["TPUJOB_JOBLIFE"] = "1"
+    joblife.enable()
 
 
 @pytest.fixture(autouse=True)
@@ -57,3 +66,19 @@ def _lockdep_guard():
     yield
     after = lockdep.violation_count()
     assert after == before, lockdep.report()
+
+
+@pytest.fixture(autouse=True)
+def _joblife_guard():
+    """Fail any test on whose watch the controller's deletion sweep found
+    per-job state (or a metric series) outliving a deleted job.
+
+    The epoch bump scopes each test's sweeps to containers constructed
+    within it — job names recur constantly across the suite, and an
+    abandoned previous-test controller must not pollute this test's
+    verdict."""
+    joblife.new_epoch()
+    before = joblife.violation_count()
+    yield
+    after = joblife.violation_count()
+    assert after == before, joblife.report()

@@ -434,9 +434,12 @@ def test_driver_rejects_unknown_rule():
 
 
 def test_every_rule_registered():
-    assert set(RULES) == {"spec-drift", "env-contract", "status-contract",
-                          "concurrency", "lock-order", "escape",
-                          "exceptions", "payload-image"}
+    assert set(RULES) == {"lifecycle", "spec-drift", "env-contract",
+                          "status-contract", "concurrency", "lock-order",
+                          "escape", "exceptions", "payload-image"}
+    # The lifecycle rule prints first: per-job-state findings are the
+    # recurring leak class and the cheapest to act on.
+    assert next(iter(RULES)) == "lifecycle"
 
 
 # --- regression tests for the defects the analyzers surfaced -----------------

@@ -1,0 +1,430 @@
+"""Per-job state lifecycle contract tests: the ``lifecycle`` analyzer
+rule against fixture trees with seeded violations (exact file:line
+findings), the joblife runtime witness (registry, sweeps, epochs), the
+deletion-sweep integration over a live controller, and regression tests
+for the leaks this PR's first witness run surfaced (the status server's
+heartbeat stash outliving deleted jobs; the serving/elastic/autotune
+metric prune list)."""
+
+import textwrap
+import threading
+import time
+from pathlib import Path
+
+import pytest
+
+from tpu_operator.analysis import lifecycle
+from tpu_operator.analysis.driver import run_analysis
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.util import joblife
+from tests.test_types import make_template
+
+REPO = Path(__file__).resolve().parent.parent
+
+wait_for = make_wait_for(timeout=5.0, interval=0.02)
+
+
+def write(root: Path, relpath: str, body: str) -> Path:
+    path = root / relpath
+    path.parent.mkdir(parents=True, exist_ok=True)
+    path.write_text(textwrap.dedent(body))
+    return path
+
+
+def keyed(findings):
+    return {f.key: f for f in findings}
+
+
+# --- rule fixtures: container annotations ------------------------------------
+
+def test_unannotated_per_job_container_is_found(tmp_path):
+    write(tmp_path, "tpu_operator/controller/leaky.py", """\
+        class Tracker:
+            def __init__(self):
+                self._by_job = {}
+
+            def add(self, key, value):
+                self._by_job[key] = value
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    f = found["per-job:tpu_operator/controller/leaky.py:Tracker._by_job"]
+    assert (f.path, f.line) == ("tpu_operator/controller/leaky.py", 3)
+    assert "no `# per-job:` annotation" in f.message
+
+
+def test_tuple_keyed_and_set_containers_are_per_job_shaped(tmp_path):
+    write(tmp_path, "tpu_operator/controller/shapes.py", """\
+        class Beats:
+            def __init__(self):
+                self._beats = {}
+                self._marks = set()
+
+            def put(self, namespace, name, hb):
+                self._beats[(namespace, name)] = hb
+
+            def mark(self, uid):
+                self._marks.add(uid)
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    assert "per-job:tpu_operator/controller/shapes.py:Beats._beats" in found
+    assert "per-job:tpu_operator/controller/shapes.py:Beats._marks" in found
+
+
+def test_non_job_keys_do_not_trip_the_heuristic(tmp_path):
+    write(tmp_path, "tpu_operator/controller/clean.py", """\
+        class Depths:
+            def __init__(self):
+                self._by_queue = {}
+
+            def bump(self, queue):
+                self._by_queue[queue] = self._by_queue.get(queue, 0) + 1
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+def test_missing_and_removal_free_removers_are_found(tmp_path):
+    write(tmp_path, "tpu_operator/controller/removers.py", """\
+        from tpu_operator.util import joblife
+
+
+        class Ghost:
+            def __init__(self):
+                self._m = joblife.track("Ghost._m")  # per-job: forget
+
+            def get(self, key):
+                return self._m.get(key)
+
+
+        class Hollow:
+            def __init__(self):
+                self._m = joblife.track("Hollow._m")  # per-job: forget
+
+            def get(self, key):
+                return self._m.get(key)
+
+            def forget(self, key):
+                return key  # touches nothing
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    ghost = found["per-job-remover:tpu_operator/controller/removers.py:"
+                  "Ghost._m:forget"]
+    assert "does not exist" in ghost.message
+    hollow = found["per-job-remover:tpu_operator/controller/removers.py:"
+                   "Hollow._m:forget"]
+    assert "performs no removal" in hollow.message
+
+
+def test_unreferenced_remover_is_found_and_call_site_clears_it(tmp_path):
+    body = """\
+        from tpu_operator.util import joblife
+
+
+        class Orphan:
+            def __init__(self):
+                self._m = joblife.track("Orphan._m")  # per-job: forget
+
+            def get(self, key):
+                return self._m.get(key)
+
+            def forget(self, key):
+                self._m.pop(key, None)
+        """
+    write(tmp_path, "tpu_operator/controller/orphan.py", body)
+    found = keyed(lifecycle.run(tmp_path))
+    assert ("per-job-unreached:tpu_operator/controller/orphan.py:"
+            "Orphan._m:forget") in found
+    # A call site anywhere in the scanned tree (here: another module)
+    # makes the remover reachable.
+    write(tmp_path, "tpu_operator/controller/caller.py", """\
+        def on_delete(tracker, key):
+            tracker.forget(key)
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+def test_untracked_annotated_container_is_found_and_no_track_opts_out(
+        tmp_path):
+    write(tmp_path, "tpu_operator/controller/untracked.py", """\
+        class Raw:
+            def __init__(self):
+                self._m = {}  # per-job: forget
+
+            def get(self, key):
+                return self._m.get(key)
+
+            def forget(self, key):
+                self._m.pop(key, None)
+
+
+        def caller(r, key):
+            r.forget(key)
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    f = found["per-job-untracked:tpu_operator/controller/untracked.py:Raw._m"]
+    assert "joblife.track" in f.message
+    write(tmp_path, "tpu_operator/controller/untracked.py", """\
+        class Raw:
+            def __init__(self):
+                self._m = {}  # per-job: forget no-track
+
+            def get(self, key):
+                return self._m.get(key)
+
+            def forget(self, key):
+                self._m.pop(key, None)
+
+
+        def caller(r, key):
+            r.forget(key)
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+def test_track_name_must_match_class_and_attr(tmp_path):
+    write(tmp_path, "tpu_operator/controller/misnamed.py", """\
+        from tpu_operator.util import joblife
+
+
+        class Off:
+            def __init__(self):
+                self._m = joblife.track("Other._x")  # per-job: forget
+
+            def get(self, key):
+                return self._m.get(key)
+
+            def forget(self, key):
+                self._m.pop(key, None)
+
+
+        def caller(o, key):
+            o.forget(key)
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    assert ("per-job-untracked:tpu_operator/controller/misnamed.py:Off._m"
+            in found)
+
+
+# --- rule fixtures: metric families ------------------------------------------
+
+def test_job_identity_metric_without_remove_series_is_found(tmp_path):
+    write(tmp_path, "tpu_operator/controller/metrics_leak.py", """\
+        class C:
+            def tick(self, ns, name):
+                self.metrics.inc("job_thing_total",
+                                 labels={"namespace": ns, "name": name})
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    f = found["per-job-metric:job_thing_total"]
+    assert (f.path, f.line) == ("tpu_operator/controller/metrics_leak.py", 3)
+    # A remove_series call site anywhere in the tree clears it.
+    write(tmp_path, "tpu_operator/controller/pruner.py", """\
+        class P:
+            def on_delete(self, ns, name):
+                self.metrics.remove_series(
+                    "job_thing_total", labels={"namespace": ns, "name": name})
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+def test_metric_names_written_through_variables_resolve(tmp_path):
+    """The tuple-driven fold loops (checkpoint counters, the deletion
+    prune loop) pass family names through variables; resolution goes via
+    the enclosing function's literals ∩ registered families."""
+    write(tmp_path, "tpu_operator/controller/varmetrics.py", """\
+        class M:
+            def __init__(self):
+                self.register("job_var_total", "counter", "h")
+
+            def tick(self, ns, name):
+                for metric in ("job_var_total",):
+                    self.metrics.inc(metric, 1,
+                                     labels={"namespace": ns, "name": name})
+        """)
+    found = keyed(lifecycle.run(tmp_path))
+    assert "per-job-metric:job_var_total" in found
+    write(tmp_path, "tpu_operator/controller/varprune.py", """\
+        class P:
+            def on_delete(self, ns, name):
+                for series in ("job_var_total",):
+                    self.metrics.remove_series(
+                        series, labels={"namespace": ns, "name": name})
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+def test_stage_labeled_metrics_are_not_job_identity(tmp_path):
+    write(tmp_path, "tpu_operator/controller/stagemetrics.py", """\
+        class C:
+            def tick(self, stage, v):
+                self.metrics.observe("job_startup_seconds", v,
+                                     labels={"stage": stage})
+        """)
+    assert lifecycle.run(tmp_path) == []
+
+
+# --- the witness itself ------------------------------------------------------
+
+def test_track_returns_raw_containers_when_disabled():
+    assert joblife.enabled()  # conftest turns it on for the suite
+    joblife.enable(False)
+    try:
+        import collections
+        assert type(joblife.track("X._d")) is dict
+        assert type(joblife.track("X._o", kind="ordered")) is \
+            collections.OrderedDict
+        assert type(joblife.track("X._s", kind="set")) is set
+    finally:
+        joblife.enable(True)
+
+
+def test_sweep_finds_residuals_across_key_shapes():
+    d = joblife.track("W._by_key")
+    o = joblife.track("W._seen", kind="ordered")
+    s = joblife.track("W._marks", kind="set")
+    d["default/j1"] = 1
+    o[("default", "j1", "Reason", "msg")] = ("ev", 1)
+    s.add("uid-123")
+    before = joblife.violation_count()
+    leaks = joblife.sweep(("default/j1", ("default", "j1"), "uid-123"),
+                          where="test deletion")
+    assert len(leaks) == 3
+    assert joblife.violation_count() == before + 3
+    assert any("W._by_key" in v for v in leaks)
+    assert any("W._seen" in v for v in leaks)
+    assert any("W._marks" in v for v in leaks)
+    # Entries for OTHER jobs are untouched and unreported.
+    d.clear(), o.clear(), s.clear()
+    d["default/j2"] = 1
+    joblife.reset()  # absolve the seeded violations for the autouse guard
+    assert joblife.sweep(("default/j1", ("default", "j1"))) == []
+
+
+def test_epoch_isolates_previous_tests_containers():
+    stale = joblife.track("Old._m")
+    stale["default/j1"] = 1
+    joblife.new_epoch()
+    assert joblife.sweep(("default/j1",)) == []
+    assert "Old._m" not in joblife.counts()
+
+
+def test_counts_sums_live_entries_per_name():
+    a = joblife.track("C._m")
+    b = joblife.track("C._m")
+    a["default/x"] = 1
+    b["default/y"] = 1
+    b["default/z"] = 1
+    assert joblife.counts()["C._m"] == 3
+
+
+# --- integration: the deletion sweep over a live controller ------------------
+
+def job_dict(name="lc-job", replicas=1):
+    return t.TPUJob(
+        metadata={"name": name, "namespace": "default"},
+        spec=t.TPUJobSpec(
+            replica_specs=[
+                t.TPUReplicaSpec(replicas=replicas, template=make_template(),
+                                 tpu_replica_type=t.TPUReplicaType.WORKER)
+            ],
+            runtime_id="lc01",
+        ),
+    ).to_dict()
+
+
+@pytest.fixture
+def harness():
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)
+    controller = Controller(cs, factory)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True)
+    runner.start()
+    yield cs, controller
+    stop.set()
+    runner.join(timeout=5.0)
+
+
+def test_deleted_job_prunes_statusserver_heartbeats_eagerly(harness):
+    """Regression: before the deletion-listener hook, a deleted job's
+    stashed heartbeat survived in StatusServer._heartbeats until the
+    next scrape ran the lazy informer diff — the first leak the joblife
+    deletion sweep caught on the real tree."""
+    cs, controller = harness
+    server = StatusServer(0, controller=controller,
+                          metrics=controller.metrics)
+    server.start()  # stop() blocks in shutdown() unless serving
+    try:
+        cs.tpujobs.create("default", job_dict("hb-job"))
+        assert wait_for(lambda: "default/hb-job" in controller.jobs)
+        ok, msg = server.record_heartbeat(
+            {"namespace": "default", "name": "hb-job", "step": 5,
+             "stepTimeSeconds": 0.1, "loss": 1.5})
+        assert ok, msg
+        with server._heartbeats_lock:
+            assert ("default", "hb-job") in server._heartbeats
+        before = joblife.violation_count()
+        cs.tpujobs.delete("default", "hb-job")
+        assert wait_for(lambda: "default/hb-job" not in controller.jobs)
+        # The listener pruned the stash ON the deletion reconcile — no
+        # scrape ran — and the sweep recorded nothing.
+        def stash_empty():
+            with server._heartbeats_lock:
+                return ("default", "hb-job") not in server._heartbeats
+        assert wait_for(stash_empty)
+        assert joblife.violation_count() == before, joblife.report()
+    finally:
+        server.stop()
+
+
+def test_deletion_sweep_catches_a_seeded_leak(harness):
+    """The witness end to end: a tracked container that does NOT clean up
+    on deletion is reported by the controller's sweep."""
+    cs, controller = harness
+    leak = joblife.track("Seeded._leak")
+    cs.tpujobs.create("default", job_dict("doomed"))
+    assert wait_for(lambda: "default/doomed" in controller.jobs)
+    leak["default/doomed"] = {"stale": True}
+    cs.tpujobs.delete("default", "doomed")
+    assert wait_for(
+        lambda: any("Seeded._leak" in v for v in joblife.violations()))
+    joblife.reset()  # absolve: the leak was the point of the test
+
+
+def test_deletion_prunes_serving_elastic_autotune_series(harness):
+    """Regression for the PR 10/12/13 metric families: every registry
+    series carrying the deleted job's identity — serving gauges, world
+    size, autotune counters — leaves on the deletion reconcile (the
+    sweep's job_series probe turns any miss into a violation)."""
+    cs, controller = harness
+    m = controller.metrics
+    cs.tpujobs.create("default", job_dict("metr"))
+    assert wait_for(lambda: "default/metr" in controller.jobs)
+    ident = {"namespace": "default", "name": "metr"}
+    m.set_gauge("job_world_size", 4, labels=ident)
+    m.set_gauge("job_serving_replicas_ready", 2, labels=ident)
+    m.set_gauge("job_serving_latency_seconds", 0.1,
+                labels={**ident, "quantile": "0.95"})
+    m.inc("job_weight_reloads_total", 1, labels=ident)
+    m.inc("job_autotune_adjustments_total", 2,
+          labels={**ident, "knob": "prefetch", "direction": "up"})
+    m.set_gauge("job_prefetch_depth", 3, labels=ident)
+    assert m.job_series("default", "metr")
+    before = joblife.violation_count()
+    cs.tpujobs.delete("default", "metr")
+    assert wait_for(lambda: "default/metr" not in controller.jobs)
+    assert wait_for(lambda: not m.job_series("default", "metr"))
+    assert joblife.violation_count() == before, joblife.report()
+
+
+# --- the real tree -----------------------------------------------------------
+
+def test_real_tree_lifecycle_is_clean_under_allowlist():
+    active, _suppressed, stale = run_analysis(REPO, rules=["lifecycle"])
+    assert active == [], "\n".join(f.render() for f in active)
+    assert not stale, f"stale lifecycle allowlist entries: {stale}"

@@ -13,11 +13,15 @@ from pathlib import Path
 from typing import Iterable, List, Optional, Set, Tuple
 
 from tpu_operator.analysis import concurrency, env_contract, escape, \
-    exception_policy, lock_order, payload_image, spec_drift, status_contract
+    exception_policy, lifecycle, lock_order, payload_image, spec_drift, \
+    status_contract
 from tpu_operator.analysis.base import Allowlist, Finding
 
 # Stable rule-id -> module order; findings print grouped in this order.
+# ``lifecycle`` runs first: per-job state ownership is the recurring
+# leak class, and its findings are the cheapest to act on.
 RULES = {
+    lifecycle.RULE: lifecycle,
     spec_drift.RULE: spec_drift,
     env_contract.RULE: env_contract,
     status_contract.RULE: status_contract,

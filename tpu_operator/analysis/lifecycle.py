@@ -1,0 +1,407 @@
+"""Rule ``lifecycle``: per-job state must die with the job.
+
+The operator's most recurring bug class is per-job state that outlives
+the job — leaked event-dedup entries (PR 1), unbounded queue-depth label
+series (PR 7), metric series only pruned after a PR 9 hand-audit. This
+rule makes the ownership a checked contract instead of reviewer
+folklore, in the ``# guarded-by:`` style:
+
+1. **Mandatory ``# per-job:`` annotations.** A container attribute keyed
+   by job identity declares its removal path at its ``__init__``
+   assignment::
+
+       self._scheduled: Dict[str, float] = joblife.track(
+           "DeadlineManager._scheduled")  # per-job: forget
+
+   "Keyed by job identity" is detected from the class's own accesses: a
+   subscript/``get``/``pop``/``setdefault``/``add``/``discard``/``in``
+   whose key expression is a ``key``/``uid`` name (or attribute) or a
+   ``(namespace, name)``-shaped tuple. An unannotated per-job-shaped
+   container is a finding — someone added job-keyed state with no
+   declared teardown.
+
+2. **The declared removers must really remove, and really run.** Each
+   method named in the annotation must exist in the same class and
+   contain a removal operation on the attribute (``.pop``/``.popitem``/
+   ``.clear``/``.discard``/``.remove``/``del``/reassignment), and must
+   be referenced from somewhere in the scanned tree — a remover nobody
+   calls is a leak with paperwork.
+
+3. **Annotated containers register with the runtime witness.** The
+   assignment must construct through ``joblife.track("Class._attr")``
+   (name matching the annotation site exactly) so the ``TPUJOB_JOBLIFE``
+   deletion sweep sees it; a deliberate opt-out says ``no-track`` in the
+   annotation (e.g. state whose entries are transient per-operation,
+   not per-lifetime).
+
+4. **Job-identity metric families prune on deletion.** Any
+   ``inc``/``set_gauge``/``observe`` whose ``labels`` literal carries
+   both ``namespace`` and ``name`` names a family whose series are
+   per-job state in the metrics registry; the rule fails unless some
+   ``Metrics.remove_series`` call site names the same family (the
+   controller's deletion path owns these today). Family names written
+   through variables resolve against string literals in the enclosing
+   function intersected with the registered-family set (parsed from
+   ``Metrics.register`` calls), which covers the tuple-driven fold/prune
+   loops.
+
+Keys: ``per-job:<file>:<Class>.<attr>`` (missing annotation),
+``per-job-remover:<file>:<Class>.<attr>:<method>`` (remover missing or
+removal-free), ``per-job-unreached:<file>:<Class>.<attr>:<method>``
+(remover never referenced), ``per-job-untracked:<file>:<Class>.<attr>``
+(no/wrong ``joblife.track``), ``per-job-metric:<family>`` (no
+``remove_series`` site).
+
+Scope: the long-lived control-plane surface — controller (incl. the
+status server), scheduler, trainer, store, util. The client layer's
+generic cache machinery (informer stores, workqueues) keys on opaque
+items and is owned by the watch protocol itself; it stays out of scope
+here, covered by the concurrency/escape rules.
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import re
+import tokenize
+from pathlib import Path
+from typing import Any, Dict, List, Optional, Set, Tuple
+
+from tpu_operator.analysis.base import Finding, attach_parents, dotted_name, \
+    enclosing_function, iter_py_files, parse_file, rel, self_attr, str_const
+
+RULE = "lifecycle"
+
+# The long-lived control-plane surface whose containers outlive any one
+# job (per-job objects like TrainingJob/GangRuntime die with their map
+# entry; their internals are covered transitively by the entry's sweep).
+SCAN = (
+    ("tpu_operator", "controller"),
+    ("tpu_operator", "scheduler"),
+    ("tpu_operator", "trainer"),
+    ("tpu_operator", "store"),
+    ("tpu_operator", "util"),
+)
+
+# Names whose appearance as a container key mark it per-job-keyed.
+JOB_KEY_NAMES = {"key", "job_key", "jobkey", "uid", "job_uid"}
+JOB_KEY_ATTRS = {"key", "uid"}
+NS_NAMES = {"namespace", "ns"}
+NAME_NAMES = {"name"}
+
+_KEYED_METHODS = {"get", "pop", "setdefault", "add", "discard", "remove"}
+_REMOVAL_METHODS = {"pop", "popitem", "clear", "discard", "remove"}
+
+# Removers are a comma-joined list (no spaces); the only flag word is
+# no-track. Anything after — another tag like guarded-by:, prose — is
+# outside the capture, so tags can share a comment line.
+_ANNOTATION_RE = re.compile(r"per-job:\s*([A-Za-z0-9_,]+)((?:\s+no-track)?)")
+
+
+def _per_job_annotations(path: Path) -> Dict[int, Tuple[List[str], Set[str]]]:
+    """line -> ([removers], {flags}) for ``# per-job: a,b [no-track]``
+    comments (ast drops comments; this walks the token stream)."""
+    out: Dict[int, Tuple[List[str], Set[str]]] = {}
+    try:
+        tokens = tokenize.generate_tokens(
+            io.StringIO(path.read_text(encoding="utf-8")).readline)
+    except (OSError, tokenize.TokenError, SyntaxError):
+        return out
+    for tok in tokens:
+        if tok.type != tokenize.COMMENT:
+            continue
+        m = _ANNOTATION_RE.search(tok.string)
+        if not m:
+            continue
+        removers = [w for w in m.group(1).split(",") if w]
+        flags = {"no-track"} if m.group(2).strip() else set()
+        out[tok.start[0]] = (removers, flags)
+    return out
+
+
+def _container_value(value: ast.AST) -> Optional[str]:
+    """What container an ``__init__`` assignment builds: "dict", "set",
+    "track" (a joblife.track call), or None for non-containers."""
+    if isinstance(value, (ast.Dict, ast.DictComp)):
+        return "dict"
+    if isinstance(value, (ast.Set, ast.SetComp)):
+        return "set"
+    if isinstance(value, ast.Call):
+        dn = dotted_name(value.func)
+        leaf = dn.rsplit(".", 1)[-1]
+        if dn.endswith("joblife.track") or dn == "track":
+            return "track"
+        if leaf in ("dict", "OrderedDict", "defaultdict"):
+            return "dict"
+        if leaf == "set":
+            return "set"
+    return None
+
+
+def _is_job_identity(expr: ast.AST) -> bool:
+    if isinstance(expr, ast.Name):
+        return expr.id in JOB_KEY_NAMES
+    if isinstance(expr, ast.Attribute):
+        return expr.attr in JOB_KEY_ATTRS
+    if isinstance(expr, ast.Tuple):
+        leaves = set()
+        for elt in expr.elts:
+            if isinstance(elt, ast.Name):
+                leaves.add(elt.id)
+            elif isinstance(elt, ast.Attribute):
+                leaves.add(elt.attr)
+        return bool(leaves & NS_NAMES) and bool(leaves & NAME_NAMES)
+    return False
+
+
+def _access_keys(cls: ast.ClassDef, attr: str) -> List[ast.AST]:
+    """Key expressions the class uses against ``self.<attr>``."""
+    keys: List[ast.AST] = []
+    for node in ast.walk(cls):
+        if isinstance(node, ast.Subscript) \
+                and self_attr(node.value) == attr:
+            keys.append(node.slice)
+        elif isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _KEYED_METHODS \
+                and self_attr(node.func.value) == attr and node.args:
+            keys.append(node.args[0])
+        elif isinstance(node, ast.Compare) and len(node.ops) == 1 \
+                and isinstance(node.ops[0], (ast.In, ast.NotIn)) \
+                and len(node.comparators) == 1 \
+                and self_attr(node.comparators[0]) == attr:
+            keys.append(node.left)
+    return keys
+
+
+def _removes_attr(method: ast.FunctionDef, attr: str) -> bool:
+    for node in ast.walk(method):
+        if isinstance(node, ast.Call) \
+                and isinstance(node.func, ast.Attribute) \
+                and node.func.attr in _REMOVAL_METHODS \
+                and self_attr(node.func.value) == attr:
+            return True
+        if isinstance(node, ast.Delete):
+            for target in node.targets:
+                if isinstance(target, ast.Subscript) \
+                        and self_attr(target.value) == attr:
+                    return True
+        if isinstance(node, ast.Assign) \
+                and any(self_attr(t) == attr for t in node.targets):
+            return True
+    return False
+
+
+def _reference_index(trees: Dict[str, ast.Module]) -> Set[str]:
+    """Every attribute/name referenced anywhere in the scanned tree —
+    the (deliberately coarse) reachability oracle for removers."""
+    refs: Set[str] = set()
+    for tree in trees.values():
+        for node in ast.walk(tree):
+            if isinstance(node, ast.Attribute):
+                refs.add(node.attr)
+            elif isinstance(node, ast.Name):
+                refs.add(node.id)
+    return refs
+
+
+def _check_containers(tree: ast.Module, path_rel: str,
+                      notes: Dict[int, Tuple[List[str], Set[str]]],
+                      refs: Set[str]) -> List[Finding]:
+    findings: List[Finding] = []
+    for cls in ast.walk(tree):
+        if not isinstance(cls, ast.ClassDef):
+            continue
+        init = next((m for m in cls.body if isinstance(m, ast.FunctionDef)
+                     and m.name == "__init__"), None)
+        if init is None:
+            continue
+        methods = {m.name: m for m in cls.body
+                   if isinstance(m, ast.FunctionDef)}
+        for stmt in ast.walk(init):
+            target = None
+            if isinstance(stmt, ast.Assign) and len(stmt.targets) == 1:
+                target = stmt.targets[0]
+            elif isinstance(stmt, ast.AnnAssign):
+                target = stmt.target
+            attr = self_attr(target) if target is not None else None
+            if attr is None or stmt.value is None:
+                continue
+            kind = _container_value(stmt.value)
+            if kind is None:
+                continue
+            # A multi-line assignment can carry the annotation on any of
+            # its physical lines (the guarded-by convention).
+            note = None
+            end = getattr(stmt, "end_lineno", None) or stmt.lineno
+            for line in range(stmt.lineno, end + 1):
+                note = notes.get(line)
+                if note is not None:
+                    break
+            shaped = any(_is_job_identity(k) for k in _access_keys(cls, attr))
+            qual = f"{cls.name}.{attr}"
+            if note is None:
+                if shaped:
+                    findings.append(Finding(
+                        RULE, path_rel, stmt.lineno,
+                        f"{qual} is keyed by job identity but carries no "
+                        f"`# per-job:` annotation — declare its removal "
+                        f"path on the delete/terminal/TTL path (or "
+                        f"allowlist with justification)",
+                        key=f"per-job:{path_rel}:{qual}"))
+                continue
+            removers, flags = note
+            for remover in removers:
+                method = methods.get(remover)
+                if method is None or not _removes_attr(method, attr):
+                    what = ("does not exist in the class"
+                            if method is None else
+                            "performs no removal on the attribute")
+                    findings.append(Finding(
+                        RULE, path_rel, stmt.lineno,
+                        f"{qual} declares remover {remover}() which "
+                        f"{what} — the per-job contract is unenforced",
+                        key=f"per-job-remover:{path_rel}:{qual}:{remover}"))
+                elif remover not in refs:
+                    findings.append(Finding(
+                        RULE, path_rel, stmt.lineno,
+                        f"{qual}'s declared remover {remover}() is never "
+                        f"referenced anywhere in the scanned tree — a "
+                        f"removal path nobody calls is a leak with "
+                        f"paperwork",
+                        key=f"per-job-unreached:{path_rel}:{qual}:{remover}"))
+            if not removers:
+                findings.append(Finding(
+                    RULE, path_rel, stmt.lineno,
+                    f"{qual}'s `# per-job:` annotation names no remover",
+                    key=f"per-job-remover:{path_rel}:{qual}:<none>"))
+            if "no-track" not in flags:
+                ok = kind == "track"
+                if ok:
+                    lit = (str_const(stmt.value.args[0])
+                           if isinstance(stmt.value, ast.Call)
+                           and stmt.value.args else None)
+                    ok = lit == qual
+                if not ok:
+                    findings.append(Finding(
+                        RULE, path_rel, stmt.lineno,
+                        f"{qual} is `# per-job:` annotated but not "
+                        f"constructed via joblife.track({qual!r}) — the "
+                        f"runtime deletion sweep cannot see it (say "
+                        f"no-track in the annotation to opt out "
+                        f"deliberately)",
+                        key=f"per-job-untracked:{path_rel}:{qual}"))
+    return findings
+
+
+# --- metric families ---------------------------------------------------------
+
+_WRITE_METHODS = {"inc", "set_gauge", "observe"}
+
+
+def _labels_dict(call: ast.Call) -> Optional[ast.Dict]:
+    for kw in call.keywords:
+        if kw.arg == "labels" and isinstance(kw.value, ast.Dict):
+            return kw.value
+    return None
+
+
+def _has_job_labels(call: ast.Call) -> bool:
+    labels = _labels_dict(call)
+    if labels is None:
+        return False
+    keys = {str_const(k) for k in labels.keys if k is not None}
+    return "namespace" in keys and "name" in keys
+
+
+def _literal_names(node: ast.AST) -> Set[str]:
+    lit = str_const(node)
+    if lit is not None:
+        return {lit}
+    if isinstance(node, ast.IfExp):
+        return _literal_names(node.body) | _literal_names(node.orelse)
+    return set()
+
+
+def _function_constants(node: ast.AST, tree: ast.Module) -> Set[str]:
+    scope = enclosing_function(node) or tree
+    return {n.value for n in ast.walk(scope)
+            if isinstance(n, ast.Constant) and isinstance(n.value, str)}
+
+
+def _check_metrics(trees: Dict[str, Tuple[Path, ast.Module]]
+                   ) -> List[Finding]:
+    registered: Set[str] = set()
+    write_sites: List[Tuple[str, ast.Call, ast.Module]] = []
+    remove_sites: List[Tuple[ast.Call, ast.Module]] = []
+    for path_rel, (_path, tree) in trees.items():
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) \
+                    or not isinstance(node.func, ast.Attribute):
+                continue
+            leaf = node.func.attr
+            if leaf == "register" and len(node.args) >= 2 \
+                    and str_const(node.args[1]) in ("counter", "gauge",
+                                                    "histogram"):
+                name = str_const(node.args[0])
+                if name:
+                    registered.add(name)
+            elif leaf in _WRITE_METHODS and node.args \
+                    and _has_job_labels(node):
+                write_sites.append((path_rel, node, tree))
+            elif leaf == "remove_series" and node.args:
+                remove_sites.append((node, tree))
+
+    known = set(registered)
+    for _p, call, _t in write_sites:
+        known |= _literal_names(call.args[0])
+    for call, _t in remove_sites:
+        known |= _literal_names(call.args[0])
+
+    def resolve(call: ast.Call, tree: ast.Module) -> Set[str]:
+        names = _literal_names(call.args[0])
+        if names:
+            return names
+        # Written through a variable: every known family named in the
+        # enclosing function is a candidate (covers tuple-driven loops).
+        return _function_constants(call.args[0], tree) & known
+
+    removed: Set[str] = set()
+    for call, tree in remove_sites:
+        removed |= resolve(call, tree)
+    findings: List[Finding] = []
+    seen: Set[str] = set()
+    for path_rel, call, tree in write_sites:
+        for family in sorted(resolve(call, tree)):
+            if family in removed or family in seen:
+                continue
+            seen.add(family)
+            findings.append(Finding(
+                RULE, path_rel, call.lineno,
+                f"metric family {family} carries job identity labels "
+                f"{{namespace,name}} but no Metrics.remove_series call "
+                f"site prunes it — its series outlive every deleted job",
+                key=f"per-job-metric:{family}"))
+    return findings
+
+
+def run(root: Path) -> List[Finding]:
+    trees: Dict[str, Tuple[Path, ast.Module]] = {}
+    for parts in SCAN:
+        for path in iter_py_files(root, *parts):
+            path_rel = rel(root, path)
+            if path_rel in trees:
+                continue
+            tree = parse_file(path)
+            if tree is None:
+                continue
+            attach_parents(tree)
+            trees[path_rel] = (path, tree)
+    refs = _reference_index({p: t for p, (_f, t) in trees.items()})
+    findings: List[Finding] = []
+    for path_rel, (path, tree) in trees.items():
+        notes = _per_job_annotations(path)
+        findings += _check_containers(tree, path_rel, notes, refs)
+    findings += _check_metrics(trees)
+    return findings

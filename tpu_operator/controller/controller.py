@@ -62,7 +62,7 @@ from tpu_operator.trainer import serving as serving_mod
 from tpu_operator.trainer.training import TrainingJob, live_pod
 from tpu_operator.util import tracing
 from tpu_operator.util.tracing import traced
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -168,23 +168,36 @@ class Controller:
                           if writeback_qps > 0 else None)
         # UID-keyed in-memory jobs (ref: controller.go:71); lock-guarded so
         # threadiness > 1 is safe (the reference's was not).
-        self.jobs: Dict[str, TrainingJob] = {}  # guarded-by: _jobs_lock
+        self.jobs: Dict[str, TrainingJob] = joblife.track(
+            "Controller.jobs")  # per-job: sync_tpujob; guarded-by: _jobs_lock
         self._jobs_lock = lockdep.lock("Controller._jobs_lock")
         # key -> heartbeat "time" of the last persist-enqueued heartbeat
         # (guarded by _jobs_lock; see record_heartbeat's coalescing).
-        self._hb_persisted: Dict[str, float] = {}  # guarded-by: _jobs_lock
+        self._hb_persisted: Dict[str, float] = joblife.track(
+            "Controller._hb_persisted")  # per-job: sync_tpujob; guarded-by: _jobs_lock
         # Straggler detection state, key -> {"attempt": n, "procs":
         # {processId -> {"p95", "step", "time"}}, "flagged": set(pid)}.
         # In-memory only (rebuilt from fresh cadence beats after an
         # operator restart — it is telemetry, not state); reset on attempt
         # change, dropped on job deletion.
-        self._gang_cadence: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
+        self._gang_cadence: Dict[str, Dict[str, Any]] = joblife.track(
+            "Controller._gang_cadence")  # per-job: sync_tpujob; guarded-by: _jobs_lock
         # Serving-mode per-replica state, key -> {"attempt": n, "procs":
         # {processId -> {"ready", "rps", "p50", "p95", "loadedStep",
         # "reloads", "seen"}}}. In-memory like the cadence map (readiness
         # re-earns itself from fresh beats after an operator restart; the
         # reload delta baselines persist IN status.serving).
-        self._serving: Dict[str, Dict[str, Any]] = {}  # guarded-by: _jobs_lock
+        self._serving: Dict[str, Dict[str, Any]] = joblife.track(
+            "Controller._serving")  # per-job: sync_tpujob; guarded-by: _jobs_lock
+        # Parties holding per-job state the controller can't reach (the
+        # status server's heartbeat stash) register here; every callback
+        # runs on the deletion reconcile, BEFORE the joblife sweep that
+        # asserts nothing per-job survived.
+        self._deletion_listeners: List[Callable[[str, str], None]] = []  # guarded-by: _jobs_lock
+        # Epoch pin for the deletion sweep: a worker of THIS controller
+        # draining a last deletion after a test harness moved on must
+        # not judge the next epoch's containers.
+        self._joblife_epoch = joblife.current_epoch()
         # Straggler-remediation pacing (spec.elastic.stragglerPolicy):
         # how long each flagged member has stayed flagged; crossing the
         # patience window hands the member to the TrainingJob's next
@@ -235,6 +248,15 @@ class Controller:
 
     def enqueue(self, obj: Dict[str, Any]) -> None:
         self.queue.add(object_key(obj))
+
+    def add_deletion_listener(self,
+                              listener: Callable[[str, str], None]) -> None:
+        """Register a ``(namespace, name)`` callback run on every job
+        deletion reconcile — the hook for per-job state living outside
+        the controller's own maps. Idempotent per callable."""
+        with self._jobs_lock:
+            if listener not in self._deletion_listeners:
+                self._deletion_listeners.append(listener)
 
     def _enqueue_owner(self, obj: Dict[str, Any]) -> None:
         md = obj.get("metadata") or {}
@@ -386,7 +408,7 @@ class Controller:
             # Deleted: children are garbage-collected by K8s via
             # OwnerReferences (ref: controller.go:227-232 just forgets).
             with self._jobs_lock:
-                self.jobs.pop(key, None)
+                tj = self.jobs.pop(key, None)
                 self._hb_persisted.pop(key, None)
                 self._gang_cadence.pop(key, None)
                 self._serving.pop(key, None)
@@ -436,6 +458,45 @@ class Controller:
                     "job_autotune_adjustments_total",
                     labels={"namespace": namespace, "name": name,
                             "knob": knob, "direction": direction})
+            # Out-of-controller per-job state (the status server's
+            # heartbeat stash) cleans up through registered listeners —
+            # snapshotted under the lock, called outside it (a listener
+            # takes its own lock; nesting it under _jobs_lock would mint
+            # a lock-order edge for nothing).
+            with self._jobs_lock:
+                listeners = list(self._deletion_listeners)
+            for listener in listeners:
+                try:
+                    listener(namespace, name)
+                except Exception as e:  # noqa: BLE001 — cleanup best-effort
+                    log.warning("deletion listener failed for %s: %s",
+                                key, e)
+            # ...and then the joblife witness audits the whole process:
+            # any `# per-job:` container still holding this job's key, or
+            # any registry series still carrying its identity labels, is
+            # a lifecycle leak (recorded, failing the owning test / the
+            # churn soak — the runtime half of the `lifecycle` analyzer
+            # rule).
+            if joblife.enabled() \
+                    and joblife.current_epoch() == self._joblife_epoch:
+                leaked = [f"joblife: metric series outlives deleted job "
+                          f"{key}: {series} (add the family to the "
+                          f"deletion prune list above)"
+                          for series in self.metrics.job_series(namespace,
+                                                                name)]
+                for message in leaked:
+                    joblife.record_violation(message)
+                tokens = [key, (namespace, name)]
+                if tj is not None:
+                    tokens.append(tj.uid)
+                leaked += joblife.sweep(tokens,
+                                        where=f"deletion of TPUJob {key}",
+                                        epoch=self._joblife_epoch)
+                for message in leaked:
+                    # Violations accumulate for the conftest guard / the
+                    # churn soak; the log line is what a production
+                    # operator surfaces.
+                    log.warning("%s", message)
             return True
 
         job = TPUJob.from_dict(cached)

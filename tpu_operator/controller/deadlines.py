@@ -25,7 +25,7 @@ from __future__ import annotations
 
 import time
 from typing import Any, Callable, Dict, Optional
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 # Scheduling slack added to every wakeup so the reconcile runs just *after*
 # the obligation (a wakeup landing a hair early would see nothing due,
@@ -44,7 +44,8 @@ class DeadlineManager:
         # key -> pending wakeup epoch (best-effort view; the queue owns the
         # actual timers, which are never cancelled — a stale wakeup just
         # causes one cheap no-op reconcile).
-        self._scheduled: Dict[str, float] = {}  # guarded-by: _lock
+        self._scheduled: Dict[str, float] = joblife.track(
+            "DeadlineManager._scheduled")  # per-job: forget; guarded-by: _lock
 
     def sync(self, key: str, due: Optional[float]) -> None:
         """Ensure a reconcile of ``key`` runs at epoch ``due``.

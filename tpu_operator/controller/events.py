@@ -19,7 +19,7 @@ from typing import Any, Optional, Tuple
 
 from tpu_operator.client import errors
 from tpu_operator.util.util import rand_string
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -50,7 +50,8 @@ class EventRecorder:
         self._lock = lockdep.lock("EventRecorder._lock")
         # LRU: (ns, name, reason, message) -> (event_name, count)
         self._seen: "collections.OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
-            collections.OrderedDict())  # guarded-by: _lock
+            joblife.track("EventRecorder._seen",
+                          kind="ordered"))  # per-job: forget_object; guarded-by: _lock
 
     def forget_object(self, namespace: str, name: str) -> int:
         """Drop dedup entries for a deleted object (the controller calls this

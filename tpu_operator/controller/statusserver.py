@@ -52,7 +52,7 @@ from tpu_operator.payload.steptrace import (
 )
 from tpu_operator.util import tracing
 from tpu_operator.util.util import now_rfc3339, parse_rfc3339
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -401,6 +401,27 @@ class Metrics:
             if fam is not None:
                 fam.series.pop(_series_key(labels), None)
 
+    def job_series(self, namespace: str, name: str) -> List[str]:
+        """Registry series whose labels carry this job's identity —
+        the joblife deletion sweep's metrics probe: right after a job's
+        deletion reconcile this must be empty, or a family is missing
+        from the controller's prune list."""
+        out: List[str] = []
+        with self._lock:
+            for fam in self._families.values():
+                for key in fam.series:
+                    labels = dict(key)
+                    if labels.get("namespace") == namespace \
+                            and labels.get("name") == name:
+                        out.append(f"{fam.name}{_label_str(labels)}")
+        return sorted(out)
+
+    def series_count(self) -> int:
+        """Total labeled series resident in the registry (the churn
+        soak's flatness probe — job churn must not grow it)."""
+        with self._lock:
+            return sum(len(fam.series) for fam in self._families.values())
+
     def counter_value(self, name: str, labels: LabelsT = None) -> float:
         """One labeled counter/gauge series' value (0.0 when absent) —
         the label-exact read the budget benches assert against, where
@@ -667,7 +688,16 @@ class StatusServer:
         self._leading = threading.Event()
         self._heartbeats_lock = lockdep.lock("StatusServer._heartbeats_lock")
         # (namespace, name) -> last heartbeat dict (+ receivedAt epoch)
-        self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = {}  # guarded-by: _heartbeats_lock
+        self._heartbeats: Dict[Tuple[str, str], Dict[str, Any]] = joblife.track(
+            "StatusServer._heartbeats")  # per-job: forget_job; guarded-by: _heartbeats_lock
+        # Eager deletion prune: before this listener existed, a deleted
+        # job's heartbeat survived here until the next scrape/roll-up ran
+        # _live_heartbeats — the first leak the joblife deletion sweep
+        # caught. The lazy informer diff stays as the backstop for beats
+        # that race the deletion reconcile.
+        if controller is not None \
+                and hasattr(controller, "add_deletion_listener"):
+            controller.add_deletion_listener(self.forget_job)
         outer = self
 
         class Handler(BaseHTTPRequestHandler):
@@ -795,6 +825,8 @@ class StatusServer:
         """Called when this instance wins leadership and builds a controller."""
         with self._controller_lock:
             self._controller = controller
+        if hasattr(controller, "add_deletion_listener"):
+            controller.add_deletion_listener(self.forget_job)
         self._leading.set()
         self.metrics.inc("leader_elections_won_total")
 
@@ -804,6 +836,14 @@ class StatusServer:
             return self._controller
 
     # -- heartbeats ------------------------------------------------------------
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Deletion-listener hook (registered with the controller): drop
+        a deleted job's stashed heartbeat eagerly, so its gauge source
+        dies with the job instead of lingering until the next scrape's
+        ``_live_heartbeats`` informer diff."""
+        with self._heartbeats_lock:
+            self._heartbeats.pop((namespace, name), None)
 
     def record_heartbeat(self, body: Dict[str, Any]) -> Tuple[bool, str]:
         """Ingest one payload heartbeat: stash for per-job gauges and pass it
@@ -946,6 +986,16 @@ class StatusServer:
                 oldest = min(self._heartbeats,
                              key=lambda k: self._heartbeats[k]["receivedAt"])
                 del self._heartbeats[oldest]
+        if c.job_informer.store.get(namespace, name) is None:
+            # The job was deleted between the entry check at the top and
+            # the stash: without this repair the deletion reconcile's
+            # forget_job has already run and the entry would linger until
+            # the lazy scrape diff (or forever on an unscraped instance).
+            # Re-validating AFTER inserting closes the window — whichever
+            # of stash/deletion ran second cleans up.
+            with self._heartbeats_lock:
+                self._heartbeats.pop((namespace, name), None)
+            return False, f"unknown job {namespace}/{name}"
         self.metrics.inc("heartbeats_total")
         return True, ""
 

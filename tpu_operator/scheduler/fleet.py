@@ -49,7 +49,7 @@ from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_operator.apis.tpujob.v1alpha1.types import DEFAULT_SCHEDULING_QUEUE
 from tpu_operator.scheduler.inventory import SliceInventory
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 log = logging.getLogger(__name__)
 
@@ -105,11 +105,14 @@ class FleetScheduler:
         self._clock = clock
         self._lock = lockdep.lock("FleetScheduler._lock")
         self._inventory = inventory or SliceInventory()  # guarded-by: _lock
-        self._admitted: Dict[str, _Entry] = {}  # guarded-by: _lock
-        self._pending: Dict[str, _Entry] = {}  # guarded-by: _lock
+        self._admitted: Dict[str, _Entry] = joblife.track(
+            "FleetScheduler._admitted")  # per-job: release; guarded-by: _lock
+        self._pending: Dict[str, _Entry] = joblife.track(
+            "FleetScheduler._pending")  # per-job: release; guarded-by: _lock
         # key -> (victim uid, reason): UID-scoped so a directive aimed at
         # a deleted job can never preempt a same-name successor.
-        self._evicting: Dict[str, Tuple[str, str]] = {}  # guarded-by: _lock
+        self._evicting: Dict[str, Tuple[str, str]] = joblife.track(
+            "FleetScheduler._evicting")  # per-job: release; guarded-by: _lock
         self._known_queues: set = set()  # gauge zeroing; guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
 

@@ -156,40 +156,40 @@ class SliceInventory:
         drains as they finish, exactly like the restart-rebuild path."""
         self._capacity = {str(k): int(v) for k, v in (capacity or {}).items()}
 
-    def modeled(self, key: str) -> bool:
-        return key in self._capacity
+    def modeled(self, shape: str) -> bool:
+        return shape in self._capacity
 
-    def capacity(self, key: str) -> Optional[int]:
+    def capacity(self, shape: str) -> Optional[int]:
         """Total modeled slices of a shape (None when unmodeled) — what
         distinguishes 'waiting for capacity' from 'can NEVER fit'."""
-        return self._capacity.get(key)
+        return self._capacity.get(shape)
 
-    def free(self, key: str) -> int:
-        if key not in self._capacity:
+    def free(self, shape: str) -> int:
+        if shape not in self._capacity:
             return 0
-        return self._capacity[key] - self._used.get(key, 0)
+        return self._capacity[shape] - self._used.get(shape, 0)
 
-    def fits(self, key: str, slices: int) -> bool:
+    def fits(self, shape: str, slices: int) -> bool:
         """Whether a whole gang of ``slices`` slices fits right now.
-        Unmodeled keys always fit (module docstring)."""
-        if key not in self._capacity:
+        Unmodeled shapes always fit (module docstring)."""
+        if shape not in self._capacity:
             return True
-        return self.free(key) >= slices
+        return self.free(shape) >= slices
 
     def snapshot(self) -> Dict[str, Dict[str, int]]:
-        """Introspection view: key → {capacity, used}."""
+        """Introspection view: shape → {capacity, used}."""
         return {k: {"capacity": c, "used": self._used.get(k, 0)}
                 for k, c in sorted(self._capacity.items())}
 
     # -- accounting ------------------------------------------------------------
 
-    def reserve(self, key: str, slices: int) -> None:
+    def reserve(self, shape: str, slices: int) -> None:
         """Unchecked reservation (callers decide via fits(); the rebuild
-        path reserves past capacity on purpose). Unmodeled keys are not
+        path reserves past capacity on purpose). Unmodeled shapes are not
         tracked — there is nothing to account against."""
-        if key in self._capacity:
-            self._used[key] = self._used.get(key, 0) + slices
+        if shape in self._capacity:
+            self._used[shape] = self._used.get(shape, 0) + slices
 
-    def release(self, key: str, slices: int) -> None:
-        if key in self._capacity:
-            self._used[key] = max(0, self._used.get(key, 0) - slices)
+    def release(self, shape: str, slices: int) -> None:
+        if shape in self._capacity:
+            self._used[shape] = max(0, self._used.get(shape, 0) - slices)

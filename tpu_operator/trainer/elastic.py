@@ -41,7 +41,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobSpec,
     TPUReplicaType,
 )
-from tpu_operator.util import lockdep
+from tpu_operator.util import joblife, lockdep
 
 
 def elastic_range(spec: TPUJobSpec) -> Optional[Tuple[int, int]]:
@@ -172,7 +172,8 @@ class RemediationTracker:
         self._lock = lockdep.lock("RemediationTracker._lock")
         # key -> {"attempt": n, "since": {pid: first-flag epoch},
         #         "done": set(pid remediated this attempt)}
-        self._jobs: Dict[str, Dict[str, Any]] = {}  # guarded-by: _lock
+        self._jobs: Dict[str, Dict[str, Any]] = joblife.track(
+            "RemediationTracker._jobs")  # per-job: forget; guarded-by: _lock
 
     def observe(self, key: str, attempt: int, flagged: Set[int],
                 now: float, patience: float) -> List[int]:

@@ -1,0 +1,279 @@
+"""Runtime job-lifecycle witness: tracked containers that prove per-job
+state dies with the job.
+
+The operator's most recurring bug class is per-job state that outlives
+the job: leaked event-dedup entries (PR 1), unbounded ``tpujob_queue_depth``
+label series (PR 7), metric series only pruned on deletion after a PR 9
+fix — each found by hand. This module is the lockdep of that bug class:
+every container keyed by job identity (the ones carrying a ``# per-job:``
+annotation, which the ``lifecycle`` analyzer rule enforces) is created
+through the :func:`track` factory with a stable class key
+("Controller.jobs"). When the witness is enabled, the factory returns a
+registered subclass of the raw container; the controller then
+:func:`sweep`-s the registry on every job deletion with the job's
+identity tokens, and any tracked container still holding a matching
+entry is a leak — recorded in a process-global violation list (the
+conftest autouse fixture fails the owning test; the churn soak in
+``bench.py --churn`` fails the gate).
+
+Cost model, same contract as :mod:`tpu_operator.util.lockdep`:
+**disabled (default), the factories return the raw builtin
+containers** — zero overhead, one branch at construction. Enabled
+(``TPUJOB_JOBLIFE=1``, or :func:`enable` before the containers are
+constructed — tests/conftest.py does this for the whole suite), the
+containers are plain subclasses (no per-operation cost); the only work
+is the O(total tracked entries) scan per job deletion.
+
+Identity tokens and matching: a deleted job is described by its
+reconcile key (``"ns/name"``), its ``(namespace, name)`` tuple, and its
+UID when known. A container entry leaks when its key equals a token, or
+is a tuple whose leading elements equal a tuple token — which covers
+every per-job keying shape in the tree: ``key``-keyed maps (controller,
+fleet scheduler, deadline manager, remediation tracker), ``(namespace,
+name)``-keyed maps (statusserver heartbeats), and ``(namespace, name,
+reason, message)``-keyed caches (event dedup).
+
+Epochs keep the registry honest across a long pytest session: a test's
+sweep must not report residue from a *previous* test's abandoned
+controller (same job names recur constantly), so the conftest fixture
+bumps the epoch before every test and :func:`sweep`/:func:`counts` only
+see containers constructed in the current epoch.
+
+Violations accumulate (``violations()``) rather than raise: the sweep
+runs inside the reconcile worker's broad try/except, where a raise would
+be swallowed into a requeue loop — exactly the lockdep lesson.
+"""
+
+from __future__ import annotations
+
+import collections
+import os
+import threading
+import weakref
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+_enabled = os.environ.get("TPUJOB_JOBLIFE", "") not in ("", "0", "false")
+
+# The witness's own state is guarded by one RAW lock (never witnessed /
+# never lockdep-instrumented: the watcher must not watch itself).
+_state_lock = threading.Lock()
+_containers: "weakref.WeakSet" = weakref.WeakSet()  # guarded-by: _state_lock
+_violations: List[str] = []                         # guarded-by: _state_lock
+_epoch = 0                                          # guarded-by: _state_lock
+
+
+def enable(on: bool = True) -> None:
+    """Turn the witness on for containers constructed AFTER this call."""
+    global _enabled
+    _enabled = on
+
+
+def enabled() -> bool:
+    return _enabled
+
+
+def new_epoch() -> int:
+    """Start a fresh tracking epoch (the conftest fixture calls this per
+    test): sweeps and counts only see containers constructed after the
+    bump, so one test's abandoned state never bleeds into the next
+    test's verdict. Returns the new epoch id."""
+    global _epoch
+    with _state_lock:
+        _epoch += 1
+        return _epoch
+
+
+def current_epoch() -> int:
+    """The live epoch id — sweep owners (the controller) capture it at
+    construction and pass it back to :func:`sweep`, so a sweeper thread
+    lingering from a previous epoch (an abandoned test's worker draining
+    its last deletion) can never charge a violation to containers of the
+    epoch that replaced it."""
+    with _state_lock:
+        return _epoch
+
+
+def reset() -> None:
+    """Test hook: drop recorded violations and start a new epoch."""
+    global _epoch
+    with _state_lock:
+        del _violations[:]
+        _epoch += 1
+
+
+def violations() -> List[str]:
+    with _state_lock:
+        return list(_violations)
+
+
+def violation_count() -> int:
+    with _state_lock:
+        return len(_violations)
+
+
+def report() -> str:
+    """Human-readable dump of every recorded violation."""
+    with _state_lock:
+        if not _violations:
+            return "joblife: no per-job state leaks"
+        return "\n\n".join(_violations)
+
+
+def record_violation(message: str) -> None:
+    """Record an externally detected lifecycle violation (the controller
+    uses this for metric series that outlive a deleted job — the metric
+    registry is scanned through :meth:`Metrics.job_series`, not through
+    a tracked container)."""
+    with _state_lock:
+        _violations.append(message)
+
+
+# --- factories ---------------------------------------------------------------
+
+class _TrackedDict(dict):
+    """Plain dict, weakref-able and registered under a class key.
+    Identity-hashed so the weak registry can hold it (dicts are
+    unhashable; these containers are registry members, never keys)."""
+
+    __hash__ = object.__hash__
+
+
+class _TrackedOrderedDict(collections.OrderedDict):
+    """OrderedDict variant (LRU caches: move_to_end/popitem survive)."""
+
+    __hash__ = object.__hash__
+
+
+class _TrackedSet(set):
+    """Set variant."""
+
+    __hash__ = object.__hash__
+
+
+_KINDS = {
+    "dict": (dict, _TrackedDict),
+    "ordered": (collections.OrderedDict, _TrackedOrderedDict),
+    "set": (set, _TrackedSet),
+}
+
+
+def track(name: str, kind: str = "dict") -> Any:
+    """A container registered for deletion sweeps under ``name``
+    ("Class._attr" — the same key the ``# per-job:`` annotation sits
+    on). Returns the RAW builtin when the witness is off."""
+    raw_cls, tracked_cls = _KINDS[kind]
+    if not _enabled:
+        return raw_cls()
+    obj = tracked_cls()
+    with _state_lock:
+        obj._joblife_name = name
+        obj._joblife_epoch = _epoch
+        _containers.add(obj)
+    return obj
+
+
+def _live() -> List[Any]:
+    with _state_lock:
+        epoch = _epoch
+        return [c for c in _containers
+                if getattr(c, "_joblife_epoch", -1) == epoch]
+
+
+# --- sweeps ------------------------------------------------------------------
+
+def _matches(key: Any, token: Any) -> bool:
+    if key == token:
+        return True
+    if isinstance(key, tuple) and isinstance(token, tuple) \
+            and len(key) >= len(token):
+        return tuple(key[:len(token)]) == token
+    return False
+
+
+_SCAN_ABANDONED = object()
+
+
+def _scan(container: Any, tokens: Tuple[Any, ...]) -> Any:
+    """Residual keys of one container, resilient to concurrent mutation
+    (other jobs' state legitimately churns while we scan). Returns the
+    sentinel ``_SCAN_ABANDONED`` when the container would not hold still
+    — the caller reports it rather than silently vouching "clean" for a
+    container the witness never actually saw."""
+    import time as _time
+    for attempt in range(5):
+        try:
+            return [k for k in list(container)
+                    if any(_matches(k, t) for t in tokens)]
+        except RuntimeError:  # size changed mid-list(); retry
+            if attempt < 4:
+                _time.sleep(0.001)
+    return _SCAN_ABANDONED
+
+
+def residuals(tokens: Iterable[Any]) -> List[Tuple[str, Any]]:
+    """(container name, residual key) pairs matching ``tokens`` across
+    every live tracked container — the read-only form of :func:`sweep`.
+    An unscannable container reports the abandonment sentinel as its
+    residual key."""
+    toks = tuple(tokens)
+    out: List[Tuple[str, Any]] = []
+    for container in _live():
+        found = _scan(container, toks)
+        if found is _SCAN_ABANDONED:
+            out.append((container._joblife_name, _SCAN_ABANDONED))
+            continue
+        for k in found:
+            out.append((container._joblife_name, k))
+    return out
+
+
+def sweep(tokens: Iterable[Any], where: str = "",
+          epoch: Optional[int] = None) -> List[str]:
+    """Assert no tracked container still holds an entry for the job
+    described by ``tokens`` (its reconcile key, its ``(namespace, name)``
+    tuple, its UID). Each residual entry is a leak: recorded in the
+    violation list and returned. Call AFTER the deletion path's cleanup
+    has run — anything still matching outlived the job.
+
+    ``epoch`` is the sweeper's capture of :func:`current_epoch` at
+    construction: when it no longer matches, the sweeper outlived its
+    epoch (an abandoned harness's worker draining a last deletion) and
+    the sweep is skipped — its verdict would be about containers it
+    never owned."""
+    if epoch is not None:
+        with _state_lock:
+            if epoch != _epoch:
+                return []
+    found = residuals(tokens)
+    if not found:
+        return []
+    out = []
+    for name, k in found:
+        if k is _SCAN_ABANDONED:
+            out.append(
+                f"joblife: sweep could not scan {name} after "
+                f"{where or 'job deletion'} — the container never held "
+                f"still across 5 attempts; its leak verdict is UNKNOWN, "
+                f"which the witness refuses to report as clean")
+            continue
+        out.append(
+            f"joblife: per-job state leak — {name} still holds "
+            f"{k!r} after {where or 'job deletion'} (every `# per-job:` "
+            f"container must drop its entries on the delete path)")
+    with _state_lock:
+        _violations.extend(out)
+    return out
+
+
+def counts() -> Dict[str, int]:
+    """Live entry count per tracked container name, summed over
+    instances (the churn soak's flatness probe)."""
+    out: Dict[str, int] = {}
+    for container in _live():
+        name = container._joblife_name
+        out[name] = out.get(name, 0) + len(container)
+    return out
+
+
+def total_entries() -> int:
+    return sum(counts().values())
