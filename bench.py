@@ -1648,6 +1648,7 @@ def bench_steptrace(quick: bool) -> list:
     import jax
 
     from tpu_operator.payload import cifar, data as data_mod
+    from tpu_operator.payload import heartbeat as heartbeat_mod
     from tpu_operator.payload import steptrace as steptrace_mod
 
     # Small batch, many steps per window: the recorder's cost is constant
@@ -1690,6 +1691,15 @@ def bench_steptrace(quick: bool) -> list:
                 fence = metrics
                 rec.lap(steptrace_mod.HOST)
                 rec.commit()
+                # The idle profile-directive poll, exactly as the
+                # production loop runs it after every commit
+                # (train.train_loop): the on-demand capture path must
+                # cost nothing while no directive is pending, so its
+                # idle cost is measured inside the same ≤1% budget as
+                # the recorder.
+                take = getattr(idle_hb, "take_profile_directive", None)
+                if take is not None and take():
+                    raise AssertionError("idle reporter yielded a directive")
         jax.device_get(metrics["loss"])
         return (time.perf_counter() - t0) / steps
 
@@ -1698,6 +1708,10 @@ def bench_steptrace(quick: bool) -> list:
         state, metrics = step_fn(state, *next(cycled))
     jax.device_get(metrics["loss"])
 
+    # A REAL reporter (never started — no beats, no sockets) so the
+    # idle poll exercises the production take_profile_directive path.
+    idle_hb = heartbeat_mod.HeartbeatReporter(
+        "http://bench.invalid", "bench", poster=lambda *_a: None)
     recorder = steptrace_mod.StepRecorder(capacity=1024)
     off_times, on_times = [], []
     for _ in range(windows):

@@ -158,6 +158,14 @@ python bench.py --fleet --quick
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
 # regression fails CI by name, not as a slow bench row.
 python -m pytest tests/test_api_budget.py -x -q
+# Standalone observability gate: the unified timeline (store bounds +
+# lifecycle residue, span assembly/ordering, Chrome export, fleet
+# rollup fold, profile directive round-trip, ctl timeline/profile/top),
+# then the same plane proven over the real operator binary and status
+# port — queue→admit→preempt/resize→Done with the churn-soak residue
+# check riding along.
+python -m pytest tests/test_timeline.py -x -q
+python -m pytest tests/test_fleet_obs_e2e.py -x -q
 # And the measured form of the same contract: bench.py --control-plane
 # exits nonzero if reads-per-reconcile leaves zero or the parallel gang
 # create stops beating sequential (--quick: 16-32 replicas, seconds).
@@ -175,6 +183,8 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_serving.py \
   --ignore=tests/test_lockdep.py \
   --ignore=tests/test_lifecycle.py \
-  --ignore=tests/test_schedules.py
+  --ignore=tests/test_schedules.py \
+  --ignore=tests/test_timeline.py \
+  --ignore=tests/test_fleet_obs_e2e.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

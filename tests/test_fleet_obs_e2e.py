@@ -1,0 +1,326 @@
+"""Fleet observability e2e: the unified timeline over the real operator
+binary, and the churn-soak residue gate for the TimelineStore.
+
+The binary tier drives one elastic job through its whole observable life
+— submit while the fleet is full (Queued), admit off a freed slice, run,
+preempt into a capacity-grown pool (restart + resize up), finish — with
+the operator running as a real process against the HTTP test apiserver,
+then reads the timeline back over the operator's OWN status port and
+asserts the span tree tells that story in order: queue/admit decision
+spans, the phase ladder, the failure-ledger restart span, the
+elastic:resize span, and a Chrome trace export perfetto would accept.
+The fleet rollup endpoint and the fleet_* metric families (goodput,
+queue waits, preemption cost) are scraped from the same port, so the
+whole observability plane is proven over the wire, process boundary
+included.
+
+The in-process tier is the lifecycle gate: a create/delete churn storm
+must leave ``TimelineStore.job_count() == 0`` — the conftest joblife
+guard turns any per-job residue into a test failure.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import io
+import json
+import signal
+import socket
+import subprocess
+import sys
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.controller.controller import Controller
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+
+wait_for = make_wait_for(timeout=60.0, interval=0.25)
+
+V4 = "cloud-tpus.google.com/v4"
+
+
+def free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+def status_get(port: int, path: str):
+    """GET against the operator's status port; (code, parsed-or-text)."""
+    url = f"http://127.0.0.1:{port}{path}"
+    try:
+        with urllib.request.urlopen(url, timeout=5.0) as resp:
+            body = resp.read().decode()
+            ctype = resp.headers.get("Content-Type", "")
+            if "json" in ctype:
+                return resp.status, json.loads(body)
+            return resp.status, body
+    except urllib.error.HTTPError as e:
+        return e.code, e.read().decode()
+    except (urllib.error.URLError, OSError):
+        return 0, ""
+
+
+def node(name: str, sid: str) -> dict:
+    return {"metadata": {"name": name, "labels": {
+        "cloud.google.com/gke-tpu-topology": "2x2x2",
+        "tpuoperator.dev/slice-id": sid}},
+        "status": {"allocatable": {V4: "4"}}}
+
+
+def make_template(chips=4):
+    return {"spec": {"containers": [{"name": "tpu", "image": "x",
+                                     "resources": {"requests": {
+                                         V4: str(chips)}}}]}}
+
+
+def rigid_job(name: str) -> dict:
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=1, template=make_template(),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="ob01", tpu_topology="2x2x2",
+        restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    return t.TPUJob(metadata={"name": name, "namespace": "default",
+                              "uid": f"uid-{name}"}, spec=spec).to_dict()
+
+
+def elastic_job(name: str) -> dict:
+    """A 2-process gang over [1, 2] v4 slices: small enough to admit on
+    one freed slice, elastic enough to resize up on restart."""
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=2, template=make_template(),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="ob02", tpu_topology="2x2x2", num_slices=2,
+        elastic=t.ElasticSpec(min_slices=1, max_slices=2),
+        restart_backoff=t.RestartBackoffSpec(base_seconds=0))
+    return t.TPUJob(metadata={"name": name, "namespace": "default",
+                              "uid": f"uid-{name}"}, spec=spec).to_dict()
+
+
+def set_pod_state(cs, pod, phase, container_state):
+    pod["status"] = {
+        "phase": phase,
+        "containerStatuses": [{"name": "tpu", "state": container_state}],
+    }
+    cs.pods.update("default", pod)
+
+
+def live_pods(cs, job="obs"):
+    """The job's live gang (a deleted job's pods may linger until the GC
+    sweep — scope by name so the hog's orphan doesn't count)."""
+    return [p for p in cs.pods.list("default")
+            if p["metadata"]["name"].startswith(f"{job}-")
+            and (p.get("status") or {}).get("phase")
+            not in ("Succeeded", "Failed")]
+
+
+@pytest.fixture
+def operator_env():
+    """Real operator binary with fleet scheduling discovered from the
+    node watch and the status server on a real port."""
+    harness = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=harness.url, timeout=5.0))
+    port = free_port()
+    op = subprocess.Popen(
+        [sys.executable, "-m", "tpu_operator.cmd.main", "--master",
+         harness.url, "--namespace", "default", "--no-leader-elect",
+         "--discover-slice-inventory", "--status-port", str(port)],
+        stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+    yield cs, port
+    op.send_signal(signal.SIGINT)
+    try:
+        op.wait(timeout=10)
+    except subprocess.TimeoutExpired:
+        op.kill()
+    harness.stop()
+
+
+def phase_of(cs, name):
+    return (cs.tpujobs.get("default", name).get("status") or {}) \
+        .get("phase")
+
+
+@pytest.mark.slow
+def test_timeline_over_operator_binary(operator_env):
+    """Acceptance walk for the observability plane: queue → admit →
+    run → preempt/resize → Done, then the timeline read back over the
+    operator's status port tells the whole story in span order."""
+    cs, port = operator_env
+
+    # One discovered slice; a rigid hog takes it, so the elastic job
+    # queues — the timeline's first chapters.
+    cs.nodes.create("", node("n1", "slice-a"))
+    cs.tpujobs.create("default", rigid_job("hog"))
+    assert wait_for(lambda: phase_of(cs, "hog") == "Creating")
+    cs.tpujobs.create("default", elastic_job("obs"))
+    assert wait_for(lambda: phase_of(cs, "obs") == "Queued")
+
+    # The hog finishes its tenancy: its slice frees, obs admits at the
+    # elastic minimum (1 of 2 slices → a 1-process gang).
+    cs.tpujobs.delete("default", "hog")
+    assert wait_for(lambda: phase_of(cs, "obs") == "Creating")
+    assert wait_for(lambda: len(live_pods(cs)) == 1)
+    for p in live_pods(cs):
+        set_pod_state(cs, p, "Running", {"running": {}})
+    assert wait_for(lambda: phase_of(cs, "obs") == "Running")
+
+    # A node pool scales up, then the gang is preempted (exit 137): the
+    # restart regangs at 2 slices — a failure-ledger record AND an
+    # elastic resize land on the same timeline.
+    cs.nodes.create("", node("n2", "slice-b"))
+    assert wait_for(lambda: (status_get(
+        port, "/api/fleet")[1] or {}).get("jobs") is not None)
+    for p in live_pods(cs):
+        set_pod_state(cs, p, "Failed", {"terminated": {"exitCode": 137}})
+    assert wait_for(lambda: len(live_pods(cs)) == 2, timeout=90.0)
+    status = cs.tpujobs.get("default", "obs")["status"]
+    assert status["elastic"]["slices"] == 2
+    assert status["elastic"]["lastResizeDirection"] == "up"
+    for p in live_pods(cs):
+        set_pod_state(cs, p, "Succeeded", {"terminated": {"exitCode": 0}})
+    assert wait_for(lambda: phase_of(cs, "obs") == "Done", timeout=90.0)
+
+    # -- the timeline, over the wire ------------------------------------
+    code, body = status_get(port, "/api/jobs/default/obs/timeline")
+    assert code == 200, body
+    spans = body["spans"]
+    assert body["job"] == "default/obs"
+    assert body["phase"] == "Done"
+
+    kinds = {s["kind"] for s in spans}
+    assert {"phase", "decision", "failure", "elastic"} <= kinds, kinds
+    names = [s["name"] for s in spans]
+    assert "phase:Queued" in names
+    assert "phase:Running" in names
+    assert "phase:Done" in names
+    assert "elastic:resize" in names
+    assert any(n.startswith("restart:") for n in names), names
+
+    # Spans come back start-ordered — the assembled tree IS the story.
+    starts = [s["start"] for s in spans]
+    assert starts == sorted(starts)
+    # The ledger span carries the restart's forensics inline.
+    ledger = next(s for s in spans if s["kind"] == "failure")
+    assert ledger["attrs"]["attempt"] == 0
+    resize = next(s for s in spans if s["name"] == "elastic:resize")
+    assert resize["attrs"]["direction"] == "up"
+    # Queued happened strictly before the restart record.
+    queued = next(s for s in spans if s["name"] == "phase:Queued")
+    assert queued["start"] <= ledger["start"]
+    # Decision spans carry reconcile trace ids that cross-reference the
+    # trace buffer's ?job= filter.
+    traced = [s for s in spans
+              if s["kind"] == "decision" and s.get("traceId")]
+    assert traced, [s["name"] for s in spans if s["kind"] == "decision"]
+    code, traces = status_get(port, "/api/traces?job=default/obs")
+    assert code == 200
+    trace_ids = {s.get("traceId") for s in traces.get("spans", [])}
+    assert trace_ids & {s["traceId"] for s in traced}
+
+    # -- Chrome trace export: perfetto-loadable JSON --------------------
+    code, chrome = status_get(
+        port, "/api/jobs/default/obs/timeline?format=chrome")
+    assert code == 200
+    events = chrome if isinstance(chrome, list) else json.loads(chrome)
+    phs = {ev.get("ph") for ev in events}
+    assert "M" in phs            # process/thread name metadata
+    assert phs & {"X", "i"}      # complete spans and/or instants
+    assert all("ts" in ev for ev in events if ev.get("ph") != "M")
+
+    # -- fleet rollup + metric families over the same port --------------
+    code, fleet = status_get(port, "/api/fleet")
+    assert code == 200
+    rows = {r["name"]: r for r in fleet["jobs"]}
+    assert rows["obs"]["phase"] == "Done"
+    assert rows["obs"]["restarts"] == 1
+    assert fleet["preemption"]["restarts"] >= 1
+
+    code, metrics_text = status_get(port, "/metrics")
+    assert code == 200
+    assert "fleet_goodput_ratio" in metrics_text
+    assert "fleet_preemption_lost_step_seconds" in metrics_text
+    assert "fleet_straggler_count" in metrics_text
+    assert "fleet_remediation_count" in metrics_text
+    # obs waited in the queue before admitting, so the per-queue wait
+    # quantile gauge has samples for its queue.
+    assert "fleet_queue_wait_seconds" in metrics_text
+    assert 'queue="default"' in metrics_text
+
+    # 404 contract: an unknown job is a miss, not an empty timeline.
+    code, _ = status_get(port, "/api/jobs/default/ghost/timeline")
+    assert code == 404
+
+    # -- tpujobctl against the live binary's status port ----------------
+    from tpu_operator.cmd import ctl
+    url = f"http://127.0.0.1:{port}"
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--status-url", url, "timeline", "obs"])
+    text = out.getvalue()
+    assert rc == 0
+    assert "default/obs" in text
+    assert "phase:Queued" in text and "elastic:resize" in text
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        rc = ctl.main(["--status-url", url, "top"])
+    assert rc == 0
+    assert "obs" in out.getvalue()
+
+
+# --- churn soak: zero joblife residue ---------------------------------------
+
+
+def churn_job(name: str) -> dict:
+    spec = t.TPUJobSpec(
+        replica_specs=[t.TPUReplicaSpec(
+            replicas=1, template=make_template(),
+            tpu_replica_type=t.TPUReplicaType.WORKER)],
+        runtime_id="ob03")
+    return t.TPUJob(metadata={"name": name, "namespace": "default",
+                              "uid": f"uid-{name}"}, spec=spec).to_dict()
+
+
+def test_timeline_store_survives_job_churn_with_zero_residue():
+    """Create/delete N jobs through a live controller: every one of them
+    feeds decision events into the TimelineStore, and every deletion
+    must prune its slot — ``job_count() == 0`` at the end, and the
+    conftest joblife guard fails the test on any witness residue."""
+    cs = FakeClientset()
+    factory = SharedInformerFactory(cs, resync_period=0)
+    controller = Controller(cs, factory)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True)
+    runner.start()
+    soak_wait = make_wait_for(timeout=20.0, interval=0.05)
+    try:
+        names = [f"churn-{i}" for i in range(10)]
+        for n in names:
+            cs.tpujobs.create("default", churn_job(n))
+        # Every job got far enough to emit events into its timeline.
+        assert soak_wait(lambda: all(
+            controller.timeline.events("default", n) for n in names))
+        assert controller.timeline.job_count() == len(names)
+        for n in names:
+            cs.tpujobs.delete("default", n)
+        assert soak_wait(
+            lambda: not any(f"default/{n}" in controller.jobs
+                            for n in names))
+        # Deletion reconciles pruned each slot eagerly — no residue.
+        assert soak_wait(lambda: controller.timeline.job_count() == 0), \
+            controller.timeline.job_count()
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)

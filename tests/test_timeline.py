@@ -1,0 +1,524 @@
+"""Fleet observability plane: unified timelines, on-demand profiling,
+and the fleet goodput rollup.
+
+Unit half: the timeline store (bounds, lifecycle residue), the pure
+assemblers (span ordering, Chrome export), the quantile helper, and the
+fleet rollup math (cluster goodput must equal the fold of the per-job
+``status.goodput`` folds by construction).
+
+Integration half: the operator runs in-process against the HTTP test
+apiserver (strict status-subresource schema — the new ``status.profile``
+fields prove they pass admission), a simulated payload posts heartbeats
+the way ``payload/heartbeat.py`` does, and the profile directive makes
+the full round trip: ``tpujobctl profile`` annotation → reconcile admits
+``status.profile`` Requested → heartbeat ACK carries the directive →
+capture result folds back Captured with a ``ProfileCaptured`` event.
+"""
+
+import json
+import threading
+import urllib.error
+import urllib.request
+
+import pytest
+
+from tpu_operator.apis.tpujob.v1alpha1.types import PROFILE_ANNOTATION
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer, \
+    _sanitize_profile
+from tpu_operator.obs import timeline as timeline_mod
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.payload import profile as profile_mod
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.util import joblife, tracing
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+
+# --- timeline store ----------------------------------------------------------
+
+
+def test_store_bounds_and_lifecycle_residue():
+    store = timeline_mod.TimelineStore()
+    for i in range(timeline_mod.EVENTS_PER_JOB_CAP + 50):
+        store.record_event("default", "tl", "Normal", "Tick", f"m{i}")
+    events = store.events("default", "tl")
+    assert len(events) == timeline_mod.EVENTS_PER_JOB_CAP
+    # Oldest rotated out, newest kept.
+    assert events[-1]["message"] == f"m{timeline_mod.EVENTS_PER_JOB_CAP + 49}"
+    assert store.job_count() == 1
+    # The PR-15 lifecycle contract: after the deletion prune the witness
+    # must see zero residue for the job's identity tokens.
+    store.forget_job("default", "tl")
+    assert store.job_count() == 0
+    assert joblife.residuals([("default", "tl")]) == []
+    assert store.events("default", "tl") == []
+    store.forget_job("default", "never-seen")  # prune is idempotent
+
+
+def test_store_events_carry_reconcile_trace_id():
+    store = timeline_mod.TimelineStore()
+    with tracing.span("reconcile", key="default/tr"):
+        store.record_event("default", "tr", "Normal", "Admitted", "go")
+    (event,) = store.events("default", "tr")
+    assert event["traceId"]
+    # The id is the cross-reference into /api/traces?job=<ns>/<name>.
+    spans = tracing.recent_spans(10)
+    assert any(s["traceId"] == event["traceId"] for s in spans)
+
+
+# --- assembly ----------------------------------------------------------------
+
+
+def _rich_status():
+    return {
+        "phase": "Running",
+        "phaseTimeline": {
+            "Queued": "2026-08-06T10:00:00Z",
+            "Creating": "2026-08-06T10:00:05Z",
+            "Running": "2026-08-06T10:00:30Z",
+        },
+        "failures": [{"attempt": 0, "kind": "preemption",
+                      "reason": "spot reclaim",
+                      "time": "2026-08-06T10:05:00Z",
+                      "resumeStep": 90, "worldSlices": 2,
+                      "lostSteps": 10}],
+        "startup": {"attempt": 1, "time": "2026-08-06T10:06:00Z",
+                    "rendezvousSeconds": 2.0, "restoreSeconds": 3.0,
+                    "compileSeconds": 10.0, "firstStepSeconds": 1.0,
+                    "cacheHit": True},
+        "stepTiming": {"attempt": 1, "time": "2026-08-06T10:07:00Z",
+                       "steps": 50, "stepP50Seconds": 0.1,
+                       "stepP95Seconds": 0.12, "stepMaxSeconds": 0.2},
+        "elastic": {"slices": 2, "attempt": 1, "resizes": 1,
+                    "lastResizeDirection": "down",
+                    "time": "2026-08-06T10:06:30Z",
+                    "remediations": [{"attempt": 1, "processId": 3,
+                                      "policy": "shed",
+                                      "time": "2026-08-06T10:08:00Z"}]},
+        "store": {"lastUploadedStep": 100,
+                  "time": "2026-08-06T10:08:30Z"},
+        "profile": {"id": "abc", "state": "Captured", "steps": 8,
+                    "capturedSteps": 8, "time": "2026-08-06T10:09:00Z",
+                    "artifactKey": "artifacts/profile-abc.json"},
+        "goodput": {"ratio": 0.91, "usefulStepSeconds": 91.0,
+                    "wallclockSeconds": 100.0},
+        "scheduling": {"queue": "batch", "priority": 5},
+    }
+
+
+def test_assemble_timeline_merges_every_signal_in_order():
+    events = [{"time": "2026-08-06T10:00:04Z", "type": "Normal",
+               "reason": "Admitted", "message": "queue batch",
+               "traceId": "t1"}]
+    tl = timeline_mod.assemble_timeline("default", "rich", _rich_status(),
+                                        events)
+    assert tl["job"] == "default/rich"
+    assert tl["phase"] == "Running"
+    spans = tl["spans"]
+    starts = [s["start"] for s in spans]
+    assert starts == sorted(starts)
+    kinds = {s["kind"] for s in spans}
+    assert {"phase", "decision", "failure", "startup", "steps",
+            "elastic", "store", "profile"} <= kinds
+    # The ledger span carries the restart's audit trail.
+    (ledger,) = [s for s in spans if s["kind"] == "failure"]
+    assert ledger["attrs"]["resumeStep"] == 90
+    assert ledger["attrs"]["lostSteps"] == 10
+    # The decision span carries its reconcile trace id.
+    (decision,) = [s for s in spans if s["kind"] == "decision"]
+    assert decision["traceId"] == "t1"
+    # Phase spans: non-terminal phases have durations that chain.
+    queued = next(s for s in spans if s["name"] == "phase:Queued")
+    assert queued["durationSeconds"] == pytest.approx(5.0)
+    # Elastic: both the resize and the remediation appear.
+    elastic_names = {s["name"] for s in spans if s["kind"] == "elastic"}
+    assert any(n.startswith("elastic:resize") for n in elastic_names)
+    assert any(n.startswith("elastic:remediation") for n in elastic_names)
+
+
+def test_chrome_export_is_perfetto_shaped():
+    tl = timeline_mod.assemble_timeline("default", "rich", _rich_status(),
+                                        [])
+    trace = timeline_mod.to_chrome_trace(tl)
+    # Must survive a JSON round trip (the CLI dumps it verbatim).
+    parsed = json.loads(json.dumps(trace))
+    phases = {e["ph"] for e in parsed}
+    assert {"M", "X", "i"} <= phases
+    names = {e["name"] for e in parsed if e["ph"] == "M"}
+    assert "process_name" in names and "thread_name" in names
+    for e in parsed:
+        if e["ph"] == "X":
+            assert e["dur"] >= 0 and isinstance(e["ts"], int)
+
+
+def test_quantiles_nearest_rank():
+    q = timeline_mod.quantiles([3.0, 1.0, 2.0, 4.0])
+    assert q["count"] == 4
+    assert q["p50"] == 2.0
+    assert q["p95"] == 4.0
+    single = timeline_mod.quantiles([7.5])
+    assert single["p50"] == single["p95"] == 7.5
+
+
+# --- fleet rollup ------------------------------------------------------------
+
+
+def test_fleet_rollup_matches_per_job_goodput_fold():
+    jobs = [
+        {"namespace": "default", "name": "a", "status": {
+            "phase": "Running",
+            "goodput": {"usefulStepSeconds": 80.0,
+                        "wallclockSeconds": 100.0, "ratio": 0.8,
+                        "lastStep": 100},
+            "lastHeartbeat": {"step": 100, "stepTimeSeconds": 0.5},
+            "failures": [{"attempt": 0, "kind": "preemption",
+                          "lostSteps": 20}],
+            "checkpoint": {"lastCheckpointStep": 80},
+            "scheduling": {"queue": "batch"},
+            "stragglers": [{"processId": 1, "ratio": 1.7}],
+            "elastic": {"remediations": [{"processId": 1}]},
+        }},
+        {"namespace": "default", "name": "b", "status": {
+            "phase": "Queued",
+            "goodput": {"usefulStepSeconds": 40.0,
+                        "wallclockSeconds": 60.0, "ratio": 0.667},
+            "scheduling": {"queue": "batch", "position": 0},
+        }},
+    ]
+    rollup = timeline_mod.fleet_rollup(
+        jobs, {"batch": {"p50": 1.0, "p95": 2.0, "count": 3}})
+    # THE acceptance invariant: the cluster ratio is the fold of the
+    # per-job folds — Σ useful / Σ wallclock, not an average of ratios.
+    assert rollup["goodput"]["ratio"] == pytest.approx(120.0 / 160.0)
+    assert rollup["preemption"]["restarts"] == 1
+    assert rollup["preemption"]["lostSteps"] == 20
+    # 20 lost steps × 0.5 s/step = 10 lost step-seconds.
+    assert rollup["preemption"]["lostStepSeconds"] == pytest.approx(10.0)
+    assert rollup["stragglers"] == {"flagged": 1, "remediations": 1}
+    assert rollup["queues"]["batch"]["p95"] == 2.0
+    rows = rollup["jobs"]
+    assert [r["name"] for r in rows] == ["a", "b"]
+    assert rows[0]["worstStragglerRatio"] == pytest.approx(1.7)
+    assert rows[0]["lastDurableStep"] == 80
+    assert rows[1]["queuePosition"] == 0
+    # Empty fleet: well-formed zeros, not a division crash.
+    empty = timeline_mod.fleet_rollup([])
+    assert empty["goodput"]["ratio"] == 0.0 and empty["jobs"] == []
+
+
+# --- heartbeat directive channel (payload side) ------------------------------
+
+
+def test_reporter_takes_ack_directive_once_and_resends_result():
+    acks = []
+    posts = []
+
+    def poster(_url, body):
+        posts.append(body)
+        return acks.pop(0) if acks else {"ok": True}
+
+    r = heartbeat_mod.HeartbeatReporter("http://x:1", "j", poster=poster,
+                                        clock=lambda: 0.0)
+    acks.append({"ok": True, "profile": {"id": "p1", "steps": 4}})
+    assert r.report(1, {"loss": 1.0})
+    assert r.take_profile_directive() == {"id": "p1", "steps": 4}
+    assert r.take_profile_directive() is None  # one-shot swap
+
+    # The same directive id on a later ACK is deduplicated — re-delivery
+    # while status.profile is still Requested must not restart a capture.
+    acks.append({"ok": True, "profile": {"id": "p1", "steps": 4}})
+    assert r.report(2, {"loss": 1.0})
+    assert r.take_profile_directive() is None
+
+    # The capture result rides every beat until a post succeeds.
+    r.attach_profile_result({"id": "p1", "capturedSteps": 4})
+    assert r.report(3, {"loss": 1.0})
+    assert posts[-1]["profile"] == {"id": "p1", "capturedSteps": 4}
+    assert r.report(4, {"loss": 1.0})
+    assert "profile" not in posts[-1]  # cleared after the 200
+
+
+def test_profile_capture_laps_and_artifact(tmp_path):
+    cap = profile_mod.ProfileCapture({"id": "cap/1", "steps": 3},
+                                     base_dir=str(tmp_path),
+                                     allow_jax_trace=False)
+    cap.start(completed_step=10)
+    done = []
+    for step in (11, 12, 13):
+        done.append(cap.tick(step))
+    assert done == [False, False, True]
+    path, result = cap.finish()
+    assert result["id"] == "cap/1" and result["capturedSteps"] == 3
+    body = json.loads(open(path, encoding="utf-8").read())
+    assert body["kind"] == profile_mod.ARTIFACT_KIND
+    assert [row["step"] for row in body["steps"]] == [11, 12, 13]
+    assert all(row["wallSeconds"] >= 0 for row in body["steps"])
+    # Path-hostile directive ids are sanitized into the file name.
+    assert "/" not in path.rsplit("profile-", 1)[1]
+
+
+def test_sanitize_profile_rejects_garbage():
+    clean, err = _sanitize_profile({"id": "p1", "capturedSteps": 4,
+                                    "artifactKey": "artifacts/x.json"})
+    assert not err and clean["capturedSteps"] == 4
+    _clean, err = _sanitize_profile({"capturedSteps": 4})
+    assert err  # id is mandatory
+    _clean, err = _sanitize_profile({"id": "p1", "capturedSteps": -2})
+    assert err
+    _clean, err = _sanitize_profile("not a dict")
+    assert err
+
+
+# --- integration: operator + strict apiserver --------------------------------
+
+
+def worker_job(name, replicas=1):
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": name, "namespace": "default"},
+        "spec": {"replicaSpecs": [{
+            "replicas": replicas, "tpuReplicaType": "WORKER",
+            "tpuPort": 8476,
+            "template": {"spec": {"containers": [{"name": "tpu",
+                                                  "image": "x"}]}}}]},
+    }
+
+
+def get(port, path):
+    with urllib.request.urlopen(
+            f"http://127.0.0.1:{port}{path}", timeout=5) as r:
+        return r.read().decode()
+
+
+@pytest.fixture()
+def harness():
+    tracing.clear_spans()
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def _run_job(api, cs, name):
+    cs.tpujobs.create("default", worker_job(name))
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) >= 1)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", name)
+                    .get("status", {}).get("phase") == "Running")
+
+
+def test_timeline_endpoint_and_trace_filter(harness):
+    api, cs, controller, server = harness
+    _run_job(api, cs, "tljob")
+
+    tl = json.loads(get(server.port, "/api/jobs/default/tljob/timeline"))
+    assert tl["job"] == "default/tljob"
+    spans = tl["spans"]
+    assert spans, "a running job must have phase + decision spans"
+    assert [s["start"] for s in spans] == sorted(s["start"] for s in spans)
+    assert any(s["kind"] == "phase" for s in spans)
+    decisions = [s for s in spans if s["kind"] == "decision"]
+    assert any("SuccessfulCreate" in s["name"] for s in decisions)
+
+    # Decision spans cross-reference the reconcile trace that caused
+    # them, and ?job= filters /api/traces down to that job's traces.
+    traced = [s for s in decisions if s.get("traceId")]
+    assert traced
+    body = json.loads(get(server.port,
+                          "/api/traces?job=default/tljob&limit=500"))
+    trace_ids = {s["traceId"] for s in body["spans"]}
+    assert traced[0]["traceId"] in trace_ids
+    other = json.loads(get(server.port,
+                           "/api/traces?job=default/absent&limit=500"))
+    assert other["spans"] == []
+
+    # Chrome export over HTTP parses and carries the lane metadata.
+    chrome = json.loads(get(
+        server.port, "/api/jobs/default/tljob/timeline?format=chrome"))
+    assert any(e["ph"] == "M" for e in chrome)
+
+    with pytest.raises(urllib.error.HTTPError) as ei:
+        urllib.request.urlopen(
+            f"http://127.0.0.1:{server.port}"
+            f"/api/jobs/default/absent/timeline", timeout=5)
+    assert ei.value.code == 404
+
+
+def test_fleet_endpoint_matches_status_goodput(harness):
+    api, cs, controller, server = harness
+    _run_job(api, cs, "fljob")
+
+    reporter = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "fljob", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "0", "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=64)
+    assert reporter.report(10, {"loss": 1.0})
+    assert wait_for(lambda: (cs.tpujobs.get("default", "fljob")
+                             .get("status", {}).get("goodput")
+                             or {}).get("ratio") is not None)
+
+    status = cs.tpujobs.get("default", "fljob")["status"]
+    fleet = json.loads(get(server.port, "/api/fleet"))
+    (row,) = [j for j in fleet["jobs"] if j["name"] == "fljob"]
+    # The acceptance invariant: the rollup's per-job ratio IS the
+    # persisted status.goodput fold, and with one job the cluster ratio
+    # must reduce to it.
+    assert row["goodputRatio"] == status["goodput"]["ratio"]
+    assert fleet["goodput"]["ratio"] == pytest.approx(
+        min(1.0, status["goodput"]["usefulStepSeconds"]
+            / status["goodput"]["wallclockSeconds"]), abs=1e-4)
+
+    # The fleet metric families render alongside the rollup.
+    body = get(server.port, "/metrics")
+    assert "fleet_goodput_ratio" in body
+    assert "fleet_preemption_lost_step_seconds" in body
+    assert "fleet_straggler_count" in body
+    assert "fleet_remediation_count" in body
+
+
+def test_profile_directive_full_round_trip(harness):
+    api, cs, controller, server = harness
+    _run_job(api, cs, "prjob")
+
+    # tpujobctl profile: stamp the directive annotation.
+    job = cs.tpujobs.get("default", "prjob")
+    job["metadata"].setdefault("annotations", {})[PROFILE_ANNOTATION] = \
+        json.dumps({"id": "req-1", "steps": 4})
+    cs.tpujobs.update("default", job)
+
+    # Reconcile admits it: status.profile goes Requested (strict schema).
+    assert wait_for(lambda: (cs.tpujobs.get("default", "prjob")
+                             .get("status", {}).get("profile")
+                             or {}).get("state") == "Requested")
+    pr = cs.tpujobs.get("default", "prjob")["status"]["profile"]
+    assert pr["id"] == "req-1" and pr["steps"] == 4
+
+    # Process 0's next heartbeat ACK carries the directive...
+    reporter = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "prjob", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "0", "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=64)
+    assert reporter.report(5, {"loss": 2.0})
+    assert wait_for(lambda: reporter.take_profile_directive() is not None
+                    or reporter.report(6, {"loss": 2.0}) is False)
+    # ...but a non-zero process never receives it.
+    cadence = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "prjob", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "1", "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=64)
+    assert cadence.report(5, None)
+    assert cadence.take_profile_directive() is None
+
+    # The capture result folds back: Captured + ProfileCaptured event.
+    reporter.attach_profile_result({
+        "id": "req-1", "capturedSteps": 4,
+        "artifactKey": "artifacts/profile-req-1.json"})
+    assert reporter.report(7, {"loss": 1.9})
+    assert wait_for(lambda: (cs.tpujobs.get("default", "prjob")
+                             .get("status", {}).get("profile")
+                             or {}).get("state") == "Captured")
+    pr = cs.tpujobs.get("default", "prjob")["status"]["profile"]
+    assert pr["capturedSteps"] == 4
+    assert pr["artifactKey"] == "artifacts/profile-req-1.json"
+    events = api.clientset.events.list("default")
+    assert any(e.get("reason") == "ProfileRequested" for e in events)
+    assert any(e.get("reason") == "ProfileCaptured" for e in events)
+
+    # The profile span joins the unified timeline.
+    tl = json.loads(get(server.port, "/api/jobs/default/prjob/timeline"))
+    assert any(s["kind"] == "profile" for s in tl["spans"])
+
+    # Once Captured, the directive stops riding ACKs (one-shot).
+    fresh = heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "prjob", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": "0", "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=64)
+    assert fresh.report(8, {"loss": 1.8})
+    assert fresh.take_profile_directive() is None
+
+
+# --- tpujobctl ---------------------------------------------------------------
+
+
+def test_top_column_contract(monkeypatch, capsys):
+    # The column set is an interface: scripts parse it. Pin it.
+    assert ctl.TOP_COLUMNS == ["NAME", "PHASE", "QUEUE", "POS", "GOODPUT",
+                               "STRAGGLER", "DURABLE", "STEP", "RESTARTS"]
+    fleet = timeline_mod.fleet_rollup([
+        {"namespace": "default", "name": "a", "status": {
+            "phase": "Running",
+            "goodput": {"ratio": 0.9, "usefulStepSeconds": 90.0,
+                        "wallclockSeconds": 100.0, "lastStep": 120},
+            "checkpoint": {"lastCheckpointStep": 100},
+            "scheduling": {"queue": "batch"},
+        }},
+    ], {"batch": {"p50": 1.0, "p95": 2.0, "count": 3}})
+    monkeypatch.setattr(ctl, "_status_get", lambda _o, _p: fleet)
+    opts = ctl.build_parser().parse_args(["top"])
+    assert ctl.cmd_top(None, opts) == 0
+    out = capsys.readouterr().out
+    header = next(line for line in out.splitlines()
+                  if line.startswith("NAME"))
+    assert header.split() == ctl.TOP_COLUMNS
+    assert "default/a" in out and "90.0%" in out and "batch" in out
+    assert "Fleet: goodput" in out
+
+
+def test_ctl_timeline_renders_table_and_chrome(monkeypatch, capsys):
+    tl = timeline_mod.assemble_timeline("default", "rich", _rich_status(),
+                                        [])
+    monkeypatch.setattr(
+        ctl, "_status_get",
+        lambda _o, path: (timeline_mod.to_chrome_trace(tl)
+                          if "format=chrome" in path else tl))
+    opts = ctl.build_parser().parse_args(["timeline", "rich"])
+    assert ctl.cmd_timeline(None, opts) == 0
+    out = capsys.readouterr().out
+    assert "Timeline: default/rich" in out
+    assert "phase:Running" in out
+    opts = ctl.build_parser().parse_args(["timeline", "rich", "--chrome"])
+    assert ctl.cmd_timeline(None, opts) == 0
+    parsed = json.loads(capsys.readouterr().out)
+    assert any(e["ph"] == "X" for e in parsed)
+
+
+def test_ctl_profile_stamps_annotation(harness):
+    api, cs, controller, server = harness
+    _run_job(api, cs, "ctlprof")
+    opts = ctl.build_parser().parse_args(
+        ["profile", "ctlprof", "--steps", "6"])
+    opts.namespace = "default"
+    assert ctl.cmd_profile(cs, opts) == 0
+    raw = cs.tpujobs.get("default", "ctlprof")["metadata"][
+        "annotations"][PROFILE_ANNOTATION]
+    directive = json.loads(raw)
+    assert directive["steps"] == 6 and directive["id"]
+    assert wait_for(lambda: (cs.tpujobs.get("default", "ctlprof")
+                             .get("status", {}).get("profile")
+                             or {}).get("state") == "Requested")

@@ -47,6 +47,7 @@ RULE = "concurrency"
 SCAN = (
     ("tpu_operator", "client"),
     ("tpu_operator", "controller"),
+    ("tpu_operator", "obs"),
     ("tpu_operator", "scheduler"),
     ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),

@@ -78,6 +78,7 @@ RULE = "lifecycle"
 # entry; their internals are covered transitively by the entry's sweep).
 SCAN = (
     ("tpu_operator", "controller"),
+    ("tpu_operator", "obs"),
     ("tpu_operator", "scheduler"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "store"),

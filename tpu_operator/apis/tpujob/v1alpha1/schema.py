@@ -371,6 +371,13 @@ def status_schema() -> Dict[str, Any]:
             "dataPlane": dataplane_knobs_schema(),
             # Serving-mode beat (mode: serve replicas post these).
             "serving": serving_beat_schema(),
+            # On-demand deep-profile result (process 0, one-shot until
+            # the controller ACKs it by folding status.profile).
+            "profile": _obj({
+                "id": _str(),
+                "capturedSteps": _int(minimum=0),
+                "artifactKey": _str(),
+            }),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -449,6 +456,19 @@ def status_schema() -> Dict[str, Any]:
         # Serving-mode roll-up: readiness, aggregate traffic + tail
         # latency, the gang's loaded snapshot step, reload accounting.
         "serving": serving_status_schema(),
+        # On-demand deep-profile directive lifecycle: Requested when the
+        # ``tpujobctl profile`` annotation is admitted, Captured when
+        # process 0's capture result folds back in (artifactKey names
+        # the raw-laps JSON under the store's ``artifacts/`` prefix).
+        "profile": _obj({
+            "id": _str(),
+            "state": _str(enum=["Requested", "Captured"]),
+            "steps": _int(minimum=1),
+            "capturedSteps": _int(minimum=0),
+            "artifactKey": _str(),
+            "attempt": _int(minimum=0),
+            "time": _str(),
+        }),
         # Fleet-scheduling state: effective queue/priority, and — while
         # phase is Queued — the admission-order position (0 = next).
         "scheduling": _obj({
@@ -473,6 +493,9 @@ def status_schema() -> Dict[str, Any]:
             # World size (slices) the failed attempt ran at (elastic
             # jobs): size and resume step are auditable together.
             "worldSlices": _int(minimum=1),
+            # Steps of progress the restart discarded (lastStep minus
+            # resumeStep) — the fleet rollup's preemption-cost input.
+            "lostSteps": _int(minimum=0),
         })),
         # Lifetime failure counters by kind (retry budgets charge these).
         "restartCounts": {

@@ -181,6 +181,14 @@ DEFAULT_SERVE_RELOAD_POLL = 10
 # is a postmortem aid, not an unbounded event log.
 FAILURE_LEDGER_CAP = 32
 
+# Annotation carrying the on-demand deep-profile directive (set by
+# ``tpujobctl profile``): JSON ``{"id": <unique>, "steps": <N>}``.
+# Reconcile admits it into ``status.profile`` (state Requested); the
+# status server piggybacks the directive on a heartbeat ACK to process
+# 0; the capture result folds back to Captured. Lives HERE (not in the
+# trainer) because both the reconciler and the CLI speak it.
+PROFILE_ANNOTATION = "tpu-operator.dev/profile-request"
+
 # Restart backoff defaults (exponential, per group restart): base doubles
 # each attempt, capped. Mirrors the workqueue's 10 s base and K8s Job's
 # 6-minute cap.
@@ -1000,6 +1008,12 @@ class FailureRecord:
     # and which step it resumed from live in ONE record (None: rigid
     # job, the size is always spec.numSlices).
     world_slices: Optional[int] = None
+    # Steps of progress the restart discarded: last heartbeat step minus
+    # the resume step (never negative). The fleet rollup prices
+    # preemption cost in step-seconds from THIS, not a re-derivation —
+    # the ledger is the one durable record of what each restart cost
+    # (None: pre-upgrade record, or the attempt never heartbeated).
+    lost_steps: Optional[int] = None
 
     def to_dict(self) -> Dict[str, Any]:
         d = {"attempt": self.attempt, "kind": self.kind,
@@ -1008,6 +1022,8 @@ class FailureRecord:
             d["resumeStep"] = self.resume_step
         if self.world_slices is not None:
             d["worldSlices"] = self.world_slices
+        if self.lost_steps is not None:
+            d["lostSteps"] = self.lost_steps
         return d
 
     @classmethod
@@ -1021,6 +1037,8 @@ class FailureRecord:
                          if d.get("resumeStep") is not None else None),
             world_slices=(int(d["worldSlices"])
                           if d.get("worldSlices") is not None else None),
+            lost_steps=(int(d["lostSteps"])
+                        if d.get("lostSteps") is not None else None),
         )
 
 
@@ -1104,6 +1122,13 @@ class TPUJobStatus:
     # reloads (lifetime weight reloads, delta-accounted), attemptReloads
     # (per-process baselines of that accounting), attempt, time}.
     serving: Optional[Dict[str, Any]] = None
+    # On-demand deep-profile state, written by the controller:
+    # {id, state (Requested -> Captured), steps, time} when a
+    # ``tpujobctl profile`` directive is in flight, plus
+    # {capturedSteps, artifactKey, attempt} once process 0's capture
+    # result folds back in. One directive at a time; a new request
+    # overwrites a Captured record.
+    profile: Optional[Dict[str, Any]] = None
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -1159,6 +1184,8 @@ class TPUJobStatus:
             d["elastic"] = dict(self.elastic)
         if self.serving:
             d["serving"] = dict(self.serving)
+        if self.profile:
+            d["profile"] = dict(self.profile)
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -1202,6 +1229,7 @@ class TPUJobStatus:
                         if d.get("dataPlane") else None),
             elastic=(dict(d["elastic"]) if d.get("elastic") else None),
             serving=(dict(d["serving"]) if d.get("serving") else None),
+            profile=(dict(d["profile"]) if d.get("profile") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),

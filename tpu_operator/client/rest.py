@@ -313,7 +313,12 @@ class RestResourceClient:
         self.kind = kind
 
     def _path(self, namespace: str, name: str = "") -> str:
-        base = f"{self._prefix}/namespaces/{namespace}/{self.resource}"
+        # Empty namespace means cluster-scoped (nodes) or all-namespaces
+        # (list/watch): either way the un-prefixed collection path.
+        if namespace:
+            base = f"{self._prefix}/namespaces/{namespace}/{self.resource}"
+        else:
+            base = f"{self._prefix}/{self.resource}"
         return f"{base}/{name}" if name else base
 
     def create(self, namespace: str, obj: dict) -> dict:

@@ -12,6 +12,15 @@ in-cluster / --master), and against the in-repo test apiserver.
     tpujobctl list
     tpujobctl describe cifar10
     tpujobctl delete cifar10
+
+Observability commands talk to the operator's STATUS server (the /api
+surface the controller serves, not the apiserver) via ``--status-url``
+or ``$TPUJOB_STATUS_URL``:
+
+    tpujobctl timeline cifar10           # unified per-job span timeline
+    tpujobctl timeline cifar10 --chrome  # perfetto-loadable trace JSON
+    tpujobctl profile cifar10 --steps 16 # request a raw-lap deep capture
+    tpujobctl top                        # one-screen fleet rollup
 """
 
 from __future__ import annotations
@@ -19,8 +28,10 @@ from __future__ import annotations
 import argparse
 import calendar
 import json
+import os
 import sys
 import time
+import uuid
 from typing import Any, Dict, List
 
 from tpu_operator import version as version_mod
@@ -28,8 +39,17 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_AUTOTUNE_MAX_DEPTH,
     DEFAULT_AUTOTUNE_MIN_DEPTH,
     DEFAULT_AUTOTUNE_WINDOW_STEPS,
+    PROFILE_ANNOTATION,
 )
 from tpu_operator.client import errors
+
+# The ``tpujobctl top`` column contract, pinned by tests: reordering or
+# renaming a column is an interface change, not a cosmetic one.
+TOP_COLUMNS = ["NAME", "PHASE", "QUEUE", "POS", "GOODPUT", "STRAGGLER",
+               "DURABLE", "STEP", "RESTARTS"]
+
+# Commands served entirely by the status server — no apiserver client.
+STATUS_ONLY_COMMANDS = ("timeline", "top")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -40,6 +60,9 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument("--master", default="", help="apiserver URL override")
     p.add_argument("--kubeconfig", default="", help="kubeconfig path")
     p.add_argument("-n", "--namespace", default="default")
+    p.add_argument("--status-url", default="",
+                   help="operator status-server URL (default "
+                        "$TPUJOB_STATUS_URL or http://localhost:8080)")
     p.add_argument("--version", action="store_true", help="print version and exit")
     sub = p.add_subparsers(dest="command")
 
@@ -59,6 +82,22 @@ def build_parser() -> argparse.ArgumentParser:
 
     rp = sub.add_parser("delete", help="delete a TPUJob (children follow via GC)")
     rp.add_argument("name")
+
+    tl = sub.add_parser("timeline",
+                        help="unified span timeline for one job")
+    tl.add_argument("name")
+    tl.add_argument("--chrome", action="store_true",
+                    help="emit Chrome trace-event JSON (perfetto-loadable)"
+                         " instead of the table")
+
+    pr = sub.add_parser("profile",
+                        help="request an on-demand deep capture of N raw "
+                             "step laps from process 0")
+    pr.add_argument("name")
+    pr.add_argument("--steps", type=int, default=8)
+
+    sub.add_parser("top", help="one-screen fleet rollup "
+                               "(goodput, queues, stragglers)")
     return p
 
 
@@ -374,12 +413,122 @@ def cmd_delete(cs, opts) -> int:
     return 0
 
 
+def _status_get(opts, path: str) -> Any:
+    """GET a JSON body from the operator's status server."""
+    import urllib.request
+
+    base = (opts.status_url or os.environ.get("TPUJOB_STATUS_URL")
+            or "http://localhost:8080").rstrip("/")
+    with urllib.request.urlopen(base + path, timeout=10) as resp:
+        return json.loads(resp.read().decode("utf-8"))
+
+
+def _fmt_seconds(value: Any) -> str:
+    if value is None:
+        return "-"
+    v = float(value)
+    if v >= 60:
+        return f"{v / 60:.1f}m"
+    return f"{v:.2f}s"
+
+
+def cmd_timeline(cs, opts) -> int:
+    fmt = "?format=chrome" if opts.chrome else ""
+    body = _status_get(
+        opts, f"/api/jobs/{opts.namespace}/{opts.name}/timeline{fmt}")
+    if opts.chrome:
+        # The raw trace-event array: pipe to a file and load in perfetto.
+        print(json.dumps(body, indent=1))
+        return 0
+    spans = body.get("spans") or []
+    print(f"Timeline: {body.get('job', '')} "
+          f"(phase {body.get('phase', '?')}, {len(spans)} span(s))")
+    gp = body.get("goodput") or {}
+    if gp.get("ratio") is not None:
+        print(f"Goodput:  {100 * float(gp['ratio']):.1f}%")
+    if not spans:
+        return 0
+    t0 = min(sp["start"] for sp in spans)
+    rows = []
+    for sp in spans:
+        attrs = sp.get("attrs") or {}
+        detail = " ".join(f"{k}={attrs[k]}" for k in sorted(attrs))
+        if sp.get("traceId"):
+            detail = (detail + " " if detail else "")                 + f"trace={sp['traceId']}"
+        rows.append([
+            f"+{sp['start'] - t0:.1f}s",
+            _fmt_seconds(sp.get("durationSeconds")),
+            sp.get("kind", ""),
+            sp.get("name", ""),
+            detail,
+        ])
+    _print_table(rows, ["AT", "DUR", "KIND", "SPAN", "DETAIL"])
+    return 0
+
+
+def cmd_profile(cs, opts) -> int:
+    """Request an on-demand deep capture: stamp the directive annotation;
+    the reconcile admits it into status.profile and the heartbeat-ACK
+    channel delivers it to process 0."""
+    steps = max(1, opts.steps)
+    directive = {"id": uuid.uuid4().hex[:12], "steps": steps}
+    job = cs.tpujobs.get(opts.namespace, opts.name)
+    annotations = (job.setdefault("metadata", {})
+                      .setdefault("annotations", {}))
+    annotations[PROFILE_ANNOTATION] = json.dumps(directive)
+    cs.tpujobs.update(opts.namespace, job)
+    print(f"profile {directive['id']} requested: {steps} raw step lap(s) "
+          f"of {opts.namespace}/{opts.name} "
+          f"(watch status.profile for Captured)")
+    return 0
+
+
+def cmd_top(cs, opts) -> int:
+    fleet = _status_get(opts, "/api/fleet")
+    gp = fleet.get("goodput") or {}
+    pre = fleet.get("preemption") or {}
+    st = fleet.get("stragglers") or {}
+    print(f"Fleet: goodput {100 * float(gp.get('ratio') or 0):.1f}% "
+          f"({gp.get('usefulStepSeconds', 0):.0f}s useful / "
+          f"{gp.get('wallclockSeconds', 0):.0f}s wall), "
+          f"{pre.get('restarts', 0)} restart(s) costing "
+          f"{pre.get('lostStepSeconds', 0):.0f} lost step-seconds, "
+          f"{st.get('flagged', 0)} straggler(s) / "
+          f"{st.get('remediations', 0)} remediation(s)")
+    for queue, q in sorted((fleet.get("queues") or {}).items()):
+        print(f"Queue {queue!r}: wait p50 {_fmt_seconds(q.get('p50'))} "
+              f"p95 {_fmt_seconds(q.get('p95'))} "
+              f"over {q.get('count', 0)} admission(s)")
+    rows = []
+    for job in fleet.get("jobs") or []:
+        ratio = job.get("goodputRatio")
+        straggler = job.get("worstStragglerRatio")
+        rows.append([
+            f"{job.get('namespace', '')}/{job.get('name', '')}",
+            job.get("phase", ""),
+            job.get("queue") or "-",
+            "-" if job.get("queuePosition") is None
+            else str(job["queuePosition"]),
+            "-" if ratio is None else f"{100 * float(ratio):.1f}%",
+            "-" if not straggler else f"{float(straggler):.2f}x",
+            "-" if job.get("lastDurableStep") is None
+            else str(job["lastDurableStep"]),
+            "-" if job.get("lastStep") is None else str(job["lastStep"]),
+            str(job.get("restarts", 0)),
+        ])
+    _print_table(rows, TOP_COLUMNS)
+    return 0
+
+
 COMMANDS = {
     "submit": cmd_submit,
     "list": cmd_list,
     "get": cmd_get,
     "describe": cmd_describe,
     "delete": cmd_delete,
+    "timeline": cmd_timeline,
+    "profile": cmd_profile,
+    "top": cmd_top,
 }
 
 
@@ -395,7 +544,10 @@ def main(argv=None) -> int:
     import yaml
 
     try:
-        cs = _clientset(opts)
+        # Status-server commands need no apiserver client (and must not
+        # demand a kubeconfig that may not exist on an observer's box).
+        cs = (None if opts.command in STATUS_ONLY_COMMANDS
+              else _clientset(opts))
         return COMMANDS[opts.command](cs, opts)
     except (errors.ApiError, OSError, yaml.YAMLError) as e:
         # OSError covers FileNotFoundError plus network-level failures

@@ -49,6 +49,7 @@ from tpu_operator.client.informer import (
 from tpu_operator.client.workqueue import RateLimitingQueue
 from tpu_operator.controller.deadlines import DeadlineManager
 from tpu_operator.controller.events import EventRecorder
+from tpu_operator.obs.timeline import TimelineStore
 from tpu_operator.scheduler.fleet import FleetScheduler
 from tpu_operator.scheduler.inventory import (
     SliceInventory,
@@ -157,6 +158,14 @@ class Controller:
         # delayed enqueue for that moment (controller/deadlines.py).
         self.deadlines = DeadlineManager(self.queue, clock=wall_clock)
         self.recorder = EventRecorder(clientset, metrics=self.metrics)
+        # Unified job timelines: every decision event the recorder emits
+        # (Queued/Admitted/Preempted/GroupRestart/...) also lands in the
+        # per-job timeline store, stamped with the reconcile trace id —
+        # the live half of GET /api/jobs/<ns>/<name>/timeline. Pruned on
+        # deletion through the listener below, audited by the joblife
+        # sweep like every other per-job container.
+        self.timeline = TimelineStore()
+        self.recorder.add_observer(self.timeline.record_event)
         # Fleet scheduler: the admission queue + slice inventory every
         # TrainingJob consults. An empty inventory (no sliceInventory in
         # config) admits everything — the pre-fleet behavior.
@@ -414,6 +423,7 @@ class Controller:
                 self._serving.pop(key, None)
             self._remediation.forget(key)
             self.recorder.forget_object(namespace, name)
+            self.timeline.forget_job(namespace, name)
             self.deadlines.forget(key)
             # A deleted job's slice reservation (or queue slot) frees for
             # the next pending gang.
@@ -558,6 +568,7 @@ class Controller:
         key = f"{namespace}/{name}"
         new_t = parse_rfc3339(str(heartbeat.get("time", ""))) or 0.0
         straggler_events: list = []
+        profile_events: list = []
         with self._jobs_lock:
             tj = self.jobs.get(key)
             if tj is None:
@@ -603,11 +614,15 @@ class Controller:
             else:
                 self._apply_steptiming_heartbeat(tj, pid, heartbeat,
                                                  hb_attempt)
+                profile_changed = self._apply_profile_heartbeat(
+                    tj, heartbeat, hb_attempt, profile_events)
                 persist = self._fold_heartbeat_locked(
                     key, tj, namespace, name, heartbeat, hb_attempt, new_t
-                ) or straggler_changed or serving_changed
+                ) or straggler_changed or serving_changed or profile_changed
         for message in straggler_events:
             self.recorder.event(tj, "Warning", "StragglerDetected", message)
+        for message in profile_events:
+            self.recorder.event(tj, "Normal", "ProfileCaptured", message)
         if persist:
             self.queue.add(key)
         return True
@@ -674,6 +689,62 @@ class Controller:
         if persist:
             self._hb_persisted[key] = new_t
         return persist
+
+    def pending_profile(self, namespace: str, name: str
+                        ) -> Optional[Dict[str, Any]]:
+        """The on-demand profile directive to ride process 0's next
+        heartbeat ACK: ``{"id", "steps"}`` while ``status.profile`` sits
+        in state Requested (set by the reconcile from the tpujobctl
+        profile annotation), None otherwise. Folding the capture result
+        flips the state, which stops the directive — the payload
+        additionally dedups by id, so a directive raced by its own
+        result is harmless."""
+        with self._jobs_lock:
+            tj = self.jobs.get(f"{namespace}/{name}")
+            if tj is None:
+                return None
+            pr = tj.job.status.profile
+            if not pr or pr.get("state") != "Requested":
+                return None
+            return {"id": str(pr.get("id", "")),
+                    "steps": int(pr.get("steps") or 8)}
+
+    def _apply_profile_heartbeat(self, tj: TrainingJob,
+                                 heartbeat: Dict[str, Any],
+                                 hb_attempt: Optional[int],
+                                 events: list) -> bool:
+        """Fold process 0's profile capture result into
+        ``status.profile`` (called under _jobs_lock). The result is a
+        one-shot the payload resends until ACKed, so an already-folded
+        id is a duplicate, not a change; a fresh fold flips the state to
+        Captured (stopping the ACK directive) and queues the
+        ProfileCaptured event for emission after the lock drops."""
+        pr = heartbeat.get("profile")
+        if not isinstance(pr, dict) or not pr.get("id"):
+            return False
+        rid = str(pr["id"])
+        cur = tj.job.status.profile or {}
+        if cur.get("id") == rid and cur.get("state") == "Captured":
+            return False
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        new: Dict[str, Any] = {
+            "id": rid,
+            "state": "Captured",
+            "capturedSteps": int(pr.get("capturedSteps") or 0),
+            "attempt": int(gen),
+        }
+        if cur.get("steps"):
+            new["steps"] = int(cur["steps"])
+        if pr.get("artifactKey"):
+            new["artifactKey"] = str(pr["artifactKey"])
+        if heartbeat.get("time"):
+            new["time"] = str(heartbeat["time"])
+        tj.job.status.profile = new
+        events.append(
+            f"profile {rid}: captured {new['capturedSteps']} raw step "
+            f"lap(s)" + (f" -> {new['artifactKey']}"
+                         if new.get("artifactKey") else ""))
+        return True
 
     def _apply_checkpoint_heartbeat(self, tj: TrainingJob, namespace: str,
                                     name: str, heartbeat: Dict[str, Any],

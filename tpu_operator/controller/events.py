@@ -15,7 +15,7 @@ from __future__ import annotations
 import collections
 import datetime
 import logging
-from typing import Any, Optional, Tuple
+from typing import Any, Callable, List, Optional, Tuple
 
 from tpu_operator.client import errors
 from tpu_operator.util.util import rand_string
@@ -52,6 +52,21 @@ class EventRecorder:
         self._seen: "collections.OrderedDict[Tuple[str, str, str, str], Tuple[str, int]]" = (
             joblife.track("EventRecorder._seen",
                           kind="ordered"))  # per-job: forget_object; guarded-by: _lock
+        # Side observers of the event stream (the timeline store): called
+        # with (namespace, name, type, reason, message) for EVERY event()
+        # call — including aggregated repeats — before the apiserver RPC,
+        # so observers see events even when recording fails. Registered
+        # once at wiring time, before any event flows; reads are
+        # therefore lock-free by the same single-writer argument as
+        # tracing._enabled.
+        self._observers: List[Callable[[str, str, str, str, str], None]] = []
+
+    def add_observer(self,
+                     observer: Callable[[str, str, str, str, str], None]
+                     ) -> None:
+        """Register an event-stream observer (idempotent per callable)."""
+        if observer not in self._observers:
+            self._observers.append(observer)
 
     def forget_object(self, namespace: str, name: str) -> int:
         """Drop dedup entries for a deleted object (the controller calls this
@@ -69,6 +84,12 @@ class EventRecorder:
         """``obj`` is anything with .metadata/.name/.namespace (TrainingJob or
         TPUJob). Failures to record never break reconcile (events are
         best-effort, as in client-go)."""
+        for observer in self._observers:
+            try:
+                observer(obj.namespace, obj.name, event_type, reason,
+                         message)
+            except Exception as e:  # noqa: BLE001 — observers best-effort too
+                log.debug("event observer failed for %s: %s", reason, e)
         try:
             self._record(obj, event_type, reason, message)
         except Exception as e:  # noqa: BLE001 — best-effort by design

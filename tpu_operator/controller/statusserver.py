@@ -50,6 +50,7 @@ from tpu_operator.payload.steptrace import (
     DIGEST_KEYS as STEP_DIGEST_KEYS,
     PHASE_FIELDS as STEP_PHASE_FIELDS,
 )
+from tpu_operator.obs import timeline as timeline_mod
 from tpu_operator.util import tracing
 from tpu_operator.util.util import now_rfc3339, parse_rfc3339
 from tpu_operator.util import joblife, lockdep
@@ -645,6 +646,32 @@ def _sanitize_serving(sv: Any) -> Tuple[Optional[Dict[str, Any]], str]:
     return (clean or None), ""
 
 
+def _sanitize_profile(pr: Any) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Sanitize a heartbeat's ``profile`` capture result down to exactly
+    the CRD schema's shape: (clean-or-None, error). Same door discipline
+    as the startup breakdown — it is a one-shot the payload resends until
+    ACKed, and a bad value persisted into ``status.profile`` would wedge
+    every later status write against a real apiserver's schema."""
+    if not isinstance(pr, dict):
+        return None, "bad heartbeat: profile must be an object"
+    rid = pr.get("id")
+    if not isinstance(rid, str) or not rid:
+        return None, "bad heartbeat: profile.id must be a non-empty string"
+    clean: Dict[str, Any] = {"id": rid}
+    steps, err = _int_field(pr.get("capturedSteps", 0), 0,
+                            "profile.capturedSteps")
+    if err:
+        return None, err
+    clean["capturedSteps"] = steps
+    key = pr.get("artifactKey")
+    if key is not None:
+        if not isinstance(key, str):
+            return None, "bad heartbeat: profile.artifactKey must be a string"
+        if key:
+            clean["artifactKey"] = key
+    return clean, ""
+
+
 def _public_heartbeat(hb: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if not hb:
         return None
@@ -733,6 +760,22 @@ class StatusServer:
                     elif path == "/api/jobs":
                         self._send(200, json.dumps(outer.jobs_rollup()),
                                    "application/json")
+                    elif path == "/api/fleet":
+                        self._send(200, json.dumps(outer.fleet_rollup()),
+                                   "application/json")
+                    elif path.startswith("/api/jobs/") \
+                            and path.endswith("/timeline"):
+                        parts = path.split("/")
+                        # ['', 'api', 'jobs', ns, name, 'timeline']
+                        if len(parts) != 6 or not parts[3] or not parts[4]:
+                            self._send(404, "not found")
+                            return
+                        import urllib.parse
+                        params = dict(urllib.parse.parse_qsl(query))
+                        code, body = outer.timeline_body(
+                            parts[3], parts[4],
+                            fmt=params.get("format", ""))
+                        self._send(code, body, "application/json")
                     elif path == "/api/traces":
                         import urllib.parse
                         params = dict(urllib.parse.parse_qsl(query))
@@ -744,7 +787,7 @@ class StatusServer:
                         if limit <= 0:
                             limit = 256  # documented default, never "all"
                         self._send(200, json.dumps(
-                            {"spans": tracing.recent_spans(limit)}),
+                            outer.traces_body(params.get("job", ""), limit)),
                             "application/json")
                     elif path == "/":
                         self._send(200, outer.render_dashboard(),
@@ -788,7 +831,14 @@ class StatusServer:
                         return
                     ok, message = outer.record_heartbeat(body)
                     if ok:
-                        self._send(200, json.dumps({"ok": True}),
+                        # The 200 ACK is the only control channel back into
+                        # the payload: a pending on-demand profile directive
+                        # for process 0 rides here (tpujobctl profile).
+                        resp: Dict[str, Any] = {"ok": True}
+                        directive = outer.profile_directive_for(body)
+                        if directive:
+                            resp["profile"] = directive
+                        self._send(200, json.dumps(resp),
                                    "application/json")
                     else:
                         # "; retry"-suffixed rejections are transient
@@ -932,6 +982,13 @@ class StatusServer:
             # with a "startup" key) and 503 no-op beats on a fresh leader.
             if clean:
                 hb["startup"] = clean
+        pr = body.get("profile")
+        if pr is not None:
+            clean_pr, err = _sanitize_profile(pr)
+            if err:
+                return False, err
+            if clean_pr:
+                hb["profile"] = clean_pr
         c = self.controller
         if c is None:
             # A standby cannot persist the heartbeat (no in-memory job) nor
@@ -958,14 +1015,14 @@ class StatusServer:
             recorded = c.record_heartbeat(namespace, name, hb)
             if recorded is None:
                 return True, ""
-            if recorded is False and "startup" in hb:
-                # The startup breakdown is a ONE-SHOT per attempt: the
-                # payload stops resending it after the first 200 (unlike
-                # the checkpoint fields, which ride on every beat). ACKing
-                # it before the TrainingJob exists — a fresh leader whose
-                # first reconcile hasn't run — would silently lose the
-                # attempt's status.startup and its histogram/cache-hit
-                # observations. Fail retryably instead; the payload
+            if recorded is False and ("startup" in hb or "profile" in hb):
+                # The startup breakdown and the profile capture result are
+                # ONE-SHOTs: the payload stops resending them after the
+                # first 200 (unlike the checkpoint fields, which ride on
+                # every beat). ACKing one before the TrainingJob exists —
+                # a fresh leader whose first reconcile hasn't run — would
+                # silently lose the attempt's status.startup /
+                # status.profile fold. Fail retryably instead; the payload
                 # re-attaches it to the next due beat.
                 return False, "not ready: job not yet reconciled; retry"
         if hb.get("processId") not in (None, 0):
@@ -1091,6 +1148,76 @@ class StatusServer:
             })
         return out
 
+    def traces_body(self, job: str, limit: int) -> Dict[str, Any]:
+        """Recent spans, optionally filtered to the traces that touched
+        one job (``?job=<ns>/<name>``): a trace qualifies when any of its
+        spans carries the job's reconcile key attribute — the controller
+        stamps it on every reconcile root span, which is what lets a
+        timeline entry link back to the reconcile that caused it."""
+        spans = tracing.recent_spans(0)
+        if job:
+            trace_ids = {s["traceId"] for s in spans
+                         if (s.get("attrs") or {}).get("key") == job}
+            spans = [s for s in spans if s["traceId"] in trace_ids]
+        return {"spans": spans[:limit]}
+
+    def timeline_body(self, namespace: str, name: str,
+                      fmt: str = "") -> Tuple[int, str]:
+        """The ``GET /api/jobs/<ns>/<name>/timeline`` body: the unified
+        span tree (``?format=chrome`` → Chrome trace-event JSON)."""
+        c = self.controller
+        if c is None:
+            return 503, json.dumps({"error": "standby: not leading"})
+        obj = c.job_informer.store.get(namespace, name)
+        if obj is None:
+            return 404, json.dumps(
+                {"error": f"unknown job {namespace}/{name}"})
+        status = obj.get("status") or {}
+        store = getattr(c, "timeline", None)
+        events = store.events(namespace, name) if store is not None else []
+        timeline = timeline_mod.assemble_timeline(
+            namespace, name, status, events)
+        if fmt == "chrome":
+            return 200, json.dumps(timeline_mod.to_chrome_trace(timeline))
+        return 200, json.dumps(timeline)
+
+    def fleet_rollup(self) -> Dict[str, Any]:
+        """The ``GET /api/fleet`` body: cluster goodput (the fold of the
+        per-job ``status.goodput`` folds), per-queue admission-wait
+        quantiles, preemption cost in lost step-seconds, and
+        straggler/remediation counts."""
+        c = self.controller
+        jobs: List[Dict[str, Any]] = []
+        queue_waits: Dict[str, Dict[str, float]] = {}
+        if c is not None:
+            for obj in c.job_informer.store.list():
+                md = obj.get("metadata") or {}
+                jobs.append({
+                    "namespace": md.get("namespace", "default"),
+                    "name": md.get("name", ""),
+                    "status": obj.get("status") or {},
+                })
+            sched = getattr(c, "scheduler", None)
+            if sched is not None and hasattr(sched, "queue_wait_quantiles"):
+                queue_waits = sched.queue_wait_quantiles()
+        return timeline_mod.fleet_rollup(jobs, queue_waits)
+
+    def profile_directive_for(self, body: Dict[str, Any]
+                              ) -> Optional[Dict[str, Any]]:
+        """Pending profile directive to ride this heartbeat's 200 ACK —
+        only process 0 captures (it owns the recorder + artifact path),
+        and only while ``status.profile.state`` is Requested."""
+        if body.get("processId") not in (None, 0):
+            return None
+        c = self.controller
+        if c is None or not hasattr(c, "pending_profile"):
+            return None
+        name = str(body.get("name") or "")
+        namespace = str(body.get("namespace") or "default")
+        if not name:
+            return None
+        return c.pending_profile(namespace, name)
+
     def render_metrics(self) -> str:
         lines = self.metrics.render_lines()
 
@@ -1130,6 +1257,40 @@ class StatusServer:
             lines.append(f"# TYPE {full} gauge")
             for phase, n in sorted(phases.items()):
                 lines.append(f'{full}{{phase="{_escape_label(phase)}"}} {n}')
+
+            # Fleet rollup gauges — derived per scrape from the same
+            # aggregation /api/fleet serves, so the two can never drift.
+            fleet = self.fleet_rollup()
+            emit("fleet_goodput_ratio", fleet["goodput"]["ratio"],
+                 "Cluster goodput: sum of per-job useful step-seconds "
+                 "over sum of per-job wallclock — the fold of the "
+                 "status.goodput folds.")
+            emit("fleet_preemption_lost_step_seconds",
+                 fleet["preemption"]["lostStepSeconds"],
+                 "Step-seconds re-run because restarts resumed behind "
+                 "the step reached at failure (ledger lostSteps x "
+                 "current step time), summed over live jobs.")
+            emit("fleet_straggler_count", fleet["stragglers"]["flagged"],
+                 "Gang members currently flagged in status.stragglers, "
+                 "summed over live jobs.")
+            emit("fleet_remediation_count",
+                 fleet["stragglers"]["remediations"],
+                 "Straggler remediations recorded in the elastic audit "
+                 "trails of live jobs.")
+            if fleet["queues"]:
+                full = METRIC_PREFIX + "fleet_queue_wait_seconds"
+                lines.append(f"# HELP {full} Admission-queue wait "
+                             f"quantiles per fair-share queue, over the "
+                             f"scheduler's recent-admission window.")
+                lines.append(f"# TYPE {full} gauge")
+                for queue, stats in sorted(fleet["queues"].items()):
+                    for quantile in ("p50", "p95"):
+                        labels = _label_str({
+                            "queue": queue,
+                            "quantile": "0.5" if quantile == "p50"
+                            else "0.95"})
+                        lines.append(
+                            f"{full}{labels} {_fmt(stats[quantile])}")
 
             beats = self._live_heartbeats(c)
             if beats:

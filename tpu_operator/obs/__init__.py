@@ -1,0 +1,3 @@
+"""Fleet observability plane: unified job timelines, on-demand deep
+profiling support, and the fleet goodput rollup (docs/design.md "Fleet
+observability")."""

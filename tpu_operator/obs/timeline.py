@@ -1,0 +1,454 @@
+"""Unified per-job timelines and the fleet goodput rollup.
+
+The operator emits rich but scattered signals: ``status.phaseTimeline``
+stamps phase entries, the scheduler emits Queued/Admitted/Preempted
+events, the failure ledger records every restart with its resume step,
+the startup breakdown times each stage of an attempt, the step-phase
+recorder digests where step time goes, and the elastic/store blocks log
+resizes, remediations and uploads. Answering "why was this job slow?"
+means hand-joining five status blocks. This module joins them once:
+
+- :class:`TimelineStore` captures the operator's *decision* events
+  (the ones that flow through the event recorder) per job, each stamped
+  with the reconcile trace id so a timeline entry links to the exact
+  ``/api/traces`` reconcile that caused it. Per-job-keyed, so it follows
+  the PR-15 lifecycle contract: witness-tracked, pruned by the
+  controller's deletion reconcile through :meth:`forget_job`.
+- :func:`assemble_timeline` merges the live decision stream with the
+  status-derived spans (phases, ledger, startup stages, step digest,
+  resizes, remediations, store uploads, profile captures) into one
+  ordered span list.
+- :func:`to_chrome_trace` exports that list as Chrome trace-event JSON
+  (perfetto-loadable) for offline analysis.
+- :func:`fleet_rollup` aggregates per-job ``status.goodput`` folds into
+  the cluster view ``GET /api/fleet`` serves: cluster goodput ratio,
+  per-queue wait quantiles, preemption cost in lost step-seconds, and
+  straggler/remediation counts.
+
+Everything except the store is a pure function over status dicts — the
+status server calls them per request; nothing here caches derived data.
+"""
+
+from __future__ import annotations
+
+import time
+from typing import Any, Dict, Iterable, List, Optional, Tuple
+
+from tpu_operator.util import joblife, lockdep, tracing
+from tpu_operator.util.util import now_rfc3339, parse_rfc3339
+
+# Decision events kept per job. 256 covers hundreds of restarts/resizes;
+# beyond that the oldest entries rotate out (the status-derived spans —
+# ledger, phaseTimeline — are not subject to this cap).
+EVENTS_PER_JOB_CAP = 256
+
+# Chrome trace-event lanes (tid) per span kind, so perfetto renders one
+# row per signal family instead of one interleaved soup.
+_LANES = {
+    "phase": 1,
+    "decision": 2,
+    "failure": 3,
+    "startup": 4,
+    "steps": 5,
+    "elastic": 6,
+    "store": 7,
+    "profile": 8,
+}
+_LANE_NAMES = {
+    1: "phases",
+    2: "decisions",
+    3: "failure ledger",
+    4: "startup stages",
+    5: "step timing",
+    6: "elastic",
+    7: "store",
+    8: "profile",
+}
+
+
+class TimelineStore:
+    """Bounded per-job ring of operator decision events.
+
+    Fed by the event recorder's observer hook (every Queued / Admitted /
+    Preempted / GroupRestart / ElasticResized / ... event lands here with
+    the reconcile trace id attached); drained by the status server when
+    assembling a timeline; pruned by the controller's deletion listener.
+    """
+
+    def __init__(self) -> None:
+        self._lock = lockdep.lock("TimelineStore._lock")
+        self._events: Dict[Tuple[str, str], List[Dict[str, Any]]] = \
+            joblife.track("TimelineStore._events")  # per-job: forget_job; guarded-by: _lock
+
+    def record_event(self, namespace: str, name: str, event_type: str,
+                     reason: str, message: str) -> None:
+        entry: Dict[str, Any] = {
+            "time": now_rfc3339(),
+            "type": str(event_type),
+            "reason": str(reason),
+            "message": str(message),
+        }
+        trace_id = tracing.current_trace_id()
+        if trace_id:
+            entry["traceId"] = trace_id
+        with self._lock:
+            events = self._events.setdefault((namespace, name), [])
+            events.append(entry)
+            if len(events) > EVENTS_PER_JOB_CAP:
+                del events[:len(events) - EVENTS_PER_JOB_CAP]
+
+    def events(self, namespace: str, name: str) -> List[Dict[str, Any]]:
+        with self._lock:
+            return [dict(e) for e in self._events.get((namespace, name), ())]
+
+    def job_count(self) -> int:
+        with self._lock:
+            return len(self._events)
+
+    def forget_job(self, namespace: str, name: str) -> None:
+        """Deletion-reconcile prune (wired as a controller deletion
+        listener, which runs before the joblife sweep)."""
+        with self._lock:
+            self._events.pop((namespace, name), None)
+
+
+# --- timeline assembly -------------------------------------------------------
+
+
+def _span(name: str, kind: str, start: Optional[float],
+          duration: Optional[float] = None,
+          attrs: Optional[Dict[str, Any]] = None,
+          trace_id: str = "") -> Optional[Dict[str, Any]]:
+    if start is None:
+        return None
+    out: Dict[str, Any] = {"name": name, "kind": kind, "start": start}
+    if duration is not None:
+        out["durationSeconds"] = round(max(0.0, duration), 6)
+    if attrs:
+        out["attrs"] = {k: v for k, v in attrs.items() if v is not None}
+    if trace_id:
+        out["traceId"] = trace_id
+    return out
+
+
+# Startup stages in pipeline order, (status key, span label). The
+# breakdown records durations but not per-stage wall-clock starts, so the
+# assembler lays them back-to-back ending at the breakdown's stamp time —
+# a reconstruction, which the span attrs flag.
+_STARTUP_STAGES = (
+    ("rendezvousSeconds", "rendezvous"),
+    ("restoreSeconds", "restore"),
+    ("compileSeconds", "compile"),
+    ("firstStepSeconds", "first-step"),
+)
+
+
+def _phase_spans(status: Dict[str, Any], now: float) -> List[Dict[str, Any]]:
+    timeline = status.get("phaseTimeline") or {}
+    entries: List[Tuple[float, str]] = []
+    for phase, stamp in timeline.items():
+        t = parse_rfc3339(str(stamp))
+        if t is not None:
+            entries.append((t, str(phase)))
+    entries.sort()
+    spans: List[Dict[str, Any]] = []
+    terminal = status.get("phase") in ("Done", "Failed")
+    for idx, (start, phase) in enumerate(entries):
+        if idx + 1 < len(entries):
+            duration: Optional[float] = entries[idx + 1][0] - start
+        elif phase in ("Done", "Failed"):
+            duration = 0.0
+        elif terminal:
+            duration = 0.0
+        else:
+            duration = max(0.0, now - start)
+        sp = _span(f"phase:{phase}", "phase", start, duration,
+                   {"phase": phase, "ongoing": idx + 1 == len(entries)
+                    and not terminal or None})
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _event_spans(events: Iterable[Dict[str, Any]]) -> List[Dict[str, Any]]:
+    spans = []
+    for ev in events:
+        start = parse_rfc3339(str(ev.get("time", "")))
+        sp = _span(f"decision:{ev.get('reason', '')}", "decision", start,
+                   attrs={"type": ev.get("type"),
+                          "message": ev.get("message")},
+                   trace_id=str(ev.get("traceId", "")))
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _ledger_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    spans = []
+    for rec in status.get("failures") or []:
+        start = parse_rfc3339(str(rec.get("time", "")))
+        sp = _span(f"restart:{rec.get('kind', '')}", "failure", start,
+                   attrs={"attempt": rec.get("attempt"),
+                          "reason": rec.get("reason"),
+                          "resumeStep": rec.get("resumeStep"),
+                          "worldSlices": rec.get("worldSlices"),
+                          "lostSteps": rec.get("lostSteps")})
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _startup_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    st = status.get("startup") or {}
+    end = parse_rfc3339(str(st.get("time", "")))
+    if end is None:
+        return []
+    stages = [(label, float(st.get(key) or 0.0))
+              for key, label in _STARTUP_STAGES if st.get(key)]
+    total = sum(d for _, d in stages)
+    cursor = end - total
+    spans = []
+    for label, duration in stages:
+        sp = _span(f"startup:{label}", "startup", cursor, duration,
+                   {"attempt": st.get("attempt"), "reconstructed": True})
+        if sp:
+            spans.append(sp)
+        cursor += duration
+    return spans
+
+
+def _digest_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    spans = []
+    digest = status.get("stepTiming") or {}
+    start = parse_rfc3339(str(digest.get("time", "")))
+    if start is not None:
+        attrs = {k: digest.get(k) for k in
+                 ("p50Seconds", "p95Seconds", "maxSeconds", "steps",
+                  "windowSteps", "phases") if digest.get(k) is not None}
+        sp = _span("steps:digest", "steps", start, attrs=attrs)
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _elastic_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    spans = []
+    elastic = status.get("elastic") or {}
+    start = parse_rfc3339(str(elastic.get("time", "")))
+    if start is not None and elastic.get("resizes"):
+        sp = _span("elastic:resize", "elastic", start,
+                   attrs={"slices": elastic.get("slices"),
+                          "workers": elastic.get("workers"),
+                          "resizes": elastic.get("resizes"),
+                          "direction": elastic.get("lastResizeDirection")})
+        if sp:
+            spans.append(sp)
+    for rem in elastic.get("remediations") or []:
+        start = parse_rfc3339(str(rem.get("time", "")))
+        sp = _span(f"elastic:remediation:{rem.get('action', '')}", "elastic",
+                   start, attrs={k: rem.get(k) for k in
+                                 ("action", "worker", "slice", "ratio")
+                                 if rem.get(k) is not None})
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _store_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    spans = []
+    store = status.get("store") or {}
+    start = parse_rfc3339(str(store.get("time", "")))
+    if start is not None:
+        sp = _span("store:upload", "store", start,
+                   attrs={"lastUploadedStep": store.get("lastUploadedStep"),
+                          "uploadFailures": store.get("uploadFailures"),
+                          "prefetched": store.get("prefetched")})
+        if sp:
+            spans.append(sp)
+    return spans
+
+
+def _profile_spans(status: Dict[str, Any]) -> List[Dict[str, Any]]:
+    profile = status.get("profile") or {}
+    start = parse_rfc3339(str(profile.get("time", "")))
+    sp = _span(f"profile:{str(profile.get('state', '')).lower()}", "profile",
+               start, attrs={"id": profile.get("id"),
+                             "artifactKey": profile.get("artifactKey"),
+                             "capturedSteps": profile.get("capturedSteps")})
+    return [sp] if sp else []
+
+
+def assemble_timeline(namespace: str, name: str, status: Dict[str, Any],
+                      events: Iterable[Dict[str, Any]],
+                      now: Optional[float] = None) -> Dict[str, Any]:
+    """One ordered span list per job, merged from every status signal
+    plus the live decision stream. Pure function: derives everything per
+    call from the passed status/events."""
+    now = time.time() if now is None else now
+    spans: List[Dict[str, Any]] = []
+    spans.extend(_phase_spans(status, now))
+    spans.extend(_event_spans(events))
+    spans.extend(_ledger_spans(status))
+    spans.extend(_startup_spans(status))
+    spans.extend(_digest_spans(status))
+    spans.extend(_elastic_spans(status))
+    spans.extend(_store_spans(status))
+    spans.extend(_profile_spans(status))
+    spans.sort(key=lambda s: (s["start"], s["kind"], s["name"]))
+    out: Dict[str, Any] = {
+        "job": f"{namespace}/{name}",
+        "phase": status.get("phase", ""),
+        "spans": spans,
+    }
+    scheduling = status.get("scheduling") or {}
+    if scheduling:
+        out["scheduling"] = {k: scheduling.get(k)
+                             for k in ("queue", "priority", "position")
+                             if scheduling.get(k) is not None}
+    goodput = status.get("goodput") or {}
+    if goodput:
+        out["goodput"] = {k: goodput.get(k)
+                          for k in ("ratio", "usefulStepSeconds",
+                                    "wallclockSeconds", "lastStep")
+                          if goodput.get(k) is not None}
+    return out
+
+
+def to_chrome_trace(timeline: Dict[str, Any]) -> List[Dict[str, Any]]:
+    """Chrome trace-event JSON array (perfetto's legacy JSON importer):
+    duration spans become ``ph: "X"`` complete events, point-in-time
+    spans become ``ph: "i"`` instants; each span kind gets its own lane
+    via thread-name metadata."""
+    job = timeline.get("job", "")
+    out: List[Dict[str, Any]] = [
+        {"name": "process_name", "ph": "M", "pid": 1, "tid": 0,
+         "args": {"name": job}},
+    ]
+    used_lanes = set()
+    events: List[Dict[str, Any]] = []
+    for span in timeline.get("spans") or []:
+        tid = _LANES.get(str(span.get("kind")), 2)
+        used_lanes.add(tid)
+        ts_us = int(float(span["start"]) * 1e6)
+        ev: Dict[str, Any] = {
+            "name": span.get("name", ""),
+            "pid": 1,
+            "tid": tid,
+            "ts": ts_us,
+            "cat": span.get("kind", ""),
+        }
+        args = dict(span.get("attrs") or {})
+        if span.get("traceId"):
+            args["traceId"] = span["traceId"]
+        if args:
+            ev["args"] = args
+        if "durationSeconds" in span:
+            ev["ph"] = "X"
+            ev["dur"] = int(float(span["durationSeconds"]) * 1e6)
+        else:
+            ev["ph"] = "i"
+            ev["s"] = "t"
+        events.append(ev)
+    for tid in sorted(used_lanes):
+        out.append({"name": "thread_name", "ph": "M", "pid": 1, "tid": tid,
+                    "args": {"name": _LANE_NAMES.get(tid, str(tid))}})
+    out.extend(events)
+    return out
+
+
+# --- fleet rollup ------------------------------------------------------------
+
+
+def quantiles(samples: List[float]) -> Dict[str, float]:
+    """Nearest-rank p50/p95 over a sample list (the per-queue wait
+    summary shape)."""
+    if not samples:
+        return {"p50": 0.0, "p95": 0.0, "count": 0}
+    ordered = sorted(samples)
+    n = len(ordered)
+
+    def rank(q: float) -> float:
+        idx = min(n - 1, max(0, int(q * n + 0.5) - 1))
+        return ordered[idx]
+
+    return {"p50": round(rank(0.50), 6), "p95": round(rank(0.95), 6),
+            "count": n}
+
+
+def fleet_rollup(jobs: List[Dict[str, Any]],
+                 queue_waits: Optional[Dict[str, Dict[str, float]]] = None,
+                 ) -> Dict[str, Any]:
+    """Aggregate per-job status into the ``GET /api/fleet`` body.
+
+    ``jobs`` rows are ``{"namespace", "name", "status": {...}}``. Cluster
+    goodput is the fold of the per-job folds: Σ usefulStepSeconds over
+    Σ wallclockSeconds, so it matches ``status.goodput`` by construction.
+    Preemption cost sums the ledger's per-restart ``lostSteps`` (steps
+    re-run because the durable resume step trailed the step reached at
+    failure) times the job's current step time — an approximation when
+    step time drifted across attempts, and flagged as such in docs.
+    """
+    useful = 0.0
+    wallclock = 0.0
+    lost_step_seconds = 0.0
+    lost_steps = 0
+    restarts = 0
+    straggler_count = 0
+    remediation_count = 0
+    rows: List[Dict[str, Any]] = []
+    for job in jobs:
+        status = job.get("status") or {}
+        goodput = status.get("goodput") or {}
+        job_useful = float(goodput.get("usefulStepSeconds") or 0.0)
+        job_wall = float(goodput.get("wallclockSeconds") or 0.0)
+        useful += job_useful
+        wallclock += job_wall
+        beat = status.get("lastHeartbeat") or {}
+        step_time = float(beat.get("stepTimeSeconds") or 0.0)
+        failures = status.get("failures") or []
+        restarts += len(failures)
+        job_lost_steps = sum(int(rec.get("lostSteps") or 0)
+                             for rec in failures)
+        lost_steps += job_lost_steps
+        lost_step_seconds += job_lost_steps * step_time
+        stragglers = status.get("stragglers") or []
+        straggler_count += len(stragglers)
+        worst_ratio = 0.0
+        for s in stragglers:
+            worst_ratio = max(worst_ratio, float(s.get("ratio") or 0.0))
+        elastic = status.get("elastic") or {}
+        remediation_count += len(elastic.get("remediations") or [])
+        checkpoint = status.get("checkpoint") or {}
+        scheduling = status.get("scheduling") or {}
+        rows.append({
+            "namespace": job.get("namespace", ""),
+            "name": job.get("name", ""),
+            "phase": status.get("phase", ""),
+            "queue": scheduling.get("queue", ""),
+            "queuePosition": scheduling.get("position"),
+            "goodputRatio": goodput.get("ratio"),
+            "worstStragglerRatio": round(worst_ratio, 4) or None,
+            "lastDurableStep": checkpoint.get("lastCheckpointStep"),
+            "lastStep": goodput.get("lastStep", beat.get("step")),
+            "restarts": len(failures),
+        })
+    rows.sort(key=lambda r: (r["namespace"], r["name"]))
+    ratio = min(1.0, useful / wallclock) if wallclock > 0 else 0.0
+    return {
+        "jobs": rows,
+        "goodput": {
+            "usefulStepSeconds": round(useful, 3),
+            "wallclockSeconds": round(wallclock, 3),
+            "ratio": round(ratio, 4),
+        },
+        "queues": dict(queue_waits or {}),
+        "preemption": {
+            "restarts": restarts,
+            "lostSteps": lost_steps,
+            "lostStepSeconds": round(lost_step_seconds, 3),
+        },
+        "stragglers": {
+            "flagged": straggler_count,
+            "remediations": remediation_count,
+        },
+    }

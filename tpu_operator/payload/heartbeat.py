@@ -52,14 +52,21 @@ def interval_of(heartbeat: Any) -> float:
     return interval
 
 
-def _http_post(url: str, body: Dict[str, Any]) -> None:
+def _http_post(url: str, body: Dict[str, Any]) -> Optional[Dict[str, Any]]:
     import urllib.request
 
     req = urllib.request.Request(
         url, data=json.dumps(body).encode(),
         headers={"Content-Type": "application/json"}, method="POST")
-    with urllib.request.urlopen(req, timeout=POST_TIMEOUT):
-        pass
+    with urllib.request.urlopen(req, timeout=POST_TIMEOUT) as resp:
+        # The 200 ACK body is the operator's only control channel back
+        # into the payload (the on-demand profile directive rides it);
+        # non-JSON bodies are fine — the ACK is then just an ACK.
+        try:
+            parsed = json.loads(resp.read() or b"{}")
+        except (ValueError, json.JSONDecodeError):
+            return None
+        return parsed if isinstance(parsed, dict) else None
 
 
 class HeartbeatReporter:
@@ -103,6 +110,15 @@ class HeartbeatReporter:
         # ACK/retry protocol (the 503-until-reconciled dance) needs the
         # real result.
         self.async_sink: Optional[Callable[..., bool]] = None
+        # On-demand deep-profiling channel (process 0 only): a directive
+        # arriving in a heartbeat ACK is stashed here until the train
+        # loop takes it; the capture result is attached to every
+        # subsequent beat until a 200 ACK clears it (the startup
+        # one-shot protocol). Seen ids dedup a directive raced by its
+        # own result fold.
+        self._profile_directive: Optional[Dict[str, Any]] = None
+        self._profile_result: Optional[Dict[str, Any]] = None
+        self._profile_seen: set = set()
 
     def due(self, _step: int) -> bool:
         now = self._clock()
@@ -202,24 +218,56 @@ class HeartbeatReporter:
             except (TypeError, ValueError):
                 pass
         self._last_post, self._last_step = now, int(step)
+        if self._profile_result is not None:
+            body["profile"] = dict(self._profile_result)
         return self._post(body)
+
+    def take_profile_directive(self) -> Optional[Dict[str, Any]]:
+        """The pending on-demand profile directive (``{"id", "steps"}``)
+        stashed from a heartbeat ACK, consumed exactly once — the train
+        loop polls this after each due beat."""
+        directive, self._profile_directive = self._profile_directive, None
+        return directive
+
+    def attach_profile_result(self, result: Dict[str, Any]) -> None:
+        """Attach a finished capture's result to every subsequent beat
+        until a 200 ACK clears it (the startup one-shot protocol); the
+        id joins the seen set so the directive — still Requested until
+        the controller folds this very result — is never re-taken."""
+        self._profile_seen.add(str(result.get("id", "")))
+        self._profile_result = dict(result)
 
     def _post(self, body: Dict[str, Any]) -> bool:
         """Best-effort POST shared by every report flavor: never raises,
         logs the first failure of a streak rather than a stream. With an
         ``async_sink`` wired (the autotune host worker), steady posts are
         handed off — enqueue-and-return, True = accepted for delivery —
-        while ``startup``-carrying beats keep the synchronous path: their
-        one-shot retry protocol needs the server's actual verdict."""
+        while ``startup``/``profile``-carrying beats keep the synchronous
+        path: their one-shot retry protocol needs the server's actual
+        verdict."""
         sink = self.async_sink
-        if sink is not None and "startup" not in body:
+        if sink is not None and "startup" not in body \
+                and "profile" not in body:
             return bool(sink(self._post_now, body))
         return self._post_now(body)
 
     def _post_now(self, body: Dict[str, Any]) -> bool:
         try:
-            self._poster(self.url, body)
+            ack = self._poster(self.url, body)
             self._failed_once = False
+            if "profile" in body:
+                # The capture result one-shot is ACKed — stop resending.
+                self._profile_result = None
+            if isinstance(ack, dict):
+                directive = ack.get("profile")
+                if isinstance(directive, dict) and directive.get("id") \
+                        and str(directive["id"]) not in self._profile_seen:
+                    if len(self._profile_seen) >= 64:
+                        # Ids arrive one explicit tpujobctl call at a
+                        # time; the cap is a leak backstop, not a policy.
+                        self._profile_seen.clear()
+                    self._profile_seen.add(str(directive["id"]))
+                    self._profile_directive = dict(directive)
             return True
         except Exception as e:  # noqa: BLE001 — heartbeats never kill training
             if not self._failed_once:

@@ -1,0 +1,196 @@
+"""On-demand deep profiling: one-shot capture of raw per-step laps.
+
+The flight recorder (``steptrace.py``) ships windowed *digests* on the
+heartbeat — p50/p95/max per phase — which is the right steady-state cost
+but the wrong artifact for "why is step 41k slow on THIS job RIGHT NOW":
+a digest has no per-step resolution and no device trace. This module is
+the payload half of the profile directive round-trip:
+
+- the controller stamps ``status.profile`` (state ``Requested``) from a
+  ``tpujobctl profile`` annotation;
+- the status server piggybacks the directive on a heartbeat ACK to
+  process 0 (no new channel, no payload-facing port);
+- :class:`ProfileCapture` then records the NEXT N committed steps' raw
+  wall laps, merges the flight recorder's per-phase rows for the same
+  step span when the recorder is on, and optionally brackets the window
+  with a ``jax.profiler`` trace (gated: jax may be absent, and the
+  loop's own ``--profile`` window owns the profiler when active);
+- the JSON artifact ships through the PR-8 write-behind ``artifacts/``
+  path and the result rides back on the next heartbeat, where the
+  controller folds ``status.profile`` to ``Captured``.
+
+Stdlib-only on purpose (same discipline as ``steptrace.py``): the
+controller and tests import this module's constants and must not drag
+jax into the control plane; ``jax.profiler`` is imported lazily inside a
+broad try/except at trace start/stop only.
+"""
+
+from __future__ import annotations
+
+import json
+import logging
+import os
+import re
+import tempfile
+import time
+from typing import Any, Dict, List, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+# Directive defaults/bounds. The controller clamps ``steps`` at admission
+# too, but the payload re-clamps: the directive crossed two trust
+# boundaries (annotation JSON, heartbeat ACK body) to get here.
+DEFAULT_STEPS = 8
+MAX_STEPS = 512
+
+ARTIFACT_KIND = "tpujob-profile"
+
+
+def _safe_id(raw: str) -> str:
+    """Directive ids become file names; strip anything path-hostile."""
+    cleaned = re.sub(r"[^A-Za-z0-9._-]", "_", raw or "")
+    return cleaned or "anon"
+
+
+class ProfileCapture:
+    """One in-flight capture window. Step-loop thread only, never shared:
+    armed when the heartbeat ACK delivers a directive, ticked once per
+    committed step, finished when the requested window is full.
+
+    The wall lap is measured between consecutive :meth:`tick` calls —
+    the tick site sits at a fixed point of the loop body (after
+    ``recorder.commit()``), so the delta spans exactly one full step
+    including every host phase, with zero added fences."""
+
+    def __init__(self, directive: Dict[str, Any], base_dir: str = "",
+                 allow_jax_trace: bool = True):
+        self.id = str(directive.get("id") or "")
+        try:
+            steps = int(directive.get("steps") or DEFAULT_STEPS)
+        except (TypeError, ValueError):
+            steps = DEFAULT_STEPS
+        self.steps = max(1, min(MAX_STEPS, steps))
+        self.base_dir = base_dir or tempfile.gettempdir()
+        self._allow_trace = allow_jax_trace
+        self._laps: List[Dict[str, Any]] = []
+        self._t_last: Optional[float] = None
+        self._tracing = False
+        self.trace_dir = ""
+        self.first_step: Optional[int] = None
+        self.last_step: Optional[int] = None
+
+    # -- lifecycle ----------------------------------------------------------
+
+    def start(self, completed_step: int) -> None:
+        """Arm the window: ``completed_step`` is the step that just
+        finished (the directive rode its heartbeat ACK); capture begins
+        with the NEXT step so every lap is a whole step."""
+        self.first_step = completed_step + 1
+        self._t_last = time.perf_counter()
+        if self._allow_trace:
+            try:
+                import jax  # noqa: PLC0415 — payload-only, absent on the control plane
+
+                self.trace_dir = os.path.join(
+                    self.base_dir, "profile-trace-%s" % _safe_id(self.id))
+                jax.profiler.start_trace(self.trace_dir)
+                self._tracing = True
+            except Exception:  # noqa: BLE001 — trace is a bonus, never a blocker
+                self.trace_dir = ""
+        log.info("profile %s: capturing %d step(s) from step %d "
+                 "(jax trace: %s)", self.id or "<anon>", self.steps,
+                 self.first_step, "on" if self._tracing else "off")
+
+    def tick(self, completed_step: int) -> bool:
+        """Record the wall lap for the step that just committed; True once
+        the requested window is full (caller then calls :meth:`finish`)."""
+        now = time.perf_counter()
+        if (self._t_last is not None and self.first_step is not None
+                and completed_step >= self.first_step):
+            self._laps.append({
+                "step": completed_step,
+                "wallSeconds": round(now - self._t_last, 6),
+            })
+            self.last_step = completed_step
+        self._t_last = now
+        return len(self._laps) >= self.steps
+
+    def _stop_trace(self) -> None:
+        if not self._tracing:
+            return
+        self._tracing = False
+        try:
+            import jax  # noqa: PLC0415
+
+            jax.profiler.stop_trace()
+        except Exception:  # noqa: BLE001 — a failed stop must not kill the step loop
+            log.debug("profile %s: jax trace stop failed", self.id,
+                      exc_info=True)
+
+    def abandon(self) -> None:
+        """Teardown path: close any open jax trace, drop the laps. Called
+        from the loop's ``finally`` so a preemption mid-capture never
+        leaves the profiler started."""
+        self._stop_trace()
+        self._laps = []
+
+    # -- artifact -----------------------------------------------------------
+
+    def _merge_recorder(self, recorder: Any) -> List[Dict[str, Any]]:
+        """Join the flight recorder's per-phase rows onto the wall laps.
+        The ring keys steps by the 0-based loop index (``begin(i)``) while
+        the heartbeat — and this capture — speak 1-based completed steps,
+        hence the ``-1``. Best-effort: the ring may have already evicted
+        the span's head on tiny capacities."""
+        rows = [dict(lap) for lap in self._laps]
+        if recorder is None or not rows:
+            return rows
+        try:
+            by_step = {rec.get("step"): rec for rec in recorder.snapshot()}
+        except Exception:  # noqa: BLE001 — recorder is observability, not control flow
+            return rows
+        for row in rows:
+            rec = by_step.get(row["step"] - 1)
+            if not rec:
+                continue
+            for key, value in rec.items():
+                if key != "step":
+                    row.setdefault(key, value)
+        return rows
+
+    def finish(self, recorder: Any = None
+               ) -> Tuple[str, Dict[str, Any]]:
+        """Close the window: stop the trace, write the artifact JSON
+        (atomic tmp+rename), and return ``(path, result)`` where result
+        is the heartbeat's ``profile`` payload. A failed write returns an
+        empty path with the result intact — the controller still folds
+        ``Captured`` (sans artifactKey) instead of re-requesting forever."""
+        self._stop_trace()
+        steps = self._merge_recorder(recorder)
+        result: Dict[str, Any] = {
+            "id": self.id,
+            "capturedSteps": len(steps),
+        }
+        body: Dict[str, Any] = {
+            "kind": ARTIFACT_KIND,
+            "id": self.id,
+            "requestedSteps": self.steps,
+            "capturedSteps": len(steps),
+            "firstStep": self.first_step,
+            "lastStep": self.last_step,
+            "jaxTraceDir": self.trace_dir,
+            "steps": steps,
+        }
+        path = os.path.join(self.base_dir,
+                            "profile-%s.json" % _safe_id(self.id))
+        try:
+            os.makedirs(self.base_dir, exist_ok=True)
+            tmp = path + ".tmp"
+            with open(tmp, "w", encoding="utf-8") as f:
+                json.dump(body, f, indent=1)
+            os.replace(tmp, path)
+        except OSError:
+            log.warning("profile %s: artifact write to %s failed",
+                        self.id, path, exc_info=True)
+            return "", result
+        return path, result

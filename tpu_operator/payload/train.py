@@ -37,6 +37,7 @@ from tpu_operator.payload import bootstrap as bootstrap_mod
 from tpu_operator.payload import data as data_mod
 from tpu_operator.payload import heartbeat as heartbeat_mod
 from tpu_operator.payload import models as models_mod
+from tpu_operator.payload import profile as profile_mod
 from tpu_operator.payload import startup as startup_mod
 from tpu_operator.payload import steptrace as steptrace_mod
 
@@ -680,6 +681,33 @@ def _dump_steptrace(recorder: Optional[steptrace_mod.StepRecorder],
         uploader.enqueue_artifact(path)
 
 
+def _finish_profile(capture: profile_mod.ProfileCapture,
+                    recorder: Optional[steptrace_mod.StepRecorder],
+                    checkpointer, heartbeat) -> None:
+    """Close a completed on-demand capture: write the artifact, ship it
+    through the write-behind ``artifacts/`` path (same route as the
+    steptrace postmortem), and attach the result to the heartbeat so the
+    controller folds ``status.profile`` to Captured. Best-effort on every
+    branch — a profile must never take down the step loop."""
+    try:
+        path, result = capture.finish(recorder)
+    except Exception:  # noqa: BLE001 — capture teardown is observability only
+        log.exception("profile %s: finish failed", capture.id)
+        return
+    if path:
+        uploader = getattr(checkpointer, "uploader", None)
+        if uploader is not None and hasattr(uploader, "enqueue_artifact"):
+            uploader.enqueue_artifact(path)
+            result["artifactKey"] = "artifacts/" + os.path.basename(path)
+    attach = getattr(heartbeat, "attach_profile_result", None)
+    if attach is not None:
+        attach(result)
+    log.info("profile %s: captured %d step(s)%s", capture.id,
+             result.get("capturedSteps", 0),
+             " -> " + result["artifactKey"] if "artifactKey" in result
+             else "")
+
+
 def _startup_heartbeat_ticker(tracker: startup_mod.StartupTracker,
                               heartbeat, stop: threading.Event) -> None:
     """Pre-first-step liveness: until the first step lands there are no
@@ -874,6 +902,9 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
     # beat per digest window, and a <20-step window's nearest-rank p95 IS
     # its max). One step of telemetry lag, zero self-measurement.
     fence = ready = None
+    # On-demand deep profile (one at a time): armed when a heartbeat ACK
+    # delivers a directive, ticked once per committed step below.
+    profile_capture: Optional[profile_mod.ProfileCapture] = None
     try:
         for i in range(start, steps):
             if recorder is not None:
@@ -1012,6 +1043,28 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             if recorder is not None:
                 recorder.lap(steptrace_mod.HOST)
                 recorder.commit()
+            if heartbeat is not None:
+                # Ticked AFTER commit so the flight recorder's row for
+                # this step is in the ring when a full window merges it.
+                take = getattr(heartbeat, "take_profile_directive", None)
+                directive = take() if take is not None else None
+                if directive and profile_capture is None:
+                    profile_capture = profile_mod.ProfileCapture(
+                        directive,
+                        base_dir=(getattr(checkpointer, "directory", "")
+                                  or os.environ.get(
+                                      "TPU_CHECKPOINT_DIR", "")),
+                        # The loop's own --profile window owns the jax
+                        # profiler while armed or active; the on-demand
+                        # capture then ships raw laps only.
+                        allow_jax_trace=(not tracing
+                                         and (not profile_dir or profiled)))
+                    profile_capture.start(i + 1)
+                if (profile_capture is not None
+                        and profile_capture.tick(i + 1)):
+                    _finish_profile(profile_capture, recorder,
+                                    checkpointer, heartbeat)
+                    profile_capture = None
     except SystemExit as e:
         # Retryable exits (preemption drain, save-failure escalation) are
         # exactly when a postmortem wants the last N steps' phase timings:
@@ -1032,6 +1085,11 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         # park the exit).
         dev_batches.close()
         runtime.close()
+        if profile_capture is not None:
+            # A preemption mid-capture must not leave the jax profiler
+            # started; the partial window is dropped (the directive is
+            # one-shot — the user re-requests against the new attempt).
+            profile_capture.abandon()
         if tracing:
             # Close the trace on EVERY exit path — normal completion with the
             # window open, SIGTERM drain (SystemExit above), or a step error —

@@ -42,12 +42,14 @@ Exported metrics (registered in controller/statusserver.py):
 
 from __future__ import annotations
 
+import collections
 import logging
 import time
 from dataclasses import dataclass, field
 from typing import Any, Callable, Dict, List, Optional, Tuple
 
 from tpu_operator.apis.tpujob.v1alpha1.types import DEFAULT_SCHEDULING_QUEUE
+from tpu_operator.obs import timeline as timeline_mod
 from tpu_operator.scheduler.inventory import SliceInventory
 from tpu_operator.util import joblife, lockdep
 
@@ -59,6 +61,11 @@ log = logging.getLogger(__name__)
 # PR-1 event-dedup-cache slow-leak class). Idle queues beyond the cap are
 # dropped from tracking and their series removed from the registry.
 QUEUE_GAUGE_CAP = 256
+
+# Per-queue admission-wait sample window for the fleet rollup's
+# p50/p95: the newest N admissions per queue, not a lifetime histogram —
+# the rollup answers "what does THIS queue cost right now".
+QUEUE_WAIT_SAMPLES = 256
 
 
 @dataclass
@@ -114,6 +121,10 @@ class FleetScheduler:
         self._evicting: Dict[str, Tuple[str, str]] = joblife.track(
             "FleetScheduler._evicting")  # per-job: release; guarded-by: _lock
         self._known_queues: set = set()  # gauge zeroing; guarded-by: _lock
+        # queue name -> recent admission waits (seconds, newest last).
+        # Keyed by QUEUE (not job), bounded by the same eviction pattern
+        # as the depth gauges — queue-name churn cannot grow it.
+        self._queue_waits: Dict[str, "collections.deque"] = {}  # guarded-by: _lock
         self._seq = 0  # guarded-by: _lock
 
     # -- the reconcile-time gate -----------------------------------------------
@@ -443,10 +454,23 @@ class FleetScheduler:
             self._inventory.reserve(head.demand_key, head.slices)
             self._admitted[head.key] = head
             wake.append(head.key)
-            if self._metrics is not None and head.enqueued_at:
-                self._metrics.observe(
-                    "tpujob_admission_latency_seconds",
-                    max(0.0, self._clock() - head.enqueued_at))
+            if head.enqueued_at:
+                waited = max(0.0, self._clock() - head.enqueued_at)
+                if self._metrics is not None:
+                    self._metrics.observe(
+                        "tpujob_admission_latency_seconds", waited)
+                window = self._queue_waits.get(head.queue)
+                if window is None:
+                    if len(self._queue_waits) >= QUEUE_GAUGE_CAP:
+                        # Same bound as the depth gauges: drop the
+                        # stalest queue's window before admitting a new
+                        # queue name (FIFO by insertion is enough — a
+                        # queue that admits again simply re-enters).
+                        self._queue_waits.pop(
+                            next(iter(self._queue_waits)))
+                    window = collections.deque(maxlen=QUEUE_WAIT_SAMPLES)
+                    self._queue_waits[head.queue] = window
+                window.append(waited)
         self._cancel_unjustified_evictions_locked()
         self._update_gauges_locked()
         return wake
@@ -538,6 +562,16 @@ class FleetScheduler:
             self._metrics.set_gauge("tpujob_queue_depth",
                                     depths.get(queue, 0),
                                     labels={"queue": queue})
+
+    def queue_wait_quantiles(self) -> Dict[str, Dict[str, Any]]:
+        """Recent per-queue admission-wait p50/p95 (+ sample count) for
+        the fleet rollup (``GET /api/fleet``): nearest-rank over the
+        newest QUEUE_WAIT_SAMPLES admissions of each queue."""
+        with self._lock:
+            windows = {queue: list(w)
+                       for queue, w in self._queue_waits.items() if w}
+        return {queue: timeline_mod.quantiles(samples)
+                for queue, samples in windows.items()}
 
     # -- wakeups (outside the lock: enqueue takes the workqueue's lock) --------
 

@@ -71,6 +71,7 @@ TPU-native hardening baked in (SURVEY.md §7 "hard parts"):
 from __future__ import annotations
 
 import copy
+import json
 import logging
 import time
 from typing import Any, Callable, Dict, List, Optional, Tuple
@@ -84,6 +85,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     FailureKind,
     FailureRecord,
     PREEMPTION_BUDGET_FACTOR,
+    PROFILE_ANNOTATION,
     RestartPolicy,
     ReplicaState,
     State,
@@ -93,6 +95,7 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     TPUJobSpec,
 )
 from tpu_operator.client import errors
+from tpu_operator.payload import profile as profile_mod
 from tpu_operator.scheduler.inventory import job_demand, scheduling_params
 from tpu_operator.trainer import elastic as elastic_mod
 from tpu_operator.trainer import replicas as replicas_mod
@@ -536,9 +539,13 @@ class TrainingJob:
     # a deferred sizing write that dies with the operator would
     # re-reserve the spec's full (phantom) size; it changes at most once
     # per attempt plus per remediation, so it cannot storm the limiter.
+    # ``profile`` is here because the directive's delivery path POLLS
+    # status (the heartbeat-ACK piggyback reads status.profile.state):
+    # a Requested record parked behind the write limiter is a directive
+    # the payload never sees until unrelated churn flushes it.
     _CRITICAL_STATUS_FIELDS = ("phase", "attempt", "state", "reason",
                                "backoffUntil", "failures", "startup",
-                               "stragglers", "elastic")
+                               "stragglers", "elastic", "profile")
 
     def _critical_status_delta(self, base: Dict[str, Any],
                                wire: Dict[str, Any]) -> bool:
@@ -597,6 +604,7 @@ class TrainingJob:
             return
 
         self.setup_replicas()
+        self._sync_profile()
         attempt = self.job.status.attempt
 
         # Fleet-scheduler eviction directive, checked before the suspend/
@@ -874,6 +882,49 @@ class TrainingJob:
         job-scoped LIST so no live pod survives on cache staleness."""
         self.gang.delete_live_pods()
 
+    def _sync_profile(self) -> None:
+        """Admit an on-demand deep-profile directive from the
+        ``tpujobctl profile`` annotation into ``status.profile`` (state
+        Requested). From there the status server piggybacks the directive
+        on a heartbeat ACK to process 0, and the controller folds the
+        capture result back to Captured. Idempotent per directive id:
+        the annotation stays on the object, so re-admitting the same id
+        must be a no-op — including after Captured, or the record would
+        flap Requested forever."""
+        raw = (self.job.metadata.get("annotations") or {}).get(
+            PROFILE_ANNOTATION)
+        if not raw:
+            return
+        try:
+            directive = json.loads(raw)
+        except (TypeError, ValueError):
+            return
+        if not isinstance(directive, dict):
+            return
+        rid = str(directive.get("id") or "")
+        if not rid:
+            return
+        cur = self.job.status.profile or {}
+        if cur.get("id") == rid:
+            return
+        try:
+            steps = int(directive.get("steps")
+                        or profile_mod.DEFAULT_STEPS)
+        except (TypeError, ValueError):
+            steps = profile_mod.DEFAULT_STEPS
+        steps = max(1, min(profile_mod.MAX_STEPS, steps))
+        self.job.status.profile = {
+            "id": rid,
+            "state": "Requested",
+            "steps": steps,
+            "time": _now(),
+        }
+        if self.recorder:
+            self.recorder.event(
+                self, "Normal", "ProfileRequested",
+                f"profile {rid}: capture of {steps} raw step lap(s) "
+                f"requested")
+
     def _record_failure(self, attempt: int, kind: str, reason: str) -> None:
         """Record one classified failure: an entry in the ``status.failures``
         ledger (bounded postmortem trail: oldest entries fall off past
@@ -920,10 +971,23 @@ class TrainingJob:
                 world = int(el["slices"])
             else:
                 world = max(1, self.job.spec.num_slices)
+        # Progress the restart discards: the last step the attempt
+        # reported minus the step it will resume from. Priced in
+        # step-seconds by the fleet rollup — stamped HERE because only
+        # the restart moment knows both numbers at once.
+        lost = None
+        gp = status.goodput or {}
+        last_step = gp.get("lastStep", hb.get("step"))
+        if resume is not None and last_step is not None:
+            try:
+                lost = max(0, int(last_step) - resume)
+            except (TypeError, ValueError):
+                lost = None
         ledger.append(FailureRecord(attempt=attempt, kind=kind,
                                     reason=reason, time=_now(),
                                     resume_step=resume,
-                                    world_slices=world))
+                                    world_slices=world,
+                                    lost_steps=lost))
         if len(ledger) > FAILURE_LEDGER_CAP:
             del ledger[:len(ledger) - FAILURE_LEDGER_CAP]
         status.restart_counts[kind] = status.restart_counts.get(kind, 0) + 1
