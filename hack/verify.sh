@@ -153,6 +153,21 @@ python -m pytest tests/test_fleet_scheduler.py -x -q
 # reconcile latency, the status-PUT budget, and the PR-3 zero-read steady
 # state asserted at fleet scale — exits nonzero on regression.
 python bench.py --fleet --quick
+# Standalone fake-cluster gate: node/kubelet state machines (bind →
+# ContainerCreating → Running/heartbeats → terminal), kubelet-level
+# preemption shape, seeded storm-plan determinism (same seed →
+# bit-identical schedule), the inventory flap-debounce regression, and
+# the chaos-composition soak (FlakyClientset × pod kills × blob faults
+# with preemption-kind-only ledger records).
+python -m pytest tests/test_fake_cluster.py -x -q
+# And the measured form: ~1k pods / 500 jobs through the REAL operator
+# over the in-process apiserver while a seeded storm lands mid-flight
+# (slice preemption sweeps, node flaps inside the debounce window, an
+# API-fault burst, slow kubelets, a drain). Gates: full drain, zero
+# leaked pods / stuck Queued / joblife residue, flat metric-series
+# count, bounded RSS, and reconcile p99 bounded DURING the storm —
+# exits nonzero on regression. Full scale (10k pods): bench.py --cluster.
+python bench.py --cluster --quick
 # Standalone control-plane budget gate: steady-state reconcile must issue
 # ZERO read RPCs (all reads served by the informer indexes) and the first
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
@@ -185,6 +200,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_lifecycle.py \
   --ignore=tests/test_schedules.py \
   --ignore=tests/test_timeline.py \
-  --ignore=tests/test_fleet_obs_e2e.py
+  --ignore=tests/test_fleet_obs_e2e.py \
+  --ignore=tests/test_fake_cluster.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

@@ -1,0 +1,444 @@
+"""Fake cluster tests: node/kubelet state machines, seeded storm plans,
+and the chaos-composition soak (ISSUE 17).
+
+Three layers:
+
+1. **State machine units** — a pod created in the backing store walks
+   Pending/ContainerCreating → Running (bound to a node, heartbeating
+   through a status-server stub) → Succeeded, driven entirely by the
+   cluster's pump/timer threads; NotReady nodes hold pods unbound;
+   preemption produces the exact kubelet-level shape
+   (``Failed``/``Preempted``, **no** container record) trainer/policy.py
+   classifies as a preemption-kind restart.
+
+2. **Storm determinism** — the entire kill/flap schedule derives from
+   ``(seed, sorted identities, waves)``: same seed → bit-identical
+   ``repr``, plan unchanged by live cluster mutation, paired end events
+   always emitted. This is what makes a failing soak seed reproducible
+   from its printed number alone (docs/design.md).
+
+3. **Chaos composition** — FlakyClientset at 10% × a pod-kill storm × a
+   blob fault hook, simultaneously, against a small fake cluster: a
+   checkpointed job still reaches Done *through Backoff* with
+   preemption-kind (never application-kind) ledger records.
+
+Plus the inventory flap-debounce regression (a NotReady→Ready flap
+inside ``--node-debounce-seconds`` drives ZERO FleetScheduler
+churn) — one of the two named scale-risk surfaces in the issue.
+"""
+
+import random
+import threading
+import time
+
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.workqueue import RateLimitingQueue
+from tpu_operator.controller.chaos import ChaosMonkey, FlakyClientset
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import Metrics
+from tpu_operator.scheduler.inventory import SliceInventory
+from tpu_operator.store.blob import FakeBackend
+from tpu_operator.testing.cluster import (
+    FakeCluster,
+    FakeNode,
+    KubeletProfile,
+    StormController,
+    make_nodes,
+)
+from tpu_operator.testing.waiting import make_wait_for
+
+wait_for = make_wait_for(timeout=10.0, interval=0.02)
+
+
+def bare_pod(name, job="j", idx=0, attempt=0):
+    """A pod exactly as the operator creates it: labeled, no status."""
+    return {
+        "metadata": {"name": name, "labels": {
+            t.LABEL_JOB_NAME: job,
+            t.LABEL_TASK_INDEX: str(idx),
+            t.LABEL_ATTEMPT: str(attempt),
+        }},
+        "spec": {"containers": [{"name": "tpu"}]},
+    }
+
+
+def pod_status(cs, name):
+    try:
+        return cs.pods.get("default", name).get("status") or {}
+    except Exception:  # noqa: BLE001 — deleted
+        return {}
+
+
+# --- node identity feeds discovery -------------------------------------------
+
+def test_fake_nodes_feed_slice_inventory_discovery():
+    nodes = make_nodes(4, slices=2)
+    inv = SliceInventory.from_node_objects([n.manifest() for n in nodes])
+    assert inv.capacities() == {"cloud-tpus.google.com/v4:2x2x2": 2}
+    # NotReady nodes drop out of the discovered model — the condition
+    # the kubelet layer flips is the condition discovery reads.
+    half = [n.manifest(ready=(i % 2 == 0)) for i, n in enumerate(nodes)]
+    assert SliceInventory.from_node_objects(half).capacities() == {
+        "cloud-tpus.google.com/v4:2x2x2": 1}
+
+
+# --- pod state machine -------------------------------------------------------
+
+def test_pod_walks_kubelet_state_machine():
+    backing = FakeClientset()
+    with FakeCluster(backing, nodes=tuple(make_nodes(2, slices=2)),
+                     profile=KubeletProfile(create_latency=0.3,
+                                            run_seconds=0.3)):
+        backing.pods.create("default", bare_pod("p-0"))
+        # Pending + ContainerCreating, already bound to a node.
+        wait_for(lambda: pod_status(backing, "p-0").get("phase") == "Pending")
+        pod = backing.pods.get("default", "p-0")
+        assert pod["spec"]["nodeName"].startswith("node-")
+        waiting = pod["status"]["containerStatuses"][0]["state"]["waiting"]
+        assert waiting["reason"] == "ContainerCreating"
+        # Running/Ready after the create latency.
+        wait_for(lambda: pod_status(backing, "p-0").get("phase") == "Running")
+        status = pod_status(backing, "p-0")
+        assert status["containerStatuses"][0]["ready"] is True
+        # Terminal with a clean container record after run_seconds.
+        wait_for(lambda: pod_status(backing, "p-0").get("phase")
+                 == "Succeeded")
+        status = pod_status(backing, "p-0")
+        term = status["containerStatuses"][0]["state"]["terminated"]
+        assert term["exitCode"] == 0
+
+
+def test_instant_profile_is_a_single_status_write():
+    backing = FakeClientset()
+    with FakeCluster(backing, profile=KubeletProfile()):
+        backing.pods.create("default", bare_pod("p-0"))
+        wait_for(lambda: pod_status(backing, "p-0").get("phase")
+                 == "Succeeded")
+        writes = [a for a in backing.actions
+                  if a[0] == "update" and a[3] == "p-0"]
+        # The budget benches depend on this: no intermediate phases.
+        assert len(writes) == 1, backing.actions
+
+
+def test_not_ready_nodes_hold_pods_unbound():
+    backing = FakeClientset()
+    nodes = tuple(make_nodes(1, slices=1))
+    with FakeCluster(backing, nodes=nodes,
+                     profile=KubeletProfile()) as cluster:
+        cluster.set_node_ready(nodes[0].name, False)
+        backing.pods.create("default", bare_pod("p-0"))
+        time.sleep(0.5)  # several bind-retry rounds
+        assert pod_status(backing, "p-0") == {}  # still Pending, unbound
+        assert cluster.tracked_pods() == 1
+        # The node recovers: the held pod binds and completes.
+        cluster.set_node_ready(nodes[0].name, True)
+        wait_for(lambda: pod_status(backing, "p-0").get("phase")
+                 == "Succeeded")
+
+
+def test_heartbeats_flow_through_status_server():
+    beats = []
+
+    class ServerStub:
+        def record_heartbeat(self, body):
+            beats.append(body)
+
+    backing = FakeClientset()
+    with FakeCluster(backing, nodes=tuple(make_nodes(1, slices=1)),
+                     profile=KubeletProfile(run_seconds=0.5,
+                                            heartbeat_interval=0.05),
+                     status_server=ServerStub()):
+        backing.pods.create("default",
+                            bare_pod("p-0", job="train", idx=1, attempt=2))
+        wait_for(lambda: len(beats) >= 3)
+        assert beats[0]["name"] == "train"
+        assert beats[0]["processId"] == 1
+        assert beats[0]["attempt"] == 2
+        steps = [b["step"] for b in beats[:3]]
+        assert steps == sorted(steps) and len(set(steps)) == 3
+
+
+def test_preemption_has_kubelet_level_shape():
+    backing = FakeClientset()
+    nodes = tuple(make_nodes(2, slices=2))
+    with FakeCluster(backing, nodes=nodes,
+                     profile=KubeletProfile(run_seconds=30.0)) as cluster:
+        backing.pods.create("default", bare_pod("p-0"))
+        wait_for(lambda: pod_status(backing, "p-0").get("phase") == "Running")
+        bound = backing.pods.get("default", "p-0")["spec"]["nodeName"]
+        slice_id = cluster.get_node(bound).slice_id
+        victims = cluster.preempt_slices([slice_id])
+        assert victims == ["p-0"]
+        status = pod_status(backing, "p-0")
+        # The exact shape trainer/policy.py reads as PREEMPTION-kind:
+        # kubelet-level Failed, reason Preempted, NO container record.
+        assert status["phase"] == "Failed"
+        assert status["reason"] == "Preempted"
+        assert "containerStatuses" not in status
+        # Pods on other slices are untouched.
+        assert cluster.preempt_slices(["no-such-slice"]) == []
+
+
+def test_deleted_pod_leaves_the_state_machine():
+    backing = FakeClientset()
+    with FakeCluster(backing, nodes=tuple(make_nodes(1, slices=1)),
+                     profile=KubeletProfile(run_seconds=30.0)) as cluster:
+        backing.pods.create("default", bare_pod("p-0"))
+        wait_for(lambda: cluster.tracked_pods() == 1)
+        wait_for(lambda: pod_status(backing, "p-0").get("phase") == "Running")
+        backing.pods.delete("default", "p-0")
+        wait_for(lambda: cluster.tracked_pods() == 0)
+
+
+# --- seeded storms -----------------------------------------------------------
+
+STORM_WAVES = (
+    (0.0, "preempt", {"count": 4, "sweeps": 3, "interval": 0.5}),
+    (1.0, "flap", {"count": 3, "down_seconds": 0.4}),
+    (2.0, "drain", {"down_seconds": 1.0}),
+    (3.0, "api_fault", {"rate": 0.2, "seconds": 1.5}),
+    (4.0, "slow_kubelet", {"scale": 4.0, "seconds": 1.0}),
+    (5.0, "pod_kill", {}),
+    (6.0, "blob_fault", {"seconds": 0.5}),
+)
+
+
+def storm_on(cluster, seed):
+    return StormController(cluster, seed, STORM_WAVES)
+
+
+def test_storm_plan_replays_bit_identically():
+    backing = FakeClientset()
+    cluster = FakeCluster(backing, nodes=tuple(make_nodes(32, slices=16)))
+    plan = [repr(e) for e in storm_on(cluster, 1234).plan()]
+    # Same seed, same cluster shape → bit-identical schedule; a second
+    # controller instance sees the same world the failing run printed.
+    assert [repr(e) for e in storm_on(cluster, 1234).plan()] == plan
+    assert [repr(e) for e in storm_on(cluster, 4321).plan()] != plan
+    # Paired end events exist for every window-shaped wave.
+    kinds = [e.kind for e in storm_on(cluster, 1234).plan()]
+    for on, off in (("flap_down", "flap_up"), ("drain", "return"),
+                    ("api_fault_on", "api_fault_off"),
+                    ("slow_on", "slow_off"), ("blob_on", "blob_off")):
+        assert kinds.count(on) == 1 and kinds.count(off) == 1
+    # A preempt window sweeps the SAME seeded targets, not fresh draws.
+    sweeps = [e for e in storm_on(cluster, 1234).plan()
+              if e.kind == "preempt"]
+    assert len(sweeps) == 3
+    assert len({tuple(e.params["slice_ids"]) for e in sweeps}) == 1
+
+
+def test_storm_plan_ignores_live_cluster_mutation():
+    backing = FakeClientset()
+    cluster = FakeCluster(backing, nodes=tuple(make_nodes(8, slices=4)))
+    storm = storm_on(cluster, 7)
+    before = [repr(e) for e in storm.plan()]
+    # The identity snapshot is taken at construction: draining a node
+    # mid-storm must not shift later waves of the SAME plan.
+    cluster.drain_node(cluster.node_names()[0])
+    assert [repr(e) for e in storm.plan()] == before
+
+
+def test_storm_run_applies_and_unwinds_fault_windows():
+    backing = FakeClientset()
+    nodes = tuple(make_nodes(4, slices=2))
+    flaky = FlakyClientset(FakeClientset(), error_rate=0.0,
+                           rng=random.Random(3))
+    blob_log = []
+    with FakeCluster(backing, nodes=nodes) as cluster:
+        storm = StormController(
+            cluster, seed=5,
+            waves=((0.0, "api_fault", {"rate": 0.5, "seconds": 0.1}),
+                   (0.1, "drain", {"down_seconds": 0.1}),
+                   (0.3, "blob_fault", {"seconds": 0.1}),
+                   (0.5, "slow_kubelet", {"scale": 9.0, "seconds": 0.1})),
+            flaky=flaky,
+            blob_arm=lambda: blob_log.append("armed"),
+            blob_disarm=lambda: blob_log.append("disarmed"))
+        storm.run()
+        assert storm.window is not None
+        assert flaky.error_rate == 0.0          # fault window unwound
+        assert blob_log == ["armed", "disarmed"]
+        assert sorted(cluster.node_names()) == sorted(
+            n.name for n in nodes)              # drained node returned
+        backing_nodes = {n["metadata"]["name"]
+                         for n in backing.nodes.list("")}
+        assert backing_nodes == {n.name for n in nodes}
+
+
+# --- inventory flap debounce (named scale-risk regression) -------------------
+
+def test_node_flap_inside_debounce_window_causes_zero_inventory_churn():
+    """A NotReady→Ready flap inside --node-debounce-seconds must drive
+    ZERO FleetScheduler.update_inventory calls: without the window every
+    kubelet heartbeat blip would release/re-admit the Queued head at
+    fleet scale. A shrink that OUTLIVES the window still applies, and
+    recovery growth applies immediately."""
+    backing = FakeClientset()
+    cluster = FakeCluster(backing, nodes=tuple(make_nodes(2, slices=2)))
+    config = t.ControllerConfig(discover_slice_inventory=True,
+                                node_debounce_seconds=0.6)
+    factory = SharedInformerFactory(backing, "default", resync_period=0)
+    controller = Controller(backing, factory, config, "default", shards=1)
+
+    calls = []
+    orig = controller.scheduler.update_inventory
+
+    def counting(caps):
+        calls.append(dict(caps))
+        return orig(caps)
+
+    controller.scheduler.update_inventory = counting
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(1, stop),
+                              daemon=True)
+    runner.start()
+    key = "cloud-tpus.google.com/v4:2x2x2"
+    try:
+        wait_for(lambda: controller.scheduler.summary()["inventory"]
+                 .get(key, {}).get("capacity") == 2)
+        time.sleep(0.2)  # let the initial add burst fully settle
+        settled = len(calls)
+
+        flapped = cluster.node_names()[0]
+        cluster.set_node_ready(flapped, False)
+        time.sleep(0.2)  # well inside the 0.6 s window
+        cluster.set_node_ready(flapped, True)
+        time.sleep(1.2)  # past where the withheld shrink would fire
+        assert calls[settled:] == [], calls[settled:]
+        assert controller.scheduler.summary()["inventory"][key][
+            "capacity"] == 2
+
+        # A real outage (shrink outliving the window) DOES apply...
+        cluster.set_node_ready(flapped, False)
+        wait_for(lambda: controller.scheduler.summary()["inventory"]
+                 .get(key, {}).get("capacity") == 1, timeout=5.0)
+        # ...and recovery growth applies on the very node event.
+        cluster.set_node_ready(flapped, True)
+        wait_for(lambda: controller.scheduler.summary()["inventory"]
+                 .get(key, {}).get("capacity") == 2, timeout=2.0)
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)
+
+
+# --- chaos composition -------------------------------------------------------
+
+def soak_job():
+    return {
+        "apiVersion": "tpuoperator.dev/v1alpha1", "kind": "TPUJob",
+        "metadata": {"name": "soak", "namespace": "default"},
+        "spec": {
+            "replicaSpecs": [{
+                "replicas": 2, "tpuReplicaType": "WORKER", "tpuPort": 8476,
+                "template": {"spec": {"containers": [{"name": "tpu"}]}},
+            }],
+            # ONE application restart: two preemptions only fit the
+            # preemption budget — any application-kind classification
+            # fails the job before Done.
+            "maxRestarts": 1,
+            "checkpointDir": "/ckpt/soak",
+            "restartBackoff": {"baseSeconds": 1, "maxSeconds": 4},
+        },
+    }
+
+
+def test_chaos_composition_checkpointed_job_survives_storm():
+    """FlakyClientset (10% injected 429/500s) × pod-kill storm × blob
+    fault hook, all live at once over a small fake cluster: the
+    checkpointed job reaches Done through Backoff, and the ledger holds
+    preemption-kind records only."""
+    backing = FakeClientset()
+    metrics = Metrics()
+    flaky = FlakyClientset(backing, error_rate=0.10,
+                           rng=random.Random(7), metrics=metrics)
+    factory = SharedInformerFactory(flaky, "default", resync_period=1.0)
+    controller = Controller(
+        flaky, factory, namespace="default", metrics=metrics,
+        queue=RateLimitingQueue(base_delay=0.2, max_delay=1.0))
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True)
+    runner.start()
+
+    blob = FakeBackend()
+
+    def blob_fault(op, key):
+        raise IOError(f"chaos: injected blob fault on {op} {key}")
+
+    nodes = tuple(make_nodes(4, slices=2))
+    cluster = FakeCluster(backing, nodes=nodes,
+                          profile=KubeletProfile(create_latency=0.02,
+                                                 run_seconds=0.6))
+    cluster.start()
+    monkey = ChaosMonkey(backing, "default", level=1,
+                         rng=random.Random(11), metrics=metrics)
+    storm = StormController(
+        cluster, seed=1234,
+        waves=tuple([(0.3 * i, "pod_kill", {}) for i in range(6)]
+                    + [(0.2, "blob_fault", {"seconds": 1.2})]),
+        monkey=monkey,
+        blob_arm=lambda: setattr(blob, "fault_hook", blob_fault),
+        blob_disarm=lambda: setattr(blob, "fault_hook", None))
+    storm_thread = threading.Thread(target=storm.run, daemon=True)
+
+    def job_status():
+        try:
+            return backing.tpujobs.get("default", "soak").get("status") or {}
+        except Exception:  # noqa: BLE001 — racing creation
+            return {}
+
+    try:
+        backing.tpujobs.create("default", soak_job())
+        storm_thread.start()
+
+        # The blob window is REAL: while armed, the store layer fails.
+        wait_for(lambda: blob.fault_hook is not None, timeout=5.0)
+        try:
+            blob.put("ckpt/probe", b"x")
+            raise AssertionError("armed blob backend accepted a put")
+        except IOError:
+            pass
+
+        # Deterministic preemption pressure, exactly the chaos-soak
+        # pattern: generations 0 and 1 die Preempted (kubelet-level, via
+        # the cluster's own injector so the sims stay coherent); the
+        # storm's kill/blob/API faults rage around them the whole time.
+        deadline = time.monotonic() + 30.0
+        while time.monotonic() < deadline \
+                and job_status().get("attempt", 0) < 2:
+            early = [
+                p["metadata"]["name"]
+                for p in backing.pods.list("default")
+                if (p["metadata"].get("labels") or {})
+                .get("attempt") in ("0", "1")
+                and (p.get("status") or {}).get("phase")
+                not in ("Failed", "Succeeded")]
+            cluster.preempt_pods(early)
+            time.sleep(0.05)
+        assert job_status().get("attempt", 0) >= 2, job_status()
+
+        wait_for(lambda: job_status().get("phase") == "Done",
+                 timeout=30.0)
+        status = job_status()
+        assert status["state"] == "Succeeded"
+        # Both restarts were spaced through Backoff...
+        assert "Backoff" in (status.get("phaseTimeline") or {}), status
+        # ...and classified as preemption-kind: the application budget
+        # (maxRestarts=1) was never touched despite the monkey and the
+        # injected API faults running throughout.
+        kinds = [f["kind"] for f in status.get("failures") or []]
+        assert kinds and set(kinds) == {"preemption"}, status.get("failures")
+
+        storm_thread.join(timeout=10.0)
+        assert not storm_thread.is_alive()
+        # The composition actually happened: API faults were injected,
+        # and the blob window armed + disarmed around real failures.
+        assert metrics.snapshot()["chaos_api_errors_total"] > 0
+        assert blob.fault_hook is None
+    finally:
+        stop.set()
+        cluster.stop()
+        runner.join(timeout=10.0)

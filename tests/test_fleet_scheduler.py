@@ -242,6 +242,55 @@ def test_node_watch_updates_admission_live():
         runner.join(timeout=5.0)
 
 
+def test_node_not_ready_transition_shrinks_inventory_live():
+    """Satellite of the kubelet layer: a node's Ready condition flipping
+    False must flow node informer → discovery (which skips NotReady
+    nodes) → FleetScheduler capacity, live; flipping back restores it.
+    Debounce is disabled here — the flap-absorption behavior has its own
+    regression in tests/test_fake_cluster.py."""
+    cs = FakeClientset()
+
+    def node(name, sid, ready=True):
+        return {"metadata": {"name": name, "labels": {
+            "cloud.google.com/gke-tpu-topology": "2x2x2",
+            "tpuoperator.dev/slice-id": sid}},
+            "status": {"allocatable": {V4: "4"},
+                       "conditions": [{"type": "Ready",
+                                       "status": "True" if ready
+                                       else "False"}]}}
+
+    cs.nodes.create("", node("n1", "slice-a"))
+    cs.nodes.create("", node("n2", "slice-b"))
+
+    factory = SharedInformerFactory(cs, resync_period=0)
+    config = t.ControllerConfig(discover_slice_inventory=True,
+                                node_debounce_seconds=0.0)
+    controller = Controller(cs, factory, config, shards=1)
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(1, stop),
+                              daemon=True)
+    runner.start()
+
+    def capacity():
+        return (controller.scheduler.summary()["inventory"]
+                .get(KEY, {}).get("capacity"))
+
+    try:
+        assert wait_for(lambda: capacity() == 2)
+        # Kubelet heartbeat lost: NotReady drops the slice from the model.
+        cs.nodes.update_status("", node("n2", "slice-b", ready=False))
+        assert wait_for(lambda: capacity() == 1)
+        # Recovery is immediate (growth is never debounced).
+        cs.nodes.update_status("", node("n2", "slice-b", ready=True))
+        assert wait_for(lambda: capacity() == 2)
+        # Node DELETED events shrink the same way (drain storms).
+        cs.nodes.delete("", "n1")
+        assert wait_for(lambda: capacity() == 1)
+    finally:
+        stop.set()
+        runner.join(timeout=5.0)
+
+
 # --- admission queue ordering ------------------------------------------------
 
 def sched(capacity=1, metrics=None, clock=time.time):
@@ -267,6 +316,31 @@ def test_admission_capacity_and_release_wakeup():
     assert "default/c" in wakes
     assert s.is_admitted("default/c")
     assert offer(s, "c")  # idempotent fast path
+
+
+def test_queued_head_reexamination_under_mass_release():
+    """Named scale-risk regression (ISSUE 17): when a storm releases many
+    admitted gangs at once (mass preemption, churn teardown), EVERY freed
+    slice must re-admit from the queue head in the same pass, and every
+    newly admitted key must be woken through the enqueue callback — a
+    fresh add, not a rate-limited requeue, so the admission is not parked
+    behind the workqueue's 10 s per-item backoff tail."""
+    s, wakes = sched(capacity=4)
+    admitted = ["a", "b", "c", "d"]
+    parked = ["e", "f", "g", "h"]
+    for name in admitted:
+        assert offer(s, name)
+    for name in parked:
+        assert not offer(s, name)
+    assert s.summary()["pending"] == 4
+    wakes.clear()
+    for name in admitted:
+        s.release(f"default/{name}")
+    # One release at a time, but the whole parked head drained: nothing
+    # waits for a resync or a second release to be re-examined.
+    assert all(s.is_admitted(f"default/{n}") for n in parked), s.summary()
+    assert s.summary()["pending"] == 0
+    assert {f"default/{n}" for n in parked} <= set(wakes)
 
 
 def test_priority_orders_admission():

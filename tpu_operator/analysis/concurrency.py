@@ -52,6 +52,7 @@ SCAN = (
     ("tpu_operator", "store"),
     ("tpu_operator", "trainer"),
     ("tpu_operator", "util"),
+    ("tpu_operator", "testing", "cluster.py"),
     ("tpu_operator", "payload", "autotune.py"),
     ("tpu_operator", "payload", "checkpoint.py"),
     ("tpu_operator", "payload", "serve.py"),

@@ -83,6 +83,10 @@ SCAN = (
     ("tpu_operator", "trainer"),
     ("tpu_operator", "store"),
     ("tpu_operator", "util"),
+    # The fake-cluster harness runs threaded against the same stores the
+    # operator watches; its containers (pod sims, kubelets, timers) must
+    # prove the same no-residue discipline the control plane does.
+    ("tpu_operator", "testing", "cluster.py"),
 )
 
 # Names whose appearance as a container key mark it per-job-keyed.

@@ -1342,6 +1342,16 @@ class TPUAcceleratorConfig:
         )
 
 
+def _debounce_seconds(value: Any) -> float:
+    """Validate ``nodeDebounceSeconds``: a negative window is a config
+    error (there is no 'apply shrinks from the past'), not a silent 0."""
+    seconds = float(value)
+    if seconds < 0:
+        raise ValueError(
+            f"nodeDebounceSeconds must be >= 0, got {value!r}")
+    return seconds
+
+
 @dataclass
 class ControllerConfig:
     """Admin-provided operator config (ref: types.go:170-178).
@@ -1375,6 +1385,13 @@ class ControllerConfig:
     # an operator restart. When set alongside a static ``sliceInventory``,
     # the discovered model wins as soon as the node cache syncs.
     discover_slice_inventory: bool = False
+    # Debounce window for discovered-capacity SHRINKS (``nodeDebounceSeconds``
+    # / ``--node-debounce-seconds``): a NotReady→Ready flap inside the
+    # window must not churn the fleet scheduler through a shrink/regrow
+    # rebalance cycle. Growth always applies immediately — a new node
+    # admitting a queued gang must never wait out a flap timer. 0 disables
+    # (every node event applies verbatim, the pre-debounce behavior).
+    node_debounce_seconds: float = 5.0
 
     def to_dict(self) -> Dict[str, Any]:
         d: Dict[str, Any] = {
@@ -1388,6 +1405,8 @@ class ControllerConfig:
             d["sliceInventory"] = dict(self.slice_inventory)
         if self.discover_slice_inventory:
             d["discoverSliceInventory"] = True
+        if self.node_debounce_seconds != 5.0:
+            d["nodeDebounceSeconds"] = self.node_debounce_seconds
         return d
 
     @classmethod
@@ -1418,4 +1437,6 @@ class ControllerConfig:
             slice_inventory=inventory,
             discover_slice_inventory=bool(
                 d.get("discoverSliceInventory", False)),
+            node_debounce_seconds=_debounce_seconds(
+                d.get("nodeDebounceSeconds", 5.0)),
         )

@@ -288,6 +288,12 @@ class FakeClientset:
             maxlen=self.EVENT_LOG_SIZE)  # guarded-by: lock
         self._evicted_through = 0  # highest RV ever dropped from _events; guarded-by: lock
         self.actions: List[Tuple[str, str, str, str]] = []  # guarded-by: lock
+        # Soak benches disable the audit log: real apiservers keep no
+        # such log, and at 10k-pod scale its per-request tuples are
+        # long-lived small allocations scattered through the churn —
+        # they pin allocator arenas far beyond their own size and read
+        # as RSS growth that no operator code caused.
+        self.record_actions = True
         self.pods = FakeResourceClient("Pod", self)
         self.services = FakeResourceClient("Service", self)
         self.events = FakeResourceClient("Event", self)
@@ -344,8 +350,9 @@ class FakeClientset:
                 q.put(None)
 
     def record(self, verb: str, resource: str, namespace: str, name: str) -> None:
-        with self.lock:
-            self.actions.append((verb, resource, namespace, name))
+        if self.record_actions:
+            with self.lock:
+                self.actions.append((verb, resource, namespace, name))
         if self.metrics is not None:
             self.metrics.inc("api_requests_total",
                              labels={"verb": verb, "resource": resource})

@@ -74,6 +74,13 @@ def build_parser() -> argparse.ArgumentParser:
                         "static map; capacity changes (node added/removed/"
                         "relabeled) update admission and rebalance the "
                         "queue without an operator restart")
+    p.add_argument("--node-debounce-seconds", type=float, default=None,
+                   help="debounce window for DISCOVERED capacity shrinks: "
+                        "a node NotReady→Ready flap inside the window never "
+                        "reaches the fleet scheduler, so admission does not "
+                        "churn on kubelet heartbeat blips; growth always "
+                        "applies immediately (default: 5.0, or the config "
+                        "file's nodeDebounceSeconds; 0 disables)")
     p.add_argument("--resync-period", type=float, default=30.0,
                    help="informer resync/re-list period in seconds")
     p.add_argument("--no-leader-elect", action="store_true",

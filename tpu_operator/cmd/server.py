@@ -88,6 +88,8 @@ def run(opts: Any, clientset: Optional[Any] = None,
         config.slice_inventory = parse_slice_inventory(opts.slice_inventory)
     if getattr(opts, "discover_slice_inventory", False):
         config.discover_slice_inventory = True
+    if getattr(opts, "node_debounce_seconds", None) is not None:
+        config.node_debounce_seconds = max(0.0, opts.node_debounce_seconds)
     tracing.configure(span_buffer=getattr(opts, "trace_buffer",
                                           tracing.DEFAULT_SPAN_BUFFER))
     stop_event = stop_event or threading.Event()

@@ -151,7 +151,7 @@ class FlakyClientset:
     test and opt-in in production via ``--chaos-api-error-rate``."""
 
     RESOURCES = ("pods", "services", "events", "endpoints", "configmaps",
-                 "leases", "tpujobs")
+                 "leases", "tpujobs", "nodes")
 
     def __init__(self, inner: Any, error_rate: float = 0.1,
                  max_latency: float = 0.0,

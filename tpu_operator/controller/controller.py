@@ -244,6 +244,13 @@ class Controller:
         # and trigger a queue rebalance — without an operator restart.
         # Cluster-scoped: namespace "" = the un-namespaced node path.
         self._node_informer = None
+        # Debounce state for discovered-capacity refreshes: the capacity
+        # map last handed to the scheduler, and the pending shrink timer
+        # (a NotReady→Ready flap inside config.node_debounce_seconds must
+        # cancel its own shrink before the scheduler ever sees it).
+        self._inv_lock = lockdep.lock("Controller._inv_lock")
+        self._inv_applied: Optional[Dict[str, int]] = None  # guarded-by: _inv_lock
+        self._inv_timer: Optional[threading.Timer] = None  # guarded-by: _inv_lock
         if getattr(self.config, "discover_slice_inventory", False):
             self._node_informer = self.factory.informer_for("nodes",
                                                             namespace="")
@@ -313,6 +320,12 @@ class Controller:
         for w in workers:
             w.start()
         stop_event.wait()
+        with self._inv_lock:
+            # A debounce timer outliving the controller would apply a
+            # stale shrink into a torn-down scheduler mid-test-teardown.
+            if self._inv_timer is not None:
+                self._inv_timer.cancel()
+                self._inv_timer = None
         self.queue.shutdown()
         for w in workers:
             w.join(timeout=5.0)
@@ -358,12 +371,71 @@ class Controller:
         it into the fleet scheduler (reservations preserved; newly
         fitting gangs admit and their reconciles are woken). O(nodes) per
         node event — idempotent, so the initial sync's per-node add burst
-        just converges on the same model."""
+        just converges on the same model.
+
+        Capacity SHRINKS are debounced (config.node_debounce_seconds): a
+        node whose Ready condition flaps NotReady→Ready inside the window
+        produces zero scheduler calls — without the window every kubelet
+        heartbeat blip would drive a shrink/regrow rebalance pair through
+        FleetScheduler.update_inventory, churning the Queued head at
+        fleet scale. Growth is never delayed: a new node admitting a
+        queued gang applies on this very event."""
         if self._node_informer is None:
             return
         inv = SliceInventory.from_node_objects(
             self._node_informer.store.list())
-        self.scheduler.update_inventory(inv.capacities())
+        new = inv.capacities()
+        debounce = float(getattr(self.config, "node_debounce_seconds", 0.0)
+                         or 0.0)
+        apply_now: Optional[Dict[str, int]] = None
+        with self._inv_lock:
+            applied = self._inv_applied
+            if applied is not None and new == applied:
+                # Converged (the flap healed, or a no-op relabel): any
+                # pending shrink is now stale — drop it unfired.
+                if self._inv_timer is not None:
+                    self._inv_timer.cancel()
+                    self._inv_timer = None
+                return
+            if applied is None or debounce <= 0:
+                merged = dict(new)
+            else:
+                # Growth applies immediately (elementwise max); a key
+                # shrinking or vanishing keeps its old value until the
+                # debounce timer confirms the shrink outlived the window.
+                merged = {k: max(v, applied.get(k, 0))
+                          for k, v in new.items()}
+                for k, v in applied.items():
+                    merged.setdefault(k, v)
+            if merged != new and self._inv_timer is None:
+                timer = threading.Timer(debounce,
+                                        self._flush_node_inventory)
+                timer.daemon = True
+                self._inv_timer = timer
+                timer.start()
+            if merged != applied:
+                self._inv_applied = dict(merged)
+                apply_now = merged
+        if apply_now is not None:
+            # Outside _inv_lock: update_inventory takes the scheduler's
+            # lock and wakes reconciles — never nested under ours.
+            self.scheduler.update_inventory(apply_now)
+
+    def _flush_node_inventory(self) -> None:
+        """Debounce expiry: the shrink survived the window, so apply the
+        capacity model exactly as the live node cache states it now (the
+        cache may have healed further since the timer was armed)."""
+        if self._node_informer is None:
+            return
+        inv = SliceInventory.from_node_objects(
+            self._node_informer.store.list())
+        new = inv.capacities()
+        with self._inv_lock:
+            self._inv_timer = None
+            if new == self._inv_applied:
+                return
+            self._inv_applied = dict(new)
+        self.scheduler.update_inventory(new)
 
     def _worker(self, stop_event: threading.Event,
                 shard: Optional[int] = None) -> None:

@@ -50,6 +50,17 @@ def slice_key(resource: str, topology: str) -> str:
     return f"{resource}:{topology}"
 
 
+def node_ready(node: Dict[str, Any]) -> bool:
+    """Whether a node object is schedulable per its Ready condition.
+    Only an EXPLICIT Ready=False/Unknown excludes the node — absent
+    conditions mean ready, so hand-built node manifests (every test
+    before the fake-kubelet layer existed) keep counting."""
+    for cond in ((node.get("status") or {}).get("conditions") or []):
+        if (cond or {}).get("type") == "Ready":
+            return str(cond.get("status", "True")) == "True"
+    return True
+
+
 def tpu_resource_name(template: Optional[Dict[str, Any]]) -> str:
     """First ``cloud-tpus.google.com/*`` resource name a pod template
     requests ('' when it requests none) — the accelerator half of the
@@ -121,6 +132,12 @@ class SliceInventory:
         for node in nodes:
             md = node.get("metadata") or {}
             labels = md.get("labels") or {}
+            if not node_ready(node):
+                # A NotReady node's slices are not schedulable capacity:
+                # counting them would admit gangs onto dead hardware. A
+                # node with no conditions at all stays ready (back-compat
+                # with static manifests that never carry conditions).
+                continue
             allocatable = ((node.get("status") or {})
                            .get("allocatable") or {})
             resource = next(

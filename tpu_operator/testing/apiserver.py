@@ -230,6 +230,13 @@ class _TrackingHTTPServer(ThreadingHTTPServer):
 
     def get_request(self):
         sock, addr = super().get_request()
+        # The REST client opens one connection per request, so a long soak
+        # accepts tens of thousands of sockets; keep only the live ones or
+        # this list single-handedly dominates harness RSS (kill() only needs
+        # sockets that still have an fd anyway).
+        if len(self.client_socks) >= 512:
+            self.client_socks = [
+                s for s in self.client_socks if s.fileno() != -1]
         self.client_socks.append(sock)
         return sock, addr
 

@@ -1,0 +1,742 @@
+"""Kwok-style fake cluster: node/kubelet state machines over the fake store.
+
+The fleet and churn benches used to carry hand-rolled watch loops that
+unconditionally succeeded every pod — no node identity, no readiness
+latency, no failure (ROADMAP item 5 names the gap). This module is the
+real thing, scaled the way `kwok <https://kwok.sigs.k8s.io>`_ scales it:
+no containers run anywhere, but every pod the REAL operator creates is
+driven through a real kubelet state machine
+
+    Pending → bound to a Node → ContainerCreating (configurable latency)
+    → Running/Ready (+ synthetic heartbeats through the real status
+    server) → Succeeded / Failed
+
+entirely via the backing :class:`~tpu_operator.client.fake.FakeClientset`
+— the same store the in-process apiserver serves — so the operator binary
+(REST clientset, informers, sharded workqueue, fleet scheduler) is
+exercised unmodified at 10k-pod scale on one machine.
+
+Topology: :class:`FakeNode` objects advertise the TPU resource,
+``cloud.google.com/gke-tpu-topology`` and ``tpuoperator.dev/slice-id``
+labels, feeding the PR-8 ``--discover-slice-inventory`` path; each node
+runs a :class:`FakeKubelet` holding its pods' machines. Threading is NOT
+one-per-kubelet (256 nodes must not mean 256 threads): one watch-pump
+thread ingests pod events, one timer thread fires due transitions off a
+heap — both consumers of the backing store, never pollers (a 20 Hz
+``pods.list`` at 10k retained pods deepcopies the world under the fake
+store's global lock and starves the apiserver sharing it).
+
+On top rides :class:`StormController`: a SEEDED chaos composer whose
+entire kill/flap schedule is derived from ``(seed, sorted node and slice
+identities, wave config)`` and never from live pod state or wall-clock —
+so one failing seed replays bit-identically (docs/design.md "Fake
+cluster & storm soak"). It composes the existing chaos surfaces
+(:class:`~tpu_operator.controller.chaos.FlakyClientset` error-rate
+bursts, :class:`~tpu_operator.controller.chaos.ChaosMonkey` pod kills,
+blob fault hooks) with the node-level injectors only this layer can
+express: slice preemption storms, node NotReady/flap windows,
+drain-then-return, slow-kubelet degradation.
+"""
+
+from __future__ import annotations
+
+import heapq
+import logging
+import random
+import threading
+import time
+from typing import Any, Callable, Dict, List, Optional, Tuple
+
+from tpu_operator.apis.tpujob.v1alpha1.types import (
+    LABEL_ATTEMPT,
+    LABEL_JOB_NAME,
+    LABEL_TASK_INDEX,
+)
+from tpu_operator.scheduler.inventory import (
+    NODE_SLICE_ID_LABEL,
+    NODE_TOPOLOGY_LABEL,
+)
+from tpu_operator.util import lockdep
+
+log = logging.getLogger(__name__)
+
+DEFAULT_TPU_RESOURCE = "cloud-tpus.google.com/v4"
+
+
+def ready_condition(ready: bool) -> Dict[str, str]:
+    """The one node condition the discovery path reads."""
+    return {"type": "Ready", "status": "True" if ready else "False"}
+
+
+class KubeletProfile:
+    """Timing knobs of one kubelet's state machine. All-zero is the
+    *instant* profile — a pod goes straight to Succeeded in one status
+    write, byte-identical to the old bench ``kubelet_sim`` closures (the
+    budget benches depend on that single-update behavior)."""
+
+    def __init__(self, create_latency: float = 0.0,
+                 run_seconds: float = 0.0,
+                 heartbeat_interval: float = 0.0):
+        self.create_latency = max(0.0, create_latency)
+        self.run_seconds = max(0.0, run_seconds)
+        # 0 disables beats entirely; > 0 beats once on Running and then
+        # every interval until terminal.
+        self.heartbeat_interval = max(0.0, heartbeat_interval)
+
+    @property
+    def instant(self) -> bool:
+        return (self.create_latency == 0.0 and self.run_seconds == 0.0
+                and self.heartbeat_interval == 0.0)
+
+    def copy(self) -> "KubeletProfile":
+        return KubeletProfile(self.create_latency, self.run_seconds,
+                              self.heartbeat_interval)
+
+
+class FakeNode:
+    """One TPU node's identity: name, slice shape, slice membership."""
+
+    def __init__(self, name: str, resource: str = DEFAULT_TPU_RESOURCE,
+                 topology: str = "2x2x2", slice_id: Optional[str] = None,
+                 chips: int = 4):
+        self.name = name
+        self.resource = resource
+        self.topology = topology
+        # No slice-id label → the discovery path treats the node as its
+        # own single-host slice; normalize here so storm targeting can
+        # always address pods by slice.
+        self.slice_id = slice_id or f"node:{name}"
+        self.chips = chips
+
+    def manifest(self, ready: bool = True) -> Dict[str, Any]:
+        """The node object the discovery informer consumes."""
+        return {
+            "metadata": {
+                "name": self.name,
+                "labels": {
+                    NODE_TOPOLOGY_LABEL: self.topology,
+                    NODE_SLICE_ID_LABEL: self.slice_id,
+                },
+            },
+            "status": {
+                "allocatable": {self.resource: str(self.chips)},
+                "conditions": [ready_condition(ready)],
+            },
+        }
+
+
+def make_nodes(count: int, slices: int, prefix: str = "node",
+               resource: str = DEFAULT_TPU_RESOURCE,
+               topology: str = "2x2x2") -> List[FakeNode]:
+    """``count`` nodes spread round-robin over ``slices`` slice ids."""
+    return [
+        FakeNode(f"{prefix}-{i:04d}", resource=resource, topology=topology,
+                 slice_id=f"{prefix}-slice-{i % max(1, slices):04d}")
+        for i in range(count)
+    ]
+
+
+class _PodSim:
+    """One pod's position in the kubelet state machine. All fields are
+    guarded by the owning cluster's condition (accessed only from
+    ``*_locked`` paths); no lock of its own."""
+
+    __slots__ = ("pod_name", "namespace", "node_name", "state", "container",
+                 "job_name", "task_index", "attempt", "step")
+
+    def __init__(self, pod_name: str, namespace: str, pod: Dict[str, Any]):
+        self.pod_name = pod_name
+        self.namespace = namespace
+        self.node_name: Optional[str] = None
+        self.state = "new"  # new → creating → running → done
+        spec = pod.get("spec") or {}
+        containers = spec.get("containers") or [{}]
+        self.container = str(containers[0].get("name") or "tpu")
+        labels = (pod.get("metadata") or {}).get("labels") or {}
+        self.job_name = str(labels.get(LABEL_JOB_NAME, ""))
+        try:
+            self.task_index = int(labels.get(LABEL_TASK_INDEX, 0))
+        except (TypeError, ValueError):
+            self.task_index = 0
+        try:
+            self.attempt = int(labels.get(LABEL_ATTEMPT, 0))
+        except (TypeError, ValueError):
+            self.attempt = 0
+        self.step = 0
+
+
+class FakeKubelet:
+    """One node's kubelet: holds the node identity, its timing profile
+    and the names of the pods bound to it. Passive — the cluster's pump
+    and timer threads drive every transition, so 256 kubelets cost zero
+    threads. All mutable fields are guarded by the cluster's condition;
+    every method runs with it held (the ``*_locked`` convention)."""
+
+    def __init__(self, node: FakeNode, profile: KubeletProfile):
+        self.node = node
+        self.profile = profile.copy()
+        self.ready = True
+        self.latency_scale = 1.0  # slow-kubelet degradation multiplier
+        self.pod_names: set = set()
+
+    def create_latency_locked(self) -> float:
+        return self.profile.create_latency * self.latency_scale
+
+    def run_seconds_locked(self) -> float:
+        return self.profile.run_seconds * self.latency_scale
+
+    def bind_locked(self, sim: _PodSim) -> None:
+        sim.node_name = self.node.name
+        self.pod_names.add(sim.pod_name)
+
+    def unbind_locked(self, sim: _PodSim) -> None:
+        self.pod_names.discard(sim.pod_name)
+
+
+class FakeCluster:
+    """The assembled fake cluster over one backing FakeClientset.
+
+    Usage::
+
+        cluster = FakeCluster(backing, nodes=make_nodes(8, slices=8),
+                              profile=KubeletProfile(0.05, 0.2, 10.0),
+                              status_server=status)
+        cluster.start()
+        ... create TPUJobs; the real operator's pods run through the
+            node/kubelet machines ...
+        cluster.stop()
+
+    With ``nodes=()`` and the default (instant) profile this is exactly
+    the old bench ``kubelet_sim``: every operator-created pod succeeds in
+    one status write, no binding, no latency.
+    """
+
+    # Timer tags — the per-pod transition each heap entry fires.
+    _BIND, _RUN, _FINISH, _BEAT = "bind", "run", "finish", "beat"
+
+    def __init__(self, backing: Any, namespace: str = "default",
+                 nodes: Tuple[FakeNode, ...] = (),
+                 profile: Optional[KubeletProfile] = None,
+                 status_server: Optional[Any] = None,
+                 register_nodes: bool = True):
+        self._backing = backing
+        self._namespace = namespace
+        self._status_server = status_server
+        self._profile = (profile or KubeletProfile()).copy()
+        self._cond = lockdep.condition("FakeCluster._cond")
+        self._pods: Dict[str, _PodSim] = {}  # guarded-by: _cond
+        self._kubelets: Dict[str, FakeKubelet] = {}  # guarded-by: _cond
+        # (due, seq, pod_name, tag) heap; seq breaks due-time ties so the
+        # heap never compares pod names of equal-due entries unstably.
+        self._timers: List[Tuple[float, int, str, str]] = []  # guarded-by: _cond
+        self._seq = 0  # guarded-by: _cond
+        self._stopped = False  # guarded-by: _cond
+        for node in nodes:
+            self._kubelets[node.name] = FakeKubelet(node, self._profile)
+            if register_nodes:
+                self._backing.nodes.create("", node.manifest())
+        # Register the watch before any thread starts (events queue up),
+        # so no pod created between start() and the first poll is lost.
+        self._watch = backing.pods.watch(namespace)
+        self._pump_thread = threading.Thread(
+            target=self._pump, daemon=True, name="fake-cluster-pump")
+        self._timer_thread = threading.Thread(
+            target=self._timer_loop, daemon=True, name="fake-cluster-timer")
+
+    # -- lifecycle -----------------------------------------------------------
+
+    def start(self) -> "FakeCluster":
+        self._pump_thread.start()
+        self._timer_thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._cond:
+            self._stopped = True
+            self._cond.notify_all()
+        self._watch.stop()
+        self._pump_thread.join(timeout=5.0)
+        self._timer_thread.join(timeout=5.0)
+
+    def __enter__(self) -> "FakeCluster":
+        return self.start()
+
+    def __exit__(self, *exc: Any) -> None:
+        self.stop()
+
+    # -- introspection -------------------------------------------------------
+
+    def node_names(self) -> List[str]:
+        with self._cond:
+            return sorted(self._kubelets)
+
+    def slice_ids(self) -> List[str]:
+        with self._cond:
+            return sorted({k.node.slice_id for k in self._kubelets.values()})
+
+    def tracked_pods(self) -> int:
+        with self._cond:
+            return len(self._pods)
+
+    def get_node(self, node_name: str) -> Optional[FakeNode]:
+        with self._cond:
+            kubelet = self._kubelets.get(node_name)
+            return kubelet.node if kubelet is not None else None
+
+    # -- node-level fault injectors (the StormController's verbs) ------------
+
+    def set_node_ready(self, node_name: str, ready: bool) -> None:
+        """Flip the node's Ready condition through the backing store —
+        the node informer sees a MODIFIED event, exactly like a real
+        kubelet losing/regaining its heartbeat lease."""
+        with self._cond:
+            kubelet = self._kubelets.get(node_name)
+            if kubelet is None:
+                return
+            kubelet.ready = ready
+            manifest = kubelet.node.manifest(ready=ready)
+        try:
+            node = self._backing.nodes.get("", node_name)
+            node["status"] = manifest["status"]
+            self._backing.nodes.update_status("", node)
+        except Exception:  # noqa: BLE001 — raced a drain
+            pass
+
+    def drain_node(self, node_name: str) -> List[str]:
+        """Delete the node object (DELETED watch event → inventory
+        shrink) and preempt every pod bound to it; returns the preempted
+        pod names."""
+        victims = self.preempt_nodes([node_name])
+        with self._cond:
+            self._kubelets.pop(node_name, None)
+        try:
+            self._backing.nodes.delete("", node_name)
+        except Exception:  # noqa: BLE001 — already drained
+            pass
+        return victims
+
+    def return_node(self, node: FakeNode) -> None:
+        """Bring a drained node back (ADDED watch event → inventory grow)."""
+        with self._cond:
+            self._kubelets[node.name] = FakeKubelet(node, self._profile)
+        try:
+            self._backing.nodes.create("", node.manifest())
+        except Exception:  # noqa: BLE001 — never drained
+            pass
+
+    def preempt_slices(self, slice_ids: List[str]) -> List[str]:
+        """Slice preemption storm: every non-terminal pod bound to a node
+        of these slices dies at once with the kubelet-level ``Preempted``
+        reason and no container record — the exact shape
+        trainer/policy.py classifies as a PREEMPTION-kind (not
+        application-kind) restart."""
+        with self._cond:
+            wanted = set(slice_ids)
+            names = [k.node.name for k in self._kubelets.values()
+                     if k.node.slice_id in wanted]
+        return self.preempt_nodes(names)
+
+    def preempt_nodes(self, node_names: List[str]) -> List[str]:
+        with self._cond:
+            wanted = set(node_names)
+            victims = [sim for sim in self._pods.values()
+                       if sim.node_name in wanted and sim.state != "done"]
+            for sim in victims:
+                self._mark_done_locked(sim)
+        return self._preempt(victims)
+
+    def preempt_pods(self, pod_names: List[str]) -> List[str]:
+        """Preempt specific pods by name (tests target one generation
+        deterministically; slice/node storms resolve to this shape)."""
+        with self._cond:
+            wanted = set(pod_names)
+            victims = [sim for sim in self._pods.values()
+                       if sim.pod_name in wanted and sim.state != "done"]
+            for sim in victims:
+                self._mark_done_locked(sim)
+        return self._preempt(victims)
+
+    def _preempt(self, victims: List[_PodSim]) -> List[str]:
+        for sim in victims:
+            self._apply_status(sim, {"phase": "Failed",
+                                     "reason": "Preempted"})
+        return [sim.pod_name for sim in victims]
+
+    def scale_kubelet_latency(self, scale: float) -> None:
+        """Slow-kubelet degradation window: multiply every pending and
+        future create/run latency (1.0 restores)."""
+        with self._cond:
+            for kubelet in self._kubelets.values():
+                kubelet.latency_scale = max(0.0, scale)
+
+    # -- pod state machine ---------------------------------------------------
+
+    def _pump(self) -> None:
+        """Watch-pump thread: ingest pod events into sims + timers. No
+        status writes happen here — the timer thread owns every
+        transition, so one pod's updates are totally ordered."""
+        for event_type, pod in self._watch:
+            md = pod.get("metadata") or {}
+            pod_name = str(md.get("name") or "")
+            if not pod_name:
+                continue
+            if event_type == "DELETED":
+                with self._cond:
+                    sim = self._pods.pop(pod_name, None)
+                    if sim is not None and sim.node_name:
+                        kubelet = self._kubelets.get(sim.node_name)
+                        if kubelet is not None:
+                            kubelet.unbind_locked(sim)
+                continue
+            if event_type not in ("ADDED", "MODIFIED"):
+                continue
+            if (pod.get("status") or {}).get("phase"):
+                continue  # our own echo, or a foreign pre-statused pod
+            with self._cond:
+                if self._stopped or pod_name in self._pods:
+                    continue
+                sim = _PodSim(pod_name, str(md.get("namespace")
+                                            or self._namespace), pod)
+                self._pods[pod_name] = sim
+                self._schedule_locked(0.0, pod_name, self._BIND)
+                self._cond.notify_all()
+
+    def _schedule_locked(self, delay: float, pod_name: str, tag: str) -> None:
+        self._seq += 1
+        heapq.heappush(self._timers,
+                       (time.monotonic() + delay, self._seq, pod_name, tag))
+
+    def _timer_loop(self) -> None:
+        """Timer thread: pop due transitions under the condition, fire
+        them outside it (every fire writes the backing store / status
+        server — never under the lock)."""
+        while True:
+            due: List[Tuple[str, str]] = []
+            with self._cond:
+                if self._stopped:
+                    return
+                now = time.monotonic()
+                while self._timers and self._timers[0][0] <= now:
+                    _due, _seq, pod_name, tag = heapq.heappop(self._timers)
+                    due.append((pod_name, tag))
+                if not due:
+                    timeout = (self._timers[0][0] - now
+                               if self._timers else 0.5)
+                    self._cond.wait(timeout=min(0.5, max(0.001, timeout)))
+                    continue
+            for pod_name, tag in due:
+                self._fire(pod_name, tag)
+
+    def _fire(self, pod_name: str, tag: str) -> None:
+        status: Optional[Dict[str, Any]] = None
+        beat: Optional[Dict[str, Any]] = None
+        with self._cond:
+            if self._stopped:
+                return
+            sim = self._pods.get(pod_name)
+            if sim is None or sim.state == "done":
+                return  # deleted or preempted since scheduling
+            if tag == self._BIND:
+                status = self._bind_locked(sim)
+            elif tag == self._RUN:
+                sim.state = "running"
+                status = {
+                    "phase": "Running",
+                    "conditions": [{"type": "Ready", "status": "True"}],
+                    "containerStatuses": [{
+                        "name": sim.container, "ready": True,
+                        "state": {"running": {}}}],
+                }
+                self._schedule_locked(self._run_seconds_locked(sim),
+                                      pod_name, self._FINISH)
+                if self._beat_enabled_locked():
+                    beat = self._beat_body_locked(sim)
+                    self._schedule_locked(self._profile.heartbeat_interval,
+                                          pod_name, self._BEAT)
+            elif tag == self._BEAT:
+                if sim.state == "running" and self._beat_enabled_locked():
+                    beat = self._beat_body_locked(sim)
+                    self._schedule_locked(self._profile.heartbeat_interval,
+                                          pod_name, self._BEAT)
+            elif tag == self._FINISH:
+                self._mark_done_locked(sim)
+                status = {
+                    "phase": "Succeeded",
+                    "containerStatuses": [{
+                        "name": sim.container,
+                        "state": {"terminated": {"exitCode": 0}}}],
+                }
+        if status is not None:
+            self._apply_status(sim, status)
+        if beat is not None and self._status_server is not None:
+            try:
+                # Rejections are legitimate (the job may already be
+                # deleted); the real payload tolerates them the same way.
+                self._status_server.record_heartbeat(beat)
+            except Exception:  # noqa: BLE001 — raced a server stop
+                pass
+
+    def _bind_locked(self, sim: _PodSim) -> Optional[Dict[str, Any]]:
+        """Bind to a ready node (or no node when the cluster models
+        none) and enter ContainerCreating; instant profile jumps straight
+        to the terminal single-write the budget benches expect."""
+        if self._kubelets:
+            ready = [self._kubelets[n] for n in sorted(self._kubelets)
+                     if self._kubelets[n].ready]
+            if not ready:
+                # No schedulable node right now: stay Pending, retry —
+                # exactly a scheduler waiting out a NotReady window.
+                self._schedule_locked(0.2, sim.pod_name, self._BIND)
+                return None
+            kubelet = ready[self._seq % len(ready)]
+            kubelet.bind_locked(sim)
+            create_latency = kubelet.create_latency_locked()
+        else:
+            create_latency = self._profile.create_latency
+        if self._profile.instant:
+            self._mark_done_locked(sim)
+            return {
+                "phase": "Succeeded",
+                "containerStatuses": [{
+                    "name": sim.container,
+                    "state": {"terminated": {"exitCode": 0}}}],
+            }
+        sim.state = "creating"
+        self._schedule_locked(create_latency, sim.pod_name, self._RUN)
+        return {
+            "phase": "Pending",
+            "conditions": [{"type": "PodScheduled", "status": "True"}],
+            "containerStatuses": [{
+                "name": sim.container, "ready": False,
+                "state": {"waiting": {"reason": "ContainerCreating"}}}],
+        }
+
+    def _run_seconds_locked(self, sim: _PodSim) -> float:
+        kubelet = self._kubelets.get(sim.node_name or "")
+        if kubelet is not None:
+            return kubelet.run_seconds_locked()
+        return self._profile.run_seconds
+
+    def _beat_enabled_locked(self) -> bool:
+        return (self._profile.heartbeat_interval > 0
+                and self._status_server is not None)
+
+    def _beat_body_locked(self, sim: _PodSim) -> Dict[str, Any]:
+        sim.step += 1
+        return {
+            "namespace": sim.namespace, "name": sim.job_name,
+            "processId": sim.task_index, "attempt": sim.attempt,
+            "step": sim.step, "stepTimeSeconds": 0.1, "loss": 1.0,
+            "lastCheckpointStep": max(0, sim.step - 1),
+        }
+
+    def _mark_done_locked(self, sim: _PodSim) -> None:
+        sim.state = "done"
+        if sim.node_name:
+            kubelet = self._kubelets.get(sim.node_name)
+            if kubelet is not None:
+                kubelet.unbind_locked(sim)
+
+    def _apply_status(self, sim: _PodSim, status: Dict[str, Any]) -> None:
+        """One pod status write through the backing store, kubelet-style:
+        read-modify-write so spec.nodeName binding and status land
+        together. Retries a 409 (another writer slipped between read and
+        write); losing the pod to a teardown is normal and final."""
+        for _ in range(3):
+            try:
+                pod = self._backing.pods.get(sim.namespace, sim.pod_name)
+                if sim.node_name:
+                    pod.setdefault("spec", {})["nodeName"] = sim.node_name
+                pod["status"] = status
+                self._backing.pods.update(sim.namespace, pod)
+                return
+            except Exception as e:  # noqa: BLE001 — raced a teardown
+                if getattr(e, "code", None) == 409:
+                    continue
+                return
+
+
+# --- seeded storms ------------------------------------------------------------
+
+class StormEvent:
+    """One scheduled injection. ``at`` is seconds from storm start."""
+
+    __slots__ = ("at", "kind", "params")
+
+    def __init__(self, at: float, kind: str, params: Dict[str, Any]):
+        self.at = at
+        self.kind = kind
+        self.params = params
+
+    def __repr__(self) -> str:
+        inner = ", ".join(f"{k}={self.params[k]!r}"
+                          for k in sorted(self.params))
+        return f"StormEvent(at={self.at:.3f}, kind={self.kind!r}, {inner})"
+
+
+class StormController:
+    """Seeded chaos composer over a :class:`FakeCluster`.
+
+    The ENTIRE schedule — which slices a preemption wave hits, which
+    nodes flap, when each fault window opens and closes — is computed in
+    :meth:`plan` from ``seed`` + the cluster's *sorted* node/slice
+    identities + the wave list, and never from live pod state or
+    wall-clock. Same seed, same cluster shape → bit-identical schedule
+    (asserted by tests/test_fake_cluster.py), which is what makes a
+    failing soak seed reproducible from its printed number alone.
+
+    Wave kinds (the storm catalog; docs/design.md):
+
+    - ``preempt``  — kill every pod on ``count`` seeded-chosen slices,
+      swept ``sweeps`` times ``interval`` apart (a preemption window)
+    - ``flap``     — ``count`` nodes NotReady for ``down_seconds``, then
+      Ready again (inside the inventory debounce window = absorbed)
+    - ``drain``    — delete a node, return it after ``down_seconds``
+    - ``api_fault``— raise the FlakyClientset's error rate to ``rate``
+      for ``seconds``
+    - ``slow_kubelet`` — multiply kubelet latencies by ``scale`` for
+      ``seconds``
+    - ``pod_kill`` — one ChaosMonkey ``kill_once`` sweep
+    - ``blob_fault`` — call ``blob_arm()`` / ``blob_disarm()`` around a
+      ``seconds`` window (the store-layer fault hook surface)
+    """
+
+    def __init__(self, cluster: FakeCluster, seed: int,
+                 waves: Tuple[Tuple[float, str, Dict[str, Any]], ...],
+                 flaky: Optional[Any] = None,
+                 monkey: Optional[Any] = None,
+                 blob_arm: Optional[Callable[[], None]] = None,
+                 blob_disarm: Optional[Callable[[], None]] = None):
+        self.cluster = cluster
+        self.seed = seed
+        self.waves = tuple(waves)
+        self.flaky = flaky
+        self.monkey = monkey
+        self.blob_arm = blob_arm
+        self.blob_disarm = blob_disarm
+        # Identity snapshot at construction: the plan must not drift if
+        # a drain wave later removes a node.
+        self._node_names = tuple(cluster.node_names())
+        self._slice_ids = tuple(cluster.slice_ids())
+        self._drained: Dict[str, FakeNode] = {}
+        self.window: Optional[Tuple[float, float]] = None
+        # Realized disruption tally (pods preempted/killed/drained) —
+        # what the soak gate checks to prove the storm actually landed
+        # (scheduler counters only see *eviction* preemptions, not these
+        # kubelet-level deaths).
+        self.stats: Dict[str, int] = {"preempted_pods": 0,
+                                      "killed_pods": 0,
+                                      "drained_pods": 0}
+
+    def plan(self) -> List[StormEvent]:
+        """The full deterministic schedule, paired end events included."""
+        rng = random.Random(self.seed)
+        events: List[StormEvent] = []
+        for at, kind, params in self.waves:
+            if kind == "preempt":
+                count = min(int(params.get("count", 1)),
+                            len(self._slice_ids))
+                targets = sorted(rng.sample(self._slice_ids, count)) \
+                    if count else []
+                # A real preemption takes the slice down for a WINDOW,
+                # not an instant: sweep the same seeded targets several
+                # times so pods created mid-wave die too (and so a storm
+                # can't whiff on a fleet of short-lived pods).
+                sweeps = max(1, int(params.get("sweeps", 1)))
+                interval = float(params.get("interval", 0.5))
+                for s in range(sweeps):
+                    events.append(StormEvent(at + s * interval, "preempt",
+                                             {"slice_ids": targets}))
+            elif kind == "flap":
+                count = min(int(params.get("count", 1)),
+                            len(self._node_names))
+                down = float(params.get("down_seconds", 0.5))
+                targets = sorted(rng.sample(self._node_names, count)) \
+                    if count else []
+                events.append(StormEvent(at, "flap_down",
+                                         {"nodes": targets}))
+                events.append(StormEvent(at + down, "flap_up",
+                                         {"nodes": targets}))
+            elif kind == "drain":
+                if not self._node_names:
+                    continue
+                target = rng.choice(sorted(self._node_names))
+                down = float(params.get("down_seconds", 1.0))
+                events.append(StormEvent(at, "drain", {"node": target}))
+                events.append(StormEvent(at + down, "return",
+                                         {"node": target}))
+            elif kind == "api_fault":
+                rate = float(params.get("rate", 0.1))
+                seconds = float(params.get("seconds", 2.0))
+                events.append(StormEvent(at, "api_fault_on",
+                                         {"rate": rate}))
+                events.append(StormEvent(at + seconds, "api_fault_off", {}))
+            elif kind == "slow_kubelet":
+                scale = float(params.get("scale", 4.0))
+                seconds = float(params.get("seconds", 2.0))
+                events.append(StormEvent(at, "slow_on", {"scale": scale}))
+                events.append(StormEvent(at + seconds, "slow_off", {}))
+            elif kind == "pod_kill":
+                events.append(StormEvent(at, "pod_kill", {}))
+            elif kind == "blob_fault":
+                seconds = float(params.get("seconds", 2.0))
+                events.append(StormEvent(at, "blob_on", {}))
+                events.append(StormEvent(at + seconds, "blob_off", {}))
+            else:
+                raise ValueError(f"unknown storm kind {kind!r}")
+        events.sort(key=lambda e: (e.at, e.kind))
+        return events
+
+    def run(self, stop_event: Optional[threading.Event] = None) -> None:
+        """Play the plan against the live cluster. Blocking — benches run
+        it in a thread. Records the realized (start, end) monotonic
+        window in ``self.window`` for during-storm assertions."""
+        events = self.plan()
+        t0 = time.monotonic()
+        for event in events:
+            delay = t0 + event.at - time.monotonic()
+            if delay > 0:
+                if stop_event is not None:
+                    if stop_event.wait(delay):
+                        break
+                else:
+                    time.sleep(delay)
+            self._apply(event)
+        self.window = (t0, time.monotonic())
+
+    def _apply(self, event: StormEvent) -> None:
+        log.info("storm: %r", event)
+        kind, p = event.kind, event.params
+        if kind == "preempt":
+            self.stats["preempted_pods"] += len(
+                self.cluster.preempt_slices(p["slice_ids"]))
+        elif kind == "flap_down":
+            for node in p["nodes"]:
+                self.cluster.set_node_ready(node, False)
+        elif kind == "flap_up":
+            for node in p["nodes"]:
+                self.cluster.set_node_ready(node, True)
+        elif kind == "drain":
+            node = self.cluster.get_node(p["node"])
+            if node is not None:
+                self._drained[p["node"]] = node
+                self.stats["drained_pods"] += len(
+                    self.cluster.drain_node(p["node"]))
+        elif kind == "return":
+            node = self._drained.pop(p["node"], None)
+            if node is not None:
+                self.cluster.return_node(node)
+        elif kind == "api_fault_on" and self.flaky is not None:
+            self.flaky.error_rate = p["rate"]
+        elif kind == "api_fault_off" and self.flaky is not None:
+            self.flaky.error_rate = 0.0
+        elif kind == "slow_on":
+            self.cluster.scale_kubelet_latency(p["scale"])
+        elif kind == "slow_off":
+            self.cluster.scale_kubelet_latency(1.0)
+        elif kind == "pod_kill" and self.monkey is not None:
+            self.stats["killed_pods"] += self.monkey.kill_once()
+        elif kind == "blob_on" and self.blob_arm is not None:
+            self.blob_arm()
+        elif kind == "blob_off" and self.blob_disarm is not None:
+            self.blob_disarm()
