@@ -143,6 +143,18 @@ def parse_args(argv=None):
                         "under sustained load — exits nonzero if any "
                         "decode step fails or the reload does not "
                         "complete")
+    p.add_argument("--flagship", action="store_true",
+                   help="run ONLY the flagship compute-path A/B rows "
+                        "(CPU-hostable with --quick): each optimization "
+                        "of the shared compute surface (remat policy, "
+                        "fused loss, adam8, scan-over-blocks, AOT via the "
+                        "warm cache) measured INDIVIDUALLY against the "
+                        "seed path in interleaved windows with the "
+                        "min-of-pairwise-delta discipline, plus one arm "
+                        "with autotune + host pipeline + async host "
+                        "engaged whose steptrace digest names the "
+                        "dominant residue phase; exits nonzero if any "
+                        "optimization regresses past its budget")
     p.add_argument("--startup-worker", default="", help=argparse.SUPPRESS)
     p.add_argument("--batch", type=int, default=0, help="override global batch")
     p.add_argument("--steps", type=int, default=0, help="override timed steps")
@@ -232,6 +244,20 @@ def bench_cifar(quick: bool, batch_override: int = 0,
               for b in itertools.islice(batches, 8)]
     cycled = itertools.cycle(pregen)
 
+    # AOT-compile through the warm persistent cache BEFORE any timed
+    # window (ROADMAP 1c), and report the compile as an out-of-window
+    # field instead of letting first-window warmup absorb it. A stable
+    # default cache dir makes the second bench invocation a warm
+    # deserialize unless the operator injected its own cache volume.
+    from tpu_operator.payload import compute
+
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/tpujob-bench-xla-cache")
+    compiled, compile_seconds, cache_hit = compute.aot_compile_cached(
+        step, state, pregen[0])
+    if compiled is not None:
+        step = compiled
+
     # Median of three timed windows (compile cost is paid once, before
     # the first window; each window still runs its own 5 warmup steps):
     # the tunnel adds a few percent of run-to-run jitter a single
@@ -251,7 +277,263 @@ def bench_cifar(quick: bool, batch_override: int = 0,
         "value": round(per_chip, 2),
         "unit": "images/sec/chip",
         "vs_baseline": round(per_chip / BASELINE_IMAGES_PER_SEC, 3),
+        "compile_seconds": round(compile_seconds, 3),
+        "compile_cache_hit": cache_hit,
     }
+
+
+# --- flagship compute-path A/B rows --------------------------------------------
+
+# Each optimization of the shared compute surface (payload/compute.py),
+# measured INDIVIDUALLY against the seed path: (key, off-arm extra argv,
+# on-arm extra argv, gate kind, (quick budget, full budget), floor µs).
+# "step" gates the min-of-pairwise-delta steady-state REGRESSION of the
+# on arm (budget in %) — the memory-for-compute trades (remat, int8
+# moments) are allowed to cost steady-state time on a platform with no
+# memory pressure (quick = CPU host, tiny shapes), the parity-expected
+# ones (fused loss, AOT dispatch) are not. "compile" gates the on arm's
+# build+first-step seconds against the off arm's (budget = max ratio %):
+# scan-over-blocks' claim is compile time that stops scaling with depth,
+# not steady-state speed (its While body costs loop overhead the seed
+# path's inlined blocks don't pay — the documented trade), so its row
+# runs at a DEEPER depth where the claim is testable and its steady-state
+# regression is reported unbudgeted. adam8's off arm is plain adam — the
+# honest 8-bit-vs-f32 comparison within the same optimizer family;
+# everything else A/Bs against the unmodified seed argv.
+FLAGSHIP_AB = (
+    ("remat_dots", [], ["--remat-policy", "dots"], "step",
+     (60.0, 60.0), 500.0),
+    ("fused_loss", [], ["--fused-loss"], "step", (10.0, 5.0), 300.0),
+    ("adam8", ["--optimizer", "adam"], ["--optimizer", "adam8"], "step",
+     (60.0, 15.0), 500.0),
+    ("scan_blocks", [], ["--scan-blocks"], "compile", (120.0, 125.0), 0.0),
+)
+
+# steptrace wire field -> the phase name the ISSUE-facing row reports.
+_PHASE_NAMES = {"dataWait": "DATA", "dispatch": "DISPATCH",
+                "compute": "COMPUTE", "checkpoint": "CHECKPOINT",
+                "host": "HOST"}
+
+
+def bench_flagship(quick: bool) -> list:
+    """The --flagship gate: the shared compute path's optimizations, each
+    A/B-measured individually against the seed flagship path, plus the
+    autotune-engaged residue-attribution arm (ROADMAP item 1a).
+
+    Discipline is PR 9's (bench_steptrace): both arms run the same loop
+    shape over pre-staged HBM batches, in INTERLEAVED windows so clock
+    drift and host contention land on both arms equally; the headline
+    regression is the MINIMUM of the pairwise (on - off) deltas, clamped
+    at zero — a real systematic cost is present in every pair, a
+    contention burst is absent from at least one. Each arm owns its
+    state (the step donates it; adam8/scan change the state tree).
+
+    The final row runs the optimized path through the REAL train_loop
+    with the self-tuning data plane engaged (TPUJOB_DATAPLANE_AUTOTUNE,
+    host pipeline + async host live, a heartbeat reporter attached) and
+    the PR-9 step recorder on, then attributes the residual step time to
+    the dominant phase by the recorder's p50 digest — COMPUTE dominating
+    is the honest "the remaining gap is compute-bound" answer; anything
+    else names the subsystem to go after next."""
+    import jax
+
+    from tpu_operator.payload import cifar, compute
+    from tpu_operator.payload import data as data_mod
+
+    if quick:
+        batch, steps, windows = 32, 30, 5
+        cfg = ["--blocks", "2", "--widths", "8", "8", "8"]
+    else:
+        batch, steps, windows = 1024, 20, 5
+        cfg = ["--blocks", "3", "--widths", "16", "32", "64"]
+    base_argv = ["--batch", str(batch), *cfg]
+
+    def build_arm(extra):
+        cargs = cifar.parse_args(base_argv + list(extra))
+        t0 = time.perf_counter()
+        mesh, _model, state, step_fn, batches = cifar.build(cargs)
+        pregen = [data_mod.put_global_batch(mesh, *b)
+                  for b in itertools.islice(batches, 4)]
+        arm = {"state": state, "step": step_fn,
+               "cycled": itertools.cycle(pregen), "mesh": mesh}
+        # First fenced step = trace + compile; timed per arm so the
+        # compile-gated rows (scan_blocks) have their number, and always
+        # outside every timed window.
+        arm["state"], metrics = arm["step"](arm["state"],
+                                            *next(arm["cycled"]))
+        jax.device_get(metrics["loss"])
+        arm["compile_seconds"] = time.perf_counter() - t0
+        for _ in range(2):
+            arm["state"], metrics = arm["step"](arm["state"],
+                                                *next(arm["cycled"]))
+        jax.device_get(metrics["loss"])
+        return arm
+
+    def run_window(arm, n_steps) -> float:
+        t0 = time.perf_counter()
+        metrics = None
+        for _ in range(n_steps):
+            arm["state"], metrics = arm["step"](arm["state"],
+                                                *next(arm["cycled"]))
+        jax.device_get(metrics["loss"])
+        return (time.perf_counter() - t0) / n_steps
+
+    def ab_row(key, off_arm, on_arm, gate, budget, floor_us, extra=None):
+        # Compile-gated rows keep their (unbudgeted, informational)
+        # steady-state measurement short — their deep config makes full
+        # windows cost minutes for a number nothing gates on.
+        n_windows, n_steps = (2, 10) if gate == "compile" else (windows,
+                                                               steps)
+        off_times, on_times = [], []
+        for _ in range(n_windows):
+            off_times.append(run_window(off_arm, n_steps))
+            on_times.append(run_window(on_arm, n_steps))
+        off = min(off_times)
+        deltas = [on_t - off_t for off_t, on_t in zip(off_times, on_times)]
+        regression = max(0.0, min(deltas))
+        speedup = max(0.0, min(off_t - on_t for off_t, on_t
+                               in zip(off_times, on_times)))
+        row = {
+            "metric": f"flagship_ab_{key}",
+            "off_step_ms": round(off * 1e3, 4),
+            "on_step_ms": round((off + regression - speedup) * 1e3, 4),
+            "regression_pct": round(100.0 * regression / off, 2),
+            "speedup_pct": round(100.0 * speedup / off, 2),
+            "regression_us_per_step": round(regression * 1e6, 2),
+            "compile_off_s": round(off_arm["compile_seconds"], 3),
+            "compile_on_s": round(on_arm["compile_seconds"], 3),
+            "windows": n_windows,
+            "gate": gate,
+            "budget": budget,
+            "floor_us": floor_us,
+            "unit": "pct",
+            "value": round(100.0 * regression / off, 2),
+        }
+        row.update(extra or {})
+        return row
+
+    rows = []
+    for key, off_extra, on_extra, gate, budgets, floor_us in FLAGSHIP_AB:
+        # The compile-gated row runs DEEP (quick: blocks 6): the claim
+        # under test is that scan's compile cost stops scaling with
+        # depth, which two blocks per stage cannot distinguish (later
+        # --blocks wins in argparse).
+        depth = (["--blocks", "6"] if gate == "compile" and quick else [])
+        off_arm = build_arm(depth + list(off_extra))
+        on_arm = build_arm(depth + list(on_extra))
+        rows.append(ab_row(
+            key, off_arm, on_arm, gate, budgets[0 if quick else 1],
+            floor_us))
+
+    # AOT dispatch: the SAME seed program, jit-dispatched vs invoked as
+    # the AOT executable compiled through the persistent cache — a
+    # steady-state parity check (AOT's win is trace-time at step 0, paid
+    # out-of-window here and reported alongside).
+    off_arm = build_arm([])
+    on_arm = build_arm([])
+    # Same stable default cache dir as bench_cifar: the second invocation
+    # (and every verify run after the first) exercises the WARM
+    # persistent-cache deserialize path and reports the hit.
+    os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                          "/tmp/tpujob-bench-xla-cache")
+    compiled, compile_seconds, cache_hit = compute.aot_compile_cached(
+        on_arm["step"], on_arm["state"], next(on_arm["cycled"]))
+    if compiled is not None:
+        on_arm["step"] = compiled
+    rows.append(ab_row(
+        "aot", off_arm, on_arm, "step", 10.0, 300.0,
+        extra={"aot_compile_seconds": round(compile_seconds, 3),
+               "compile_cache_hit": cache_hit}))
+
+    # -- the autotune-engaged residue-attribution arm -------------------------
+    from tpu_operator.payload import autotune as autotune_mod
+    from tpu_operator.payload import heartbeat as heartbeat_mod
+    from tpu_operator.payload import steptrace as steptrace_mod
+    from tpu_operator.payload import train
+
+    residue_steps = 120 if quick else 200
+    cargs = cifar.parse_args(base_argv + ["--fused-loss", "--log-every", "0"])
+    mesh, _model, state, step_fn, batches = cifar.build(cargs)
+    recorder = steptrace_mod.StepRecorder(capacity=4096)
+    # A real reporter (no-op poster, never due mid-run: a due beat drains
+    # the recorder's window digest, and this row wants the WHOLE run's
+    # phase distribution) so the runtime's async-host hook is live.
+    reporter = heartbeat_mod.HeartbeatReporter(
+        "http://bench.invalid", "flagship", poster=lambda *_a: None,
+        interval=3600.0)
+    engaged = {autotune_mod.ENV_AUTOTUNE: "1",
+               autotune_mod.ENV_WINDOW_STEPS: "16"}
+    saved = {k: os.environ.get(k) for k in engaged}
+    os.environ.update(engaged)
+    try:
+        state, _metrics = train.train_loop(
+            mesh, step_fn, state, batches, residue_steps,
+            heartbeat=reporter, steptrace=recorder, overlap=False)
+    finally:
+        for k, v in saved.items():
+            if v is None:
+                os.environ.pop(k, None)
+            else:
+                os.environ[k] = v
+    summary = recorder.summary()
+    phases = summary["phases"] if summary else {}
+    p50s = {name: phases[field]["p50Seconds"]
+            for field, name in _PHASE_NAMES.items() if field in phases}
+    residue_phase = max(p50s, key=p50s.get) if p50s else ""
+    step_p50 = summary["stepP50Seconds"] if summary else 0.0
+    rows.append({
+        "metric": "flagship_residue_attribution",
+        "engaged": ["autotune", "host_pipeline", "async_host"],
+        # On the CPU backend jit dispatch is synchronous, so device
+        # compute lands in the DISPATCH lap and COMPUTE (the deferred
+        # fence) reads near zero — DISPATCH here is the CPU stand-in for
+        # compute-bound. On a real TPU the dispatch lap is µs-scale and
+        # COMPUTE carries the device time.
+        "platform": jax.devices()[0].platform,
+        "steps": summary["steps"] if summary else 0,
+        "step_p50_ms": round(step_p50 * 1e3, 4),
+        "images_per_sec": round(batch / step_p50, 1) if step_p50 else 0.0,
+        "residue_phase": residue_phase,
+        "phase_p50_ms": {name: round(t * 1e3, 4)
+                         for name, t in sorted(p50s.items())},
+        "phase_share_pct": {name: round(100.0 * t / max(step_p50, 1e-12), 1)
+                            for name, t in sorted(p50s.items())},
+        "unit": "phase",
+        "value": residue_phase,
+    })
+    return rows
+
+
+def _flagship_ok(rows: list) -> bool:
+    ok = True
+    for row in rows:
+        if row["metric"] == "flagship_residue_attribution":
+            if row["residue_phase"] not in _PHASE_NAMES.values():
+                print(f"flagship residue attribution MISSING: {row}",
+                      file=sys.stderr)
+                ok = False
+            continue
+        if row["gate"] == "compile":
+            ratio = 100.0 * row["compile_on_s"] / max(row["compile_off_s"],
+                                                      1e-9)
+            if ratio <= row["budget"]:
+                continue
+            print(f"flagship compile budget EXCEEDED: {row['metric']} "
+                  f"on-arm build+compile {row['compile_on_s']} s vs off "
+                  f"{row['compile_off_s']} s ({ratio:.0f}% > "
+                  f"{row['budget']}%)", file=sys.stderr)
+            ok = False
+            continue
+        over_pct = row["regression_pct"]
+        over_us = row["regression_us_per_step"]
+        if over_pct <= row["budget"] or over_us <= row["floor_us"]:
+            continue
+        print(f"flagship A/B budget EXCEEDED: {row['metric']} on-arm "
+              f"{row['on_step_ms']} ms vs off {row['off_step_ms']} ms "
+              f"({over_pct:.2f}% > {row['budget']}% and "
+              f"{over_us:.1f} µs > {row['floor_us']} µs)", file=sys.stderr)
+        ok = False
+    return ok
 
 
 # --- LM ladder / flagship MFU --------------------------------------------------
@@ -3105,6 +3387,14 @@ def main(argv=None) -> int:
         os.environ["JAX_PLATFORMS"] = "cpu"
         rows = [_emit(row) for row in bench_serve(args.quick)]
         return 0 if _serve_ok(rows) else 1
+    if args.flagship:
+        # A/B budgets are relative and both arms share every platform
+        # artifact, so the rows are CPU-hostable; --quick pins CPU like
+        # the headline (non-quick measures whatever platform is up).
+        if args.quick:
+            os.environ["JAX_PLATFORMS"] = "cpu"
+        rows = [_emit(row) for row in bench_flagship(args.quick)]
+        return 0 if _flagship_ok(rows) else 1
     if args.quick:
         # Force CPU even when a TPU plugin pinned the platform at boot
         # (backend clients initialize lazily, so this override wins).

@@ -116,6 +116,19 @@ python -m pytest tests/test_autotune.py -x -q
 # path must shave measured HOST-phase time, and recorder+autotune must
 # hold the 1% overhead budget — exits nonzero on regression.
 python bench.py --dataplane --quick
+# Standalone flagship compute-path gate: the shared option surface
+# (payload/compute.py — remat policy, sgd/adam/adam8, fused loss,
+# scan-over-blocks, AOT through the persistent cache), numerics parity
+# between the seed and optimized paths at a fixed seed, option
+# round-trips for the classifier AND the LM parsers, and checkpoint
+# resume ACROSS the path flip through the PR-4 verified walk.
+python -m pytest tests/test_flagship_compute.py -x -q
+# And its measured form: each option A/B'd individually against the
+# seed path in interleaved windows (min-of-pairwise-delta, PR-9
+# discipline) with per-option regression budgets, plus the
+# autotune-engaged residue row attributing the remaining gap to a
+# named phase — exits nonzero when an option regresses past budget.
+python bench.py --flagship --quick
 # Standalone serving-mode gate: spec.mode serve end to end — the
 # mode/serving spec wiring, readiness-gated per-replica Services (no
 # endpoints before the ready beat; removed and restored around a
@@ -196,6 +209,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_autotune.py \
   --ignore=tests/test_elastic.py \
   --ignore=tests/test_serving.py \
+  --ignore=tests/test_flagship_compute.py \
   --ignore=tests/test_lockdep.py \
   --ignore=tests/test_lifecycle.py \
   --ignore=tests/test_schedules.py \

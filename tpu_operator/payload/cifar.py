@@ -47,9 +47,14 @@ def parse_args(argv=None):
     p.add_argument("--profile-dir",
                    default=os.environ.get("TPU_PROFILE_DIR", ""),
                    help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
-    from tpu_operator.payload import autotune
+    from tpu_operator.payload import autotune, compute
 
     autotune.add_prefetch_argument(p)
+    # The shared compute lineage (payload/compute.py): --remat-policy,
+    # --optimizer sgd|adam|adam8, --fused-loss, --scan-blocks, --aot.
+    # Defaults reproduce the seed path (sgd + momentum, plain loss, no
+    # remat); bench.py --flagship A/B-gates each option individually.
+    compute.add_classifier_compute_flags(p)
     return p.parse_args(argv)
 
 
@@ -57,21 +62,24 @@ def build(args, mesh=None, num_slices: int = 1):
     """(mesh, model, state, train_step, batches) for the given config."""
     import jax
     import jax.numpy as jnp
-    import optax
 
+    from tpu_operator.payload import compute
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import models, train
 
     mesh = mesh or train.make_mesh(model_parallel=args.model_parallel,
                                    num_slices=num_slices)
     model = models.CifarResNet(blocks_per_stage=args.blocks,
-                               widths=tuple(args.widths))
-    tx = optax.sgd(args.lr, momentum=args.momentum)
+                               widths=tuple(args.widths),
+                               scan_blocks=getattr(args, "scan_blocks", False))
+    tx = compute.make_optimizer(args, default="sgd")
     sample = jnp.zeros((args.batch, *data_mod.CIFAR_SHAPE), jnp.float32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
     shardings = train.state_shardings(mesh, state)
     state = train.place_state(mesh, state, shardings)
-    step = train.make_classifier_train_step(model, tx, mesh, state, shardings)
+    step = train.make_classifier_train_step(
+        model, tx, mesh, state, shardings,
+        **compute.classifier_step_options(args))
     if getattr(args, "data", ""):
         batches = data_mod.npz_classification(
             args.data, args.seed, args.batch,

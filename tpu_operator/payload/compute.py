@@ -1,0 +1,179 @@
+"""Shared compute-path option surface for every payload.
+
+Seventeen PRs of control-plane work left the headline payload on its seed
+compute path while the LM payloads grew remat policies, the int8
+block-quantized adam8 optimizer, fused losses, and AOT compilation through
+the persistent cache. This module is the one place those options live, so
+the flagship classifier (cifar.py), the smallest payload (linear.py), and
+the LM family (transformer/moe/pipeline) opt into the SAME lineage through
+the SAME flags:
+
+===============  ========================  ===============================
+option           flag                      payloads
+===============  ========================  ===============================
+remat policy     ``--remat-policy``        all (LMs gate on ``--remat``;
+                                           classifier: != full engages
+                                           step-level ``jax.checkpoint``)
+optimizer        ``--optimizer``           all (LMs: adam/adam8;
+                                           classifier adds sgd, the seed
+                                           default)
+fused loss       ``--fused-loss``          classifier (LM loss is already
+                                           the fused lse-tgt form)
+scan blocks      ``--scan-blocks``         classifier (one compiled block
+                                           body per stage)
+AOT via cache    ``--aot`` /               all run paths AOT through the
+                 :func:`aot_compile_cached` overlapped prologue already;
+                                           this surface adds it to direct
+                                           step users (bench, tests)
+===============  ========================  ===============================
+
+Every default reproduces the seed path exactly — an unconfigured payload
+trains the same program it always has. bench.py ``--flagship`` A/B-gates
+each option individually against that seed path.
+
+Import discipline: module import stays stdlib-only (the payload entry
+modules import this at parse time, before bootstrap pins the platform);
+jax/flax/optax load lazily inside functions.
+"""
+
+from __future__ import annotations
+
+import logging
+import os
+import time
+from typing import Any, Optional, Tuple
+
+log = logging.getLogger(__name__)
+
+CLASSIFIER_OPTIMIZERS = ("sgd", "adam", "adam8")
+
+
+def add_lm_compute_flags(parser, remat_help: Optional[str] = None) -> None:
+    """The LM payloads' shared compute flags: ``--remat`` (gate),
+    ``--remat-policy``, ``--optimizer adam|adam8``. One call site per
+    parser (transformer/moe/pipeline) instead of three hand-copied
+    blocks; ``remat_help`` lets a payload keep its config-specific help
+    text (the flags themselves are identical)."""
+    from tpu_operator.payload import models, optimizers
+
+    parser.add_argument(
+        "--remat", action="store_true",
+        help=remat_help or
+        "rematerialize each block on backward (jax.checkpoint): "
+        "activation memory O(layers) -> O(1) blocks")
+    models.add_remat_policy_flag(parser)
+    optimizers.add_optimizer_flag(parser)
+
+
+def lm_block(args, base: Any = None) -> Any:
+    """The LM payloads' shared Block construction: ``nn.remat`` over
+    :class:`models.DecoderBlock` (or ``base``) with the ``--remat-policy``
+    policy when ``--remat`` is set, the plain class otherwise.
+
+    nn.remat is semantics-preserving: same params/outputs, backward
+    recomputes the block instead of keeping its activations in HBM. The
+    "dots" policy keeps each block's matmul outputs resident and
+    recomputes only the cheap elementwise ops between them — the MFU
+    sweet spot when the config fits. "dots_attn" additionally saves the
+    flash-attention kernel's named residuals (output + row logsumexp):
+    dots policies treat custom-calls as recomputable, so without the
+    names the whole attention forward re-runs inside the backward."""
+    import flax.linen as nn
+
+    from tpu_operator.payload import models
+
+    base = base or models.DecoderBlock
+    if getattr(args, "remat", False):
+        return nn.remat(base, policy=models.remat_policy(
+            getattr(args, "remat_policy", "full")))
+    return base
+
+
+def add_classifier_compute_flags(parser) -> None:
+    """The classifier payloads' compute flags (cifar.py; linear.py takes
+    the optimizer subset). No ``--remat`` gate: ``--remat-policy full``
+    (the default) IS the off position — the classifier's remat is
+    step-level ``jax.checkpoint`` in train.make_classifier_train_step,
+    not a lifted module transform, so there is no second knob to gate."""
+    from tpu_operator.payload import models, optimizers
+
+    models.add_remat_policy_flag(parser)
+    optimizers.add_optimizer_flag(parser, choices=CLASSIFIER_OPTIMIZERS,
+                                  default="sgd")
+    parser.add_argument(
+        "--fused-loss", action="store_true",
+        help="compute cross-entropy as target-gather + logsumexp (the LM "
+             "loss form): the f32 row reduction fuses into the cast, no "
+             "f32 [B, classes] log-prob tensor is materialized; parity "
+             "to tolerance (summation order differs)")
+    parser.add_argument(
+        "--scan-blocks", action="store_true",
+        help="roll each stage's identical stride-1 blocks into one "
+             "nn.scan'd body with stacked params: compile time stops "
+             "scaling with depth. Changes the param tree — checkpoints "
+             "do not resume across this flip")
+    parser.add_argument(
+        "--aot", action="store_true",
+        help="AOT-compile the train step through the persistent "
+             "compilation cache before step 0 (the run path already "
+             "does this via the overlapped prologue; this forces it for "
+             "direct step users and records compile seconds)")
+
+
+def classifier_step_options(args) -> dict:
+    """kwargs for train.make_classifier_train_step from parsed flags."""
+    return {
+        "remat_policy": getattr(args, "remat_policy", "full"),
+        "fused_loss": bool(getattr(args, "fused_loss", False)),
+    }
+
+
+def make_optimizer(args, default: str = "sgd"):
+    """The classifier payloads' optimizer construction site — one thin
+    indirection over optimizers.from_args so cifar/linear and the LM
+    builders resolve ``--optimizer`` through the same code."""
+    from tpu_operator.payload import optimizers
+
+    return optimizers.from_args(args, default=default)
+
+
+def aot_compile_cached(train_step, state, batch_args: tuple,
+                       env: Optional[dict] = None
+                       ) -> Tuple[Optional[Any], float, bool]:
+    """AOT-compile a jitted train step THROUGH the persistent compilation
+    cache (ROADMAP 1c: "AOT-compile through the warm cache everywhere"):
+    enable the cache (JAX_COMPILATION_CACHE_DIR / TPUJOB_CACHE_PATH, if
+    configured), subscribe the hit listener, then ``lower(...).compile()``
+    for the live shapes. Returns ``(compiled_or_None, compile_seconds,
+    cache_hit)`` — compiled is None when the step has no ``lower``;
+    cache_hit is True when the executable deserialized from the
+    persistent cache instead of compiling (the warm-restart fast path).
+    Callers report compile_seconds OUT of their timed windows so first-
+    window jitter never absorbs a compile."""
+    from tpu_operator.payload import bootstrap
+    from tpu_operator.payload import startup as startup_mod
+    from tpu_operator.payload import train
+
+    env = env if env is not None else os.environ
+    cache_dir = bootstrap.enable_compilation_cache(env)
+    if cache_dir:
+        # The run path enables the cache before the backend initializes;
+        # direct step users (bench, tests) reach here after warmup
+        # compiles, and jax memoizes the no-cache state at first compile
+        # — a later jax_compilation_cache_dir update is silently ignored
+        # until the cache module re-initializes. Best-effort: private
+        # module, disk entries survive the reset.
+        try:
+            from jax._src import compilation_cache as _cc
+
+            _cc.reset_cache()
+        except Exception:  # noqa: BLE001 — cache stays best-effort
+            log.debug("compilation-cache reset unavailable", exc_info=True)
+    listener = startup_mod.ensure_cache_listener()
+    before = startup_mod.cache_hit_count() if listener else 0
+    t0 = time.perf_counter()
+    compiled = train.aot_compile_step(train_step, state, batch_args)
+    compile_seconds = time.perf_counter() - t0
+    hit = bool(listener and cache_dir
+               and startup_mod.cache_hit_count() > before)
+    return compiled, compile_seconds, hit

@@ -30,9 +30,14 @@ def parse_args(argv=None):
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--target-loss", type=float, default=1e-3,
                    help="exit nonzero unless final MSE is below this")
-    from tpu_operator.payload import autotune
+    from tpu_operator.payload import autotune, compute, optimizers
 
     autotune.add_prefetch_argument(p)
+    # Optimizer selection from the shared compute surface (sgd default =
+    # the seed path; the model has no blocks/loss to remat or fuse, so
+    # the rest of the classifier flag set does not apply here).
+    optimizers.add_optimizer_flag(p, choices=compute.CLASSIFIER_OPTIMIZERS,
+                                  default="sgd")
     p.add_argument("--profile-dir",
                    default=os.environ.get("TPU_PROFILE_DIR", ""),
                    help="jax.profiler trace dir (default: $TPU_PROFILE_DIR)")
@@ -41,16 +46,15 @@ def parse_args(argv=None):
 
 def run(info: bootstrap.ProcessInfo, args=None) -> float:
     import jax
-    import optax
 
-    from tpu_operator.payload import autotune
+    from tpu_operator.payload import autotune, compute
     from tpu_operator.payload import data as data_mod
     from tpu_operator.payload import models, train
 
     args = args or parse_args([])
     mesh = train.make_mesh()
     model = models.LinearRegressor()
-    tx = optax.sgd(args.lr)
+    tx = compute.make_optimizer(args, default="sgd")
     sample = jax.numpy.zeros((args.batch, args.dim), jax.numpy.float32)
     state = train.create_train_state(model, jax.random.key(args.seed), sample, tx)
     shardings = train.state_shardings(mesh, state)

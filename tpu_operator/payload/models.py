@@ -55,6 +55,22 @@ class BasicBlock(nn.Module):
         return nn.relu(y + residual.astype(y.dtype))
 
 
+class _ScanBlock(nn.Module):
+    """``nn.scan`` adapter for :class:`BasicBlock`: the scanned module must
+    return a ``(carry, out)`` pair, and ``train`` must ride as an attribute
+    because scan broadcasts only the carry/xs call arguments."""
+
+    features: int
+    dtype: Any = jnp.bfloat16
+    train: bool = True
+
+    @nn.compact
+    def __call__(self, x: jnp.ndarray, _):
+        y = BasicBlock(self.features, 1, self.dtype,
+                       name="block")(x, train=self.train)
+        return y, None
+
+
 class CifarResNet(nn.Module):
     """ResNet-6n+2 for 32x32 inputs (He et al. CIFAR variant): 3x3 stem,
     three stages at widths ``widths`` with ``blocks_per_stage`` blocks each,
@@ -62,12 +78,24 @@ class CifarResNet(nn.Module):
 
     ``depth 20`` = blocks_per_stage 3; the flagship bench config. Tiny
     configs (blocks 1, widths (8,16,32)) keep CPU-mesh tests fast.
+
+    ``scan_blocks`` rolls each stage's stride-1 tail (blocks 1..n-1 — all
+    identical in shape) into one ``nn.scan``'d block with stacked params,
+    so XLA compiles ONE block body per stage instead of ``n`` inlined
+    copies — compile time stops scaling with depth (ROADMAP item 1's
+    scan-over-blocks). The stage's stride-2 entry block keeps its own
+    params (its projection shortcut differs in shape). Param tree changes
+    (``stage{s}_scan/block/...`` leaves gain a leading [n-1] axis), so
+    checkpoints do NOT resume across a scan_blocks flip, and the TP rule
+    in :func:`param_partition_spec` skips the now-5D conv kernels —
+    scan_blocks is the data-parallel compile-time option.
     """
 
     num_classes: int = 10
     blocks_per_stage: int = 3
     widths: Sequence[int] = (16, 32, 64)
     dtype: Any = jnp.bfloat16
+    scan_blocks: bool = False
 
     @nn.compact
     def __call__(self, x: jnp.ndarray, train: bool = True) -> jnp.ndarray:
@@ -78,6 +106,18 @@ class CifarResNet(nn.Module):
                          name="bn_stem")(x)
         x = nn.relu(x)
         for stage, width in enumerate(self.widths):
+            if self.scan_blocks and self.blocks_per_stage > 1:
+                strides = 2 if stage > 0 else 1
+                x = BasicBlock(width, strides, self.dtype,
+                               name=f"stage{stage}_block0")(x, train=train)
+                Scan = nn.scan(
+                    _ScanBlock,
+                    variable_axes={"params": 0, "batch_stats": 0},
+                    split_rngs={"params": True},
+                    length=self.blocks_per_stage - 1)
+                x, _ = Scan(width, self.dtype, train,
+                            name=f"stage{stage}_scan")(x, None)
+                continue
             for block in range(self.blocks_per_stage):
                 strides = 2 if (stage > 0 and block == 0) else 1
                 x = BasicBlock(width, strides, self.dtype,

@@ -90,13 +90,14 @@ def parse_args(argv=None):
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over K sequential "
                         "microbatches inside the jit")
-    p.add_argument("--remat", action="store_true",
-                   help="rematerialize each block on backward "
-                        "(jax.checkpoint)")
-    __import__('tpu_operator.payload.models',
-               fromlist=['models']).add_remat_policy_flag(p)
+    from tpu_operator.payload import compute
+
+    # --remat / --remat-policy / --optimizer from the shared surface
+    # (payload/compute.py) — one flag set across the LM family.
+    compute.add_lm_compute_flags(
+        p, remat_help="rematerialize each block on backward "
+                      "(jax.checkpoint)")
     p.add_argument("--lr", type=float, default=3e-3)
-    optimizers.add_optimizer_flag(p)
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--log-every", type=int, default=50)
     p.add_argument("--data", default=os.environ.get("TPU_DATA_PATH", ""),
@@ -378,10 +379,9 @@ def _build_model(args, mesh):
         return ring.reference_attention(q, k, v, causal=True)
 
     MoEMLP = _moe_mlp_class(mesh, dtype)
-    Block = (nn.remat(models.DecoderBlock,
-                      policy=models.remat_policy(
-                          getattr(args, "remat_policy", "full")))
-             if getattr(args, "remat", False) else models.DecoderBlock)
+    from tpu_operator.payload import compute
+
+    Block = compute.lm_block(args)
     # Under TP, split q/k/v so each model shard owns whole heads
     # (transformer.py's rule — a fused [d,3d] kernel's contiguous column
     # shards would straddle the q/k/v thirds).

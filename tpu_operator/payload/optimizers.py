@@ -112,28 +112,45 @@ def _dequantize(t: Quantized, sqrt_domain: bool):
     return x
 
 
-def from_args(args):
+def from_args(args, default: str = "adam"):
     """Build the payload optimizer from parsed CLI args — the one
-    construction site shared by the transformer / MoE / pipeline payloads
-    (``--optimizer adam|adam8``, ``--adam-mu-dtype`` for plain adam)."""
+    construction site shared by every payload (``--optimizer
+    sgd|adam|adam8``, ``--adam-mu-dtype`` for plain adam, ``--momentum``
+    for sgd where the payload defines it). ``default`` is the payload's
+    own seed-path optimizer: adam for the LM family, sgd for the
+    classifier/regression payloads — so an unconfigured flagship keeps
+    training exactly as it always has."""
     import jax.numpy as jnp
     import optax
 
-    choice = getattr(args, "optimizer", "adam")
+    choice = getattr(args, "optimizer", default) or default
     if choice == "adam8":
         return adam8(args.lr, seed=getattr(args, "seed", 0))
+    if choice == "sgd":
+        # Pass momentum exactly as the payload defines it (None when the
+        # parser has no --momentum, e.g. linear.py): optax.sgd's state
+        # tree differs between momentum=None and momentum=0.0, and the
+        # seed paths' checkpoints must keep restoring bit-for-bit.
+        return optax.sgd(args.lr, momentum=getattr(args, "momentum", None))
     mu_dtype = (jnp.bfloat16
                 if getattr(args, "adam_mu_dtype", "f32") == "bf16" else None)
     return optax.adam(args.lr, mu_dtype=mu_dtype)
 
 
-def add_optimizer_flag(parser) -> None:
-    """``--optimizer`` CLI flag, shared by every LM payload parser."""
+def add_optimizer_flag(parser, choices=("adam", "adam8"),
+                       default: str = "adam") -> None:
+    """``--optimizer`` CLI flag, shared by every payload parser. The LM
+    payloads keep the historical (adam, adam8) choice set; classifier
+    payloads pass ``("sgd", "adam", "adam8")`` with sgd as the seed-path
+    default (payload/compute.py owns that wiring)."""
     parser.add_argument(
-        "--optimizer", choices=("adam", "adam8"), default="adam",
+        "--optimizer", choices=tuple(choices), default=default,
         help="adam8 = int8 block-quantized moments with stochastic "
              "rounding (4x less optimizer HBM than f32 adam; "
-             "trajectory-parity-tested)")
+             "trajectory-parity-tested)"
+             + (" ; sgd = the classifier seed path "
+                "(momentum from --momentum where defined)"
+                if "sgd" in choices else ""))
 
 
 def adam8(learning_rate, b1: float = 0.9, b2: float = 0.999,

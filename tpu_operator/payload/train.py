@@ -248,6 +248,20 @@ def cross_entropy(logits: jnp.ndarray, labels: jnp.ndarray) -> jnp.ndarray:
     return -jnp.mean(jnp.take_along_axis(logp, labels[:, None], axis=1))
 
 
+def fused_cross_entropy(logits: jnp.ndarray,
+                        labels: jnp.ndarray) -> jnp.ndarray:
+    """:func:`cross_entropy` in the target-gather + logsumexp form the LM
+    loss already uses (:func:`next_token_nll`): the f32 work is a row
+    reduction XLA fuses into the cast, so no f32 [B, num_classes] log-prob
+    tensor is materialized. Mathematically identical (``-logp[label] =
+    lse(logits) - logits[label]``); summation order differs, so parity is
+    to tolerance, not bit-exact — tests/test_flagship_compute.py pins it."""
+    logits = logits.astype(jnp.float32)
+    lse = jax.scipy.special.logsumexp(logits, axis=-1)
+    tgt = jnp.take_along_axis(logits, labels[:, None], axis=1)[:, 0]
+    return jnp.mean(lse - tgt)
+
+
 def next_token_nll(logits: jnp.ndarray, tokens: jnp.ndarray) -> jnp.ndarray:
     """Mean next-token negative log-likelihood, f32 reduction (house
     numerics). Written as target-gather + logsumexp instead of a full
@@ -462,20 +476,42 @@ def make_grads_train_step(grads_and_metrics: Callable,
 
 def make_classifier_train_step(model: Any, tx: optax.GradientTransformation,
                                mesh: Mesh, state: TrainState,
-                               shardings: Optional[TrainState] = None) -> Callable:
-    """Compile the classification train step with explicit shardings."""
+                               shardings: Optional[TrainState] = None,
+                               remat_policy: str = "full",
+                               fused_loss: bool = False) -> Callable:
+    """Compile the classification train step with explicit shardings.
+
+    ``remat_policy`` != "full" wraps the model forward in ``jax.checkpoint``
+    with :func:`models.remat_policy`'s policy — step-level remat rather than
+    flax lifted ``nn.remat`` because the classifier forward mutates
+    batch_stats, which step-level checkpointing handles as an explicit
+    output without touching the param tree (checkpoint-compatible; the
+    optimized path must restore the seed path's checkpoints). ``fused_loss``
+    swaps :func:`cross_entropy` for :func:`fused_cross_entropy`. Defaults
+    reproduce the seed path bit-for-bit."""
     shardings = shardings or state_shardings(mesh, state)
     batch_shard = data_mod.batch_sharding(mesh)
     label_shard = NamedSharding(mesh, P("data"))
+    loss_of = fused_cross_entropy if fused_loss else cross_entropy
+
+    def forward(params, batch_stats, images):
+        logits, mutated = model.apply(
+            {"params": params, "batch_stats": batch_stats},
+            images, train=True, mutable=["batch_stats"],
+        )
+        return logits, mutated["batch_stats"]
+
+    if remat_policy != "full":
+        from tpu_operator.payload import models as models_mod
+
+        forward = jax.checkpoint(
+            forward, policy=models_mod.remat_policy(remat_policy))
 
     def step(state: TrainState, images: jnp.ndarray,
              labels: jnp.ndarray) -> Tuple[TrainState, dict]:
         def loss_fn(params):
-            logits, mutated = model.apply(
-                {"params": params, "batch_stats": state.batch_stats},
-                images, train=True, mutable=["batch_stats"],
-            )
-            return cross_entropy(logits, labels), (logits, mutated["batch_stats"])
+            logits, new_stats = forward(params, state.batch_stats, images)
+            return loss_of(logits, labels), (logits, new_stats)
 
         (loss, (logits, new_stats)), grads = jax.value_and_grad(
             loss_fn, has_aux=True

@@ -71,13 +71,14 @@ def parse_args(argv=None):
                         "The permutation is applied inside the jit (token "
                         "gather + position ids + shifted-target loss); "
                         "model params and semantics are identical")
-    p.add_argument("--remat", action="store_true",
-                   help="rematerialize each block on backward (jax.checkpoint"
-                        "): activation memory O(layers) -> O(1) blocks, for "
-                        "long-context configs that would not fit HBM")
-    from tpu_operator.payload import models
+    from tpu_operator.payload import compute
 
-    models.add_remat_policy_flag(p)
+    # --remat / --remat-policy / --optimizer from the shared surface
+    # (payload/compute.py) — one flag set across the LM family.
+    compute.add_lm_compute_flags(
+        p, remat_help="rematerialize each block on backward (jax.checkpoint"
+                      "): activation memory O(layers) -> O(1) blocks, for "
+                      "long-context configs that would not fit HBM")
     p.add_argument("--grad-accum", type=int, default=1,
                    help="accumulate gradients over K sequential "
                         "microbatches inside the jit (activation-memory "
@@ -101,7 +102,6 @@ def parse_args(argv=None):
                         "negligible quality cost — the m accumulator is a "
                         "smoothed gradient, far less precision-sensitive "
                         "than v or the master params, which stay f32")
-    optimizers.add_optimizer_flag(p)
     p.add_argument("--vocab", type=int, default=256)
     p.add_argument("--dim", type=int, default=256)
     p.add_argument("--heads", type=int, default=4)
@@ -198,21 +198,12 @@ def _build_model(args, mesh):
     kv_heads = getattr(args, "kv_heads", 0)
     models.validate_heads_dims(args.heads, kv_heads, args.dim, tp)
 
-    # nn.remat is semantics-preserving: same params/outputs, backward
-    # recomputes the block instead of keeping its activations in HBM.
-    # The "dots" policy keeps each block's matmul outputs resident and
-    # recomputes only the cheap elementwise ops between them — the MFU
-    # sweet spot when the config fits. "dots_attn" additionally saves the
-    # flash-attention kernel's named residuals (output + row logsumexp —
-    # flash_attention._attn_fwd): dots policies treat custom-calls as
-    # recomputable, so without the names the whole attention forward
-    # re-runs inside the backward (~1/3 of flagship attention time,
-    # docs/benchmarks.md attribution) for no memory it couldn't afford.
-    if getattr(args, "remat", False):
-        Block = nn.remat(models.DecoderBlock, policy=models.remat_policy(
-            getattr(args, "remat_policy", "full")))
-    else:
-        Block = models.DecoderBlock
+    # Shared Block construction (compute.lm_block): nn.remat over
+    # DecoderBlock with the --remat-policy policy when --remat is set —
+    # the policy trade-offs are documented on lm_block itself.
+    from tpu_operator.payload import compute
+
+    Block = compute.lm_block(args)
 
     class TransformerLM(nn.Module):
         vocab: int
