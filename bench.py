@@ -78,6 +78,15 @@ def parse_args(argv=None):
                         "workers; exits nonzero if p99 reconcile latency, "
                         "the status-write budget, or the zero-read steady "
                         "state regresses (--quick: a few hundred jobs)")
+    p.add_argument("--drain", action="store_true",
+                   help="run ONLY the cooperative-drain rows (no JAX/TPU "
+                        "needed): planned restart vs hard preemption "
+                        "lost-step-seconds over the real trainer machinery "
+                        "with an injected clock, plus the drain-deadline "
+                        "hard-kill backstop; exits nonzero if a "
+                        "cooperative drain costs more than one checkpoint "
+                        "interval (or more than the hard reference), or "
+                        "the never-ACKed drain fails to reach Done")
     p.add_argument("--churn", action="store_true",
                    help="run the create-run-delete churn soak: >=200 "
                         "cycles through the real operator with the "
@@ -1544,6 +1553,197 @@ def _fleet_ok(rows: list) -> bool:
             print(f"FAIL: steady-state fleet wave issued {row['value']} "
                   f"read RPCs (budget: 0)", file=sys.stderr)
             ok = False
+    return ok
+
+
+# --- cooperative drain rows -----------------------------------------------------
+
+def _drain_scenario():
+    """A Running single-slice gang over the REAL TrainingJob machinery
+    with an injected trainer clock. Returns (cs, controller, tj, clock);
+    the caller must restore ``training._now``."""
+    from tpu_operator.apis.tpujob.v1alpha1 import types as t
+    from tpu_operator.client.fake import FakeClientset
+    from tpu_operator.client.informer import SharedInformerFactory
+    from tpu_operator.controller.controller import Controller
+    from tpu_operator.trainer.training import TrainingJob
+    from tpu_operator.util.util import format_rfc3339
+
+    class _Clock:
+        def __init__(self):
+            self.t = 1_700_000_000.0
+
+        def __call__(self):
+            return format_rfc3339(self.t)
+
+        def advance(self, dt):
+            self.t += dt
+
+    clock = _Clock()
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    controller.scheduler.update_inventory({FLEET_SLICE_KEY: 1})
+    job_dict = _fleet_job("bench-drain", queue="default")
+    job_dict["spec"]["drain"] = {"deadlineSeconds": 2,
+                                 "resizeDebounceSeconds": 0}
+    from tpu_operator.apis.tpujob.v1alpha1 import types as types_mod
+    job = types_mod.TPUJob.from_dict(job_dict)
+    cs.tpujobs.create("default", job.to_dict())
+    tj = TrainingJob(cs, controller.recorder, job,
+                     metrics=controller.metrics,
+                     scheduler=controller.scheduler)
+    controller.jobs["default/bench-drain"] = tj
+    tj.reconcile()
+    _drain_mark_pods(cs, {"running": {}})
+    tj.reconcile()
+    assert tj.job.status.phase == "Running", tj.job.status.phase
+    return cs, controller, tj, clock
+
+
+def _drain_mark_pods(cs, state, phase=None):
+    phase = phase or ("Running" if "running" in state else "Failed")
+    for pod in cs.pods.list("default"):
+        if (pod.get("status") or {}).get("phase") in ("Failed", "Succeeded"):
+            continue
+        pod["status"] = {"phase": phase, "containerStatuses": [
+            {"name": "tpu", "state": state}]}
+        cs.pods.update("default", pod)
+
+
+def bench_drain(quick: bool) -> list:
+    """Cooperative-drain step-seconds accounting over the real
+    controller/trainer machinery with an injected clock (no JAX, no
+    sleeps). Three scenarios:
+
+    - **cooperative**: a gang mid-checkpoint-interval (last durable save
+      ``interval`` steps ago) is drained; the payload ACKs a boundary
+      step, runs the verified save, exits planned. The ledger's
+      ``lostSteps`` must price the restart at <= one checkpoint interval
+      (the protocol's whole claim) — and in the simulated schedule, at
+      zero.
+    - **hard** (reference): the identical gang is preempted the old way;
+      its restart discards every step since the last periodic save.
+    - **deadline expiry**: a drain the payload never ACKs hard-kills at
+      ``spec.drain.deadlineSeconds`` and the job still reaches Done.
+    """
+    from tpu_operator.trainer import training
+
+    sec_per_step = 1.0
+    interval_steps = 50 if quick else 200
+    last_save = 1000
+    now_step = last_save + interval_steps - 20  # mid-interval
+    rows: list = []
+    orig_now = training._now
+    try:
+        # Scenario 1: cooperative drain.
+        cs, controller, tj, clock = _drain_scenario()
+        training._now = clock
+        controller.record_heartbeat("default", "bench-drain", {
+            "time": clock(), "step": now_step, "attempt": 0,
+            "processId": 0})
+        tj.job.status.checkpoint = {"lastCheckpointStep": last_save}
+        tj.request_drain("maintenance", "bench: planned restart")
+        rid = tj.job.status.drain["id"]
+        clock.advance(0.5)
+        controller.record_heartbeat("default", "bench-drain", {
+            "time": clock(), "step": now_step + 1, "attempt": 0,
+            "processId": 0, "drainAck": {"id": rid, "step": now_step + 1}})
+        # The gang-agreed verified save lands at the boundary step...
+        tj.job.status.checkpoint = {"lastCheckpointStep": now_step + 1}
+        clock.advance(0.5)
+        # ...and every process exits EXIT_PLANNED (160).
+        _drain_mark_pods(cs, {"terminated": {"exitCode": 160}})
+        tj.reconcile()
+        rec = tj.job.status.failures[-1]
+        assert rec.kind == "planned", rec
+        coop_lost = (rec.lost_steps or 0) * sec_per_step
+        drain_hist = controller.metrics.histogram_snapshot(
+            "job_drain_seconds",
+            labels={"namespace": "default", "name": "bench-drain"})
+        planned = controller.metrics.counter_value(
+            "job_planned_restarts_total",
+            labels={"namespace": "default", "name": "bench-drain",
+                    "reason": "maintenance"})
+        rows.append({"metric": "drain_coop_lost_step_seconds",
+                     "value": coop_lost,
+                     "budget_s": interval_steps * sec_per_step,
+                     "interval_steps": interval_steps})
+        rows.append({"metric": "drain_latency_seconds",
+                     "value": (drain_hist or {}).get("sum"),
+                     "observations": (drain_hist or {}).get("count")})
+        rows.append({"metric": "drain_planned_restarts",
+                     "value": planned})
+
+        # Scenario 2: the hard-preemption reference on identical state.
+        cs, controller, tj, clock = _drain_scenario()
+        training._now = clock
+        controller.record_heartbeat("default", "bench-drain", {
+            "time": clock(), "step": now_step, "attempt": 0,
+            "processId": 0})
+        tj.job.status.checkpoint = {"lastCheckpointStep": last_save}
+        _drain_mark_pods(cs, {"terminated": {"exitCode": 137}})
+        tj.reconcile()
+        rec = tj.job.status.failures[-1]
+        assert rec.kind == "preemption", rec
+        hard_lost = (rec.lost_steps or 0) * sec_per_step
+        rows.append({"metric": "drain_hard_lost_step_seconds",
+                     "value": hard_lost})
+
+        # Scenario 3: deadline expiry still converges to Done.
+        cs, controller, tj, clock = _drain_scenario()
+        training._now = clock
+        tj.request_drain("maintenance", "bench: wedged payload")
+        clock.advance(3.0)  # past deadlineSeconds=2, no ACK, no exit
+        tj.reconcile()
+        expired = (tj.job.status.drain or {}).get("state") == "Expired"
+        tj.reconcile()  # re-gang
+        _drain_mark_pods(cs, {"running": {}})
+        tj.reconcile()
+        _drain_mark_pods(cs, {"terminated": {"exitCode": 0}},
+                         phase="Succeeded")
+        tj.reconcile()
+        done = tj.job.status.phase == "Done"
+        rows.append({"metric": "drain_deadline_expiry_done",
+                     "value": 1.0 if (expired and done) else 0.0})
+    finally:
+        training._now = orig_now
+    return rows
+
+
+def _drain_ok(rows: list) -> bool:
+    """The CI contract (hack/verify.sh runs --drain --quick): a
+    cooperative drain costs at most one checkpoint interval of lost
+    step-seconds (and never more than the hard-preemption reference),
+    exactly one planned restart is billed with its latency observed, and
+    a never-ACKed drain still reaches Done through the deadline."""
+    ok = True
+    by = {row["metric"]: row for row in rows}
+    coop = by.get("drain_coop_lost_step_seconds", {})
+    if coop.get("value") is None or coop["value"] > coop.get("budget_s", 0):
+        print(f"FAIL: cooperative drain lost {coop.get('value')} "
+              f"step-seconds, over the one-checkpoint-interval budget "
+              f"{coop.get('budget_s')}", file=sys.stderr)
+        ok = False
+    hard = by.get("drain_hard_lost_step_seconds", {}).get("value")
+    if hard is None or coop.get("value", 0) > hard:
+        print(f"FAIL: cooperative drain ({coop.get('value')}) lost more "
+              f"than the hard-preemption reference ({hard})",
+              file=sys.stderr)
+        ok = False
+    if by.get("drain_planned_restarts", {}).get("value") != 1:
+        print("FAIL: expected exactly one planned restart billed",
+              file=sys.stderr)
+        ok = False
+    lat = by.get("drain_latency_seconds", {})
+    if lat.get("observations") != 1 or not lat.get("value"):
+        print("FAIL: job_drain_seconds not observed for the completed "
+              "drain", file=sys.stderr)
+        ok = False
+    if by.get("drain_deadline_expiry_done", {}).get("value") != 1.0:
+        print("FAIL: never-ACKed drain did not expire to Done",
+              file=sys.stderr)
+        ok = False
     return ok
 
 
@@ -3337,6 +3537,10 @@ def main(argv=None) -> int:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_fleet(args.quick)]
         return 0 if _fleet_ok(rows) else 1
+    if args.drain:
+        # Operator-only rows: no JAX import, runs anywhere (the CI gate).
+        rows = [_emit(row) for row in bench_drain(args.quick)]
+        return 0 if _drain_ok(rows) else 1
     if args.churn:
         # Operator-only rows: no JAX import, runs anywhere (the CI gate).
         rows = [_emit(row) for row in bench_churn(args.quick)]

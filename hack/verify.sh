@@ -181,6 +181,20 @@ python -m pytest tests/test_fake_cluster.py -x -q
 # count, bounded RSS, and reconcile p99 bounded DURING the storm —
 # exits nonzero on regression. Full scale (10k pods): bench.py --cluster.
 python bench.py --cluster --quick
+# Standalone cooperative-drain gate: the status.drain directive lifecycle
+# (request → heartbeat-ACK → planned exit 160 → preemption-pool billing
+# with no backoff and no crash-loop streak), stale-attempt expiry, the
+# grow-debounced in-attempt live resize, drain-first eviction with the
+# checkpoint-freshness skip, the maintenance cordon watch, deadline
+# expiry → hard teardown, and the observability fold (metrics, describe,
+# per-job series prune) over the in-process apiserver.
+python -m pytest tests/test_drain.py -x -q
+# And the measured form: cooperative lost-step-seconds must stay within
+# one checkpoint interval (vs the hard-kill reference losing most of
+# one), exactly one planned restart billed, the request→exit latency
+# histogram observed, and the deadline-expiry path must still reach
+# Done — exits nonzero on regression.
+python bench.py --drain --quick
 # Standalone control-plane budget gate: steady-state reconcile must issue
 # ZERO read RPCs (all reads served by the informer indexes) and the first
 # reconcile exactly N pod + N+1 service creates — a reads-per-reconcile
@@ -215,6 +229,7 @@ python -m pytest tests/ -x -q --ignore=tests/test_metrics_conformance.py \
   --ignore=tests/test_schedules.py \
   --ignore=tests/test_timeline.py \
   --ignore=tests/test_fleet_obs_e2e.py \
-  --ignore=tests/test_fake_cluster.py
+  --ignore=tests/test_fake_cluster.py \
+  --ignore=tests/test_drain.py
 python hack/e2e_smoke.py --timeout 120
 echo "verify: OK"

@@ -1,0 +1,626 @@
+"""Cooperative drain protocol: planned restarts, in-attempt live resize,
+and graceful preemption.
+
+The controller stamps a drain directive into ``status.drain``; it rides
+process 0's heartbeat ACK (the profile-directive delivery path) until
+the payload's drainAck folds it Acked; the payload's verified save +
+EXIT_PLANNED (160) completes it — classified ``planned``, billed to the
+4x preemption-factor budget, never the crash-loop budget, with restart
+backoff skipped. A directive that never ACKs or never exits hard-kills
+at ``spec.drain.deadlineSeconds``, exactly the pre-drain teardown.
+
+Three call sites are covered here: the in-attempt live resize (a
+Running shrunk elastic gang grows WITHIN the job once inventory
+headroom holds through the debounce), drain-first graceful preemption
+(the fleet eviction keeps the gang running until the save lands), and
+node-maintenance drains off the cordon watch.
+
+Observability contract: ``job_planned_restarts_total{reason}`` and
+``job_drain_seconds`` are asserted against the registry by name, and
+pruned with the job (the PR-15 lifecycle discipline). The e2e at the
+bottom runs the full HTTP path — strict status-subresource schema,
+StatusServer directive delivery to process 0 only, drainAck fold, and
+``tpujobctl describe``'s Drain line.
+"""
+
+import contextlib
+import io
+import threading
+from types import SimpleNamespace
+
+import pytest
+
+from tpu_operator.apis.tpujob import validation
+from tpu_operator.apis.tpujob.v1alpha1 import types as t
+from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
+from tpu_operator.client.fake import FakeClientset
+from tpu_operator.client.informer import SharedInformerFactory
+from tpu_operator.client.rest import Clientset, RestConfig
+from tpu_operator.cmd import ctl
+from tpu_operator.controller.controller import Controller
+from tpu_operator.controller.statusserver import StatusServer
+from tpu_operator.payload import bootstrap
+from tpu_operator.payload import heartbeat as heartbeat_mod
+from tpu_operator.testing.apiserver import ApiServerHarness
+from tpu_operator.testing.waiting import make_wait_for
+from tpu_operator.trainer import policy, training
+from tpu_operator.trainer.training import TrainingJob
+from tpu_operator.util.util import parse_rfc3339
+from tests.test_elastic import KEY, elastic_job, live_pods, mark_pods, pod_env
+from tests.test_time_recovery import T0, FakeNow
+
+wait_for = make_wait_for(timeout=20.0, interval=0.05)
+
+LABELS = {"namespace": "default", "name": "dr"}
+
+
+@pytest.fixture
+def clock(monkeypatch):
+    fake = FakeNow()
+    monkeypatch.setattr(training, "_now", fake)
+    return fake
+
+
+def drain_harness(name="dr", capacity=4, replicas=8, num_slices=8,
+                  min_slices=2, drain=None, **spec_kw):
+    """A Running elastic gang under an in-process Controller whose fleet
+    scheduler models ``capacity`` v4 2x2x2 slices (the gang shrinks to
+    fit), with the heartbeat/drain fold path live."""
+    cs = FakeClientset()
+    controller = Controller(cs, SharedInformerFactory(cs, resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    controller.scheduler.update_inventory({KEY: capacity})
+    job = elastic_job(name, replicas=replicas, num_slices=num_slices,
+                      min_slices=min_slices, **spec_kw)
+    if drain is not None:
+        job.spec.drain = drain
+    cs.tpujobs.create("default", job.to_dict())
+    tj = TrainingJob(cs, controller.recorder, job,
+                     metrics=controller.metrics,
+                     scheduler=controller.scheduler)
+    controller.jobs[f"default/{name}"] = tj
+    tj.reconcile()
+    mark_pods(cs)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    return cs, controller, tj
+
+
+def beat(controller, tj, step=100, pid=0, **extra):
+    hb = {"time": training._now(), "step": step,
+          "attempt": tj.job.status.attempt, "processId": pid}
+    hb.update(extra)
+    return controller.record_heartbeat(tj.namespace, tj.name, hb)
+
+
+def event_reasons(cs):
+    return [e["reason"] for e in cs.events.list("default")]
+
+
+# --- spec, classification, billing -------------------------------------------
+
+
+def test_drain_spec_roundtrip_defaults_and_validation():
+    spec = t.DrainSpec(deadline_seconds=60, resize_debounce_seconds=5)
+    assert t.DrainSpec.from_dict(spec.to_dict()) == spec
+    assert t.DrainSpec.from_dict(None) is None
+    assert t.DrainSpec.from_dict({}) == t.DrainSpec(
+        deadline_seconds=t.DEFAULT_DRAIN_DEADLINE_SECONDS,
+        resize_debounce_seconds=t.DEFAULT_RESIZE_DEBOUNCE_SECONDS)
+
+    bad = elastic_job(drain=t.DrainSpec(deadline_seconds=0))
+    set_defaults(bad.spec)
+    with pytest.raises(validation.ValidationError, match="deadlineSeconds"):
+        validation.validate_tpujob_spec(bad.spec)
+
+    bad = elastic_job(drain=t.DrainSpec(resize_debounce_seconds=-1))
+    set_defaults(bad.spec)
+    with pytest.raises(validation.ValidationError,
+                       match="resizeDebounceSeconds"):
+        validation.validate_tpujob_spec(bad.spec)
+
+
+def test_planned_exit_code_classifies_planned():
+    assert bootstrap.EXIT_PLANNED == 160
+    assert bootstrap.EXIT_PLANNED in policy.PLANNED_EXIT_CODES
+    pod = {"metadata": {"name": "p"}, "status": {
+        "phase": "Failed", "containerStatuses": [
+            {"name": "tpu",
+             "state": {"terminated": {"exitCode": 160}}}]}}
+    kind, _reason = policy.classify_pod_failure(pod)
+    assert kind == t.FailureKind.PLANNED
+    # A planned exit is retryable — it must group-restart, not fail.
+    assert policy.is_retryable_termination_state({"exitCode": 160})
+
+
+def test_bootstrap_planned_drain_latch():
+    bootstrap.reset_drain()
+    assert not bootstrap.planned_drain()
+    assert bootstrap.drain_exit_code() == bootstrap.EXIT_RETRYABLE
+    bootstrap.request_planned_drain()
+    assert bootstrap.planned_drain()
+    assert bootstrap.drain_exit_code() == bootstrap.EXIT_PLANNED
+    bootstrap.reset_drain()
+    assert not bootstrap.planned_drain()
+
+
+def test_planned_restarts_bill_preemption_pool_not_crash_loop(clock):
+    _cs, _controller, tj = drain_harness(max_restarts=1)
+    # Shared pool: planned + preemption together draw maxRestarts * 4.
+    tj.job.status.restart_counts = {"planned": 3, "preemption": 1}
+    used, budget, desc = tj._restart_budget_usage(t.FailureKind.PLANNED)
+    assert (used, budget) == (4, 4)
+    assert "preemption" in desc
+    assert tj._within_restart_budget(t.FailureKind.PLANNED, "x")
+    tj.job.status.restart_counts["planned"] = 4
+    assert not tj._within_restart_budget(t.FailureKind.PLANNED, "x")
+    assert tj.job.status.phase == t.TPUJobPhase.FAILED
+
+
+def test_planned_failure_never_ticks_consecutive_streak(clock):
+    _cs, _controller, tj = drain_harness(name="dr2")
+    tj._record_failure(0, t.FailureKind.PLANNED, "planned exit")
+    assert tj.job.status.consecutive_failures == 0
+    assert tj.job.status.failures[-1].kind == t.FailureKind.PLANNED
+    tj._record_failure(0, t.FailureKind.APPLICATION, "crash")
+    assert tj.job.status.consecutive_failures == 1
+
+
+# --- directive lifecycle -----------------------------------------------------
+
+
+def test_request_drain_stamps_directive_once(clock):
+    cs, _controller, tj = drain_harness()
+    tj.request_drain(t.DrainReason.RESIZE, "headroom", target_slices=8)
+    dr = tj.job.status.drain
+    assert dr["state"] == t.DrainState.REQUESTED
+    assert dr["reason"] == t.DrainReason.RESIZE
+    assert dr["attempt"] == 0 and dr["targetSlices"] == 8
+    assert len(dr["id"]) == 5
+    assert parse_rfc3339(dr["deadline"]) == pytest.approx(
+        T0 + t.DEFAULT_DRAIN_DEADLINE_SECONDS)
+    assert "DrainRequested" in event_reasons(cs)
+    # Idempotent while in flight: the level-triggered call sites must not
+    # reset the directive's identity or push the deadline out forever.
+    clock.advance(10)
+    tj.request_drain(t.DrainReason.PREEMPTION, "other")
+    assert tj.job.status.drain["id"] == dr["id"]
+    assert tj.job.status.drain["reason"] == t.DrainReason.RESIZE
+    assert tj.job.status.drain["deadline"] == dr["deadline"]
+
+
+def test_heartbeat_ack_folds_requested_to_acked(clock):
+    cs, controller, tj = drain_harness()
+    tj.request_drain(t.DrainReason.RESIZE, target_slices=8)
+    dr = dict(tj.job.status.drain)
+    # Served to process 0 while Requested...
+    assert controller.pending_drain("default", "dr") == {
+        "id": dr["id"], "reason": "resize", "targetSlices": 8}
+    clock.advance(5)
+    assert beat(controller, tj, step=100,
+                drainAck={"id": dr["id"], "step": 120})
+    folded = tj.job.status.drain
+    assert folded["state"] == t.DrainState.ACKED
+    assert folded["drainedStep"] == 120
+    # job_drain_seconds measures request -> planned exit: the ACK must
+    # not reset the request stamp.
+    assert folded["time"] == dr["time"]
+    assert "DrainAcked" in event_reasons(cs)
+    # ...and stops riding ACKs once Acked.
+    assert controller.pending_drain("default", "dr") is None
+    # A duplicate ACK (the payload resends until 200'd) is a no-op.
+    assert beat(controller, tj, step=101,
+                drainAck={"id": dr["id"], "step": 130})
+    assert tj.job.status.drain["drainedStep"] == 120
+
+
+def test_stale_attempt_directive_expires_and_ack_is_refused(clock):
+    cs, controller, tj = drain_harness()
+    tj.request_drain(t.DrainReason.RESIZE, target_slices=8)
+    rid = tj.job.status.drain["id"]
+    # A real failure wins the race: the gang the directive addressed dies.
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}})
+    tj.reconcile()
+    assert tj.job.status.attempt == 1
+    # The successor's payload must never adopt the predecessor's drain:
+    # the serve gate refuses it immediately, and the next reconcile
+    # resolves the stranded record to Expired.
+    assert controller.pending_drain("default", "dr") is None
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.EXPIRED
+    # An ACK posted by the dying attempt is dropped by the attempt-age
+    # gate (None = stale); the directive stays resolved.
+    assert controller.record_heartbeat("default", "dr", {
+        "time": training._now(), "step": 99, "attempt": 0, "processId": 0,
+        "drainAck": {"id": rid, "step": 99}}) is None
+    assert tj.job.status.drain["state"] == t.DrainState.EXPIRED
+
+
+def test_suspend_mid_drain_expires_directive(clock):
+    cs, _controller, tj = drain_harness()
+    tj.request_drain(t.DrainReason.MAINTENANCE, "node cordoned")
+    tj.job.spec.suspend = True
+    job = cs.tpujobs.get("default", "dr")
+    job["spec"]["suspend"] = True
+    cs.tpujobs.update("default", job)
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.EXPIRED
+    assert tj.job.status.phase == t.TPUJobPhase.SUSPENDED
+
+
+# --- in-attempt live resize (grow) -------------------------------------------
+
+
+def test_grow_waits_out_debounce_and_resets_on_flap(clock):
+    _cs, controller, tj = drain_harness(
+        drain=t.DrainSpec(deadline_seconds=120, resize_debounce_seconds=30))
+    beat(controller, tj, step=50)
+    controller.scheduler.update_inventory({KEY: 8})
+    tj.reconcile()
+    assert tj.job.status.drain is None  # window just opened
+    assert tj._grow_ready_epoch() == pytest.approx(T0 + 30)
+    assert tj.next_time_obligation() <= T0 + 30
+    clock.advance(29)
+    tj.reconcile()
+    assert tj.job.status.drain is None
+    # Headroom flaps away: the window must restart from scratch.
+    controller.scheduler.update_inventory({KEY: 4})
+    tj.reconcile()
+    assert tj._grow_ready_epoch() is None
+    clock.advance(60)
+    controller.scheduler.update_inventory({KEY: 8})
+    tj.reconcile()
+    assert tj.job.status.drain is None
+    clock.advance(30)
+    tj.reconcile()
+    dr = tj.job.status.drain
+    assert dr["state"] == t.DrainState.REQUESTED
+    assert dr["reason"] == t.DrainReason.RESIZE
+    assert dr["targetSlices"] == 8
+
+
+def test_planned_resize_grows_within_the_job(clock):
+    cs, controller, tj = drain_harness(
+        drain=t.DrainSpec(deadline_seconds=120, resize_debounce_seconds=0),
+        restart_backoff=t.RestartBackoffSpec(base_seconds=300))
+    assert tj.job.status.elastic["slices"] == 4
+    beat(controller, tj, step=100)
+    controller.scheduler.update_inventory({KEY: 8})
+    tj.reconcile()
+    dr = tj.job.status.drain
+    assert dr["state"] == t.DrainState.REQUESTED and dr["targetSlices"] == 8
+    # The gang keeps running while the directive is in flight.
+    assert len(live_pods(cs)) == 4
+    clock.advance(5)
+    beat(controller, tj, step=110, drainAck={"id": dr["id"], "step": 120})
+    assert tj.job.status.drain["state"] == t.DrainState.ACKED
+    clock.advance(40)
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 160}})
+    tj.reconcile()   # planned restart: teardown, attempt bump, no backoff
+    done = tj.job.status.drain
+    assert done["state"] == t.DrainState.COMPLETED
+    assert done["drainedStep"] == 120
+    assert tj.job.status.attempt == 1
+    # Billed planned: zero crash-loop budget, no consecutive-failure
+    # streak, and the 300 s restart backoff is skipped outright.
+    assert tj.job.status.restart_counts == {"planned": 1}
+    assert tj.job.status.consecutive_failures == 0
+    assert not tj.job.status.backoff_until
+    rec = tj.job.status.failures[-1]
+    assert rec.kind == t.FailureKind.PLANNED
+    assert rec.world_slices == 4
+    tj.reconcile()   # re-gang at the renegotiated size
+    el = tj.job.status.elastic
+    assert el["slices"] == 8 and el["lastResizeDirection"] == "up"
+    assert len(live_pods(cs)) == 8
+    envs = pod_env(live_pods(cs)[0])
+    assert envs["JAX_NUM_PROCESSES"] == "8"
+    assert envs["MEGASCALE_NUM_SLICES"] == "8"
+    mark_pods(cs, only_live=True)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    # Observability contract: per-reason planned-restart counter and the
+    # request->exit drain latency histogram.
+    assert controller.metrics.counter_value(
+        "job_planned_restarts_total",
+        labels={**LABELS, "reason": "resize"}) == 1
+    hist = controller.metrics.histogram_snapshot("job_drain_seconds",
+                                                 labels=LABELS)
+    assert hist["count"] == 1
+    assert hist["sum"] == pytest.approx(45.0)
+
+
+def test_drain_deadline_expiry_falls_back_to_hard_teardown(clock):
+    cs, controller, tj = drain_harness(
+        drain=t.DrainSpec(deadline_seconds=60, resize_debounce_seconds=0))
+    controller.scheduler.update_inventory({KEY: 8})
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.REQUESTED
+    # The deadline is an exact-time obligation, not a polling hope.
+    assert tj._drain_deadline_epoch() == pytest.approx(T0 + 60)
+    assert tj.next_time_obligation() <= T0 + 60
+    # Payload never ACKs, never exits. Past the deadline: hard teardown,
+    # billed preemption (operator-initiated infra churn).
+    clock.advance(61)
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.EXPIRED
+    assert "DrainDeadlineExpired" in event_reasons(cs)
+    assert tj.job.status.attempt == 1
+    assert tj.job.status.restart_counts == {"preemption": 1}
+    assert controller.metrics.counter_value(
+        "job_planned_restarts_total",
+        labels={**LABELS, "reason": "resize"}) == 0.0
+    # The job still converges: the restart re-gangs at the wider size.
+    tj.reconcile()
+    assert len(live_pods(cs)) == 8
+    mark_pods(cs, only_live=True)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+
+
+# --- graceful preemption (drain-first eviction) ------------------------------
+
+
+def evict_harness(clock, **kw):
+    """A Running 8-slice victim plus a pending priority-10 preemptor
+    whose admission marked the victim for eviction."""
+    cs, controller, tj = drain_harness(capacity=8, **kw)
+    beat(controller, tj, step=100)
+    assert not controller.scheduler.ensure_admitted(
+        "default/vip", uid="uid-vip", demand=(KEY, 8), priority=10)
+    assert controller.scheduler.peek_eviction("default/dr") is not None
+    return cs, controller, tj
+
+
+def test_eviction_drains_first_then_requeues_planned(clock):
+    cs, controller, tj = evict_harness(clock)
+    tj.reconcile()
+    dr = tj.job.status.drain
+    assert dr["state"] == t.DrainState.REQUESTED
+    assert dr["reason"] == t.DrainReason.PREEMPTION
+    # Drain-first: the gang keeps running (and its reservation holds)
+    # until the verified save lands; the directive is NOT consumed yet.
+    assert len(live_pods(cs)) == 8
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert controller.scheduler.peek_eviction("default/dr") is not None
+    clock.advance(5)
+    beat(controller, tj, step=110, drainAck={"id": dr["id"], "step": 115})
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 160}})
+    tj.reconcile()
+    # Planned exit pops the eviction: reservation released, preemptor
+    # admitted, victim requeued — billed planned, not preemption-hard.
+    assert tj.job.status.phase == t.TPUJobPhase.QUEUED
+    assert controller.scheduler.granted_slices("default/vip") == 8
+    assert tj.job.status.drain["state"] == t.DrainState.COMPLETED
+    assert tj.job.status.failures[-1].kind == t.FailureKind.PLANNED
+    assert controller.metrics.counter_value(
+        "job_planned_restarts_total",
+        labels={**LABELS, "reason": "preemption"}) == 1
+
+
+def test_eviction_skips_drain_when_checkpoint_already_fresh(clock):
+    cs, controller, tj = drain_harness(capacity=8)
+    beat(controller, tj, step=100)
+    # Satellite: nothing new to save — the last uploaded step matches the
+    # last reported step, so a drain round-trip would only delay the
+    # preemptor. Hard-preempt immediately, zero drain operations.
+    tj.job.status.store = {"lastUploadedStep": 100}
+    assert not controller.scheduler.ensure_admitted(
+        "default/vip", uid="uid-vip", demand=(KEY, 8), priority=10)
+    tj.reconcile()
+    assert tj.job.status.phase == t.TPUJobPhase.QUEUED
+    assert tj.job.status.drain is None
+    assert "DrainRequested" not in event_reasons(cs)
+    assert controller.scheduler.granted_slices("default/vip") == 8
+    assert tj.job.status.failures[-1].kind == t.FailureKind.PREEMPTION
+
+
+def test_cancelled_eviction_withdraws_requested_drain(clock):
+    cs, controller, tj = evict_harness(clock)
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.REQUESTED
+    # The preemptor goes away; the fleet's unjustified-eviction sweep
+    # rescinds the mark, and the withdrawal must reach the directive
+    # before the payload adopts it: the gang keeps running undisturbed.
+    controller.scheduler.release("default/vip")
+    assert controller.scheduler.peek_eviction("default/dr") is None
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.EXPIRED
+    assert "DrainCancelled" in event_reasons(cs)
+    assert tj.job.status.phase == t.TPUJobPhase.RUNNING
+    assert tj.job.status.attempt == 0
+    assert len(live_pods(cs)) == 8
+
+
+def test_acked_drain_survives_cancel_and_restarts_in_place(clock):
+    cs, controller, tj = evict_harness(clock)
+    tj.reconcile()
+    dr = tj.job.status.drain
+    beat(controller, tj, step=110, drainAck={"id": dr["id"], "step": 115})
+    assert tj.job.status.drain["state"] == t.DrainState.ACKED
+    # Past withdrawal: the payload's latch is armed, the gang WILL exit
+    # planned. The cancel must leave the directive alone...
+    controller.scheduler.release("default/vip")
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.ACKED
+    # ...and the planned exit then restarts in place (the eviction pop
+    # no-ops), keeping the slot — the cheapest remaining outcome.
+    mark_pods(cs, "Failed", {"terminated": {"exitCode": 160}})
+    tj.reconcile()
+    assert tj.job.status.drain["state"] == t.DrainState.COMPLETED
+    assert tj.job.status.attempt == 1
+    assert tj.job.status.phase != t.TPUJobPhase.QUEUED
+    assert controller.scheduler.granted_slices("default/dr") == 8
+    assert tj.job.status.failures[-1].kind == t.FailureKind.PLANNED
+
+
+# --- node-maintenance drain --------------------------------------------------
+
+
+def test_cordon_edge_triggers_maintenance_drain(clock):
+    cs, controller, tj = drain_harness()
+    for pod in cs.pods.list("default"):
+        pod["spec"]["nodeName"] = "node-0"
+        cs.pods.update("default", pod)
+    controller.listers = SimpleNamespace(pods=SimpleNamespace(
+        list=lambda: cs.pods.list("default")))
+    node = {"metadata": {"name": "node-0"}, "spec": {"unschedulable": True}}
+    controller._maybe_drain_cordoned({"metadata": {"name": "node-0"},
+                                      "spec": {}}, node)
+    assert tj._pending_maintenance == ("node-0", 0)
+    tj.reconcile()
+    dr = tj.job.status.drain
+    assert dr["state"] == t.DrainState.REQUESTED
+    assert dr["reason"] == t.DrainReason.MAINTENANCE
+    # Edge-triggered: a node that STAYS cordoned must not re-drain every
+    # successor forever.
+    controller._maybe_drain_cordoned(node, node)
+    assert tj._pending_maintenance is None
+
+
+def test_stale_maintenance_handoff_is_dropped(clock):
+    _cs, _controller, tj = drain_harness(name="dr2")
+    # The cordon was observed against a gang that no longer exists.
+    tj.request_maintenance_drain("node-0", attempt=7)
+    tj.reconcile()
+    assert tj.job.status.drain is None
+
+
+# --- lifecycle residue -------------------------------------------------------
+
+
+def test_drain_metrics_pruned_with_the_job(clock):
+    cs, controller, _tj = drain_harness()
+    for reason in t.DrainReason.ALL:
+        controller.metrics.inc("job_planned_restarts_total",
+                               labels={**LABELS, "reason": reason})
+    controller.metrics.observe("job_drain_seconds", 12.0, labels=LABELS)
+    cs.tpujobs.delete("default", "dr")
+    controller.sync_tpujob("default/dr")
+    for reason in t.DrainReason.ALL:
+        assert controller.metrics.counter_value(
+            "job_planned_restarts_total",
+            labels={**LABELS, "reason": reason}) == 0.0
+    assert controller.metrics.histogram_snapshot(
+        "job_drain_seconds", labels=LABELS) is None
+
+
+# --- e2e: HTTP directive delivery, strict schema, describe -------------------
+
+
+@pytest.fixture()
+def harness():
+    api = ApiServerHarness().start()
+    cs = Clientset(RestConfig(host=api.url, timeout=5.0))
+    controller = Controller(cs, SharedInformerFactory(cs, "default",
+                                                      resync_period=0),
+                            heartbeat_persist_interval=0.0)
+    server = StatusServer(0, metrics=controller.metrics)
+    server.start()
+    server.set_controller(controller)
+    stop = threading.Event()
+    th = threading.Thread(target=controller.run, args=(1, stop), daemon=True)
+    th.start()
+    try:
+        yield api, cs, controller, server
+    finally:
+        stop.set()
+        th.join(timeout=5)
+        server.stop()
+        api.stop()
+
+
+def _reporter(server, pid):
+    return heartbeat_mod.from_env({
+        "TPUJOB_STATUS_URL": f"http://127.0.0.1:{server.port}",
+        "TPUJOB_NAME": "drjob", "TPUJOB_NAMESPACE": "default",
+        "JAX_PROCESS_ID": str(pid), "TPUJOB_ATTEMPT": "0",
+    }, tokens_per_batch=64)
+
+
+def test_e2e_drain_directive_http_round_trip(harness):
+    api, cs, controller, server = harness
+    job = elastic_job("drjob", replicas=2, num_slices=2, min_slices=1)
+    cs.tpujobs.create("default", job.to_dict())
+    assert wait_for(lambda: len(api.clientset.pods.list("default")) >= 2)
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", "drjob")
+                    .get("status", {}).get("phase") == "Running")
+
+    # Controller stamps the directive (the cordon handoff path) and the
+    # strict status-subresource schema admits status.drain.
+    tj = controller.jobs["default/drjob"]
+    tj.request_maintenance_drain("node-0", tj.job.status.attempt)
+    controller.queue.add("default/drjob")
+    assert wait_for(lambda: (cs.tpujobs.get("default", "drjob")
+                             .get("status", {}).get("drain")
+                             or {}).get("state") == "Requested")
+    rid = cs.tpujobs.get("default", "drjob")["status"]["drain"]["id"]
+
+    # The directive rides process 0's heartbeat ACK...
+    reporter = _reporter(server, 0)
+    assert reporter.report(5, {"loss": 2.0})
+    directive = reporter.take_drain_directive()
+    assert directive is not None
+    assert directive["id"] == rid
+    assert directive["reason"] == "maintenance"
+    # ...one-shot per id...
+    assert reporter.take_drain_directive() is None
+    # ...and never to a non-zero process.
+    cadence = _reporter(server, 1)
+    assert cadence.report(5, None)
+    assert cadence.take_drain_directive() is None
+
+    # The payload's adoption ACK folds Requested -> Acked with the
+    # gang-agreed boundary step.
+    reporter.attach_drain_ack({"id": directive["id"], "step": 42})
+    assert reporter.report(6, {"loss": 1.9})
+    assert wait_for(lambda: (cs.tpujobs.get("default", "drjob")
+                             .get("status", {}).get("drain")
+                             or {}).get("state") == "Acked")
+    assert cs.tpujobs.get("default", "drjob")["status"]["drain"][
+        "drainedStep"] == 42
+
+    # Verified save done: every process exits EXIT_PLANNED (160).
+    for pod in api.clientset.pods.list("default"):
+        pod["status"] = {"phase": "Failed", "containerStatuses": [
+            {"name": "tpu", "state": {"terminated": {"exitCode": 160}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: (cs.tpujobs.get("default", "drjob")
+                             .get("status", {})).get("attempt") == 1)
+    status = cs.tpujobs.get("default", "drjob")["status"]
+    assert status["drain"]["state"] == "Completed"
+    assert status["restartCounts"] == {"planned": 1}
+    assert not status.get("consecutiveFailures")
+
+    # The re-ganged attempt converges back to Running.
+    assert wait_for(lambda: len([
+        p for p in api.clientset.pods.list("default")
+        if (p.get("status") or {}).get("phase") not in
+        ("Failed", "Succeeded")]) >= 2)
+    for pod in api.clientset.pods.list("default"):
+        if (pod.get("status") or {}).get("phase") in ("Failed", "Succeeded"):
+            continue
+        pod["status"] = {"phase": "Running", "containerStatuses": [
+            {"name": "tpu", "state": {"running": {}}}]}
+        api.clientset.pods.update("default", pod)
+    assert wait_for(lambda: cs.tpujobs.get("default", "drjob")
+                    .get("status", {}).get("phase") == "Running")
+
+    # tpujobctl describe surfaces the resolved directive.
+    out = io.StringIO()
+    with contextlib.redirect_stdout(out):
+        assert ctl.main(["--master", api.url, "describe", "drjob"]) == 0
+    text = out.getvalue()
+    assert "Drain:" in text
+    assert "Completed — maintenance" in text
+    assert "drained at step 42" in text
+
+    # The planned restart landed in the registry under its reason label.
+    assert controller.metrics.counter_value(
+        "job_planned_restarts_total",
+        labels={"namespace": "default", "name": "drjob",
+                "reason": "maintenance"}) == 1

@@ -345,6 +345,78 @@ def soak_job():
     }
 
 
+def test_storm_coop_drain_deadline_expiry_hard_kills_and_reaches_done():
+    """Seeded storm wave for the cooperative-drain backstop: a
+    ``coop_drain`` wave stamps a maintenance drain against a Running
+    fake-cluster gang whose pods never speak the drain protocol (no ACK,
+    no planned exit). The 1 s ``spec.drain.deadlineSeconds`` expires via
+    the DeadlineManager wakeup, the gang is hard-killed exactly like the
+    pre-drain behavior (billed preemption), and the re-ganged attempt
+    still runs to Done — a wedged payload degrades, never hangs."""
+    backing = FakeClientset()
+    metrics = Metrics()
+    factory = SharedInformerFactory(backing, "default", resync_period=1.0)
+    controller = Controller(
+        backing, factory, namespace="default", metrics=metrics,
+        queue=RateLimitingQueue(base_delay=0.1, max_delay=0.5))
+    stop = threading.Event()
+    runner = threading.Thread(target=controller.run, args=(2, stop),
+                              daemon=True)
+    runner.start()
+
+    nodes = tuple(make_nodes(2, slices=2))
+    cluster = FakeCluster(backing, nodes=nodes,
+                          profile=KubeletProfile(create_latency=0.02,
+                                                 run_seconds=3.0))
+    cluster.start()
+
+    job = soak_job()
+    job["metadata"]["name"] = "cdr"
+    job["spec"]["drain"] = {"deadlineSeconds": 1}
+
+    def request_drain():
+        tj = controller.jobs.get("default/cdr")
+        if tj is not None:
+            tj.request_maintenance_drain("node-0",
+                                         tj.job.status.attempt)
+            controller.queue.add("default/cdr")
+
+    storm = StormController(cluster, seed=99,
+                            waves=((0.1, "coop_drain", {}),),
+                            drain_request=request_drain)
+
+    def job_status():
+        try:
+            return backing.tpujobs.get("default", "cdr").get("status") or {}
+        except Exception:  # noqa: BLE001 — racing creation
+            return {}
+
+    try:
+        backing.tpujobs.create("default", job)
+        assert wait_for(lambda: job_status().get("phase") == "Running",
+                        timeout=15.0)
+        storm.run()
+        assert storm.stats.get("coop_drains") == 1
+        # The directive lands, the payload never reacts, the deadline
+        # hard-kills: attempt bumps with a preemption-kind record.
+        assert wait_for(lambda: job_status().get("attempt", 0) >= 1,
+                        timeout=15.0), job_status()
+        assert wait_for(lambda: job_status().get("phase") == "Done",
+                        timeout=30.0), job_status()
+        status = job_status()
+        assert status["state"] == "Succeeded"
+        assert (status.get("drain") or {}).get("state") == "Expired"
+        kinds = [f["kind"] for f in status.get("failures") or []]
+        assert kinds and set(kinds) == {"preemption"}, status.get("failures")
+        reasons = [e.get("reason") for e in backing.events.list("default")]
+        assert "DrainRequested" in reasons
+        assert "DrainDeadlineExpired" in reasons
+    finally:
+        stop.set()
+        cluster.stop()
+        runner.join(timeout=10.0)
+
+
 def test_chaos_composition_checkpointed_job_survives_storm():
     """FlakyClientset (10% injected 429/500s) × pod-kill storm × blob
     fault hook, all live at once over a small fake cluster: the

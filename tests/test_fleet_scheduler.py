@@ -387,6 +387,32 @@ def test_preemption_victim_selection():
     assert s.pop_eviction("default/urgent") is None
 
 
+def test_preemption_prefers_shrunk_victims_over_full_width():
+    """Within a priority band, a gang running SHRUNK (admitted below its
+    preferred size) is evicted before a full-width one — it is degraded
+    already and its restart is billed to the infra budget either way —
+    even when the full-width gang is the newer admission (the old
+    newest-first rule would have picked it)."""
+    s, _wakes = sched(capacity=3)
+    # Elastic job granted 3 of its preferred 6: runs shrunk.
+    assert s.ensure_admitted("default/sh", uid="uid-sh", demand=(KEY, 6),
+                             min_slices=2)
+    assert s.granted_slices("default/sh") == 3
+    # Capacity returns (admitted sizes only change at attempt
+    # boundaries, so sh stays shrunk) and a NEWER rigid full-width job
+    # takes the freed slices.
+    s.update_inventory({KEY: 6})
+    assert offer(s, "full", slices=3)
+    # Urgent arrival needing 3: the shrunk gang is the victim, not the
+    # newest admission.
+    assert not offer(s, "urgent", priority=10, slices=3)
+    assert s.peek_eviction("default/full") is None
+    reason = s.pop_eviction("default/sh")
+    assert reason and "default/urgent" in reason
+    assert s.is_admitted("default/urgent")
+    assert s.is_admitted("default/full")
+
+
 def test_unfittable_head_blocks_only_its_own_shape():
     """A full v4 pool must not park v5e jobs whose own pool is free: the
     head-of-line block is per slice shape, not global."""

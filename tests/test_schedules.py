@@ -382,3 +382,97 @@ def test_schedule_writebehind_seeded_no_lost_accepted_steps():
         sched_.add("closer", close_and_check)
 
     schedules.run_seeds(build, seeds=range(8), step_timeout=0.75)
+
+
+# --- triple 5: drain ACK vs real-failure restart ------------------------------
+
+def test_schedule_drain_ack_vs_attempt_bump():
+    """Every serialization of: process 0's drainAck beat racing a real
+    gang failure (exit 137) and the two reconciles that restart and
+    resolve. In no schedule may the restarted attempt inherit the
+    predecessor's directive (the serve gate returns None), be billed
+    planned off a hard death, or leave a non-terminal directive
+    addressed to the live gang — an ACK from a restarted attempt is a
+    pure no-op."""
+    from tests.test_drain import drain_harness
+    from tpu_operator.trainer import training as training_mod
+
+    state = {}
+
+    def scenario():
+        cs, controller, tj = drain_harness(name="race")
+        tj.request_drain(t.DrainReason.RESIZE, target_slices=8)
+        rid = tj.job.status.drain["id"]
+        state.update(controller=controller, tj=tj)
+
+        def ack():
+            controller.record_heartbeat("default", "race", {
+                "time": training_mod._now(), "step": 100, "attempt": 0,
+                "processId": 0, "drainAck": {"id": rid, "step": 100}})
+
+        def fail():
+            mark_pods(cs, "Failed", {"terminated": {"exitCode": 137}})
+
+        return [[ack], [fail, tj.reconcile, tj.reconcile]]
+
+    def check(order):
+        controller, tj = state["controller"], state["tj"]
+        status = tj.job.status
+        assert status.attempt == 1, order
+        # Hard death is billed preemption — the raced directive must not
+        # launder a 137 into a planned restart.
+        assert status.restart_counts == {"preemption": 1}, order
+        dr = status.drain
+        assert not (dr and dr["state"] in (t.DrainState.REQUESTED,
+                                           t.DrainState.ACKED)
+                    and dr["attempt"] == status.attempt), order
+        assert controller.pending_drain("default", "race") is None, order
+
+    n = schedules.exhaustive(scenario, check)
+    assert n == 4  # merges of 1+3
+
+
+# --- triple 6: drain completion vs eviction cancel ----------------------------
+
+def test_schedule_drain_completion_vs_eviction_cancel():
+    """Every serialization of: the drained victim's planned exit (+ the
+    reconcile that classifies it) racing the fleet's unjustified-
+    eviction cancel (the preemptor released). Whichever wins, the
+    restart is billed planned exactly once, the directive resolves
+    terminally, no eviction mark is left behind, and the inventory
+    ledger still equals the sum of admitted grants."""
+    from tests.test_drain import beat, drain_harness
+
+    state = {}
+
+    def scenario():
+        cs, controller, tj = drain_harness(name="dr", capacity=8)
+        beat(controller, tj, step=100)
+        assert not controller.scheduler.ensure_admitted(
+            "default/vip", uid="uid-vip", demand=(KEY, 8), priority=10)
+        tj.reconcile()
+        assert tj.job.status.drain["reason"] == t.DrainReason.PREEMPTION
+        state.update(controller=controller, tj=tj)
+
+        def planned_exit():
+            mark_pods(cs, "Failed", {"terminated": {"exitCode": 160}})
+
+        def cancel():
+            controller.scheduler.release("default/vip")
+
+        return [[planned_exit, tj.reconcile], [cancel]]
+
+    def check(order):
+        controller, tj = state["controller"], state["tj"]
+        s = controller.scheduler
+        assert tj.job.status.restart_counts == {"planned": 1}, order
+        assert tj.job.status.drain["state"] in (
+            t.DrainState.COMPLETED, t.DrainState.EXPIRED), order
+        assert s.peek_eviction("default/dr") is None, order
+        snap = s.summary()
+        used = snap["inventory"][KEY]["used"]
+        booked = sum(e.slices for e in s._admitted.values())
+        assert used == booked, (order, snap)
+
+    n = schedules.exhaustive(scenario, check)
+    assert n == 3  # merges of 2+1

@@ -16,6 +16,8 @@ from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_AUTOTUNE_MIN_DEPTH,
     DEFAULT_AUTOTUNE_WINDOW_STEPS,
     DEFAULT_CACHE_PATH,
+    DEFAULT_DRAIN_DEADLINE_SECONDS,
+    DEFAULT_RESIZE_DEBOUNCE_SECONDS,
     DEFAULT_SCHEDULING_QUEUE,
     DEFAULT_STEPTRACE_BUFFER,
     DEFAULT_STRAGGLER_RATIO,
@@ -48,6 +50,14 @@ assert DEFAULT_STEPTRACE_BUFFER >= 8 and DEFAULT_STRAGGLER_RATIO >= 1.0
 # (minDepth > maxDepth, tiny windowSteps) reaches validation.py loudly.
 assert 0 < DEFAULT_AUTOTUNE_MIN_DEPTH <= DEFAULT_AUTOTUNE_MAX_DEPTH
 assert DEFAULT_AUTOTUNE_WINDOW_STEPS >= 8
+
+# Cooperative drain (``drain``): same discipline — the block stays
+# optional (None = the defaults; the protocol is always available),
+# DrainSpec.from_dict fills absent fields, and an explicitly written
+# zero/negative deadlineSeconds reaches validation.py loudly. The pin
+# keeps the shipped defaults inside validation's own bounds.
+assert DEFAULT_DRAIN_DEADLINE_SECONDS >= 1
+assert DEFAULT_RESIZE_DEBOUNCE_SECONDS >= 0
 
 
 def set_defaults(spec: TPUJobSpec) -> TPUJobSpec:

@@ -191,6 +191,13 @@ def spec_schema() -> Dict[str, Any]:
             "stragglerPolicy": _str(enum=list(types.StragglerPolicy.ALL)),
             "stragglerPatienceSeconds": _int(minimum=1),
         }),
+        # Cooperative drain protocol: per-directive deadline before the
+        # hard-teardown fallback, and the in-attempt grow-trigger
+        # debounce window.
+        "drain": _obj({
+            "deadlineSeconds": _int(minimum=1),
+            "resizeDebounceSeconds": _int(minimum=0),
+        }),
     }, required=["replicaSpecs"])
 
 
@@ -378,6 +385,12 @@ def status_schema() -> Dict[str, Any]:
                 "capturedSteps": _int(minimum=0),
                 "artifactKey": _str(),
             }),
+            # Cooperative-drain ACK (process 0, one-shot until the
+            # controller folds status.drain to Acked).
+            "drainAck": _obj({
+                "id": _str(),
+                "step": _int(minimum=0),
+            }),
         }),
         # Checkpoint durability roll-up: the last VERIFIED (durable) step,
         # lifetime save-failure / restore-fallback totals, and the
@@ -467,6 +480,21 @@ def status_schema() -> Dict[str, Any]:
             "capturedSteps": _int(minimum=0),
             "artifactKey": _str(),
             "attempt": _int(minimum=0),
+            "time": _str(),
+        }),
+        # Cooperative-drain directive lifecycle: Requested when the
+        # controller stamps a drain (resize / preemption / maintenance),
+        # Acked when process 0's drainAck folds back in, Completed when
+        # the payload's planned exit is classified, Expired when the
+        # deadline fell back to hard teardown.
+        "drain": _obj({
+            "id": _str(),
+            "state": _str(enum=list(types.DrainState.ALL)),
+            "reason": _str(enum=list(types.DrainReason.ALL)),
+            "attempt": _int(minimum=0),
+            "deadline": _str(),
+            "targetSlices": _int(minimum=1),
+            "drainedStep": _int(minimum=0),
             "time": _str(),
         }),
         # Fleet-scheduling state: effective queue/priority, and — while

@@ -140,12 +140,20 @@ class FailureKind:
     APPLICATION = "application"
     STALL = "stall"
     DEADLINE = "deadline"
+    # Operator-initiated cooperative restart (drain directive → verified
+    # save → EXIT_PLANNED): billed like preemption (the operator chose the
+    # restart, the payload did nothing wrong) and NEVER counted toward the
+    # crash-loop backoff streak — a planned resize must not slow the very
+    # re-gang it exists to perform.
+    PLANNED = "planned"
 
-    ALL = (PREEMPTION, APPLICATION, STALL, DEADLINE)
+    ALL = (PREEMPTION, APPLICATION, STALL, DEADLINE, PLANNED)
 
 
 # Preemption-kind restarts get this multiple of spec.maxRestarts as their
 # own budget (application/stall restarts use spec.maxRestarts directly).
+# PLANNED restarts share this factor: both are operator/environment
+# initiated, not payload crashes.
 PREEMPTION_BUDGET_FACTOR = 4
 
 
@@ -188,6 +196,43 @@ FAILURE_LEDGER_CAP = 32
 # 0; the capture result folds back to Captured. Lives HERE (not in the
 # trainer) because both the reconciler and the CLI speak it.
 PROFILE_ANNOTATION = "tpu-operator.dev/profile-request"
+
+# --- Cooperative drain protocol ----------------------------------------------
+# status.drain lifecycle states: the controller stamps Requested, the
+# directive rides process 0's heartbeat ACK until the payload's drainAck
+# folds it to Acked, and the payload's verified-save-then-EXIT_PLANNED
+# completes it. A deadline (armed through the DeadlineManager) expires a
+# drain whose payload never ACKs or never exits — the fallback is
+# exactly today's hard teardown, so a wedged payload degrades, never
+# hangs.
+class DrainState:
+    REQUESTED = "Requested"
+    ACKED = "Acked"
+    COMPLETED = "Completed"
+    EXPIRED = "Expired"
+
+    ALL = (REQUESTED, ACKED, COMPLETED, EXPIRED)
+
+
+# Why a drain was requested — recorded in status.drain and the
+# job_planned_restarts_total{reason} metric label.
+class DrainReason:
+    RESIZE = "resize"
+    PREEMPTION = "preemption"
+    MAINTENANCE = "maintenance"
+
+    ALL = (RESIZE, PREEMPTION, MAINTENANCE)
+
+
+# Seconds a drain directive has to reach Completed before the deadline
+# falls back to hard teardown (spec.drain.deadlineSeconds overrides).
+DEFAULT_DRAIN_DEADLINE_SECONDS = 120
+
+# Seconds the in-attempt grow trigger must observe sustained inventory
+# headroom before draining for a live resize — a capacity flap inside
+# this window must not cost a restart cycle
+# (spec.drain.resizeDebounceSeconds overrides; 0 = immediate).
+DEFAULT_RESIZE_DEBOUNCE_SECONDS = 30
 
 # Restart backoff defaults (exponential, per group restart): base doubles
 # each attempt, capped. Mirrors the workqueue's 10 s base and K8s Job's
@@ -645,6 +690,41 @@ class SchedulingSpec:
 
 
 @dataclass
+class DrainSpec:
+    """Cooperative-drain knobs (``spec.drain``).
+
+    ``deadlineSeconds`` bounds every drain directive: a payload that
+    neither ACKs nor exits within it is hard-killed exactly like the
+    pre-drain behavior (the protocol can only *improve* on hard
+    teardown, never hang behind it). ``resizeDebounceSeconds`` gates the
+    in-attempt grow trigger: inventory headroom must hold continuously
+    for this long before a Running elastic gang is drained to re-gang
+    larger — a node flap must not cost a restart cycle. Absent block =
+    the defaults; the protocol itself is always on.
+    """
+
+    deadline_seconds: int = DEFAULT_DRAIN_DEADLINE_SECONDS
+    resize_debounce_seconds: int = DEFAULT_RESIZE_DEBOUNCE_SECONDS
+
+    def to_dict(self) -> Dict[str, Any]:
+        return {"deadlineSeconds": self.deadline_seconds,
+                "resizeDebounceSeconds": self.resize_debounce_seconds}
+
+    @classmethod
+    def from_dict(cls, d: Optional[Dict[str, Any]]
+                  ) -> Optional["DrainSpec"]:
+        if d is None:
+            return None
+        return cls(
+            deadline_seconds=int(d.get("deadlineSeconds",
+                                       DEFAULT_DRAIN_DEADLINE_SECONDS)),
+            resize_debounce_seconds=int(
+                d.get("resizeDebounceSeconds",
+                      DEFAULT_RESIZE_DEBOUNCE_SECONDS)),
+        )
+
+
+@dataclass
 class ElasticSpec:
     """Elastic gang sizing (``spec.elastic``).
 
@@ -870,6 +950,10 @@ class TPUJobSpec:
     # replaced or shed per stragglerPolicy (None = rigid sizing, the
     # pre-elastic behavior).
     elastic: Optional[ElasticSpec] = None
+    # Cooperative drain protocol knobs: per-directive deadline and the
+    # in-attempt grow-trigger debounce (None = the defaults; the
+    # protocol itself is always available).
+    drain: Optional[DrainSpec] = None
     # Job mode: "" / "train" = the classic finite training job; "serve" =
     # long-lived inference gang (readiness-gated Services, hot weight
     # reload from the remote store, traffic-driven replica scaling).
@@ -921,6 +1005,8 @@ class TPUJobSpec:
             d["dataPlane"] = self.data_plane.to_dict()
         if self.elastic is not None:
             d["elastic"] = self.elastic.to_dict()
+        if self.drain is not None:
+            d["drain"] = self.drain.to_dict()
         if self.mode:
             d["mode"] = self.mode
         if self.serving is not None:
@@ -956,6 +1042,7 @@ class TPUJobSpec:
             step_trace=StepTraceSpec.from_dict(d.get("stepTrace")),
             data_plane=DataPlaneSpec.from_dict(d.get("dataPlane")),
             elastic=ElasticSpec.from_dict(d.get("elastic")),
+            drain=DrainSpec.from_dict(d.get("drain")),
             mode=str(d.get("mode", "")),
             serving=ServingSpec.from_dict(d.get("serving")),
         )
@@ -1129,6 +1216,13 @@ class TPUJobStatus:
     # result folds back in. One directive at a time; a new request
     # overwrites a Captured record.
     profile: Optional[Dict[str, Any]] = None
+    # Cooperative-drain state, written by the controller: {id, state
+    # (Requested → Acked → Completed | Expired), reason (resize |
+    # preemption | maintenance), attempt, deadline (RFC3339), time},
+    # plus targetSlices for a resize drain and drainedStep once the
+    # payload's planned exit is classified. One directive at a time; a
+    # new request overwrites a terminal (Completed/Expired) record.
+    drain: Optional[Dict[str, Any]] = None
     # Fleet-scheduling state, written by the controller: the effective
     # {queue, priority} the admission queue used and — while phase is
     # Queued — the job's ``position`` in admission order (0 = next).
@@ -1186,6 +1280,8 @@ class TPUJobStatus:
             d["serving"] = dict(self.serving)
         if self.profile:
             d["profile"] = dict(self.profile)
+        if self.drain:
+            d["drain"] = dict(self.drain)
         if self.scheduling:
             d["scheduling"] = dict(self.scheduling)
         if self.last_transition_time:
@@ -1230,6 +1326,7 @@ class TPUJobStatus:
             elastic=(dict(d["elastic"]) if d.get("elastic") else None),
             serving=(dict(d["serving"]) if d.get("serving") else None),
             profile=(dict(d["profile"]) if d.get("profile") else None),
+            drain=(dict(d["drain"]) if d.get("drain") else None),
             scheduling=(dict(d["scheduling"])
                         if d.get("scheduling") else None),
             last_transition_time=str(d.get("lastTransitionTime", "")),

@@ -364,6 +364,22 @@ def validate_tpujob_spec(spec: TPUJobSpec) -> None:
                 f"({spec.num_slices}) so a resized gang scales evenly"
             )
 
+    # Cooperative drain: the deadline must be a usable window (>= 1 s —
+    # zero would expire every directive before the first heartbeat ACK
+    # could even carry it), the debounce merely non-negative (0 =
+    # immediate grow, a legitimate choice for stable inventories).
+    dr = spec.drain
+    if dr is not None:
+        if dr.deadline_seconds < 1:
+            raise ValidationError(
+                "drain.deadlineSeconds must be >= 1 (a zero deadline "
+                "expires every directive before the payload can ACK it)"
+            )
+        if dr.resize_debounce_seconds < 0:
+            raise ValidationError(
+                "drain.resizeDebounceSeconds must be >= 0"
+            )
+
     # Warm-restart compilation cache (validated only when enabled: a
     # disabled block is inert, whatever its other fields say).
     cache = spec.compilation_cache

@@ -273,6 +273,20 @@ def cmd_describe(cs, opts) -> int:
         last = preemptions[-1]
         print(f"Preempted:  attempt {last.get('attempt', 0)}: "
               f"{last.get('reason', '')} ({last.get('time', '')})")
+    # Cooperative drain: the in-flight directive (with its hard-teardown
+    # deadline) or the last resolved one (with the step it drained at).
+    dr = status.get("drain") or {}
+    if dr:
+        line = (f"Drain:      {dr.get('state', '?')} — "
+                f"{dr.get('reason', '?')} (id {dr.get('id', '?')}, "
+                f"attempt {dr.get('attempt', '?')})")
+        if dr.get("targetSlices") is not None:
+            line += f", target {dr['targetSlices']} slice(s)"
+        if dr.get("drainedStep") is not None:
+            line += f", drained at step {dr['drainedStep']}"
+        if dr.get("state") in ("Requested", "Acked") and dr.get("deadline"):
+            line += f", hard teardown at {dr['deadline']}"
+        print(line)
     if status.get("backoffUntil"):
         print(f"Backoff:    re-gang parked until {status['backoffUntil']}")
     ck = status.get("checkpoint") or {}

@@ -34,6 +34,8 @@ from typing import Any, Callable, Dict, List, Optional
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
     DEFAULT_STRAGGLER_RATIO,
+    DrainReason,
+    DrainState,
     LABEL_GROUP_KEY,
     TPUJob,
     TPUJobPhase,
@@ -256,7 +258,7 @@ class Controller:
                                                             namespace="")
             self._node_informer.add_event_handler(
                 on_add=lambda _obj: self._refresh_node_inventory(),
-                on_update=lambda _old, _new: self._refresh_node_inventory(),
+                on_update=self._on_node_update,
                 on_delete=lambda _obj: self._refresh_node_inventory(),
             )
 
@@ -421,6 +423,54 @@ class Controller:
             # lock and wakes reconciles — never nested under ours.
             self.scheduler.update_inventory(apply_now)
 
+    def _on_node_update(self, old: Optional[Dict[str, Any]],
+                        new: Dict[str, Any]) -> None:
+        self._refresh_node_inventory()
+        self._maybe_drain_cordoned(old, new)
+
+    def _maybe_drain_cordoned(self, old: Optional[Dict[str, Any]],
+                              new: Dict[str, Any]) -> None:
+        """Node-maintenance drain trigger: a node whose spec just flipped
+        to unschedulable (kubectl cordon — the first step of every drain)
+        is about to lose its pods, so every TPUJob gang with a live pod
+        bound to it is asked to cooperatively drain (verified save +
+        planned exit) BEFORE the kubelet evictions start. Maintenance
+        then costs one checkpoint interval, not an uncheckpointed crash;
+        a payload that never reacts hits the drain deadline and is torn
+        down exactly as it would have been without this hook. Edge-
+        triggered on the False→True flip: a node that STAYS cordoned
+        must not re-drain every re-ganged successor forever."""
+        if not isinstance(new, dict):
+            return
+        was = bool(((old or {}).get("spec") or {}).get("unschedulable"))
+        cordoned = bool((new.get("spec") or {}).get("unschedulable"))
+        if was or not cordoned:
+            return
+        node = str((new.get("metadata") or {}).get("name") or "")
+        if not node:
+            return
+        targets: Dict[str, Any] = {}
+        with self._jobs_lock:
+            for pod in self.listers.pods.list():
+                if (pod.get("spec") or {}).get("nodeName") != node:
+                    continue
+                if not live_pod(pod):
+                    continue
+                md = pod.get("metadata") or {}
+                for ref in md.get("ownerReferences") or []:
+                    if ref.get("kind") != "TPUJob" \
+                            or not ref.get("controller"):
+                        continue
+                    key = f"{md.get('namespace', 'default')}/{ref.get('name')}"
+                    tj = self.jobs.get(key)
+                    if tj is not None and key not in targets:
+                        targets[key] = (tj, tj.job.status.attempt)
+        for key, (tj, attempt) in targets.items():
+            tj.request_maintenance_drain(node, attempt)
+            self.queue.add(key)
+            log.info("drain: node %s cordoned; requesting maintenance "
+                     "drain of %s (attempt %d)", node, key, attempt)
+
     def _flush_node_inventory(self) -> None:
         """Debounce expiry: the shrink survived the window, so apply the
         capacity model exactly as the live node cache states it now (the
@@ -522,9 +572,17 @@ class Controller:
                            "store_prefetch_misses_total",
                            "job_serving_replicas_ready",
                            "job_serving_requests_per_second",
-                           "job_weight_reloads_total"):
+                           "job_weight_reloads_total",
+                           "job_drain_seconds"):
                 self.metrics.remove_series(
                     series, labels={"namespace": namespace, "name": name})
+            # The planned-restart counter carries the drain reason on top
+            # of the job identity: drop every combination.
+            for reason in DrainReason.ALL:
+                self.metrics.remove_series(
+                    "job_planned_restarts_total",
+                    labels={"namespace": namespace, "name": name,
+                            "reason": reason})
             # The serving latency gauge carries a quantile label on top of
             # the job identity: drop every combination.
             for quantile in ("0.5", "0.95"):
@@ -641,6 +699,7 @@ class Controller:
         new_t = parse_rfc3339(str(heartbeat.get("time", ""))) or 0.0
         straggler_events: list = []
         profile_events: list = []
+        drain_events: list = []
         with self._jobs_lock:
             tj = self.jobs.get(key)
             if tj is None:
@@ -688,13 +747,18 @@ class Controller:
                                                  hb_attempt)
                 profile_changed = self._apply_profile_heartbeat(
                     tj, heartbeat, hb_attempt, profile_events)
+                drain_changed = self._apply_drain_heartbeat(
+                    tj, heartbeat, hb_attempt, drain_events)
                 persist = self._fold_heartbeat_locked(
                     key, tj, namespace, name, heartbeat, hb_attempt, new_t
-                ) or straggler_changed or serving_changed or profile_changed
+                ) or straggler_changed or serving_changed \
+                    or profile_changed or drain_changed
         for message in straggler_events:
             self.recorder.event(tj, "Warning", "StragglerDetected", message)
         for message in profile_events:
             self.recorder.event(tj, "Normal", "ProfileCaptured", message)
+        for message in drain_events:
+            self.recorder.event(tj, "Normal", "DrainAcked", message)
         if persist:
             self.queue.add(key)
         return True
@@ -756,6 +820,7 @@ class Controller:
         persist = (prev is None
                    or prev.get("attempt") != heartbeat.get("attempt")
                    or "startup" in heartbeat
+                   or "drainAck" in heartbeat
                    or last is None
                    or new_t - last >= self.heartbeat_persist_interval)
         if persist:
@@ -816,6 +881,73 @@ class Controller:
             f"profile {rid}: captured {new['capturedSteps']} raw step "
             f"lap(s)" + (f" -> {new['artifactKey']}"
                          if new.get("artifactKey") else ""))
+        return True
+
+    def pending_drain(self, namespace: str, name: str
+                      ) -> Optional[Dict[str, Any]]:
+        """The cooperative-drain directive to ride process 0's next
+        heartbeat ACK: ``{"id", "reason"[, "targetSlices"]}`` while
+        ``status.drain`` sits in state Requested for the CURRENT attempt,
+        None otherwise. Resent on every beat until the payload's drainAck
+        folds the state to Acked (the payload dedups by id); a directive
+        whose attempt already restarted — a real failure won the race —
+        is never handed to the NEW attempt's payload, the reconcile
+        resolves the stale record instead."""
+        with self._jobs_lock:
+            tj = self.jobs.get(f"{namespace}/{name}")
+            if tj is None:
+                return None
+            dr = tj.job.status.drain
+            if not dr or dr.get("state") != DrainState.REQUESTED:
+                return None
+            if int(dr.get("attempt", -1)) != int(tj.job.status.attempt):
+                return None
+            directive: Dict[str, Any] = {
+                "id": str(dr.get("id", "")),
+                "reason": str(dr.get("reason", "")),
+            }
+            if dr.get("targetSlices"):
+                directive["targetSlices"] = int(dr["targetSlices"])
+            return directive
+
+    def _apply_drain_heartbeat(self, tj: TrainingJob,
+                               heartbeat: Dict[str, Any],
+                               hb_attempt: Optional[int],
+                               events: list) -> bool:
+        """Fold process 0's drain adoption ACK into ``status.drain``
+        (called under _jobs_lock): Requested -> Acked, stamping the
+        boundary step the gang agreed to drain at. The ACK is a one-shot
+        the payload resends until 200'd, so a duplicate — or an ACK for
+        a directive this status no longer tracks (overwritten, or the
+        attempt already restarted: the satellite race) — is a no-op that
+        still clears the payload's one-shot via the 200."""
+        da = heartbeat.get("drainAck")
+        if not isinstance(da, dict) or not da.get("id"):
+            return False
+        rid = str(da["id"])
+        cur = tj.job.status.drain or {}
+        if cur.get("id") != rid or cur.get("state") != DrainState.REQUESTED:
+            return False
+        gen = hb_attempt if hb_attempt is not None else tj.job.status.attempt
+        if int(cur.get("attempt", -1)) != int(gen):
+            # The gang restarted between the directive and this ACK (the
+            # heartbeat attempt-age gate only drops OLDER beats): the new
+            # attempt must not adopt a drain aimed at its predecessor.
+            return False
+        new = dict(cur)
+        new["state"] = DrainState.ACKED
+        try:
+            step = int(da.get("step") or 0)
+        except (TypeError, ValueError):
+            step = 0
+        if step > 0:
+            new["drainedStep"] = step
+        # ``time`` keeps the REQUEST stamp: job_drain_seconds is measured
+        # request -> planned exit, and the ACK is the middle of that span.
+        tj.job.status.drain = new
+        events.append(
+            f"drain {rid} ({cur.get('reason', '')}): payload adopted, "
+            f"exiting at step boundary {step}")
         return True
 
     def _apply_checkpoint_heartbeat(self, tj: TrainingJob, namespace: str,

@@ -86,6 +86,11 @@ ADMISSION_BUCKETS = (0.1, 0.5, 1.0, 5.0, 15.0, 60.0, 300.0, 1800.0,
 # collective) — log-spaced across five decades.
 STEP_PHASE_BUCKETS = (0.0001, 0.001, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
                       10.0, 30.0)
+# Cooperative-drain latency: directive stamped → planned exit classified.
+# Sub-second when the gang is at a step boundary with a fresh save, up to
+# the drain deadline (default 120 s) plus teardown when the save is slow;
+# the tail past 300 s is the hard-kill fallback territory.
+DRAIN_BUCKETS = (1.0, 5.0, 15.0, 30.0, 60.0, 120.0, 300.0, 600.0)
 
 LabelsT = Optional[Dict[str, str]]
 
@@ -320,6 +325,18 @@ class Metrics:
                       "into the same rendezvous avoiding its node; shed: "
                       "whole-group restart at one slice fewer, billed to "
                       "the preemption budget).")
+        self.register("job_planned_restarts_total", "counter",
+                      "Operator-initiated cooperative-drain restarts "
+                      "completed, by reason (resize: in-attempt grow "
+                      "toward maxSlices; preemption: drain-first fleet "
+                      "eviction; maintenance: node cordon/drain). Billed "
+                      "to the preemption-factor budget, never the "
+                      "crash-loop backoff streak.")
+        self.register("job_drain_seconds", "histogram",
+                      "Cooperative-drain latency: drain directive stamped "
+                      "into status.drain to the gang's planned exit being "
+                      "classified (or to deadline expiry on the hard-kill "
+                      "fallback).", DRAIN_BUCKETS)
 
     # -- registry --------------------------------------------------------------
 
@@ -672,6 +689,26 @@ def _sanitize_profile(pr: Any) -> Tuple[Optional[Dict[str, Any]], str]:
     return clean, ""
 
 
+def _sanitize_drain_ack(da: Any) -> Tuple[Optional[Dict[str, Any]], str]:
+    """Sanitize a heartbeat's ``drainAck`` (the payload adopted a drain
+    directive and will exit at the named step boundary) down to exactly
+    the CRD schema's shape: (clean-or-None, error). Same door discipline
+    as the profile result — it is a one-shot the payload resends until
+    ACKed, and a bad value folded into ``status.drain`` would wedge every
+    later status write against a real apiserver's schema."""
+    if not isinstance(da, dict):
+        return None, "bad heartbeat: drainAck must be an object"
+    rid = da.get("id")
+    if not isinstance(rid, str) or not rid:
+        return None, "bad heartbeat: drainAck.id must be a non-empty string"
+    clean: Dict[str, Any] = {"id": rid}
+    step, err = _int_field(da.get("step", 0), 0, "drainAck.step")
+    if err:
+        return None, err
+    clean["step"] = step
+    return clean, ""
+
+
 def _public_heartbeat(hb: Optional[Dict[str, Any]]) -> Optional[Dict[str, Any]]:
     if not hb:
         return None
@@ -833,11 +870,15 @@ class StatusServer:
                     if ok:
                         # The 200 ACK is the only control channel back into
                         # the payload: a pending on-demand profile directive
-                        # for process 0 rides here (tpujobctl profile).
+                        # for process 0 rides here (tpujobctl profile), as
+                        # does a pending cooperative-drain directive.
                         resp: Dict[str, Any] = {"ok": True}
                         directive = outer.profile_directive_for(body)
                         if directive:
                             resp["profile"] = directive
+                        drain = outer.drain_directive_for(body)
+                        if drain:
+                            resp["drain"] = drain
                         self._send(200, json.dumps(resp),
                                    "application/json")
                     else:
@@ -989,6 +1030,13 @@ class StatusServer:
                 return False, err
             if clean_pr:
                 hb["profile"] = clean_pr
+        da = body.get("drainAck")
+        if da is not None:
+            clean_da, err = _sanitize_drain_ack(da)
+            if err:
+                return False, err
+            if clean_da:
+                hb["drainAck"] = clean_da
         c = self.controller
         if c is None:
             # A standby cannot persist the heartbeat (no in-memory job) nor
@@ -1015,14 +1063,16 @@ class StatusServer:
             recorded = c.record_heartbeat(namespace, name, hb)
             if recorded is None:
                 return True, ""
-            if recorded is False and ("startup" in hb or "profile" in hb):
-                # The startup breakdown and the profile capture result are
-                # ONE-SHOTs: the payload stops resending them after the
-                # first 200 (unlike the checkpoint fields, which ride on
-                # every beat). ACKing one before the TrainingJob exists —
-                # a fresh leader whose first reconcile hasn't run — would
-                # silently lose the attempt's status.startup /
-                # status.profile fold. Fail retryably instead; the payload
+            if recorded is False and ("startup" in hb or "profile" in hb
+                                      or "drainAck" in hb):
+                # The startup breakdown, the profile capture result, and
+                # the drain adoption ACK are ONE-SHOTs: the payload stops
+                # resending them after the first 200 (unlike the
+                # checkpoint fields, which ride on every beat). ACKing one
+                # before the TrainingJob exists — a fresh leader whose
+                # first reconcile hasn't run — would silently lose the
+                # attempt's status.startup / status.profile /
+                # status.drain fold. Fail retryably instead; the payload
                 # re-attaches it to the next due beat.
                 return False, "not ready: job not yet reconciled; retry"
         if hb.get("processId") not in (None, 0):
@@ -1217,6 +1267,25 @@ class StatusServer:
         if not name:
             return None
         return c.pending_profile(namespace, name)
+
+    def drain_directive_for(self, body: Dict[str, Any]
+                            ) -> Optional[Dict[str, Any]]:
+        """Pending cooperative-drain directive to ride this heartbeat's
+        200 ACK — only process 0 adopts it (the consensus allgather
+        spreads the latch to the gang), and only while
+        ``status.drain.state`` is Requested. Resent on every beat until
+        the payload's drainAck folds the state to Acked (the payload
+        dedups by id)."""
+        if body.get("processId") not in (None, 0):
+            return None
+        c = self.controller
+        if c is None or not hasattr(c, "pending_drain"):
+            return None
+        name = str(body.get("name") or "")
+        namespace = str(body.get("namespace") or "default")
+        if not name:
+            return None
+        return c.pending_drain(namespace, name)
 
     def render_metrics(self) -> str:
         lines = self.metrics.render_lines()

@@ -210,6 +210,15 @@ def enable_compilation_cache(env: Optional[dict] = None) -> str:
 
 EXIT_RETRYABLE = 143  # 128 + SIGTERM: the retryable band (training.go:172-208)
 
+# Operator-initiated PLANNED exit: a cooperative-drain directive (rode a
+# heartbeat ACK) asked this gang to checkpoint and restart on purpose —
+# live resize, graceful preemption, node maintenance. In the retryable
+# band (so a pre-upgrade operator still restarts the gang) but distinct
+# from 143: the classifier bills it to the preemption-factor budget and
+# never to the crash-loop backoff streak. Deliberately NOT 128+signal of
+# anything a kubelet sends — no real signal can alias it.
+EXIT_PLANNED = 160
+
 # SIGTERM inside the step loop requests a cooperative drain: train_loop
 # notices at the next step boundary, saves a checkpoint of the *current*
 # step (single-process jobs), and exits 143 — so a preempted attempt loses
@@ -217,7 +226,13 @@ EXIT_RETRYABLE = 143  # 128 + SIGTERM: the retryable band (training.go:172-208)
 # Outside the step loop (bootstrap, data loading, non-loop payloads) — or on
 # a second SIGTERM — the process exits immediately, as before; kubelet's
 # SIGKILL at the grace deadline is the final backstop.
+#
+# A drain DIRECTIVE (the operator's cooperative-drain protocol) arms the
+# same latch plus _planned: the gang agrees on a boundary step exactly
+# like the SIGTERM path, but exits EXIT_PLANNED so the restart is billed
+# as planned, not preempted.
 _drain = threading.Event()
+_planned = threading.Event()
 _in_step_loop = threading.Event()
 
 
@@ -225,13 +240,31 @@ def request_drain() -> None:
     _drain.set()
 
 
+def request_planned_drain() -> None:
+    """Arm the drain latch for an operator-directed (planned) restart:
+    drain at the next step boundary, gang-save, exit EXIT_PLANNED."""
+    _planned.set()
+    _drain.set()
+
+
 def draining() -> bool:
     return _drain.is_set()
 
 
+def planned_drain() -> bool:
+    return _planned.is_set()
+
+
+def drain_exit_code() -> int:
+    """The exit code the current drain latch maps to: EXIT_PLANNED for a
+    directive-driven drain, EXIT_RETRYABLE for a signal-driven one."""
+    return EXIT_PLANNED if _planned.is_set() else EXIT_RETRYABLE
+
+
 def reset_drain() -> None:
-    """Test hook: clear the module-level drain latch."""
+    """Test hook: clear the module-level drain latches."""
     _drain.clear()
+    _planned.clear()
 
 
 def enter_step_loop() -> None:
@@ -277,7 +310,7 @@ def run_payload(fn: Callable[[ProcessInfo], None]) -> int:
     except Exception:  # noqa: BLE001 — the contract: app error = permanent
         log.exception("payload failed")
         return 1
-    if code in (0, EXIT_RETRYABLE):
+    if code in (0, EXIT_RETRYABLE, EXIT_PLANNED):
         # Ship this attempt's compiled executables to the warm-start
         # store on the clean/drain exit paths: jobs with a store but no
         # checkpointing have no write-behind uploader, and even

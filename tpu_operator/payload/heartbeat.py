@@ -119,6 +119,15 @@ class HeartbeatReporter:
         self._profile_directive: Optional[Dict[str, Any]] = None
         self._profile_result: Optional[Dict[str, Any]] = None
         self._profile_seen: set = set()
+        # Cooperative-drain channel (process 0 only), same shape as the
+        # profile channel: the operator's drain directive arrives in a
+        # heartbeat ACK, is stashed until the train loop takes it, and the
+        # payload's ``drainAck {id, step}`` one-shot rides every
+        # subsequent beat until a 200 clears it — so the operator learns
+        # the drain was adopted even across a lossy status-server window.
+        self._drain_directive: Optional[Dict[str, Any]] = None
+        self._drain_ack: Optional[Dict[str, Any]] = None
+        self._drain_seen: set = set()
 
     def due(self, _step: int) -> bool:
         now = self._clock()
@@ -220,6 +229,8 @@ class HeartbeatReporter:
         self._last_post, self._last_step = now, int(step)
         if self._profile_result is not None:
             body["profile"] = dict(self._profile_result)
+        if self._drain_ack is not None:
+            body["drainAck"] = dict(self._drain_ack)
         return self._post(body)
 
     def take_profile_directive(self) -> Optional[Dict[str, Any]]:
@@ -237,6 +248,23 @@ class HeartbeatReporter:
         self._profile_seen.add(str(result.get("id", "")))
         self._profile_result = dict(result)
 
+    def take_drain_directive(self) -> Optional[Dict[str, Any]]:
+        """The pending cooperative-drain directive (``{"id", "reason",
+        ...}``) stashed from a heartbeat ACK, consumed exactly once — the
+        train loop polls this after each due beat and arms the planned-
+        drain latch."""
+        directive, self._drain_directive = self._drain_directive, None
+        return directive
+
+    def attach_drain_ack(self, ack: Dict[str, Any]) -> None:
+        """Attach the drain adoption ACK (``{"id", "step"}`` — the
+        boundary step the gang agreed to drain at) to every subsequent
+        beat until a 200 clears it; the id joins the seen set so the
+        directive — resent by the operator until its status folds to
+        Acked — is never re-taken."""
+        self._drain_seen.add(str(ack.get("id", "")))
+        self._drain_ack = dict(ack)
+
     def _post(self, body: Dict[str, Any]) -> bool:
         """Best-effort POST shared by every report flavor: never raises,
         logs the first failure of a streak rather than a stream. With an
@@ -247,7 +275,7 @@ class HeartbeatReporter:
         verdict."""
         sink = self.async_sink
         if sink is not None and "startup" not in body \
-                and "profile" not in body:
+                and "profile" not in body and "drainAck" not in body:
             return bool(sink(self._post_now, body))
         return self._post_now(body)
 
@@ -258,6 +286,9 @@ class HeartbeatReporter:
             if "profile" in body:
                 # The capture result one-shot is ACKed — stop resending.
                 self._profile_result = None
+            if "drainAck" in body:
+                # The drain adoption one-shot is ACKed — stop resending.
+                self._drain_ack = None
             if isinstance(ack, dict):
                 directive = ack.get("profile")
                 if isinstance(directive, dict) and directive.get("id") \
@@ -268,6 +299,14 @@ class HeartbeatReporter:
                         self._profile_seen.clear()
                     self._profile_seen.add(str(directive["id"]))
                     self._profile_directive = dict(directive)
+                drain = ack.get("drain")
+                if isinstance(drain, dict) and drain.get("id") \
+                        and str(drain["id"]) not in self._drain_seen:
+                    if len(self._drain_seen) >= 64:
+                        # One directive in flight at a time; leak backstop.
+                        self._drain_seen.clear()
+                    self._drain_seen.add(str(drain["id"]))
+                    self._drain_directive = dict(drain)
             return True
         except Exception as e:  # noqa: BLE001 — heartbeats never kill training
             if not self._failed_once:

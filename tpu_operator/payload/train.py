@@ -907,20 +907,29 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
             "no trace will be captured", trace_from, trace_to, steps)
 
     if jax.process_count() > 1:
-        # Coordinated drain: SIGTERM lands on *one* pod (preemption), but an
-        # orbax save is a group collective, so every process must agree on
-        # the boundary step. Each step, every process contributes its local
-        # drain latch to a tiny allgather; all processes evaluate the same
-        # gathered array at the same loop index, so they reach consensus at
-        # the same i and group-save one consistent checkpoint. Cost: one
+        # Coordinated drain: SIGTERM lands on *one* pod (preemption) and a
+        # drain directive lands on process 0 only, but an orbax save is a
+        # group collective, so every process must agree on the boundary
+        # step. Each step, every process contributes its local drain exit
+        # code (0 = not draining) to a tiny allgather; all processes
+        # evaluate the same gathered array at the same loop index, so they
+        # reach consensus at the same i, group-save one consistent
+        # checkpoint, and exit with the same code. ``max`` both detects
+        # any drain and picks the winning flavor: EXIT_PLANNED (160) >
+        # EXIT_RETRYABLE (143), so a directive-driven drain is billed
+        # planned even when a sibling was independently SIGTERMed — the
+        # same precedence the operator's classifier applies. Cost: one
         # scalar collective per step — noise next to a training step.
         from jax.experimental import multihost_utils
 
-        def drain_agreed() -> bool:
-            flag = np.int32(1 if bootstrap_mod.draining() else 0)
-            return bool(multihost_utils.process_allgather(flag).max())
+        def agreed_drain_code() -> int:
+            code = np.int32(bootstrap_mod.drain_exit_code()
+                            if bootstrap_mod.draining() else 0)
+            return int(multihost_utils.process_allgather(code).max())
     else:
-        drain_agreed = bootstrap_mod.draining
+        def agreed_drain_code() -> int:
+            return (bootstrap_mod.drain_exit_code()
+                    if bootstrap_mod.draining() else 0)
 
     bootstrap_mod.enter_step_loop()  # SIGTERM now defers to a step boundary
     # Flight-recorder COMPUTE fence, one step deep: after dispatching step
@@ -945,29 +954,33 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         for i in range(start, steps):
             if recorder is not None:
                 recorder.begin(i)
-            if drain_agreed():
+            drain_code = agreed_drain_code()
+            if drain_code:
                 # Drain: persist the i completed steps and exit retryable —
                 # the restarted attempt resumes exactly here. The caller's
                 # finally close() flushes the async write. In multi-process
                 # jobs every peer (signaled or not) reaches this branch at
                 # the same i (consensus above), saves collectively, and
-                # exits retryable so the operator restarts the whole group.
-                # The save is guarded: an I/O failure during the preemption
-                # drain must not escape as a permanent exit (1) — the
-                # restart simply resumes from the last verified save.
+                # exits with the same agreed code — EXIT_RETRYABLE for a
+                # signal drain, EXIT_PLANNED for an operator directive —
+                # so the operator restarts the whole group and bills the
+                # restart to the right ledger kind. The save is guarded:
+                # an I/O failure during the drain must not escape as a
+                # permanent exit (1) — the restart simply resumes from the
+                # last verified save.
                 if checkpointer is not None and i > start:
                     try:
                         checkpointer.save(i, state)
                         log.info("drain: checkpointed step %d, "
-                                 "exiting retryable", i)
-                    except Exception:  # noqa: BLE001 — 143 regardless
+                                 "exiting %d", i, drain_code)
+                    except Exception:  # noqa: BLE001 — drain code regardless
                         log.exception(
                             "drain: checkpoint save of step %d failed; "
-                            "exiting retryable anyway (resume falls back "
-                            "to the last verified step)", i)
+                            "exiting %d anyway (resume falls back "
+                            "to the last verified step)", i, drain_code)
                 else:
-                    log.info("drain: exiting retryable at step %d", i)
-                raise SystemExit(bootstrap_mod.EXIT_RETRYABLE)
+                    log.info("drain: exiting %d at step %d", drain_code, i)
+                raise SystemExit(drain_code)
             if (profile_dir and not tracing and not profiled
                     and i >= trace_from):
                 jax.profiler.start_trace(profile_dir)
@@ -1101,6 +1114,25 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
                     _finish_profile(profile_capture, recorder,
                                     checkpointer, heartbeat)
                     profile_capture = None
+                # Cooperative-drain directive (process 0 only, rode the
+                # ACK): arm the planned-drain latch — the consensus
+                # allgather spreads it to every peer at the next step
+                # boundary, where the gang saves and exits EXIT_PLANNED —
+                # and attach the adoption ACK so the operator stops
+                # resending. If the gang exits before the ACK posts, the
+                # PLANNED classification itself completes the directive.
+                take_drain = getattr(heartbeat, "take_drain_directive",
+                                     None)
+                drain_dir = take_drain() if take_drain is not None else None
+                if drain_dir and drain_dir.get("id"):
+                    log.info("drain directive %s (%s): draining at next "
+                             "step boundary", drain_dir.get("id"),
+                             drain_dir.get("reason", ""))
+                    bootstrap_mod.request_planned_drain()
+                    attach = getattr(heartbeat, "attach_drain_ack", None)
+                    if attach is not None:
+                        attach({"id": str(drain_dir["id"]),
+                                "step": i + 1})
     except SystemExit as e:
         # Retryable exits (preemption drain, save-failure escalation) are
         # exactly when a postmortem wants the last N steps' phase timings:
@@ -1109,7 +1141,8 @@ def train_loop(mesh: Mesh, train_step: Callable, state: TrainState,
         # Direct equality, no int() coercion: SystemExit.code may legally
         # be any object (sys.exit("message")) and must pass through
         # untouched.
-        if getattr(e, "code", None) == bootstrap_mod.EXIT_RETRYABLE:
+        if getattr(e, "code", None) in (bootstrap_mod.EXIT_RETRYABLE,
+                                        bootstrap_mod.EXIT_PLANNED):
             _dump_steptrace(recorder, checkpointer)
         raise
     finally:

@@ -90,10 +90,21 @@ class _Entry:
     # tests run against THIS — an elastic gang shrinks instead of
     # queueing — while the grant prefers ``slices``.
     min_slices: int = field(default=0)
+    # The size the job ASKED for (its spec's preferred maximum),
+    # refreshed from the demand on every admission-gate pass. An
+    # admitted entry granted below this is running shrunk — a tight
+    # admission grant or a straggler-shed cap — which victim selection
+    # reads: evicting an already-degraded gang costs less goodput than
+    # evicting a healthy full-width one.
+    preferred: int = field(default=0)
 
     def floor(self) -> int:
         """The size this job must at least be granted to run."""
         return self.min_slices or self.slices
+
+    def shrunk(self) -> bool:
+        """Running below the preferred size."""
+        return bool(self.preferred) and self.slices < self.preferred
 
 
 class FleetScheduler:
@@ -167,6 +178,10 @@ class FleetScheduler:
         with self._lock:
             ent = self._admitted.get(key)
             if ent is not None and ent.uid == uid:
+                # Keep the preferred size tracking the live spec: a
+                # shrunk-vs-full reading taken against a stale demand
+                # would mis-rank victims after a spec resize.
+                ent.preferred = slices
                 return True
             if ent is not None:
                 # Same name, new UID: the old job's reservation is stale.
@@ -180,7 +195,8 @@ class FleetScheduler:
                 self._admitted[key] = _Entry(
                     key=key, uid=uid, demand_key=demand_key, slices=held,
                     priority=priority, queue=queue, seq=self._seq,
-                    admit_seq=self._seq, forced=True, min_slices=min_req)
+                    admit_seq=self._seq, forced=True, min_slices=min_req,
+                    preferred=slices)
                 self._pending.pop(key, None)
                 self._update_gauges_locked()
                 return True
@@ -193,7 +209,7 @@ class FleetScheduler:
                 self._pending[key] = _Entry(
                     key=key, uid=uid, demand_key=demand_key, slices=slices,
                     priority=priority, queue=queue, seq=self._seq,
-                    min_slices=min_req,
+                    min_slices=min_req, preferred=slices,
                     enqueued_at=(pend.enqueued_at
                                  if pend is not None and pend.uid == uid
                                  else self._clock()))
@@ -259,6 +275,7 @@ class FleetScheduler:
                     self._pending[key] = _Entry(
                         key=key, uid=uid, demand_key=ent.demand_key,
                         slices=max_slices, min_slices=min_slices,
+                        preferred=ent.preferred or max_slices,
                         priority=ent.priority, queue=ent.queue,
                         seq=self._seq, enqueued_at=self._clock())
                     wake = self._rebalance_locked()
@@ -267,6 +284,47 @@ class FleetScheduler:
                                if readmitted is not None else None)
         self._notify(wake, skip=key)
         return granted
+
+    def peek_eviction(self, key: str,
+                      uid: Optional[str] = None) -> Optional[str]:
+        """Non-consuming view of a pending preemption directive: the
+        drain-first eviction path reads the reason to stamp a
+        cooperative drain while the directive — and the victim's
+        reservation — stays in place until the drained gang's planned
+        exit (or drain-deadline expiry) pops it for real. The
+        in-flight-eviction credit in ``_mark_victims_locked`` keeps a
+        peeked-but-unpopped victim counted toward the preemptor's
+        shortfall, so the drain window cannot cascade extra victims.
+        A directive recorded against a different UID targeted a deleted
+        predecessor: dropped here exactly as ``pop_eviction`` would."""
+        with self._lock:
+            entry = self._evicting.get(key)
+            if entry is None:
+                return None
+            marked_uid, reason = entry
+            if uid is not None and marked_uid != uid:
+                del self._evicting[key]
+                return None
+            return reason
+
+    def grow_headroom(self, key: str, *, uid: str,
+                      max_slices: int) -> Optional[int]:
+        """The size ``key``'s admitted reservation could grow to right
+        now (its shape's free capacity plus what it already holds,
+        capped at ``max_slices``) — WITHOUT mutating anything. The
+        live-resize trigger probes this from reconcile and only drains
+        the gang once headroom has held through the debounce window.
+        None when the job is not admitted under this UID or its shape
+        is unmodeled (unmodeled gangs already run at their preferred
+        size)."""
+        with self._lock:
+            ent = self._admitted.get(key)
+            if ent is None or ent.uid != uid:
+                return None
+            if not self._inventory.modeled(ent.demand_key):
+                return None
+            return min(max_slices,
+                       self._inventory.free(ent.demand_key) + ent.slices)
 
     def pop_eviction(self, key: str,
                      uid: Optional[str] = None) -> Optional[str]:
@@ -519,12 +577,17 @@ class FleetScheduler:
                     if k in self._evicting and v.demand_key == head.demand_key)
         if need <= 0:
             return []
+        # Within a priority band, gangs already running SHRUNK (straggler
+        # shed, tight admission grant) go first: they are degraded
+        # already, their restart is billed to the infra budget either
+        # way, and sparing a healthy full-width gang preserves strictly
+        # more goodput. Newest-admitted breaks the remaining ties.
         candidates = sorted(
             (v for k, v in self._admitted.items()
              if k not in self._evicting
              and v.demand_key == head.demand_key
              and v.priority < head.priority),
-            key=lambda v: (v.priority, -v.admit_seq))
+            key=lambda v: (v.priority, not v.shrunk(), -v.admit_seq))
         chosen: List[_Entry] = []
         freed = 0
         for victim in candidates:

@@ -599,6 +599,11 @@ class StormController:
     - ``pod_kill`` — one ChaosMonkey ``kill_once`` sweep
     - ``blob_fault`` — call ``blob_arm()`` / ``blob_disarm()`` around a
       ``seconds`` window (the store-layer fault hook surface)
+    - ``coop_drain`` — call ``drain_request()``: the harness's hook into
+      the cooperative-drain protocol (stamp a ``status.drain`` directive
+      the way the controller's resize/preemption/maintenance call sites
+      do). The storm only *requests*; whether the payload ACKs or the
+      deadline hard-kills is the scenario under test.
     """
 
     def __init__(self, cluster: FakeCluster, seed: int,
@@ -606,7 +611,8 @@ class StormController:
                  flaky: Optional[Any] = None,
                  monkey: Optional[Any] = None,
                  blob_arm: Optional[Callable[[], None]] = None,
-                 blob_disarm: Optional[Callable[[], None]] = None):
+                 blob_disarm: Optional[Callable[[], None]] = None,
+                 drain_request: Optional[Callable[[], None]] = None):
         self.cluster = cluster
         self.seed = seed
         self.waves = tuple(waves)
@@ -614,6 +620,7 @@ class StormController:
         self.monkey = monkey
         self.blob_arm = blob_arm
         self.blob_disarm = blob_disarm
+        self.drain_request = drain_request
         # Identity snapshot at construction: the plan must not drift if
         # a drain wave later removes a node.
         self._node_names = tuple(cluster.node_names())
@@ -682,6 +689,8 @@ class StormController:
                 seconds = float(params.get("seconds", 2.0))
                 events.append(StormEvent(at, "blob_on", {}))
                 events.append(StormEvent(at + seconds, "blob_off", {}))
+            elif kind == "coop_drain":
+                events.append(StormEvent(at, "coop_drain", {}))
             else:
                 raise ValueError(f"unknown storm kind {kind!r}")
         events.sort(key=lambda e: (e.at, e.kind))
@@ -740,3 +749,6 @@ class StormController:
             self.blob_arm()
         elif kind == "blob_off" and self.blob_disarm is not None:
             self.blob_disarm()
+        elif kind == "coop_drain" and self.drain_request is not None:
+            self.drain_request()
+            self.stats["coop_drains"] = self.stats.get("coop_drains", 0) + 1

@@ -42,6 +42,15 @@ RETRYABLE_POD_REASONS = frozenset(
 # SIGABRT 134, SIGBUS 135, ...) is the payload crashing — application-kind.
 PREEMPTION_EXIT_CODES = frozenset({137, 143})
 
+# Exit code produced by the payload itself when it completes a cooperative
+# drain directive (operator-initiated: live resize, graceful preemption,
+# node maintenance). Inside the retryable band so older operators still
+# restart the gang, but classified **planned**-kind here: billed to the
+# preemption-factor budget and never to the crash-loop backoff streak.
+# Checked before PREEMPTION_EXIT_CODES — 160 is not a signal exit, so the
+# two sets can never overlap, but the precedence makes the intent explicit.
+PLANNED_EXIT_CODES = frozenset({160})
+
 
 def classify_pod_failure(pod: Dict[str, Any], container_name: str = "tpu"
                          ) -> Optional[Tuple[str, str]]:
@@ -51,8 +60,10 @@ def classify_pod_failure(pod: Dict[str, Any], container_name: str = "tpu"
     Kubelet-level failures (Evicted/Preempted/... with no container
     termination record) and external-signal exits (137 non-OOM, 143) are
     **preemption**-kind — routine TPU slice churn, billed to the larger
-    preemption budget. Other retryable exits (128-255 band: SIGSEGV,
-    SIGABRT, ...) are the payload dying — **application**-kind."""
+    preemption budget. A cooperative-drain completion (160) is
+    **planned**-kind — same budget, never the backoff streak. Other
+    retryable exits (128-255 band: SIGSEGV, SIGABRT, ...) are the payload
+    dying — **application**-kind."""
     status = pod.get("status") or {}
     name = (pod.get("metadata") or {}).get("name", "")
     saw_container = False
@@ -65,9 +76,12 @@ def classify_pod_failure(pod: Dict[str, Any], container_name: str = "tpu"
             saw_container = True
             if is_retryable_termination_state(term):
                 code = int(term.get("exitCode"))
-                kind = (FailureKind.PREEMPTION
-                        if code in PREEMPTION_EXIT_CODES
-                        else FailureKind.APPLICATION)
+                if code in PLANNED_EXIT_CODES:
+                    kind = FailureKind.PLANNED
+                elif code in PREEMPTION_EXIT_CODES:
+                    kind = FailureKind.PREEMPTION
+                else:
+                    kind = FailureKind.APPLICATION
                 return kind, f"pod {name} exited {code}"
     if saw_container:
         return None

@@ -878,19 +878,28 @@ class TPUReplicaSet:
         takes a SIGKILLed sibling down with it), application-kind evidence
         wins: the restart is billed to the stricter crash-loop budget, not
         the 4x preemption budget — otherwise a crash-looper whose crashes
-        collaterally kill siblings would sidestep its own cap."""
+        collaterally kill siblings would sidestep its own cap. Planned
+        drain exits (160) sit between: a real crash outranks them (same
+        collateral argument — a drained sibling of a segfaulter is still a
+        crash), but a planned exit outranks raw preemption evidence so a
+        gang that completed its cooperative drain is ledgered planned even
+        when a straggler process was SIGKILLed at the deadline."""
         first_preemption: Optional[Tuple[str, str]] = None
+        first_planned: Optional[Tuple[str, str]] = None
         snap = snapshot or self._fallback_snapshot()
         for index in range(self.spec.replicas):
             for pod in snap.pods_for(self.replica_type, index, attempt):
                 info = policy.classify_pod_failure(pod, DEFAULT_CONTAINER_NAME)
                 if info is None:
                     continue
-                if info[0] != FailureKind.PREEMPTION:
+                if info[0] == FailureKind.PLANNED:
+                    if first_planned is None:
+                        first_planned = info
+                elif info[0] != FailureKind.PREEMPTION:
                     return info
-                if first_preemption is None:
+                elif first_preemption is None:
                     first_preemption = info
-        return first_preemption
+        return first_planned or first_preemption
 
     def get_single_replica_status(self, index: int,
                                   attempt: Optional[int] = None,

@@ -80,6 +80,10 @@ from tpu_operator.apis.tpujob import helper, validation
 from tpu_operator.apis.tpujob.v1alpha1.defaults import set_defaults
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     ControllerConfig,
+    DEFAULT_DRAIN_DEADLINE_SECONDS,
+    DEFAULT_RESIZE_DEBOUNCE_SECONDS,
+    DrainReason,
+    DrainState,
     ELASTIC_REMEDIATION_CAP,
     FAILURE_LEDGER_CAP,
     FailureKind,
@@ -202,6 +206,19 @@ class TrainingJob:
         # anything; None = no live beats to expire).
         self._serving_ready: Optional[Tuple[int, frozenset, frozenset,
                                             Optional[float]]] = None  # guarded-by: _rem_lock
+        # Maintenance-drain handoff (controller node-watch thread → the
+        # reconcile): the cordoned node whose gang should cooperatively
+        # drain, plus the attempt the cordon was observed against. One
+        # slot, latest wins — a still-cordoned node re-detects on its
+        # next node event.
+        self._pending_maintenance: Optional[Tuple[str, int]] = None  # guarded-by: _rem_lock
+        # Live-resize debounce: epoch at which scheduler headroom above
+        # the granted size was FIRST observed in the current stretch; the
+        # grow drain fires only once headroom has held for
+        # resizeDebounceSeconds. In-memory on purpose — an operator
+        # restart merely restarts the debounce window, it never loses a
+        # grow (the headroom is re-observed on the next reconcile).
+        self._grow_headroom_since: Optional[float] = None
 
     # -- gang-runtime passthrough (the pre-extraction public surface) ----------
 
@@ -543,9 +560,14 @@ class TrainingJob:
     # status (the heartbeat-ACK piggyback reads status.profile.state):
     # a Requested record parked behind the write limiter is a directive
     # the payload never sees until unrelated churn flushes it.
+    # ``drain`` is here for the same delivery reason as ``profile`` —
+    # the heartbeat-ACK piggyback polls status.drain.state — plus a
+    # sharper failure mode: a Requested drain parked behind the limiter
+    # never reaches the payload, and its deadline then hard-kills a gang
+    # that was never actually asked to save.
     _CRITICAL_STATUS_FIELDS = ("phase", "attempt", "state", "reason",
                                "backoffUntil", "failures", "startup",
-                               "stragglers", "elastic", "profile")
+                               "stragglers", "elastic", "profile", "drain")
 
     def _critical_status_delta(self, base: Dict[str, Any],
                                wire: Dict[str, Any]) -> bool:
@@ -607,6 +629,15 @@ class TrainingJob:
         self._sync_profile()
         attempt = self.job.status.attempt
 
+        # Cooperative-drain housekeeping: resolve directives stranded by a
+        # raced restart, admit a pending maintenance drain from the node
+        # watch, and enforce the per-directive deadline — a payload that
+        # never ACKed or never exited falls back to the hard teardown the
+        # drain was trying to soften. False = that teardown ended the pass.
+        if not self._sync_drain(now, attempt):
+            self.update_crd_status()
+            return
+
         # Fleet-scheduler eviction directive, checked before the suspend/
         # backoff parking below: a victim sitting out a restart backoff has
         # no pods but still holds its reservation — the preemptor must get
@@ -614,20 +645,18 @@ class TrainingJob:
         # SUCCEEDED is not torn down: the pop released its reservation (the
         # preemptor has the capacity either way), and the normal roll-up
         # below lands Done instead of pointlessly re-running finished work.
+        # A Running gang with a live heartbeat is evicted DRAIN-FIRST: the
+        # directive stays pending (capacity still drains toward the
+        # preemptor via the in-flight-eviction credit) while the payload
+        # saves and exits planned; the hard pop happens at the planned
+        # exit — or at the drain deadline.
         finished_despite_eviction = False
         if self.scheduler is not None and not self.job.spec.suspend:
-            eviction = self.scheduler.pop_eviction(self._sched_key(),
-                                                   uid=self.uid)
-            if eviction is not None:
-                state, _ = self.get_status(self.build_snapshot())
-                if state != State.SUCCEEDED:
-                    self._preempt_to_queue(attempt, eviction)
-                    self.update_crd_status()
-                    return
-                # The finished gang needs no capacity: skip the admission
-                # gate below (its terminated pods rightly don't count as
-                # held hardware) and let the roll-up land Done.
-                finished_despite_eviction = True
+            outcome = self._sync_eviction(attempt)
+            if outcome == "handled":
+                self.update_crd_status()
+                return
+            finished_despite_eviction = outcome == "finished"
 
         # Suspend/resume (spec.suspend, batch/v1 Job semantics): suspension
         # tears down the whole generation — a partial JAX group computes
@@ -804,20 +833,30 @@ class TrainingJob:
             # preemption vs application), or a stalled payload?
             failure: Optional[tuple] = None
             if self.job.spec.restart_policy == RestartPolicy.WHOLE_GROUP:
-                # Application-wins across replica sets, same as within one
-                # (replicas.retryable_failure_info): a crashing set must be
-                # billed to the strict crash-loop budget even when another
-                # set's collateral SIGKILL is discovered first.
+                # Precedence across replica sets mirrors the within-set
+                # rule (replicas.retryable_failure_info): application >
+                # planned > preemption. A crashing set must be billed to
+                # the strict crash-loop budget even when another set's
+                # collateral SIGKILL (or cooperative exit) is discovered
+                # first — and a gang whose drain completed must be billed
+                # planned even when a straggler process was SIGKILLed at
+                # the deadline's edge.
+                rank = {FailureKind.PREEMPTION: 0, FailureKind.PLANNED: 1}
                 for rs in self.replica_sets:
                     info = rs.retryable_failure_info(attempt, snap)
                     if info is None:
                         continue
-                    failure = info
-                    if info[0] != FailureKind.PREEMPTION:
+                    if (failure is None
+                            or rank.get(info[0], 2) > rank.get(failure[0], 2)):
+                        failure = info
+                    if info[0] not in rank:
                         break
             stall_at = self._stall_epoch()
             if failure is not None:
-                self._group_restart(attempt, failure[0], failure[1])
+                if failure[0] == FailureKind.PLANNED:
+                    self._planned_restart(attempt, failure[1])
+                else:
+                    self._group_restart(attempt, failure[0], failure[1])
             elif stall_at is not None and now is not None and now >= stall_at:
                 # Pods report Running but the payload made no observable
                 # progress (no heartbeat, no phase change) for the whole
@@ -860,6 +899,11 @@ class TrainingJob:
                         if (entered is not None
                                 and now - entered >= BACKOFF_RESET_SECONDS):
                             self.job.status.consecutive_failures = 0
+                    # In-attempt live resize, the grow half: a healthy
+                    # shrunk elastic gang drains and re-gangs wider once
+                    # inventory headroom has held through the debounce —
+                    # no failure required.
+                    self._maybe_request_grow(now, attempt)
 
         self.update_crd_status()
 
@@ -924,6 +968,342 @@ class TrainingJob:
                 self, "Normal", "ProfileRequested",
                 f"profile {rid}: capture of {steps} raw step lap(s) "
                 f"requested")
+
+    # -- cooperative drain (planned restarts: resize / preemption /
+    # maintenance) -------------------------------------------------------------
+
+    def _drain_params(self) -> Tuple[int, int]:
+        """(deadlineSeconds, resizeDebounceSeconds): ``spec.drain`` with
+        the API defaults filling absent fields."""
+        dr = self.job.spec.drain
+        if dr is None:
+            return (DEFAULT_DRAIN_DEADLINE_SECONDS,
+                    DEFAULT_RESIZE_DEBOUNCE_SECONDS)
+        return dr.deadline_seconds, dr.resize_debounce_seconds
+
+    def _active_drain(self, attempt: int) -> Optional[Dict[str, Any]]:
+        """The in-flight (Requested/Acked) directive addressed to the
+        current attempt's gang, or None. A non-terminal record stamped
+        for another attempt is NOT active: the gang it addressed is
+        gone, and serving it to (or folding ACKs from) a successor
+        would drain a gang nobody asked to drain."""
+        cur = self.job.status.drain
+        if (cur and cur.get("state") in (DrainState.REQUESTED,
+                                         DrainState.ACKED)
+                and int(cur.get("attempt", -1)) == int(attempt)):
+            return cur
+        return None
+
+    def request_drain(self, reason: str, detail: str = "",
+                      target_slices: Optional[int] = None) -> None:
+        """Stamp a cooperative-drain directive into ``status.drain``
+        (state Requested). From there the status server piggybacks it on
+        a heartbeat ACK to process 0 (the profile-directive delivery
+        path); the payload latches it, runs the gang-agreed verified
+        save at the next step boundary, and every process exits
+        EXIT_PLANNED — classified ``planned``, restarted with zero
+        backoff off the preemption-factor budget. The deadline stamped
+        here is the hard backstop: a payload that never ACKs or never
+        exits is torn down the old way once it passes (``_sync_drain``).
+
+        Idempotent while a directive for this attempt is in flight:
+        call sites re-request level-triggered every reconcile, and a
+        re-request must not reset the directive's identity or push its
+        deadline out forever."""
+        status = self.job.status
+        attempt = status.attempt
+        if self._active_drain(attempt) is not None:
+            return
+        deadline_s, _debounce = self._drain_params()
+        new: Dict[str, Any] = {
+            "id": rand_string(5),
+            "state": DrainState.REQUESTED,
+            "reason": reason,
+            "attempt": int(attempt),
+            "deadline": format_rfc3339(
+                (parse_rfc3339(_now()) or 0.0) + deadline_s),
+            "time": _now(),
+        }
+        if target_slices:
+            new["targetSlices"] = int(target_slices)
+        status.drain = new
+        if self.recorder:
+            extra = (f" toward {int(target_slices)} slice(s)"
+                     if target_slices else "")
+            self.recorder.event(
+                self, "Normal", "DrainRequested",
+                f"drain {new['id']} ({reason}){extra}: payload asked to "
+                f"save and exit at a step boundary"
+                + (f" — {detail}" if detail else "")
+                + f"; hard teardown if not drained within {deadline_s}s")
+        log.info("drain: %s attempt %d directive %s (%s)%s",
+                 self._sched_key(), attempt, new["id"], reason,
+                 f" target={target_slices}" if target_slices else "")
+
+    def request_maintenance_drain(self, node: str, attempt: int) -> None:
+        """Controller handoff (node-watch thread): a node hosting this
+        job's gang pods was cordoned — ask the next reconcile to drain
+        the gang so it saves and re-places around the node instead of
+        dying uncheckpointed when the node empties. One slot, latest
+        wins: a still-cordoned node re-detects on its next event."""
+        with self._rem_lock:
+            self._pending_maintenance = (str(node), int(attempt))
+
+    def _take_maintenance(self, attempt: int) -> Optional[str]:
+        with self._rem_lock:
+            pending, self._pending_maintenance = \
+                self._pending_maintenance, None
+        if pending is None:
+            return None
+        node, hand_attempt = pending
+        if hand_attempt != attempt \
+                or self.job.status.phase not in (TPUJobPhase.RUNNING,
+                                                 TPUJobPhase.CREATING):
+            return None  # the gang the cordon was observed against is gone
+        return node
+
+    def _sync_drain(self, now: Optional[float], attempt: int) -> bool:
+        """Drain-directive housekeeping, every reconcile:
+
+        - a non-terminal directive stamped for an OLDER attempt lost a
+          race with a real failure (the gang it addressed is gone) —
+          resolve it Expired so it can never be served to, or ACKed by,
+          the successor gang;
+        - a suspension mid-drain expires the directive (the teardown it
+          softened is happening anyway, on the user's explicit order);
+        - admit a pending maintenance-drain handoff from the node watch;
+        - enforce the deadline: a directive still in flight past it
+          falls back to the hard teardown it was trying to soften —
+          eviction pop + requeue for preemption drains, plain group
+          restart (billed preemption: operator-initiated infra churn)
+          otherwise. Returns False when that teardown ended the pass."""
+        status = self.job.status
+        cur = status.drain
+        if (cur and cur.get("state") in (DrainState.REQUESTED,
+                                         DrainState.ACKED)
+                and int(cur.get("attempt", -1)) != int(attempt)):
+            stale = dict(cur)
+            stale["state"] = DrainState.EXPIRED
+            status.drain = stale
+        if self.job.spec.suspend:
+            active = self._active_drain(attempt)
+            if active is not None:
+                gone = dict(active)
+                gone["state"] = DrainState.EXPIRED
+                status.drain = gone
+            return True
+        node = self._take_maintenance(attempt)
+        if node is not None:
+            self.request_drain(DrainReason.MAINTENANCE,
+                               f"node {node} cordoned for maintenance")
+        active = self._active_drain(attempt)
+        if active is None:
+            return True
+        if status.phase not in (TPUJobPhase.RUNNING, TPUJobPhase.CREATING):
+            # No gang to tear down (Queued/Backoff park the directive);
+            # it resolves by attempt staleness or by the gang returning.
+            return True
+        deadline = parse_rfc3339(str(active.get("deadline", "")))
+        if deadline is None or now is None or now < deadline:
+            return True
+        expired = dict(active)
+        expired["state"] = DrainState.EXPIRED
+        status.drain = expired
+        reason = str(active.get("reason", ""))
+        detail = (f"drain {active.get('id')} ({reason}) deadline expired "
+                  f"without a planned exit; falling back to hard teardown")
+        if self.recorder:
+            self.recorder.event(self, "Warning", "DrainDeadlineExpired",
+                                detail)
+        if reason == DrainReason.PREEMPTION and self.scheduler is not None:
+            evict = self.scheduler.pop_eviction(self._sched_key(),
+                                                uid=self.uid)
+            if evict is not None:
+                self._preempt_to_queue(attempt, evict)
+                return False
+            # The eviction evaporated mid-drain (cancelled, or aimed at a
+            # dead predecessor): restart in place, keeping the slot.
+        self._group_restart(attempt, FailureKind.PREEMPTION, detail)
+        return False
+
+    def _sync_eviction(self, attempt: int) -> str:
+        """Fleet-eviction delivery, drain-first. Returns:
+
+        - ``"handled"`` — the gang was hard-preempted; the caller
+          writes status and ends the pass;
+        - ``"finished"`` — the gang already succeeded; the directive was
+          consumed (releasing the reservation) and the caller's roll-up
+          lands Done, skipping the admission gate;
+        - ``"draining"`` — a cooperative drain is in flight for the
+          eviction; the gang keeps running until its planned exit or
+          the drain deadline;
+        - ``"none"`` — no eviction pending."""
+        peek = getattr(self.scheduler, "peek_eviction", None)
+        if peek is not None:
+            reason = peek(self._sched_key(), uid=self.uid)
+        else:
+            # Scheduler without a non-consuming peek (test doubles):
+            # popping here preserves the pre-drain hard behavior.
+            reason = self.scheduler.pop_eviction(self._sched_key(),
+                                                 uid=self.uid)
+        if reason is None:
+            self._cancel_eviction_drain(attempt)
+            return "none"
+        state, _ = self.get_status(self.build_snapshot())
+        if state == State.SUCCEEDED:
+            if peek is not None:
+                self.scheduler.pop_eviction(self._sched_key(), uid=self.uid)
+            return "finished"
+        if peek is None or not self._drain_worthwhile():
+            if peek is not None:
+                self.scheduler.pop_eviction(self._sched_key(), uid=self.uid)
+            self._preempt_to_queue(attempt, reason)
+            return "handled"
+        self.request_drain(DrainReason.PREEMPTION, reason)
+        return "draining"
+
+    def _drain_worthwhile(self) -> bool:
+        """Whether a cooperative drain can actually save anything. It
+        needs a Running gang with a live heartbeat channel (the
+        directive rides the heartbeat ACK — without one it would only
+        sit out its deadline), and it is SKIPPED when the checkpoint
+        store is already fresh: a victim whose last uploaded step equals
+        its last reported step has nothing new to save, and draining it
+        would only delay the preemptor by a directive round-trip."""
+        status = self.job.status
+        if status.phase != TPUJobPhase.RUNNING:
+            return False
+        hb = status.last_heartbeat or {}
+        if not hb:
+            return False
+        store = status.store or {}
+        uploaded = store.get("lastUploadedStep")
+        step = hb.get("step")
+        if (isinstance(uploaded, int) and isinstance(step, int)
+                and uploaded >= step):
+            return False
+        return True
+
+    def _cancel_eviction_drain(self, attempt: int) -> None:
+        """The eviction that requested a preemption drain evaporated
+        (the fleet's unjustified-eviction sweep cancelled it): withdraw
+        a directive the payload has NOT yet adopted so the gang keeps
+        running undisturbed. An ACKed directive is past withdrawal —
+        the payload's latch is armed and the gang WILL exit planned;
+        its classification then restarts in place (the eviction pop
+        no-ops), the cheapest remaining outcome."""
+        cur = self.job.status.drain or {}
+        if (cur.get("reason") == DrainReason.PREEMPTION
+                and cur.get("state") == DrainState.REQUESTED
+                and int(cur.get("attempt", -1)) == int(attempt)):
+            withdrawn = dict(cur)
+            withdrawn["state"] = DrainState.EXPIRED
+            self.job.status.drain = withdrawn
+            if self.recorder:
+                self.recorder.event(
+                    self, "Normal", "DrainCancelled",
+                    f"drain {cur.get('id')} withdrawn: the eviction that "
+                    f"requested it was cancelled before the payload "
+                    f"adopted it")
+
+    def _planned_restart(self, attempt: int, detail: str) -> None:
+        """Every process of the gang exited EXIT_PLANNED: the
+        cooperative drain completed (gang-agreed verified save, orderly
+        exit at a step boundary). Resolve the directive to Completed,
+        export the drain latency and the per-reason planned-restart
+        counter, then route by reason:
+
+        - ``preemption``: consume the pending eviction and requeue (the
+          drain-first eviction path) — the verified save just landed, so
+          the preemptor takes the slices with ~zero lost step-seconds;
+        - ``resize``/``maintenance`` (and a directive-less planned
+          exit): restart in place — the attempt bump re-enters
+          ``_sync_elastic``, which renegotiates toward maxSlices (the
+          grow) or around capacity that left the inventory."""
+        status = self.job.status
+        cur = self._active_drain(attempt)
+        reason = str(cur.get("reason", "")) if cur else ""
+        if cur is not None:
+            done = dict(cur)
+            done["state"] = DrainState.COMPLETED
+            if done.get("drainedStep") is None:
+                # The payload's ACK carries the boundary step; a gang
+                # that exited before its ACK posted falls back to the
+                # freshest durable step we know.
+                ck = status.checkpoint or {}
+                hb = status.last_heartbeat or {}
+                for source in (ck.get("lastCheckpointStep"),
+                               hb.get("step")):
+                    if isinstance(source, int):
+                        done["drainedStep"] = source
+                        break
+            status.drain = done
+            if self.metrics is not None:
+                labels = {"namespace": self.namespace, "name": self.name}
+                requested = parse_rfc3339(str(cur.get("time", "")))
+                now_epoch = parse_rfc3339(_now())
+                if requested is not None and now_epoch is not None:
+                    self.metrics.observe(
+                        "job_drain_seconds",
+                        max(0.0, now_epoch - requested), labels=labels)
+                self.metrics.inc(
+                    "job_planned_restarts_total",
+                    labels={**labels, "reason": reason})
+        if reason == DrainReason.PREEMPTION and self.scheduler is not None:
+            evict = self.scheduler.pop_eviction(self._sched_key(),
+                                                uid=self.uid)
+            if evict is not None:
+                # Billed PLANNED (the drain did its job), but through the
+                # eviction teardown: reservation released, job requeued.
+                self._preempt_to_queue(
+                    attempt,
+                    f"{evict}; cooperative drain "
+                    f"{cur.get('id') if cur else ''} completed",
+                    kind=FailureKind.PLANNED)
+                return
+        self._group_restart(attempt, FailureKind.PLANNED, detail)
+
+    def _maybe_request_grow(self, now: Optional[float],
+                            attempt: int) -> None:
+        """In-attempt live resize, the grow half: a Running elastic gang
+        granted fewer slices than maxSlices drains and re-gangs wider
+        WITHIN the job — no failure required — once the inventory has
+        held enough free capacity for the full debounce window.
+        Debounced because capacity free at the instant a neighbor
+        restarts is routinely re-taken seconds later; thrashing a
+        healthy gang for transient headroom costs more step-seconds
+        than the width would earn back."""
+        if now is None or self.scheduler is None:
+            return
+        rng = elastic_mod.elastic_range(self.job.spec)
+        if rng is None:
+            return
+        _lo, hi = rng
+        el = self.job.status.elastic or {}
+        cur_slices = int(el.get("slices") or 0)
+        if not cur_slices or cur_slices >= hi:
+            self._grow_headroom_since = None
+            return
+        if self._active_drain(attempt) is not None:
+            return
+        headroom = getattr(self.scheduler, "grow_headroom", None)
+        if headroom is None:
+            return
+        target = headroom(self._sched_key(), uid=self.uid, max_slices=hi)
+        if target is None or target <= cur_slices:
+            self._grow_headroom_since = None
+            return
+        _deadline, debounce = self._drain_params()
+        if self._grow_headroom_since is None:
+            self._grow_headroom_since = now
+        if now - self._grow_headroom_since < debounce:
+            return  # wakeup armed via next_time_obligation
+        self._grow_headroom_since = None
+        self.request_drain(
+            DrainReason.RESIZE,
+            f"inventory headroom for {int(target)}/{hi} slice(s) held "
+            f"{debounce}s (running {cur_slices})",
+            target_slices=int(target))
 
     def _record_failure(self, attempt: int, kind: str, reason: str) -> None:
         """Record one classified failure: an entry in the ``status.failures``
@@ -991,7 +1371,12 @@ class TrainingJob:
         if len(ledger) > FAILURE_LEDGER_CAP:
             del ledger[:len(ledger) - FAILURE_LEDGER_CAP]
         status.restart_counts[kind] = status.restart_counts.get(kind, 0) + 1
-        status.consecutive_failures += 1
+        if kind != FailureKind.PLANNED:
+            # Planned (cooperative-drain) exits are operator-initiated:
+            # they must not inflate the crash-streak backoff exponent,
+            # or a job that grew three times in a quiet hour would meet
+            # its next real crash at 8x the base delay.
+            status.consecutive_failures += 1
 
     def _group_restart(self, attempt: int, kind: str, reason: str) -> None:
         """Tear down the failed generation and start the next one
@@ -1009,7 +1394,11 @@ class TrainingJob:
         self.job.status.state = State.RUNNING
         delay = 0.0
         backoff = self.job.spec.restart_backoff
-        if backoff is not None:
+        # Planned (cooperative-drain) restarts re-gang immediately: the
+        # exit was orderly and the verified save landed — crash spacing
+        # has nothing to space, and every backoff second is a scheduled
+        # gang sitting idle on purpose.
+        if backoff is not None and kind != FailureKind.PLANNED:
             # Exponent = consecutive failures since the last sustained
             # healthy stretch (this one included): restart 1 waits base,
             # restart 2 waits 2*base, ... capped. The streak resets after
@@ -1063,8 +1452,13 @@ class TrainingJob:
         preemptions draw from ``maxRestarts * PREEMPTION_BUDGET_FACTOR``,
         application/stall restarts share ``maxRestarts``."""
         counts = self.job.status.restart_counts
-        if kind == FailureKind.PREEMPTION:
-            used = counts.get(FailureKind.PREEMPTION, 0)
+        if kind in (FailureKind.PREEMPTION, FailureKind.PLANNED):
+            # Planned (cooperative-drain) restarts are operator-initiated
+            # slice churn, the same pool as preemptions: they share the
+            # larger infra budget and can never exhaust the crash-loop
+            # budget.
+            used = (counts.get(FailureKind.PREEMPTION, 0)
+                    + counts.get(FailureKind.PLANNED, 0))
             budget = self.job.spec.max_restarts * PREEMPTION_BUDGET_FACTOR
             return used, budget, f"{budget} preemption restarts"
         used = (counts.get(FailureKind.APPLICATION, 0)
@@ -1172,17 +1566,19 @@ class TrainingJob:
             status.reason = f"unschedulable: {impossible}"
         self._sync_sched_status(queued=True)
 
-    def _preempt_to_queue(self, attempt: int, reason: str) -> None:
+    def _preempt_to_queue(self, attempt: int, reason: str,
+                          kind: str = FailureKind.PREEMPTION) -> None:
         """Scheduler eviction: tear the gang down as a PREEMPTION-kind
         restart (the PR-2 preemption budget — an eviction must requeue the
         job, not burn its crash-loop budget) and park it in Queued; the
-        next admission re-gangs under a bumped attempt."""
+        next admission re-gangs under a bumped attempt. The drain-first
+        path passes kind=PLANNED: same teardown and requeue, but the
+        ledger records that the gang saved and exited on request."""
         if self.metrics is not None:
             # Counted here — the actual eviction — not at pop_eviction: a
             # directive consumed by an already-succeeded gang is a no-op.
             self.metrics.inc("tpujob_preemptions_total")
-        if not self._teardown_generation(attempt, FailureKind.PREEMPTION,
-                                         reason):
+        if not self._teardown_generation(attempt, kind, reason):
             return  # budget exhausted; _fail already ran + released
         self.job.status.backoff_until = ""
         self.job.status.replica_statuses = []
@@ -1595,6 +1991,23 @@ class TrainingJob:
             return None
         return baseline + st
 
+    def _drain_deadline_epoch(self) -> Optional[float]:
+        """Epoch of the active drain directive's hard-teardown deadline
+        (None: no directive in flight for the current attempt)."""
+        active = self._active_drain(self.job.status.attempt)
+        if active is None:
+            return None
+        return parse_rfc3339(str(active.get("deadline", "")))
+
+    def _grow_ready_epoch(self) -> Optional[float]:
+        """Epoch at which observed grow headroom will have held for the
+        full debounce window (armed only mid-debounce) — the wakeup
+        that fires the resize drain of an otherwise-quiet healthy
+        gang."""
+        if self._grow_headroom_since is None:
+            return None
+        return self._grow_headroom_since + self._drain_params()[1]
+
     def _ttl_epoch(self) -> Optional[float]:
         """Epoch at which a finished job is reaped (None: keep forever)."""
         ttl = self.job.spec.ttl_seconds_after_finished
@@ -1628,6 +2041,11 @@ class TrainingJob:
                     parse_rfc3339(self.job.status.backoff_until))
             candidates.append(self._stall_epoch())
             candidates.append(self._deadline_epoch())
+            # Cooperative drain: the directive's hard-teardown deadline,
+            # and the grow debounce maturing — both need an exact-time
+            # reconcile even when the gang posts nothing.
+            candidates.append(self._drain_deadline_epoch())
+            candidates.append(self._grow_ready_epoch())
             # Serve mode: the earliest serving-beat expiry — the wakeup
             # that removes a wedged replica's Service on time even when
             # no event (beat, resync) would otherwise reconcile.
