@@ -137,9 +137,17 @@ python bench.py --flagship --quick
 # scaling through the fleet scheduler, and the hot-reload acceptance
 # e2e (loadedStep advances, attempt does not).
 python -m pytest tests/test_serving.py -x -q
-# And its measured form: the batched decode service under the synthetic
-# load generator, and the rolling reload under sustained load — zero
-# failed decode steps or the gate exits nonzero.
+# Standalone paged-KV-cache gate: the block-paged decode engine —
+# allocator invariants (alloc/free/reuse, double-free raises), the
+# paged decode path bit-equal to the dense re-forward at a fixed seed,
+# admission churn with page reuse, oversubscribed-pool backpressure,
+# and hot reload swapping params without invalidating live pages.
+python -m pytest tests/test_kvcache.py -x -q
+# And the measured form: the continuous-batching decode service under
+# the synthetic load generator (p99 under the SLO budget, zero shed,
+# zero failed decode steps), the rolling reload under sustained load,
+# the incremental-vs-reforward A/B, and the flat-per-token-cost gate —
+# any regression exits nonzero.
 python bench.py --serve --quick
 # Standalone elastic-gangs gate: inventory-sized attempts (grant in
 # [minSlices, maxSlices], shrink-don't-queue, re-expand, granted — not

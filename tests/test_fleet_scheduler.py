@@ -413,6 +413,85 @@ def test_preemption_prefers_shrunk_victims_over_full_width():
     assert s.is_admitted("default/full")
 
 
+def test_preemption_spares_serve_fleet_at_min_replicas():
+    """ISSUE 20's scheduler tail: a serving fleet already at its replica
+    floor ranks as a WORSE victim than a training gang in the same
+    priority band — even a SHRUNK training gang, and even though the
+    at-min fleet itself reads as shrunk (scaled below its preferred
+    maximum). Evicting the fleet takes live traffic capacity to zero;
+    the training gang resumes from its checkpoint."""
+    s, _wakes = sched(capacity=4)
+    # A serve fleet scaled down to its minReplicas floor of 2 (preferred
+    # maximum 4): shrunk by the old reading, at-min by the serve one.
+    assert s.ensure_admitted("default/fleet", uid="uid-fleet",
+                             demand=(KEY, 4), held_slices=2,
+                             holds_hardware=True, serve=True,
+                             serve_min_slices=2)
+    assert s.granted_slices("default/fleet") == 2
+    # A training gang running shrunk (granted 2 of preferred 6) — the
+    # old shrunk-first rule alone would have ranked the fleet equal and
+    # then evicted it as the NEWER admission.
+    assert s.ensure_admitted("default/train", uid="uid-train",
+                             demand=(KEY, 6), min_slices=2)
+    assert s.granted_slices("default/train") == 2
+    assert not offer(s, "urgent", priority=10, slices=2)
+    assert s.peek_eviction("default/fleet") is None
+    reason = s.pop_eviction("default/train")
+    assert reason and "default/urgent" in reason
+    assert s.is_admitted("default/fleet")
+    assert s.is_admitted("default/urgent")
+
+
+def test_preemption_serve_fleet_above_min_ranks_normally():
+    """A serve fleet still ABOVE its floor has slack to give back, so it
+    keeps the ordinary newest-first ranking — the at-min shield applies
+    exactly when eviction would take the fleet dark."""
+    s, _wakes = sched(capacity=4)
+    assert s.ensure_admitted("default/train", uid="uid-train",
+                             demand=(KEY, 2))
+    # Fleet at 2 slices over a minReplicas floor of 1: not at-min, and
+    # the newer admission — the ordinary victim.
+    assert s.ensure_admitted("default/fleet", uid="uid-fleet",
+                             demand=(KEY, 2), serve=True,
+                             serve_min_slices=1)
+    assert not offer(s, "urgent", priority=10, slices=2)
+    assert s.peek_eviction("default/train") is None
+    reason = s.pop_eviction("default/fleet")
+    assert reason and "default/urgent" in reason
+
+
+def test_serving_sched_kwargs_carries_serve_floor():
+    """serving.sched_kwargs tags every serve job's scheduler entry with
+    its minimum slice footprint: minReplicas for slice-per-replica
+    fleets, the whole (fixed) footprint otherwise — the input the
+    victim ranking's at-min shield reads."""
+    from tpu_operator.trainer import serving as serving_mod
+
+    job_spec = t.TPUJobSpec(replica_specs=[
+        t.TPUReplicaSpec(replicas=4, template=make_template(),
+                         tpu_port=t.DEFAULT_TPU_PORT,
+                         tpu_replica_type=t.TPUReplicaType.WORKER)])
+    job_spec.mode = t.JobMode.SERVE
+    job_spec.num_slices = 4  # slice-per-replica: 4 workers, 4 slices
+    job_spec.serving = t.ServingSpec(min_replicas=2, max_replicas=4)
+    demand, kwargs = serving_mod.sched_kwargs(
+        job_spec, {"replicas": 3}, (KEY, 4))
+    assert demand == (KEY, 3)  # current scale, not the spec maximum
+    assert kwargs == {"held_slices": 3, "serve": True,
+                      "serve_min_slices": 2}
+    # Fixed-footprint serve job (not slice-per-replica): always at its
+    # floor — the whole demand is the minimum.
+    job_spec.num_slices = 1
+    demand, kwargs = serving_mod.sched_kwargs(
+        job_spec, {"replicas": 3}, (KEY, 1))
+    assert demand == (KEY, 1)
+    assert kwargs == {"serve": True, "serve_min_slices": 1}
+    # Non-serve jobs pass through untouched.
+    job_spec.mode = t.JobMode.TRAIN
+    assert serving_mod.sched_kwargs(job_spec, None, (KEY, 4)) \
+        == ((KEY, 4), {})
+
+
 def test_unfittable_head_blocks_only_its_own_shape():
     """A full v4 pool must not park v5e jobs whose own pool is free: the
     head-of-line block is per slice shape, not global."""

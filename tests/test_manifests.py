@@ -40,10 +40,19 @@ def test_examples_exist():
             "tpujob-multislice.yml", "tpujob-gang-pair.yml"} <= names
 
 
+def tpujob_docs(path: pathlib.Path):
+    """The TPUJob documents of an example. Companion resources (e.g. the
+    serve-ingress example's Ingress) ride in the same file; every
+    example must still ship at least one TPUJob."""
+    docs = [d for d in load_docs(path)
+            if d.get("apiVersion") == types.CRD_API_VERSION]
+    assert docs, f"{path.name}: no TPUJob document"
+    return docs
+
+
 @pytest.mark.parametrize("path", TPUJOB_EXAMPLES, ids=lambda p: p.name)
 def test_tpujob_examples_default_and_validate(path):
-    for doc in load_docs(path):
-        assert doc["apiVersion"] == types.CRD_API_VERSION
+    for doc in tpujob_docs(path):
         assert doc["kind"] == types.CRD_KIND
         job = types.TPUJob.from_dict(doc)
         defaults.set_defaults(job.spec)
@@ -54,7 +63,7 @@ def test_tpujob_examples_default_and_validate(path):
 def test_tpujob_examples_pass_structural_schema_strict(path):
     from tpu_operator.apis.tpujob.v1alpha1 import schema as schema_mod
 
-    for doc in load_docs(path):
+    for doc in tpujob_docs(path):
         ok, message = schema_mod.validate_tpujob_strict(doc)
         assert ok, f"{path.name}: {message}"
 

@@ -233,6 +233,8 @@ def test_serve_env_injection():
     assert env["TPUJOB_SERVE"] == "1"
     assert env["TPUJOB_SERVE_RELOAD_POLL"] == \
         str(t.DEFAULT_SERVE_RELOAD_POLL)
+    # HTTP ingress rides the SAME port the replica Service targets.
+    assert env["TPUJOB_SERVE_PORT"] == str(t.DEFAULT_TPU_PORT)
     assert env["TPUJOB_STORE_KEEP"] == "2"
     # Independent servers: no cross-replica process group, identity kept.
     assert env["JAX_NUM_PROCESSES"] == "1"
@@ -276,12 +278,24 @@ def test_statusserver_serving_door():
     clean, err = _sanitize_serving(serving_body())
     assert err == "" and clean["ready"] is True
     assert clean["loadedStep"] == 10
+    # The paged-decode signals ride the same strict door.
+    clean, err = _sanitize_serving(serving_body(
+        tokensPerSecond=120.5, queueDepth=3, kvCacheUtilization=0.75))
+    assert err == ""
+    assert clean["tokensPerSecond"] == pytest.approx(120.5)
+    assert clean["queueDepth"] == 3
+    assert clean["kvCacheUtilization"] == pytest.approx(0.75)
     for bad in (serving_body(ready="false"),      # bool("false") is True
                 serving_body(ready=1),
                 serving_body(requestsPerSecond=-1.0),
                 serving_body(p95LatencySeconds=float("nan")),
                 serving_body(loadedStep=True),
                 serving_body(reloads=-2),
+                serving_body(tokensPerSecond=-5.0),
+                serving_body(tokensPerSecond=float("inf")),
+                serving_body(queueDepth=-1),
+                serving_body(queueDepth="deep"),
+                serving_body(kvCacheUtilization=float("nan")),
                 "not-an-object"):
         clean, err = _sanitize_serving(bad)
         assert clean is None and err, bad
@@ -367,6 +381,31 @@ def test_serving_fold_aggregates():
     assert m.counter_value("job_serving_latency_seconds",
                            {**labels, "quantile": "0.95"}) \
         == pytest.approx(0.05)
+
+
+def test_serving_fold_paged_decode_signals():
+    """tokensPerSecond and queueDepth are fleet SUMS (every replica's
+    queue is real demand, ready or mid-reload); kvCacheUtilization is
+    the WORST replica's pool pressure — and all three land on their
+    job_serving_* gauges."""
+    cs, controller, tj, now, beat = serving_harness(replicas=3)
+    beat(0, serving_body(tokensPerSecond=100.0, queueDepth=2,
+                         kvCacheUtilization=0.5))
+    beat(1, serving_body(tokensPerSecond=50.5, queueDepth=0,
+                         kvCacheUtilization=0.9))
+    beat(2, serving_body(ready=False, tokensPerSecond=10.0, queueDepth=7,
+                         kvCacheUtilization=0.1))
+    sv = tj.job.status.serving
+    assert sv["tokensPerSecond"] == pytest.approx(160.5)
+    assert sv["queueDepth"] == 9
+    assert sv["kvCacheUtilization"] == pytest.approx(0.9)
+    m = controller.metrics
+    labels = {"namespace": "default", "name": "sv"}
+    assert m.counter_value("job_serving_tokens_per_second",
+                           labels) == pytest.approx(160.5)
+    assert m.counter_value("job_serving_queue_depth", labels) == 9
+    assert m.counter_value("job_serving_kv_cache_utilization",
+                           labels) == pytest.approx(0.9)
 
 
 def test_serving_reload_delta_accounting():
@@ -889,7 +928,9 @@ def test_describe_shows_serving_section():
         job.status.phase = t.TPUJobPhase.RUNNING
         job.status.serving = {
             "replicas": 3, "desiredReplicas": 2, "replicasReady": 3,
-            "requestsPerSecond": 5.5, "p50LatencySeconds": 0.01,
+            "requestsPerSecond": 5.5, "tokensPerSecond": 480.0,
+            "queueDepth": 12, "kvCacheUtilization": 0.62,
+            "p50LatencySeconds": 0.01,
             "p95LatencySeconds": 0.025, "loadedStep": 40, "reloads": 2,
             "attempt": 0, "time": "2026-08-04T00:00:00Z"}
         cs.tpujobs.create("default", job.to_dict())
@@ -901,7 +942,9 @@ def test_describe_shows_serving_section():
         assert "Serving:    3/3 ready" in text
         assert "desired 2" in text and "range 1-4" in text
         assert "5.5 req/s" in text
+        assert "480 tok/s" in text
         assert "p95 25.0 ms" in text
+        assert "Backlog:    queue depth 12, KV cache 62% held" in text
         assert "loaded step 40" in text and "2 reload(s)" in text
 
 
@@ -960,6 +1003,117 @@ def test_burst_backlog_drains_after_arrivals_stop():
     assert summary["arrivals"] >= 50
 
 
+def test_serving_wire_carries_paged_decode_signals():
+    """The beat body grows tokensPerSecond / queueDepth /
+    kvCacheUtilization — exactly the fields the statusserver door admits
+    and the fold aggregates."""
+    loop = serve_mod.ServeLoop(serve_args(load="30:0.5"), make_info(),
+                               heartbeat=None, store=None, recorder=None)
+    summary = loop.run()
+    assert summary["completed"] == summary["arrivals"] > 0
+    assert summary["tokensGenerated"] \
+        == summary["completed"] * loop.args.decode_tokens
+    assert summary["tokensPerSecond"] > 0
+    assert summary["shed"] == 0
+    assert summary["p99LatencySeconds"] >= summary["p50LatencySeconds"]
+    wire = loop.serving_wire()
+    assert set(wire) >= {"ready", "requestsPerSecond", "tokensPerSecond",
+                         "queueDepth", "kvCacheUtilization", "loadedStep",
+                         "reloads"}
+    assert wire["queueDepth"] == 0
+    assert wire["kvCacheUtilization"] == 0.0  # all requests completed
+    # The wire body passes the statusserver's strict door verbatim.
+    from tpu_operator.controller.statusserver import _sanitize_serving
+
+    clean, err = _sanitize_serving(wire)
+    assert err == "" and clean is not None
+
+
+def test_http_ingress_decode_and_healthz():
+    """The per-replica HTTP endpoint: POST /v1/decode queues through the
+    continuous-batching loop and answers with the generated tokens —
+    and they equal the synthetic path's greedy decode for the same
+    prompt. /healthz tracks readiness."""
+    import json as json_mod
+    import urllib.error
+    import urllib.request
+
+    import numpy as np
+
+    port = _free_port()
+    args = serve_args(load="0:0", http_port=port)
+    loop = serve_mod.ServeLoop(args, make_info(), heartbeat=None,
+                               store=None, recorder=None)
+    runner = threading.Thread(target=loop.run, daemon=True)
+    runner.start()
+    try:
+        deadline = time.monotonic() + 60
+        while not loop.ready and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert loop.ready
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}/healthz", timeout=10) as r:
+            assert r.status == 200
+        prompt = [int(x) for x in (np.arange(args.window) + 1)
+                  % args.vocab]
+        body = json_mod.dumps({"prompt": prompt, "maxTokens": 2}).encode()
+        req = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/decode", data=body,
+            headers={"Content-Type": "application/json"})
+        with urllib.request.urlopen(req, timeout=60) as r:
+            assert r.status == 200
+            tokens = json_mod.loads(r.read())["tokens"]
+        assert len(tokens) == 2
+        # A malformed prompt is a 400, not a crash.
+        bad = urllib.request.Request(
+            f"http://127.0.0.1:{port}/v1/decode", data=b"{}",
+            headers={"Content-Type": "application/json"})
+        with pytest.raises(urllib.error.HTTPError) as e:
+            urllib.request.urlopen(bad, timeout=10)
+        assert e.value.code == 400
+    finally:
+        loop.stop()
+        runner.join(timeout=10)
+    assert loop.completed >= 1
+
+
+def _free_port():
+    import socket
+
+    with socket.socket() as s:
+        s.bind(("", 0))
+        return s.getsockname()[1]
+
+
+def test_backpressure_depth_bound_and_deadline_shed():
+    """Depth-bounded admission (submit past --max-queue sheds, returns
+    None) and age-bounded queues (_shed_expired drops oldest-first past
+    --queue-deadline, setting the request's shed flag) — both counted,
+    both visible on the wire as queueDepth."""
+    clock = [0.0]
+    args = serve_args(load="0:0", max_queue=2, queue_deadline=5.0)
+    loop = serve_mod.ServeLoop(args, make_info(), heartbeat=None,
+                               store=None, recorder=None,
+                               clock=lambda: clock[0])
+    a = loop.submit([1, 2, 3], 2)
+    b = loop.submit([4, 5, 6], 2)
+    assert a is not None and b is not None
+    assert loop.queue_depth() == 2
+    # Queue full: the third arrival sheds at admission.
+    c = loop.submit([7, 8, 9], 2)
+    assert c is None
+    assert loop.shed == 1
+    assert loop.serving_wire()["queueDepth"] == 2
+    # Offered load counted the shed arrival too (demand visibility) —
+    # the wire drained all 3 arrivals above.
+    clock[0] = 6.0
+    loop._shed_expired(clock[0])
+    assert loop.shed == 3
+    assert a.done.is_set() and a.shed
+    assert b.done.is_set() and b.shed
+    assert loop.queue_depth() == 0
+
+
 def test_failed_warmup_never_goes_ready():
     """A replica whose warm-up decode failed must not post ready — and a
     persistent failure streak exits instead of blackholing requests."""
@@ -982,7 +1136,8 @@ def test_failed_warmup_never_goes_ready():
     def boom(*_a, **_k):
         raise RuntimeError("poisoned device")
 
-    loop._decode = boom
+    loop.engine.warmup = boom
+    loop.engine.step = boom
     with pytest.raises(RuntimeError):
         loop.run()
     assert not any(p.get("ready") for p in posts)

@@ -55,6 +55,7 @@ SCAN = (
     ("tpu_operator", "testing", "cluster.py"),
     ("tpu_operator", "payload", "autotune.py"),
     ("tpu_operator", "payload", "checkpoint.py"),
+    ("tpu_operator", "payload", "kvcache.py"),
     ("tpu_operator", "payload", "serve.py"),
     ("tpu_operator", "payload", "startup.py"),
     ("tpu_operator", "payload", "steptrace.py"),

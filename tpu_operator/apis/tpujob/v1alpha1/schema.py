@@ -287,6 +287,13 @@ def serving_beat_schema() -> Dict[str, Any]:
     return _obj({
         "ready": {"type": "boolean"},
         "requestsPerSecond": _num(minimum=0),
+        # Decode throughput of the paged KV-cache engine (tokens emitted
+        # over the reporting window) — the bench's A/B currency.
+        "tokensPerSecond": _num(minimum=0),
+        # Ingress backpressure signals: requests waiting for a slot, and
+        # the fraction of the KV page pool held by live requests.
+        "queueDepth": _int(minimum=0),
+        "kvCacheUtilization": _num(minimum=0),
         "p50LatencySeconds": _num(minimum=0),
         "p95LatencySeconds": _num(minimum=0),
         "loadedStep": _int(minimum=0),
@@ -304,6 +311,11 @@ def serving_status_schema() -> Dict[str, Any]:
         "desiredReplicas": _int(minimum=0),
         "replicasReady": _int(minimum=0),
         "requestsPerSecond": _num(minimum=0),
+        # Fleet decode throughput (sum over ready replicas), total queued
+        # backlog, and the worst replica's KV page-pool utilization.
+        "tokensPerSecond": _num(minimum=0),
+        "queueDepth": _int(minimum=0),
+        "kvCacheUtilization": _num(minimum=0),
         "p50LatencySeconds": _num(minimum=0),
         "p95LatencySeconds": _num(minimum=0),
         "loadedStep": _int(minimum=0),

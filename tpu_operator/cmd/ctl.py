@@ -244,9 +244,22 @@ def cmd_describe(cs, opts) -> int:
             line += ")"
         if sv.get("requestsPerSecond") is not None:
             line += f", {sv['requestsPerSecond']:.1f} req/s"
+        if sv.get("tokensPerSecond") is not None:
+            line += f", {sv['tokensPerSecond']:.0f} tok/s"
         if sv.get("p95LatencySeconds") is not None:
             line += f", p95 {sv['p95LatencySeconds'] * 1000:.1f} ms"
         print(line)
+        # The backpressure line: queued demand + KV page-pool pressure
+        # (the paged-decode admission signals).
+        if sv.get("queueDepth") is not None \
+                or sv.get("kvCacheUtilization") is not None:
+            parts = []
+            if sv.get("queueDepth") is not None:
+                parts.append(f"queue depth {sv['queueDepth']}")
+            if sv.get("kvCacheUtilization") is not None:
+                parts.append(
+                    f"KV cache {sv['kvCacheUtilization'] * 100:.0f}% held")
+            print(f"Backlog:    {', '.join(parts)}")
         if sv.get("loadedStep") is not None or sv.get("reloads"):
             reload_s = f"{sv.get('reloads', 0)} reload(s)"
             if sv.get("time") and sv.get("reloads"):

@@ -572,6 +572,9 @@ class Controller:
                            "store_prefetch_misses_total",
                            "job_serving_replicas_ready",
                            "job_serving_requests_per_second",
+                           "job_serving_tokens_per_second",
+                           "job_serving_queue_depth",
+                           "job_serving_kv_cache_utilization",
                            "job_weight_reloads_total",
                            "job_drain_seconds"):
                 self.metrics.remove_series(
@@ -1287,11 +1290,13 @@ class Controller:
         entry: Dict[str, Any] = {"seen": now, "stale": False}
         entry["ready"] = bool(sv_beat.get("ready"))
         for field, key_ in (("requestsPerSecond", "rps"),
+                            ("tokensPerSecond", "tps"),
+                            ("kvCacheUtilization", "kvutil"),
                             ("p50LatencySeconds", "p50"),
                             ("p95LatencySeconds", "p95")):
             if sv_beat.get(field) is not None:
                 entry[key_] = float(sv_beat[field])
-        for field in ("loadedStep", "reloads"):
+        for field in ("queueDepth", "loadedStep", "reloads"):
             if sv_beat.get(field) is not None:
                 entry[field] = int(sv_beat[field])
         state["procs"][int(pid)] = entry
@@ -1313,6 +1318,18 @@ class Controller:
         new["replicasReady"] = len(ready_pids)
         total_rps = sum(e.get("rps", 0.0) for e in procs.values())
         new["requestsPerSecond"] = round(total_rps, 3)
+        # Fleet decode throughput and queued backlog are SUMS (every
+        # replica's contribution counts, ready or mid-reload — its queue
+        # is real demand either way); cache pressure is the WORST
+        # replica's pool utilization (1.0 anywhere means admissions are
+        # blocking on pages there, an average would hide it).
+        new["tokensPerSecond"] = round(
+            sum(e.get("tps", 0.0) for e in procs.values()), 3)
+        new["queueDepth"] = sum(int(e.get("queueDepth", 0))
+                                for e in procs.values())
+        kvutil = [e["kvutil"] for e in procs.values() if "kvutil" in e]
+        if kvutil:
+            new["kvCacheUtilization"] = round(max(kvutil), 4)
         for key_, field in (("p50", "p50LatencySeconds"),
                             ("p95", "p95LatencySeconds")):
             vals = [e[key_] for p, e in procs.items()
@@ -1373,6 +1390,19 @@ class Controller:
                                new["requestsPerSecond"],
                                labels={"namespace": namespace,
                                        "name": name})
+        self.metrics.set_gauge("job_serving_tokens_per_second",
+                               new["tokensPerSecond"],
+                               labels={"namespace": namespace,
+                                       "name": name})
+        self.metrics.set_gauge("job_serving_queue_depth",
+                               new["queueDepth"],
+                               labels={"namespace": namespace,
+                                       "name": name})
+        if new.get("kvCacheUtilization") is not None:
+            self.metrics.set_gauge("job_serving_kv_cache_utilization",
+                                   new["kvCacheUtilization"],
+                                   labels={"namespace": namespace,
+                                           "name": name})
         for q, field in (("0.5", "p50LatencySeconds"),
                          ("0.95", "p95LatencySeconds")):
             if new.get(field) is not None:

@@ -309,6 +309,20 @@ class Metrics:
                       "replicas, from serving heartbeats — the traffic "
                       "signal the replica scaler divides by "
                       "targetRequestsPerSecondPerReplica.")
+        self.register("job_serving_tokens_per_second", "gauge",
+                      "Aggregate decode tokens/sec across the job's "
+                      "ready serve replicas, from serving heartbeats — "
+                      "the paged-KV incremental-decode throughput the "
+                      "bench's A/B gate measures.")
+        self.register("job_serving_queue_depth", "gauge",
+                      "Requests queued for a decode slot across the "
+                      "job's serve replicas (depth-bounded admission "
+                      "sheds past --max-queue; a persistently deep "
+                      "queue is the scale-up signal).")
+        self.register("job_serving_kv_cache_utilization", "gauge",
+                      "KV page-pool utilization of the WORST serve "
+                      "replica (fraction of pages held by live "
+                      "requests; 1.0 = admission blocked on pages).")
         self.register("job_serving_latency_seconds", "gauge",
                       "Per-request decode latency of the WORST ready "
                       "replica, by quantile label (0.5 / 0.95) — the "
@@ -644,7 +658,8 @@ def _sanitize_serving(sv: Any) -> Tuple[Optional[Dict[str, Any]], str]:
         if not isinstance(sv["ready"], bool):
             return None, "bad heartbeat: non-boolean serving.ready"
         clean["ready"] = sv["ready"]
-    for field in ("requestsPerSecond", "p50LatencySeconds",
+    for field in ("requestsPerSecond", "tokensPerSecond",
+                  "kvCacheUtilization", "p50LatencySeconds",
                   "p95LatencySeconds"):
         if sv.get(field) is not None:
             try:
@@ -654,7 +669,7 @@ def _sanitize_serving(sv: Any) -> Tuple[Optional[Dict[str, Any]], str]:
             if not math.isfinite(value) or value < 0:
                 return None, f"bad heartbeat: bad serving.{field}"
             clean[field] = value
-    for field in ("loadedStep", "reloads"):
+    for field in ("queueDepth", "loadedStep", "reloads"):
         if sv.get(field) is not None:
             value, err = _int_field(sv[field], 0, f"serving.{field}")
             if err:

@@ -941,6 +941,173 @@ def merge_kv_block(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
     return _merge(causal, interpret, q, k, v, o, l, m, offsets)
 
 
+# --- cached decode ------------------------------------------------------------
+#
+# The serve payload's incremental decode (payload/kvcache.py) attends ONE new
+# token per slot against that slot's cached K/V. The shape is nothing like
+# training attention: Tq is 1 (or a handful at speculative widths), the key
+# span is the cache's padded capacity, and the only mask is a per-ROW valid
+# length — row b's keys beyond lengths[b] are cache garbage (stale pages from
+# a released request, zero-init) that must contribute *exactly* zero. The
+# masked score is NEG_INF, so p = exp(NEG_INF - m) underflows to 0.0 in f32
+# and 0 * finite-garbage = 0 — which is what makes a paged gather bit-equal
+# to a dense cache (tests/test_kvcache.py asserts it). No backward: decode is
+# inference-only, so there is no custom_vjp and no logsumexp residual.
+
+
+def _decode_ref(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                lengths: jnp.ndarray) -> jnp.ndarray:
+    """Length-masked attention in plain jnp, [B, Tq, H, D] query layout
+    against [B, S, KVH, D] cache. Query slot j of row b sits at global
+    position lengths[b] - Tq + j; keys are valid iff their position is
+    both < lengths[b] and <= the query's own position (causal within the
+    Tq tail). Single-pass max-subtracted softmax — masked lanes are
+    exactly zero (module note above)."""
+    b, tq, hq, d = q.shape
+    tk, hkv = k.shape[1], k.shape[2]
+    group = _group_of(jnp.einsum("bqhd->bhqd", q),
+                      jnp.einsum("bkhd->bhkd", k))
+    scale = d ** -0.5
+    qg = jnp.einsum("bqhd->bhqd", q.astype(jnp.float32)).reshape(
+        b, hkv, group, tq, d)
+    kf = jnp.einsum("bkhd->bhkd", k.astype(jnp.float32))
+    s = jnp.einsum("bhgqd,bhkd->bhgqk", qg, kf) * scale
+    q_pos = lengths.astype(jnp.int32)[:, None] - tq \
+        + jnp.arange(tq, dtype=jnp.int32)[None, :]            # [B, Tq]
+    k_pos = jnp.arange(tk, dtype=jnp.int32)                   # [S]
+    valid = k_pos[None, None, :] <= q_pos[:, :, None]         # [B, Tq, S]
+    s = jnp.where(valid[:, None, None], s, NEG_INF)
+    m = jnp.max(s, axis=-1, keepdims=True)
+    p = jnp.exp(s - m)
+    l = jnp.sum(p, axis=-1, keepdims=True)
+    o = jnp.einsum("bhgqk,bhkd->bhgqd", p,
+                   jnp.einsum("bkhd->bhkd", v.astype(jnp.float32)))
+    alive = m > NEG_INF / 2
+    o = jnp.where(alive, o / jnp.maximum(l, 1e-30), 0.0)
+    return jnp.einsum("bhqd->bqhd", o.reshape(b, hq, tq, d)).astype(q.dtype)
+
+
+def _decode_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, acc_scr, l_scr,
+                   m_scr, *, scale: float, group: int, tq: int, nk: int,
+                   blk_k: int):
+    """One (batch, kv-head, k-tile) cell of the cached-decode forward.
+    The whole Tq-deep query panel (group heads flattened, like the
+    training kernels) stays resident; per-row valid lengths arrive as a
+    scalar-prefetch array indexed by the batch grid dim, so one compiled
+    kernel serves every occupancy mix. Tiles entirely beyond the row's
+    length are skipped (and their K/V DMA elided by the clamped index
+    map in the caller)."""
+    ib = pl.program_id(0)
+    ik = pl.program_id(2)
+    length = len_ref[ib]
+    rows = group * tq
+
+    @pl.when(ik == 0)
+    def _reset():
+        acc_scr[...] = jnp.zeros_like(acc_scr)
+        l_scr[...] = jnp.zeros_like(l_scr)
+        m_scr[...] = jnp.full_like(m_scr, NEG_INF)
+
+    @pl.when(ik * blk_k < length)
+    def _tile():
+        qp = q_ref[0].reshape(rows, -1)
+        s = lax.dot_general(qp, k_ref[0, 0], (((1,), (1,)), ((), ())),
+                            preferred_element_type=jnp.float32) * scale
+        row = lax.broadcasted_iota(jnp.int32, (rows, blk_k), 0)
+        # Row r of the flattened panel is query slot r % tq (each group
+        # repeats the q panel), at global position length - tq + slot.
+        q_pos = length - tq + (lax.rem(row, tq) if tq > 1
+                               else jnp.zeros_like(row))
+        k_pos = ik * blk_k + lax.broadcasted_iota(jnp.int32, (rows, blk_k), 1)
+        s = jnp.where(k_pos <= q_pos, s, NEG_INF)
+        m = m_scr[...]
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        alpha = jnp.exp(m - m_new)
+        acc_scr[...] = acc_scr[...] * alpha + lax.dot_general(
+            p.astype(v_ref.dtype), v_ref[0, 0], (((1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32)
+        l_scr[...] = l_scr[...] * alpha + jnp.sum(p, axis=-1, keepdims=True)
+        m_scr[...] = m_new
+
+    @pl.when(ik == nk - 1)
+    def _emit():
+        m = m_scr[...]
+        l = l_scr[...]
+        alive = m > NEG_INF / 2
+        out = jnp.where(alive, acc_scr[...] / jnp.maximum(l, 1e-30), 0.0)
+        o_ref[0] = out.reshape(group, tq, -1).astype(o_ref.dtype)
+
+
+def _flash_decode_pallas(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                         lengths: jnp.ndarray, interpret: bool
+                         ) -> jnp.ndarray:
+    qt = jnp.einsum("bqhd->bhqd", q)
+    kt = jnp.einsum("bkhd->bhkd", k)
+    vt = jnp.einsum("bkhd->bhkd", v)
+    b, hq, tq, d = qt.shape
+    hkv, tk = kt.shape[1], kt.shape[2]
+    group = _group_of(qt, kt)
+    blk_k = _pick_block(tk, target=512)
+    nk = tk // blk_k
+    scale = d ** -0.5
+    lengths = lengths.astype(jnp.int32)
+
+    def qo_map(ib, ih, ik, lens):
+        return (ib, ih, 0, 0)
+
+    def kv_map(ib, ih, ik, lens):
+        # Tiles beyond the row's valid length are skipped in-kernel;
+        # clamping their index to the last contributing tile turns the
+        # skip into a free revisit (no K/V DMA), so decode reads
+        # O(length), not O(capacity).
+        last = jnp.maximum(lax.div(lens[ib] - 1, blk_k), 0)
+        return (ib, ih, jnp.minimum(ik, last), 0)
+
+    q_spec = pl.BlockSpec((1, group, tq, d), qo_map)
+    kv_spec = pl.BlockSpec((1, 1, blk_k, d), kv_map)
+    rows = group * tq
+    out = pl.pallas_call(
+        functools.partial(_decode_kernel, scale=scale, group=group, tq=tq,
+                          nk=nk, blk_k=blk_k),
+        grid_spec=pltpu.PrefetchScalarGridSpec(
+            num_scalar_prefetch=1,
+            grid=(b, hkv, nk),
+            in_specs=[q_spec, kv_spec, kv_spec],
+            out_specs=[pl.BlockSpec((1, group, tq, d), qo_map)],
+            scratch_shapes=[
+                pltpu.VMEM((rows, d), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+                pltpu.VMEM((rows, 1), jnp.float32),
+            ],
+        ),
+        out_shape=[jax.ShapeDtypeStruct((b, hq, tq, d), q.dtype)],
+        interpret=interpret,
+    )(lengths, qt, kt, vt)[0]
+    return jnp.einsum("bhqd->bqhd", out)
+
+
+def flash_decode(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray,
+                 lengths: jnp.ndarray, *,
+                 use_pallas: Optional[bool] = None) -> jnp.ndarray:
+    """Cached-decode attention: [B, Tq, H, D] new-token queries against a
+    [B, S, KVH, D] K/V cache with per-row valid ``lengths`` (int32 [B]) —
+    the serve payload's per-step hot op. Row b's query slot j sits at
+    position ``lengths[b] - Tq + j`` and attends keys at positions
+    < lengths[b] (its own K/V already written). K/V may carry grouped
+    heads exactly as in :func:`flash_attention`. Inference-only: no
+    backward, no residuals. ``use_pallas=None`` auto-selects the kernel
+    on TPU and the jnp path elsewhere."""
+    if use_pallas is None:
+        use_pallas = use_pallas_default()
+    if use_pallas and not _kernel_feasible(k.shape[1]):
+        use_pallas = False
+    if not use_pallas:
+        return _decode_ref(q, k, v, lengths)
+    interpret = jax.default_backend() != "tpu"
+    return _flash_decode_pallas(q, k, v, lengths, interpret)
+
+
 def flash_attention(q: jnp.ndarray, k: jnp.ndarray, v: jnp.ndarray, *,
                     causal: bool = True,
                     use_pallas: Optional[bool] = None) -> jnp.ndarray:

@@ -1,0 +1,350 @@
+"""Block-paged KV cache + incremental decode engine for ``mode: serve``.
+
+PR 13's serve loop re-forwards the whole ``[batch, window]`` request matrix
+for every generated token: per-token cost O(window · model), the direct
+analogue of the quadratic-prefill-per-token trap PagedAttention (Kwon et
+al., vLLM — public technique) exists to remove. This module is the
+incremental engine that replaces it:
+
+- **Paged cache.** K/V for every request live in fixed-size *pages* of a
+  shared device pool (``[layers, pages, page_size, kv_heads, head_dim]``,
+  bf16). A request owns ``ceil((prompt + budget) / page_size)`` pages,
+  allocated at admission and freed the moment it completes — a finished
+  short request's pages immediately serve a waiting long one. Per-slot
+  *page tables* (host int32, shipped to device each step) map positions to
+  pages; the gather through the table is what makes slot memory contiguous
+  to the kernel without ever being contiguous in HBM.
+- **Prefill = the batched forward.** Admission runs ONE causal forward over
+  the (padded) prompt on the ordinary attention path, emits the first
+  generated token, and scatters the prompt's K/V into the slot's pages.
+- **Decode = one token per slot per step.** The jitted step embeds each
+  active slot's last token at its current position, writes its K/V through
+  the page table, and attends against the gathered cache span with
+  :func:`flash_attention.flash_decode` (length-masked, GQA-native). Cost
+  per token is O(length · kv) instead of O(window · model).
+
+Masking discipline (what makes paged == dense *bit-equal*): any cache
+position ≥ a slot's length — zero-init, stale pages from a released
+request, the padded prompt tail — scores NEG_INF, whose probability
+underflows to exactly 0.0 in f32, so finite garbage contributes exactly
+nothing. Invalid writes (padded tail past a request's capacity, inactive
+slots) are steered to a sacrificial *trash page* (index ``num_pages``)
+that no table ever reads as valid, so they can never corrupt a neighbour.
+
+Threading: the engine is owned by the serve loop's single decode thread
+(serve.py's design — the reload watcher and HTTP ingress threads never
+touch it); the host-side tables/allocator therefore need no lock. Params
+are an *argument* to every jitted call, which is the hot-reload contract:
+swapping weights swaps nothing here, so live KV pages survive a reload.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import logging
+from typing import Any, Callable, List, Optional
+
+log = logging.getLogger(__name__)
+
+DEFAULT_PAGE_SIZE = 16
+
+
+class PageAllocator:
+    """Free-list page allocator with strict invariants: a page is either
+    free or held, double-free and foreign-free raise, and allocation is
+    all-or-nothing (a request that cannot get every page it needs gets
+    none, so admission never deadlocks holding a partial set)."""
+
+    def __init__(self, num_pages: int):
+        if num_pages <= 0:
+            raise ValueError(f"num_pages must be positive, got {num_pages}")
+        self.num_pages = int(num_pages)
+        self._free: List[int] = list(range(num_pages - 1, -1, -1))
+        self._held: set = set()
+
+    @property
+    def free_pages(self) -> int:
+        return len(self._free)
+
+    @property
+    def held_pages(self) -> int:
+        return len(self._held)
+
+    def utilization(self) -> float:
+        return len(self._held) / self.num_pages
+
+    def alloc(self, n: int) -> Optional[List[int]]:
+        """``n`` pages, or None when the pool cannot satisfy all of them
+        (the caller leaves the request queued — backpressure, not error)."""
+        if n <= 0:
+            raise ValueError(f"alloc needs a positive page count, got {n}")
+        if len(self._free) < n:
+            return None
+        pages = [self._free.pop() for _ in range(n)]
+        self._held.update(pages)
+        return pages
+
+    def free(self, pages: List[int]) -> None:
+        for p in pages:
+            if p not in self._held:
+                raise ValueError(
+                    f"page {p} is not held (double free or foreign page)")
+            self._held.discard(p)
+            self._free.append(p)
+
+
+@dataclasses.dataclass(frozen=True)
+class ModelSpec:
+    """The decode mirrors' model shape (models.lm_decode_apply args)."""
+
+    vocab: int
+    dim: int
+    heads: int
+    layers: int
+    max_seq: int
+    kv_heads: int = 0
+
+    @property
+    def grouped_kv_heads(self) -> int:
+        return self.kv_heads or self.heads
+
+    @property
+    def head_dim(self) -> int:
+        return self.dim // self.heads
+
+
+def _prefill_attend(q, k, v):
+    """Prompt attention = the ordinary batched causal forward, on the same
+    path the transformer payload selects (kernel on TPU, jnp elsewhere)."""
+    from tpu_operator.payload import flash_attention as fa
+    from tpu_operator.payload import ring_attention as ring
+
+    if fa.use_pallas_default():
+        return fa.flash_attention(q, k, v, causal=True)
+    return ring.reference_attention(q, k, v, causal=True)
+
+
+class DecodeEngine:
+    """Paged-cache incremental decode over ``slots`` concurrent requests.
+
+    Host side: page allocator + per-slot page tables / lengths / last
+    tokens (numpy). Device side: the page pool and two jitted functions —
+    ``prefill`` (one request) and ``step`` (all slots). Params are passed
+    per call; the engine never holds weights.
+    """
+
+    def __init__(self, spec: ModelSpec, *, slots: int,
+                 prompt_pad: int, max_new: int,
+                 page_size: int = DEFAULT_PAGE_SIZE, num_pages: int = 0,
+                 dtype: Any = None):
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        if slots <= 0:
+            raise ValueError(f"slots must be positive, got {slots}")
+        if page_size <= 0:
+            raise ValueError(f"page_size must be positive, got {page_size}")
+        self.spec = spec
+        self.slots = int(slots)
+        self.page_size = int(page_size)
+        self.prompt_pad = int(prompt_pad)
+        self.max_context = int(prompt_pad + max_new)
+        if self.max_context > spec.max_seq:
+            raise ValueError(
+                f"max context {self.max_context} (prompt {prompt_pad} + "
+                f"{max_new} new) exceeds the model's max_seq {spec.max_seq}")
+        self.pages_per_slot = -(-self.max_context // self.page_size)
+        self.num_pages = int(num_pages) or self.slots * self.pages_per_slot
+        # The gathered span per slot: the table is a fixed pages_per_slot
+        # wide, so the kernel sees one static padded capacity.
+        self.capacity_tokens = self.pages_per_slot * self.page_size
+        self._trash = self.num_pages  # sacrificial page for invalid writes
+        self._np = np
+        self._jnp = jnp
+        dtype = dtype or jnp.bfloat16
+        shape = (spec.layers, self.num_pages + 1, self.page_size,
+                 spec.grouped_kv_heads, spec.head_dim)
+        self._k_pages = jnp.zeros(shape, dtype)
+        self._v_pages = jnp.zeros(shape, dtype)
+        self.allocator = PageAllocator(self.num_pages)
+        self._tables = np.full((self.slots, self.pages_per_slot),
+                               self._trash, np.int32)
+        self._lengths = np.zeros(self.slots, np.int32)
+        self._last = np.zeros(self.slots, np.int32)
+        self._capacity = np.zeros(self.slots, np.int32)
+        self._owned: List[Optional[List[int]]] = [None] * self.slots
+        self._prefill = jax.jit(self._prefill_impl)
+        self._step = jax.jit(self._step_impl)
+
+    # -- jitted compute --------------------------------------------------------
+
+    def _lm(self, params, tokens, positions, attend_for_layer):
+        from tpu_operator.payload import models
+
+        s = self.spec
+        return models.lm_decode_apply(
+            params, tokens, positions, attend_for_layer, vocab=s.vocab,
+            dim=s.dim, heads=s.heads, kv_heads=s.kv_heads, layers=s.layers,
+            max_seq=s.max_seq)
+
+    def _prefill_impl(self, params, k_pages, v_pages, tokens, length, table):
+        """One request's admission forward: causal attention over the
+        padded prompt, first-token argmax at ``length - 1``, prompt K/V
+        scattered through the slot's page table. Padded-tail positions
+        land in owned-but-not-yet-valid slots (masked until decode
+        overwrites them) or the trash page — never a neighbour."""
+        import jax.numpy as jnp
+
+        collected = []
+
+        def attend_for_layer(_i):
+            def attend(q, k, v):
+                collected.append((k, v))
+                return _prefill_attend(q, k, v)
+            return attend
+
+        positions = jnp.arange(self.prompt_pad, dtype=jnp.int32)[None, :]
+        logits = self._lm(params, tokens, positions, attend_for_layer)
+        nxt = jnp.argmax(
+            logits[0, length - 1].astype(jnp.float32)).astype(jnp.int32)
+        pos = jnp.arange(self.prompt_pad, dtype=jnp.int32)
+        page_ids = table[pos // self.page_size]
+        offs = pos % self.page_size
+        for i, (k, v) in enumerate(collected):
+            k_pages = k_pages.at[i, page_ids, offs].set(k[0])
+            v_pages = v_pages.at[i, page_ids, offs].set(v[0])
+        return nxt, k_pages, v_pages
+
+    def _step_impl(self, params, k_pages, v_pages, last, lengths, tables,
+                   active):
+        """One decode iteration over every slot: embed each slot's last
+        token at its current position, write its K/V through the page
+        table (inactive slots write the trash page), and attend against
+        the gathered span with the length-masked decode kernel."""
+        import jax.numpy as jnp
+
+        from tpu_operator.payload import flash_attention as fa
+
+        s = self.spec
+        kvh, hd = s.grouped_kv_heads, s.head_dim
+        tokens = last[:, None]
+        positions = jnp.minimum(lengths, s.max_seq - 1)[:, None]
+        page_sel = jnp.take_along_axis(
+            tables, (lengths // self.page_size)[:, None], axis=1)[:, 0]
+        page_sel = jnp.where(active, page_sel, self._trash)
+        offs = lengths % self.page_size
+
+        def attend_for_layer(i):
+            def attend(q, k, v):
+                nonlocal k_pages, v_pages
+                k_pages = k_pages.at[i, page_sel, offs].set(k[:, 0])
+                v_pages = v_pages.at[i, page_sel, offs].set(v[:, 0])
+                kd = k_pages[i][tables].reshape(
+                    self.slots, self.capacity_tokens, kvh, hd)
+                vd = v_pages[i][tables].reshape(
+                    self.slots, self.capacity_tokens, kvh, hd)
+                return fa.flash_decode(q, kd, vd, lengths + 1)
+            return attend
+
+        logits = self._lm(params, tokens, positions, attend_for_layer)
+        nxt = jnp.argmax(logits[:, 0].astype(jnp.float32),
+                         axis=-1).astype(jnp.int32)
+        return nxt, k_pages, v_pages
+
+    # -- host-side slot management ---------------------------------------------
+
+    def pages_needed(self, prompt_len: int, new_tokens: int) -> int:
+        return -(-(prompt_len + new_tokens) // self.page_size)
+
+    def can_admit(self, prompt_len: int, new_tokens: int) -> bool:
+        return (self.allocator.free_pages
+                >= self.pages_needed(prompt_len, new_tokens))
+
+    def free_slots(self) -> List[int]:
+        return [i for i in range(self.slots) if self._owned[i] is None]
+
+    def admit(self, slot: int, prompt, new_tokens: int,
+              params) -> Optional[int]:
+        """Admit a request into ``slot``: allocate its pages, prefill, and
+        return the FIRST generated token (it counts against the request's
+        budget). None = page pool exhausted; the request stays queued."""
+        np = self._np
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        if self._owned[slot] is not None:
+            raise ValueError(f"slot {slot} is already occupied")
+        if new_tokens <= 0:
+            raise ValueError(f"new_tokens must be positive, got {new_tokens}")
+        if len(prompt) == 0 or len(prompt) > self.prompt_pad:
+            raise ValueError(
+                f"prompt length {len(prompt)} not in [1, {self.prompt_pad}]")
+        if len(prompt) + new_tokens > self.max_context:
+            raise ValueError(
+                f"prompt {len(prompt)} + {new_tokens} new tokens exceeds "
+                f"max context {self.max_context}")
+        pages = self.allocator.alloc(
+            self.pages_needed(len(prompt), new_tokens))
+        if pages is None:
+            return None
+        table = np.full(self.pages_per_slot, self._trash, np.int32)
+        table[:len(pages)] = pages
+        padded = np.zeros(self.prompt_pad, np.int32)
+        padded[:len(prompt)] = prompt
+        nxt, self._k_pages, self._v_pages = self._prefill(
+            params, self._k_pages, self._v_pages, padded[None, :],
+            np.int32(len(prompt)), table)
+        self._tables[slot] = table
+        self._lengths[slot] = len(prompt)
+        self._last[slot] = int(nxt)
+        self._capacity[slot] = len(prompt) + new_tokens
+        self._owned[slot] = pages
+        return int(nxt)
+
+    def step(self, params, active) -> Any:
+        """One decode iteration; ``active`` is a bool [slots] mask. Returns
+        the int32 [slots] next tokens (garbage at inactive slots). Active
+        slots advance one position — their previous token's K/V is written
+        before it attends, so the new token sees its own key."""
+        np = self._np
+        active = np.asarray(active, bool)
+        for slot in np.nonzero(active)[0]:
+            if self._owned[slot] is None:
+                raise ValueError(f"slot {slot} is active but unoccupied")
+            # A step advances the slot to length + 1; the prefill's first
+            # token already counted, so a slot whose next token would
+            # land past prompt + budget is already over budget.
+            if self._lengths[slot] + 1 >= self._capacity[slot]:
+                raise ValueError(
+                    f"slot {slot} at capacity {self._capacity[slot]}")
+        nxt, self._k_pages, self._v_pages = self._step(
+            params, self._k_pages, self._v_pages, self._last,
+            self._lengths, self._tables, active)
+        out = np.asarray(nxt).astype(np.int32)
+        self._lengths[active] += 1
+        self._last[active] = out[active]
+        return out
+
+    def release(self, slot: int) -> None:
+        """Free the slot's pages back to the pool — the moment a request
+        completes, not at a batch boundary."""
+        pages = self._owned[slot]
+        if pages is None:
+            raise ValueError(f"slot {slot} is not occupied")
+        self.allocator.free(pages)
+        self._owned[slot] = None
+        self._tables[slot] = self._trash
+        self._lengths[slot] = 0
+        self._capacity[slot] = 0
+
+    def utilization(self) -> float:
+        """Held fraction of the page pool (the heartbeat's
+        ``kvCacheUtilization``)."""
+        return self.allocator.utilization()
+
+    def slot_pages(self, slot: int) -> Optional[List[int]]:
+        """The slot's owned pages (tests assert reuse invariants)."""
+        pages = self._owned[slot]
+        return None if pages is None else list(pages)
+
+    def slot_length(self, slot: int) -> int:
+        return int(self._lengths[slot])

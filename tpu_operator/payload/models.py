@@ -355,4 +355,88 @@ def param_partition_spec(path: Tuple[str, ...], leaf: Any) -> P:
     return P()  # replicate
 
 
+# --- cached-decode mirrors ----------------------------------------------------
+#
+# The serve payload's incremental decode (payload/kvcache.py) runs the SAME
+# math as DecoderBlock / the transformer payload's TransformerLM, but over a
+# one-token (or prompt-length) slice with a caller-owned attention — the
+# cached K/V live outside the param tree, so the flax module (whose attend is
+# baked in at construction) cannot express it. These mirrors re-apply the
+# exact same flax submodules *standalone* against the trained param subtrees
+# (nn.Dense(...).apply({"params": params["q"]}, h) is bit-identical to the
+# in-module call — same kernel, same dtype casts, same op order), so decode
+# shares weights AND numerics with training without a second model
+# definition. checkpoint_name tags are identity outside jax.checkpoint and
+# decode never differentiates, so they are simply omitted.
+
+
+def decoder_block_decode(params, x: jnp.ndarray, attend: Callable,
+                         *, dim: int, heads: int, kv_heads: int = 0,
+                         dtype: Any = jnp.bfloat16) -> jnp.ndarray:
+    """Functional mirror of :class:`DecoderBlock` over one block's param
+    subtree. ``attend`` receives (q [B,T,H,Dh], k, v [B,T,KVH,Dh]) exactly
+    as in the module — the decode caller writes k/v into its cache and
+    attends against the gathered span; the prefill caller runs the plain
+    causal forward. Fused-qkv and split/GQA param layouts both load (the
+    subtree shape says which one trained)."""
+    b, t, _ = x.shape
+    head_dim = dim // heads
+    kvh = kv_heads or heads
+    kv_dim = kvh * head_dim
+    h = nn.LayerNorm(dtype=jnp.float32).apply(
+        {"params": params["ln_attn"]}, x)
+    if "qkv" in params:
+        qkv = nn.Dense(3 * dim, use_bias=False, dtype=dtype).apply(
+            {"params": params["qkv"]}, h)
+        q, k, v = jnp.split(qkv, 3, axis=-1)
+    else:
+        q = nn.Dense(dim, use_bias=False, dtype=dtype).apply(
+            {"params": params["q"]}, h)
+        k = nn.Dense(kv_dim, use_bias=False, dtype=dtype).apply(
+            {"params": params["k"]}, h)
+        v = nn.Dense(kv_dim, use_bias=False, dtype=dtype).apply(
+            {"params": params["v"]}, h)
+    q = q.reshape(b, t, heads, head_dim)
+    k = k.reshape(b, t, kvh, head_dim)
+    v = v.reshape(b, t, kvh, head_dim)
+    out = attend(q, k, v)
+    out = nn.Dense(dim, use_bias=False, dtype=dtype).apply(
+        {"params": params["attn_out"]}, out.reshape(b, t, dim))
+    x = x + out
+    h = nn.LayerNorm(dtype=jnp.float32).apply(
+        {"params": params["ln_mlp"]}, x)
+    h = nn.Dense(4 * dim, dtype=dtype).apply(
+        {"params": params["mlp_up"]}, h)
+    h = nn.gelu(h)
+    h = nn.Dense(dim, dtype=dtype).apply(
+        {"params": params["mlp_down"]}, h)
+    return x + h
+
+
+def lm_decode_apply(params, tokens: jnp.ndarray, positions: jnp.ndarray,
+                    attend_for_layer: Callable[[int], Callable],
+                    *, vocab: int, dim: int, heads: int, layers: int,
+                    max_seq: int, kv_heads: int = 0,
+                    dtype: Any = jnp.bfloat16) -> jnp.ndarray:
+    """Functional mirror of the transformer payload's TransformerLM
+    forward (embed + blocks + ln_final + lm_head) with explicit per-row
+    ``positions`` [B, T] and a per-layer attention factory —
+    ``attend_for_layer(i)`` returns the attend callable for block ``i``
+    (each layer owns a distinct cache region). Returns [B, T, vocab]
+    logits in bf16, exactly as the module does."""
+    x = nn.Embed(vocab, dim, dtype=jnp.bfloat16).apply(
+        {"params": params["tok_embed"]}, tokens)
+    pos = nn.Embed(max_seq, dim, dtype=jnp.bfloat16).apply(
+        {"params": params["pos_embed"]}, positions)
+    x = x + pos
+    for i in range(layers):
+        x = decoder_block_decode(params[f"block{i}"], x,
+                                 attend_for_layer(i), dim=dim, heads=heads,
+                                 kv_heads=kv_heads, dtype=dtype)
+    x = nn.LayerNorm(dtype=jnp.float32).apply(
+        {"params": params["ln_final"]}, x)
+    return nn.Dense(vocab, use_bias=False, dtype=jnp.bfloat16).apply(
+        {"params": params["lm_head"]}, x)
+
+
 Model = Callable[..., nn.Module]

@@ -1,25 +1,41 @@
-"""Serving payload: long-lived batched transformer decode (``mode: serve``).
+"""Serving payload: continuous-batching incremental decode (``mode: serve``).
 
 ``python -m tpu_operator.payload.serve`` — the inference half of the
 north star. Where every other payload steps to a finite ``--steps`` and
 exits, this one runs a **decode service**:
 
-- **Batched decode on the GQA path.** The model is the transformer
-  payload's decoder (``models.DecoderBlock`` with grouped-query
-  attention via ``--kv-heads``); on TPU the attention runs the fused
-  Pallas flash-attention kernel, exactly the decode-ready path
-  BENCH_SUITE measures. Each decode step is ONE jitted forward over the
-  whole ``[batch, window]`` request matrix — every active request slot
-  advances one token per step, so throughput scales with batch
-  occupancy, not request count.
-- **Synthetic load generator.** ``--load "rps:seconds,rps:seconds,…"``
-  drives open-loop arrivals at a piecewise-constant requests/sec
-  schedule; each request asks for ``--decode-tokens`` tokens and its
-  latency is measured admission-to-completion. Per-window p50/p95 and
-  requests/sec ride the heartbeat's ``serving`` body into
+- **Incremental decode on a paged KV cache** (payload/kvcache.py, the
+  default ``--decode-engine paged``): each request's K/V live in
+  fixed-size pages of a shared pool, admission runs ONE batched prefill
+  over the prompt, and every subsequent step attends one new token per
+  slot against the cached span (flash_attention.flash_decode — the GQA
+  kernel's cached-decode path). Per-token cost is O(context · kv), not
+  O(window · model); ``--decode-engine reforward`` keeps the PR-13
+  whole-matrix re-forward as the measured baseline (bench.py --serve
+  asserts the A/B).
+- **Continuous batching.** Requests admit into the in-flight batch at
+  iteration boundaries — slot-level scheduling, no drain-the-batch
+  barrier — and a request finishing mid-iteration frees its slot AND its
+  cache pages immediately, so a finished short request's pages serve a
+  waiting long one on the very next admission.
+- **Backpressure.** The ingress queue is depth-bounded (``--max-queue``;
+  past it new requests shed) and age-bounded (``--queue-deadline``;
+  queued requests older than the deadline shed oldest-first). Shedding
+  is visible: ``queueDepth`` and ``kvCacheUtilization`` ride the serving
+  heartbeat next to ``tokensPerSecond``.
+- **HTTP ingress.** ``--http-port`` (operator-injected as
+  ``$TPUJOB_SERVE_PORT``) serves ``POST /v1/decode``
+  (``{"prompt": [ints], "maxTokens": n}`` → ``{"tokens": [...]}``) and
+  ``GET /healthz`` — the readiness-gated per-replica Services carry real
+  request traffic, not just the in-process generator.
+- **Synthetic load generator.** ``--load "rps:seconds,…"`` drives
+  open-loop arrivals at a piecewise-constant requests/sec schedule; each
+  request asks for ``--decode-tokens`` tokens and its latency is
+  measured admission-to-completion. Per-window p50/p95/p99, tokens/sec,
+  and requests/sec ride the heartbeat's ``serving`` body into
   ``status.serving`` and the ``job_serving_*`` metrics.
 - **Readiness protocol.** A replica posts ``ready: true`` only after its
-  weights are loaded AND the first decode step compiled; readiness drops
+  weights are loaded AND the decode engine compiled; readiness drops
   (an immediate forced beat) for the duration of a weight reload — the
   operator deletes the replica's Service for exactly that window.
 - **Hot weight reload.** A watcher thread polls the remote warm-start
@@ -28,20 +44,23 @@ exits, this one runs a **decode service**:
   observation the loop drops readiness at a step boundary, prefetches
   the snapshot into the local checkpoint dir, restores through the PR-4
   verified walk, swaps the params in place, and re-posts ready — no
-  process restart, no attempt bump. Replicas stagger their reloads by
-  ``--reload-stagger × replicaIndex`` so the fleet rolls instead of
-  dropping all capacity at once.
+  process restart, no attempt bump, and NO cache invalidation: the
+  engine takes params per call, so live KV pages survive the swap.
+  Replicas stagger their reloads by ``--reload-stagger × replicaIndex``
+  so the fleet rolls instead of dropping all capacity at once.
 
 Env contract (trainer/replicas.py injects under ``spec.mode: serve``):
-``TPUJOB_SERVE`` (the mode flag) and ``TPUJOB_SERVE_RELOAD_POLL`` (the
-store watch cadence). The remote store rides the ordinary
-``TPUJOB_STORE_*`` contract; serve replicas are READERS — they never
-attach a write-behind uploader.
+``TPUJOB_SERVE`` (the mode flag), ``TPUJOB_SERVE_RELOAD_POLL`` (the
+store watch cadence), and ``TPUJOB_SERVE_PORT`` (the per-replica HTTP
+ingress port — the same port the replica Service targets). The remote
+store rides the ordinary ``TPUJOB_STORE_*`` contract; serve replicas
+are READERS — they never attach a write-behind uploader.
 """
 
 from __future__ import annotations
 
 import argparse
+import json
 import logging
 import os
 import threading
@@ -58,6 +77,7 @@ log = logging.getLogger(__name__)
 # Operator env contract (injected when spec.mode is serve).
 ENV_SERVE = "TPUJOB_SERVE"
 ENV_RELOAD_POLL = "TPUJOB_SERVE_RELOAD_POLL"
+ENV_SERVE_PORT = "TPUJOB_SERVE_PORT"
 
 # Idle poll when no request slot is active: the loop must not spin.
 IDLE_SLEEP = 0.002
@@ -73,8 +93,14 @@ MAX_CONSECUTIVE_FAILURES = 8
 # rolls through a reload instead of dropping every Service at once.
 DEFAULT_RELOAD_STAGGER = 0.5
 
+# Cap on the run-level latency record (the bench's SLO summary); beyond
+# it percentiles come from the first CAP samples — plenty for a gate.
+RUN_LATENCY_CAP = 65536
+
 
 def parse_args(argv=None):
+    from tpu_operator.payload import kvcache
+
     p = argparse.ArgumentParser()
     p.add_argument("--load", default="5:30",
                    help="requests/sec schedule, 'rps:seconds[,rps:seconds"
@@ -86,7 +112,8 @@ def parse_args(argv=None):
     p.add_argument("--decode-tokens", type=int, default=8,
                    help="tokens generated per request")
     p.add_argument("--window", type=int, default=64,
-                   help="context window the decode forward runs over")
+                   help="prompt context window (paged decode grows the "
+                        "context past it by up to --decode-tokens)")
     p.add_argument("--vocab", type=int, default=128)
     p.add_argument("--dim", type=int, default=64)
     p.add_argument("--heads", type=int, default=4)
@@ -95,6 +122,32 @@ def parse_args(argv=None):
                         "decode path; 0 = MHA)")
     p.add_argument("--layers", type=int, default=2)
     p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--decode-engine", choices=("paged", "reforward"),
+                   default="paged",
+                   help="paged = incremental decode on the paged KV cache "
+                        "(kvcache.py, O(1) forwards per token); reforward "
+                        "= the whole-matrix re-forward baseline the bench "
+                        "A/Bs against")
+    p.add_argument("--page-size", type=int,
+                   default=kvcache.DEFAULT_PAGE_SIZE,
+                   help="KV cache page size in tokens (paged engine)")
+    p.add_argument("--kv-pages", type=int, default=0,
+                   help="KV cache pool size in pages (0 = auto: slots x "
+                        "pages-per-request, no admission ever waits on "
+                        "pages; smaller oversubscribes the pool and "
+                        "admission backpressures on page exhaustion)")
+    p.add_argument("--max-queue", type=int, default=256,
+                   help="ingress queue depth bound: arrivals past it are "
+                        "shed at admission (backpressure, surfaced as "
+                        "queueDepth on the heartbeat)")
+    p.add_argument("--queue-deadline", type=float, default=30.0,
+                   help="seconds a request may wait queued before being "
+                        "shed oldest-first (0 = never shed on age)")
+    p.add_argument("--http-port", type=int,
+                   default=int(os.environ.get(ENV_SERVE_PORT) or 0),
+                   help="HTTP ingress port for POST /v1/decode + GET "
+                        "/healthz (defaults from the operator-injected "
+                        "$TPUJOB_SERVE_PORT; 0 = no HTTP ingress)")
     p.add_argument("--checkpoint-dir", default="",
                    help="weight source (default: $TPU_CHECKPOINT_DIR); "
                         "restored through the verified walk, hot-reloaded "
@@ -190,9 +243,9 @@ class LoadGenerator:
 
 
 class LatencyWindow:
-    """Per-request latency samples since the last drain (bounded), plus
-    arrival accounting — the heartbeat's serving body is built from one
-    drain per beat, so each window is disjoint (the steptrace digest
+    """Per-request latency + token samples since the last drain (bounded),
+    plus arrival accounting — the heartbeat's serving body is built from
+    one drain per beat, so each window is disjoint (the steptrace digest
     discipline)."""
 
     CAP = 4096
@@ -202,11 +255,17 @@ class LatencyWindow:
         self._lock = lockdep.lock("LatencyWindow._lock")
         self._samples: List[float] = []  # guarded-by: _lock
         self._arrivals = 0  # guarded-by: _lock
+        self._tokens = 0  # guarded-by: _lock
         self._since = clock()  # guarded-by: _lock
 
     def arrived(self, n: int = 1) -> None:
         with self._lock:
             self._arrivals += n
+
+    def generated(self, n: int = 1) -> None:
+        """Count decoded tokens (the throughput numerator)."""
+        with self._lock:
+            self._tokens += n
 
     def record(self, seconds: float) -> None:
         with self._lock:
@@ -214,27 +273,57 @@ class LatencyWindow:
                 self._samples.append(float(seconds))
 
     def drain(self) -> Dict[str, float]:
-        """{requestsPerSecond (offered), p50, p95, completed} over the
-        window since the previous drain; resets the window."""
+        """{requestsPerSecond (offered), tokensPerSecond, p50, p95, p99,
+        completed} over the window since the previous drain; resets the
+        window."""
         now = self._clock()
         with self._lock:
             samples = sorted(self._samples)
             arrivals, since = self._arrivals, self._since
-            self._samples, self._arrivals, self._since = [], 0, now
+            tokens = self._tokens
+            self._samples, self._arrivals, self._tokens = [], 0, 0
+            self._since = now
         elapsed = max(1e-9, now - since)
         out: Dict[str, float] = {
             "requestsPerSecond": arrivals / elapsed,
+            "tokensPerSecond": tokens / elapsed,
             "completed": float(len(samples)),
         }
         if samples:
-            out["p50"] = samples[min(len(samples) - 1,
-                                     int(0.50 * len(samples)))]
-            out["p95"] = samples[min(len(samples) - 1,
-                                     int(0.95 * len(samples)))]
+            for name, q in (("p50", 0.50), ("p95", 0.95), ("p99", 0.99)):
+                out[name] = samples[min(len(samples) - 1,
+                                        int(q * len(samples)))]
         return out
 
 
-# --- the decode engine --------------------------------------------------------
+# --- requests -----------------------------------------------------------------
+
+
+class Request:
+    """One decode request: prompt in, up to ``max_tokens`` out. ``done``
+    is set on completion OR shed — HTTP ingress threads wait on it; the
+    synthetic generator never does. ``tokens`` is written only by the
+    decode loop; readers wait for ``done`` first."""
+
+    __slots__ = ("arrived", "prompt", "max_tokens", "tokens", "done", "shed")
+
+    def __init__(self, prompt, max_tokens: int, arrived: float):
+        self.arrived = float(arrived)
+        self.prompt = prompt
+        self.max_tokens = int(max_tokens)
+        self.tokens: List[int] = []
+        self.done = threading.Event()
+        self.shed = False
+
+    def finish(self) -> None:
+        self.done.set()
+
+    def shed_now(self) -> None:
+        self.shed = True
+        self.done.set()
+
+
+# --- the decode engines -------------------------------------------------------
 
 
 def build_decode(args, mesh=None):
@@ -243,7 +332,10 @@ def build_decode(args, mesh=None):
     GQA path — jitted over the whole request matrix. ``template_state``
     is a full TrainState (optimizer state included) so trainer-written
     checkpoints restore through the unchanged verified walk; decode only
-    ever reads ``params``."""
+    ever reads ``params``. The model's position table spans
+    ``window + decode_tokens`` so the paged engine's growing contexts
+    have positions (the re-forward baseline only ever uses the first
+    ``window`` rows)."""
     import jax
     import jax.numpy as jnp
     import optax
@@ -254,7 +346,8 @@ def build_decode(args, mesh=None):
     mesh = mesh or train.make_mesh(axis_names=("data", "model"))
     shim = argparse.Namespace(
         vocab=args.vocab, dim=args.dim, heads=args.heads,
-        kv_heads=args.kv_heads, layers=args.layers, seq_len=args.window,
+        kv_heads=args.kv_heads, layers=args.layers,
+        seq_len=args.window + args.decode_tokens,
         seq_parallel=1, tensor_parallel=1, split_qkv="auto",
         sp_mode="ring", sp_layout="contiguous", remat=False)
     model = transformer._build_model(shim, mesh)
@@ -285,14 +378,139 @@ def build_decode(args, mesh=None):
     return mesh, model, state, decode_fn, token_sharding
 
 
-class ServeLoop:
-    """One replica's decode service: request slots, the load generator,
-    readiness + reload orchestration, and serving heartbeats.
+class ReforwardEngine:
+    """The PR-13 baseline: one jitted forward over the whole
+    ``[batch, window]`` sliding request matrix per generated token —
+    per-token cost O(window · model). Kept selectable so the bench's
+    incremental-vs-reforward A/B measures against the real thing."""
 
-    Single-threaded decode (the step loop owns the params); the reload
-    WATCHER is the only other thread and it communicates through one
-    flag — the loop performs the actual reload at a step boundary, so
-    the decode forward never races a params swap."""
+    kind = "reforward"
+
+    def __init__(self, args, decode_fn, token_sharding):
+        import numpy as np
+
+        self.args = args
+        self._np = np
+        self._decode_fn = decode_fn
+        self._token_sharding = token_sharding
+        self._tokens = np.zeros((args.batch, args.window), np.int32)
+
+    def can_admit(self, prompt_len: int, new_tokens: int) -> bool:
+        return True
+
+    def admit(self, slot: int, prompt, new_tokens: int,
+              params) -> Tuple[bool, Optional[int]]:
+        np = self._np
+        prompt = np.asarray(prompt, np.int32).reshape(-1)
+        row = np.zeros(self.args.window, np.int32)
+        row[-len(prompt):] = prompt[-self.args.window:]
+        self._tokens[slot] = row
+        return True, None  # first token comes from the next step
+
+    def step(self, params, active):
+        import jax
+
+        next_tokens = self._decode_fn(
+            params, jax.device_put(self._tokens, self._token_sharding))
+        out = self._np.asarray(jax.device_get(next_tokens)).astype(
+            self._np.int32)
+        for slot in self._np.nonzero(self._np.asarray(active, bool))[0]:
+            self._tokens[slot, :-1] = self._tokens[slot, 1:]
+            self._tokens[slot, -1] = out[slot]
+        return out
+
+    def release(self, slot: int) -> None:
+        self._tokens[slot] = 0
+
+    def utilization(self) -> float:
+        return 0.0
+
+    def warmup(self, params) -> None:
+        self.step(params, self._np.zeros(self.args.batch, bool))
+
+
+class PagedEngine:
+    """Incremental decode on the paged KV cache (payload/kvcache.py):
+    prefill once at admission, then one-token steps against the cached
+    span. The engine takes params per call — hot reload swaps weights
+    without touching live pages."""
+
+    kind = "paged"
+
+    def __init__(self, args):
+        import numpy as np
+
+        from tpu_operator.payload import kvcache
+
+        self.args = args
+        self._np = np
+        spec = kvcache.ModelSpec(
+            vocab=args.vocab, dim=args.dim, heads=args.heads,
+            layers=args.layers, max_seq=args.window + args.decode_tokens,
+            kv_heads=args.kv_heads)
+        self.cache = kvcache.DecodeEngine(
+            spec, slots=args.batch, prompt_pad=args.window,
+            max_new=args.decode_tokens, page_size=args.page_size,
+            num_pages=args.kv_pages)
+
+    def can_admit(self, prompt_len: int, new_tokens: int) -> bool:
+        return self.cache.can_admit(prompt_len, new_tokens)
+
+    def admit(self, slot: int, prompt, new_tokens: int,
+              params) -> Tuple[bool, Optional[int]]:
+        token = self.cache.admit(slot, prompt, new_tokens, params)
+        if token is None:
+            return False, None  # page pool exhausted; request stays queued
+        return True, token
+
+    def step(self, params, active):
+        return self.cache.step(params, active)
+
+    def release(self, slot: int) -> None:
+        self.cache.release(slot)
+
+    def utilization(self) -> float:
+        return self.cache.utilization()
+
+    def warmup(self, params) -> None:
+        """Compile both jitted paths (prefill + step) before readiness,
+        through a throwaway request in slot 0."""
+        np = self._np
+        prompt = np.ones(self.args.window, np.int32)
+        self.cache.admit(0, prompt, self.args.decode_tokens, params)
+        active = np.zeros(self.args.batch, bool)
+        active[0] = self.args.decode_tokens > 1
+        self.cache.step(params, active)
+        self.cache.release(0)
+        # The step's pool outputs can carry a different device layout
+        # than the freshly-zeroed pools the first admission compiled
+        # against, and XLA compiles a separate executable per input
+        # layout — admit once more so the steady-state admit-after-step
+        # path is also compiled before the replica reports ready.
+        self.cache.admit(0, prompt, self.args.decode_tokens, params)
+        self.cache.release(0)
+
+
+def make_engine(args, decode_fn=None, token_sharding=None):
+    """Engine factory for --decode-engine (the bench constructs both)."""
+    if args.decode_engine == "reforward":
+        return ReforwardEngine(args, decode_fn, token_sharding)
+    return PagedEngine(args)
+
+
+# --- the serve loop -----------------------------------------------------------
+
+
+class ServeLoop:
+    """One replica's decode service: the ingress queue, slot-level
+    continuous batching over the decode engine, readiness + reload
+    orchestration, and serving heartbeats.
+
+    Single-threaded decode (the step loop owns the params and the
+    engine); the reload WATCHER communicates through one flag consumed at
+    a step boundary, and HTTP ingress threads touch ONLY the queue (under
+    ``_ingress_lock``) and each Request's ``done`` event — the decode
+    forward never races a params swap or a table write."""
 
     def __init__(self, args, info: bootstrap.ProcessInfo,
                  heartbeat: Optional[Any] = "auto",
@@ -316,8 +534,10 @@ class ServeLoop:
             store = warmstore.store_from_env() \
                 if os.environ.get(ENV_SERVE) else None
         self.store = store
-        (self.mesh, self.model, self._state, self._decode,
+        (self.mesh, self.model, self._state, self._decode_fn,
          self._token_sharding) = build_decode(args)
+        self.engine = make_engine(args, self._decode_fn,
+                                  self._token_sharding)
         self.window = LatencyWindow(clock=clock)
         self.ready = False
         self.reloads = 0
@@ -325,11 +545,15 @@ class ServeLoop:
         self._consecutive_failures = 0
         self.completed = 0
         self.steps = 0
-        # Request slots: remaining-token budget (<=0 idle) + arrival time.
-        self._budget = [0] * args.batch
-        self._arrived = [0.0] * args.batch
-        self._queue: List[float] = []  # arrival times awaiting a slot
-        self._tokens = np.zeros((args.batch, args.window), np.int32)
+        self.tokens_generated = 0
+        # In-flight requests by slot (decode-loop-only) and the ingress
+        # queue (shared with HTTP threads).
+        self._requests: List[Optional[Request]] = [None] * args.batch
+        self._arrival_seq = 0
+        self._run_latencies: List[float] = []
+        self._ingress_lock = lockdep.lock("ServeLoop._ingress_lock")
+        self._queue: List[Request] = []  # guarded-by: _ingress_lock
+        self._shed = 0  # guarded-by: _ingress_lock
         # Reload handshake between the decode loop (owner of the params)
         # and the store watcher thread: the loaded step and the pending
         # target share one lock — the watcher compares-and-arms, the loop
@@ -339,6 +563,7 @@ class ServeLoop:
         self._reload_target: Optional[int] = None  # guarded-by: _reload_lock
         self._stop = threading.Event()
         self._watcher: Optional[threading.Thread] = None
+        self._http: Optional[Any] = None
 
     @property
     def loaded_step(self) -> int:
@@ -349,12 +574,22 @@ class ServeLoop:
         with self._reload_lock:
             self._loaded_step = int(step)
 
+    @property
+    def shed(self) -> int:
+        with self._ingress_lock:
+            return self._shed
+
+    def queue_depth(self) -> int:
+        with self._ingress_lock:
+            return len(self._queue)
+
     # -- weights ---------------------------------------------------------------
 
     def _restore_weights(self) -> int:
         """Restore the newest verified checkpoint into the template state
-        (params swap; the decode fn takes params per call so no
-        recompile). Returns the restored step (0 = fresh init weights)."""
+        (params swap; the decode engine takes params per call so no
+        recompile and no cache invalidation). Returns the restored step
+        (0 = fresh init weights)."""
         from tpu_operator.payload import checkpoint as checkpoint_mod
 
         directory = self.args.checkpoint_dir \
@@ -396,6 +631,9 @@ class ServeLoop:
         out: Dict[str, Any] = {
             "ready": bool(self.ready),
             "requestsPerSecond": round(stats["requestsPerSecond"], 3),
+            "tokensPerSecond": round(stats["tokensPerSecond"], 3),
+            "queueDepth": self.queue_depth(),
+            "kvCacheUtilization": round(self.engine.utilization(), 4),
             "loadedStep": int(self.loaded_step),
             "reloads": int(self.reloads),
         }
@@ -441,7 +679,9 @@ class ServeLoop:
     def _maybe_reload(self) -> bool:
         """Step-boundary reload: drop readiness (Service removed),
         stagger, prefetch + verified restore, swap params, re-post
-        ready. Returns True when a reload ran."""
+        ready. Returns True when a reload ran. Live KV pages are NOT
+        touched — in-flight requests keep decoding against their cached
+        context, on the new weights, the moment readiness returns."""
         with self._reload_lock:
             target = self._reload_target
             self._reload_target = None
@@ -471,42 +711,116 @@ class ServeLoop:
         self._set_ready(True)
         return True
 
+    # -- ingress ---------------------------------------------------------------
+
+    def submit(self, prompt, max_tokens: int,
+               now: Optional[float] = None) -> Optional[Request]:
+        """Queue a request (HTTP ingress threads and the synthetic
+        generator both land here). Returns None when the queue is at
+        ``--max-queue`` — depth-bounded admission, the shed counted and
+        the caller answered 503. Offered load (``requestsPerSecond``)
+        counts shed arrivals too: the heartbeat must show demand the
+        replica turned away."""
+        now = self._clock() if now is None else now
+        max_tokens = max(1, min(int(max_tokens), self.args.decode_tokens))
+        req = Request(prompt, max_tokens, now)
+        with self._ingress_lock:
+            if len(self._queue) >= self.args.max_queue:
+                self._shed += 1
+                req = None
+            else:
+                self._queue.append(req)
+        self.window.arrived(1)
+        return req
+
+    def _synthetic_request(self, now: float) -> None:
+        """One generated arrival: a seeded full-window prompt (request id
+        mixed in so batches aren't degenerate) asking for the standard
+        budget."""
+        np = self._np
+        self._arrival_seq += 1
+        prompt = (np.arange(self.args.window) + self._arrival_seq) \
+            % self.args.vocab
+        self.submit(prompt.astype(np.int32), self.args.decode_tokens,
+                    now=now)
+
+    def _shed_expired(self, now: float) -> None:
+        """Age-bounded queue: requests waiting past --queue-deadline shed
+        oldest-first (they would only add latency to everything behind
+        them)."""
+        deadline = float(self.args.queue_deadline)
+        if deadline <= 0:
+            return
+        expired: List[Request] = []
+        with self._ingress_lock:
+            keep: List[Request] = []
+            for req in self._queue:
+                if now - req.arrived > deadline:
+                    self._shed += 1
+                    expired.append(req)
+                else:
+                    keep.append(req)
+            self._queue[:] = keep
+        for req in expired:
+            req.shed_now()
+
+    def _admit_from_queue(self) -> None:
+        """Iteration-boundary admission: pull queued requests into free
+        slots until slots or cache pages run out — which must happen even
+        with zero new arrivals, or requests queued during an overload
+        burst would starve once the arrival stream pauses. A request the
+        cache cannot hold yet goes back to the queue HEAD (it keeps its
+        place; a finished request's freed pages admit it next round)."""
+        for slot in range(self.args.batch):
+            if self._requests[slot] is not None:
+                continue
+            with self._ingress_lock:
+                if not self._queue:
+                    return
+                req = self._queue.pop(0)
+            admitted, token = self.engine.admit(
+                slot, req.prompt, req.max_tokens, self._state.params)
+            if not admitted:
+                with self._ingress_lock:
+                    self._queue.insert(0, req)
+                return
+            self._requests[slot] = req
+            if token is not None:
+                # The paged prefill emits the first token at admission.
+                self._deliver(slot, req, token, self._clock())
+
     # -- the decode loop -------------------------------------------------------
 
-    def _admit(self, n: int, now: float) -> None:
-        """Enqueue ``n`` new arrivals, then fill free slots from the
-        BACKLOG — which must happen even with zero new arrivals, or
-        requests queued during an overload burst would starve once the
-        arrival stream pauses (slots free up, nothing pulls the queue)."""
-        if n:
-            self.window.arrived(n)
-            self._queue.extend([now] * n)
-        for slot in range(self.args.batch):
-            if not self._queue:
-                return
-            if self._budget[slot] <= 0:
-                self._arrived[slot] = self._queue.pop(0)
-                self._budget[slot] = int(self.args.decode_tokens)
-                # A fresh request gets a seeded context (request id mixed
-                # in so batches aren't degenerate); a real service would
-                # place the prompt here.
-                self._tokens[slot] = (self._np.arange(self.args.window)
-                                      + self.steps + slot) % self.args.vocab
+    def _deliver(self, slot: int, req: Request, token: int,
+                 now: float) -> None:
+        """Hand one generated token to its request; on completion free
+        the slot AND its cache pages immediately — mid-iteration, not at
+        a batch boundary — so the next admission can use them."""
+        req.tokens.append(int(token))
+        self.tokens_generated += 1
+        self.window.generated(1)
+        if len(req.tokens) >= req.max_tokens:
+            latency = now - req.arrived
+            self.window.record(latency)
+            if len(self._run_latencies) < RUN_LATENCY_CAP:
+                self._run_latencies.append(latency)
+            self.completed += 1
+            self._requests[slot] = None
+            self.engine.release(slot)
+            req.finish()
+
+    def _active_mask(self):
+        return self._np.array([r is not None for r in self._requests],
+                              bool)
 
     def _decode_step(self) -> None:
-        import jax
-
         rec = self.recorder
         if rec is not None:
             rec.begin(self.steps)
             rec.lap(steptrace_mod.DATA)
+        active = self._active_mask()
         try:
-            next_tokens = self._decode(self._state.params,
-                                       jax.device_put(
-                                           self._tokens,
-                                           self._token_sharding))
-            next_tokens = self._np.asarray(
-                jax.device_get(next_tokens)).astype(self._np.int32)
+            next_tokens = self.engine.step(self._state.params, active)
         except Exception:  # noqa: BLE001 — a failed step must be visible
             self.failed_steps += 1
             self._consecutive_failures += 1
@@ -527,17 +841,37 @@ class ServeLoop:
             rec.lap(steptrace_mod.COMPUTE)
         now = self._clock()
         for slot in range(self.args.batch):
-            if self._budget[slot] <= 0:
+            req = self._requests[slot]
+            if req is None or not active[slot]:
                 continue
-            self._tokens[slot, :-1] = self._tokens[slot, 1:]
-            self._tokens[slot, -1] = next_tokens[slot]
-            self._budget[slot] -= 1
-            if self._budget[slot] <= 0:
-                self.completed += 1
-                self.window.record(now - self._arrived[slot])
+            self._deliver(slot, req, int(next_tokens[slot]), now)
         if rec is not None:
             rec.lap(steptrace_mod.HOST)
             rec.commit()
+
+    def _warmup(self) -> None:
+        """Compile the engine's jitted paths before readiness — a Service
+        must never route to a replica that would stall its first request
+        on XLA. Failure rides the same consecutive-failure machinery as a
+        decode step (a replica whose warm-up failed must not go ready)."""
+        try:
+            self.engine.warmup(self._state.params)
+        except Exception:  # noqa: BLE001 — a failed warm-up must be visible
+            self.failed_steps += 1
+            self._consecutive_failures += 1
+            log.exception("serve: engine warm-up failed")
+            return
+        self._consecutive_failures = 0
+
+    def _start_http(self) -> None:
+        if self.args.http_port <= 0:
+            return
+        self._http = _make_http_server(self, int(self.args.http_port))
+        thread = threading.Thread(target=self._http.serve_forever,
+                                  daemon=True, name="serve-http")
+        thread.start()
+        log.info("serve: HTTP ingress on port %d",
+                 self._http.server_address[1])
 
     def run(self, duration: Optional[float] = None) -> Dict[str, Any]:
         """Serve until the load schedule ends (or ``duration`` caps it);
@@ -545,12 +879,7 @@ class ServeLoop:
         schedule = LoadSchedule.parse(self.args.load)
         gen = LoadGenerator(schedule)
         self._set_loaded_step(self._restore_weights())
-        # First decode compiled BEFORE readiness: a Service must never
-        # route to a replica that would stall its first request on XLA —
-        # and a replica whose warm-up step FAILED must not go ready
-        # either (the loop below re-earns readiness on its first
-        # successful decode instead of blackholing routed requests).
-        self._decode_step()
+        self._warmup()
         self.steps += 1
         self._set_ready(self._consecutive_failures == 0)
         if self.store is not None:
@@ -558,6 +887,7 @@ class ServeLoop:
                                              daemon=True,
                                              name="serve-reload-watch")
             self._watcher.start()
+        self._start_http()
         t0 = self._clock()
         try:
             while not self._stop.is_set():
@@ -565,16 +895,19 @@ class ServeLoop:
                 if duration is not None and now - t0 >= duration:
                     break
                 arrivals = gen.due(now)
-                if (arrivals is None and not self._queue
-                        and not any(b > 0 for b in self._budget)):
+                if (arrivals is None and self.queue_depth() == 0
+                        and all(r is None for r in self._requests)):
                     break  # schedule over, queue + in-flight drained
+                for _ in range(arrivals or 0):
+                    self._synthetic_request(now)
+                self._shed_expired(now)
                 # Fill slots from the backlog EVERY iteration (not only
                 # on new arrivals): a burst queues past the slot count,
                 # and the queued requests must drain as slots free even
                 # after the arrival stream pauses or ends.
-                self._admit(arrivals or 0, now)
+                self._admit_from_queue()
                 self._maybe_reload()
-                if any(b > 0 for b in self._budget):
+                if any(r is not None for r in self._requests):
                     self._decode_step()
                     self.steps += 1
                     if not self.ready and self._consecutive_failures == 0:
@@ -588,29 +921,122 @@ class ServeLoop:
         finally:
             self._stop.set()
             self._set_ready(False)
+            # Unblock HTTP waiters: anything still queued or in flight at
+            # shutdown is shed, not silently abandoned until its timeout.
+            with self._ingress_lock:
+                leftover, self._queue[:] = list(self._queue), []
+                self._shed += len(leftover)
+            for req in leftover:
+                req.shed_now()
+            for slot in range(self.args.batch):
+                req = self._requests[slot]
+                if req is not None and not req.done.is_set():
+                    req.shed_now()
+            if self._http is not None:
+                self._http.shutdown()
+                self._http.server_close()
             if self._watcher is not None:
                 self._watcher.join(timeout=2.0)
-        return {
+        elapsed = max(1e-9, self._clock() - t0)
+        summary: Dict[str, Any] = {
             "steps": self.steps,
             "completed": self.completed,
             "arrivals": gen.total_arrivals,
             "failedSteps": self.failed_steps,
             "reloads": self.reloads,
             "loadedStep": self.loaded_step,
+            "shed": self.shed,
+            "tokensGenerated": self.tokens_generated,
+            "elapsedSeconds": elapsed,
+            "tokensPerSecond": self.tokens_generated / elapsed,
+            "kvCacheUtilization": self.engine.utilization(),
         }
+        lat = sorted(self._run_latencies)
+        if lat:
+            for name, q in (("p50LatencySeconds", 0.50),
+                            ("p95LatencySeconds", 0.95),
+                            ("p99LatencySeconds", 0.99)):
+                summary[name] = lat[min(len(lat) - 1, int(q * len(lat)))]
+        return summary
 
     def stop(self) -> None:
         self._stop.set()
+
+
+# --- HTTP ingress -------------------------------------------------------------
+
+
+def _make_http_server(loop: ServeLoop, port: int):
+    """ThreadingHTTPServer for the per-replica decode endpoint. Handler
+    threads queue through :meth:`ServeLoop.submit` and block on the
+    request's ``done`` event — they never touch the engine or the
+    params."""
+    from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+    class Handler(BaseHTTPRequestHandler):
+        def _reply(self, code: int, body: Dict[str, Any]) -> None:
+            data = json.dumps(body).encode()
+            self.send_response(code)
+            self.send_header("Content-Type", "application/json")
+            self.send_header("Content-Length", str(len(data)))
+            self.end_headers()
+            self.wfile.write(data)
+
+        def do_GET(self):  # noqa: N802 — http.server API
+            if self.path != "/healthz":
+                self._reply(404, {"error": "not found"})
+                return
+            if loop.ready:
+                self._reply(200, {"ready": True})
+            else:
+                self._reply(503, {"ready": False})
+
+        def do_POST(self):  # noqa: N802 — http.server API
+            if self.path != "/v1/decode":
+                self._reply(404, {"error": "not found"})
+                return
+            try:
+                length = int(self.headers.get("Content-Length") or 0)
+                body = json.loads(self.rfile.read(length) or b"{}")
+                prompt = [int(t) for t in body["prompt"]]
+                if not 1 <= len(prompt) <= loop.args.window:
+                    raise ValueError(
+                        f"prompt length {len(prompt)} not in "
+                        f"[1, {loop.args.window}]")
+                max_tokens = int(body.get("maxTokens",
+                                          loop.args.decode_tokens))
+            except Exception as e:  # noqa: BLE001 — bad request, not a bug
+                self._reply(400, {"error": str(e)})
+                return
+            req = loop.submit(prompt, max_tokens)
+            if req is None:
+                self._reply(503, {"error": "queue full"})
+                return
+            # Generous bound: queueing deadline + decode time; a shed or
+            # stopped loop sets done early with shed=True.
+            deadline = max(30.0, float(loop.args.queue_deadline) + 30.0)
+            if not req.done.wait(timeout=deadline) or req.shed:
+                self._reply(503, {"error": "request shed"})
+                return
+            self._reply(200, {"tokens": req.tokens})
+
+        def log_message(self, fmt, *fmt_args):
+            log.debug("serve http: " + fmt, *fmt_args)
+
+    server = ThreadingHTTPServer(("", port), Handler)
+    server.daemon_threads = True
+    return server
 
 
 def run(info: bootstrap.ProcessInfo, args=None) -> Dict[str, Any]:
     args = args or parse_args([])
     loop = ServeLoop(args, info)
     summary = loop.run()
-    log.info("serve: %d steps, %d/%d requests completed, %d reloads, "
-             "%d failed steps", summary["steps"], summary["completed"],
-             summary["arrivals"], summary["reloads"],
-             summary["failedSteps"])
+    log.info("serve: %d steps, %d/%d requests completed (%d shed), "
+             "%.0f tokens/sec, %d reloads, %d failed steps",
+             summary["steps"], summary["completed"], summary["arrivals"],
+             summary["shed"], summary["tokensPerSecond"],
+             summary["reloads"], summary["failedSteps"])
     return summary
 
 

@@ -97,6 +97,13 @@ class _Entry:
     # reads: evicting an already-degraded gang costs less goodput than
     # evicting a healthy full-width one.
     preferred: int = field(default=0)
+    # Serving fleet (spec.mode: serve) and its minimum slice footprint
+    # (minReplicas for slice-per-replica fleets; the whole footprint for
+    # fixed-size ones). Victim selection reads :meth:`serve_at_min`:
+    # a fleet with nothing left to shrink goes dark if evicted, where a
+    # training gang resumes from its checkpoint.
+    serve: bool = field(default=False)
+    serve_min_slices: int = field(default=0)
 
     def floor(self) -> int:
         """The size this job must at least be granted to run."""
@@ -105,6 +112,13 @@ class _Entry:
     def shrunk(self) -> bool:
         """Running below the preferred size."""
         return bool(self.preferred) and self.slices < self.preferred
+
+    def serve_at_min(self) -> bool:
+        """A serving fleet already at its replica floor — evicting it
+        takes live traffic capacity to zero slack, so it ranks as the
+        WORST victim in its priority band."""
+        return self.serve and self.slices <= (self.serve_min_slices
+                                              or self.slices)
 
 
 class FleetScheduler:
@@ -146,7 +160,9 @@ class FleetScheduler:
                         queue: str = DEFAULT_SCHEDULING_QUEUE,
                         holds_hardware: Any = False,
                         min_slices: Optional[int] = None,
-                        held_slices: Optional[int] = None) -> bool:
+                        held_slices: Optional[int] = None,
+                        serve: bool = False,
+                        serve_min_slices: int = 0) -> bool:
         """True when ``key`` may (continue to) run its gang.
 
         ``demand`` is ``inventory.job_demand(spec)``; None = zero-footprint
@@ -180,8 +196,12 @@ class FleetScheduler:
             if ent is not None and ent.uid == uid:
                 # Keep the preferred size tracking the live spec: a
                 # shrunk-vs-full reading taken against a stale demand
-                # would mis-rank victims after a spec resize.
+                # would mis-rank victims after a spec resize. The serve
+                # floor likewise follows the live spec (a minReplicas
+                # edit changes which fleets rank as at-min victims).
                 ent.preferred = slices
+                ent.serve = serve
+                ent.serve_min_slices = int(serve_min_slices)
                 return True
             if ent is not None:
                 # Same name, new UID: the old job's reservation is stale.
@@ -196,7 +216,8 @@ class FleetScheduler:
                     key=key, uid=uid, demand_key=demand_key, slices=held,
                     priority=priority, queue=queue, seq=self._seq,
                     admit_seq=self._seq, forced=True, min_slices=min_req,
-                    preferred=slices)
+                    preferred=slices, serve=serve,
+                    serve_min_slices=int(serve_min_slices))
                 self._pending.pop(key, None)
                 self._update_gauges_locked()
                 return True
@@ -209,7 +230,8 @@ class FleetScheduler:
                 self._pending[key] = _Entry(
                     key=key, uid=uid, demand_key=demand_key, slices=slices,
                     priority=priority, queue=queue, seq=self._seq,
-                    min_slices=min_req, preferred=slices,
+                    min_slices=min_req, preferred=slices, serve=serve,
+                    serve_min_slices=int(serve_min_slices),
                     enqueued_at=(pend.enqueued_at
                                  if pend is not None and pend.uid == uid
                                  else self._clock()))
@@ -577,17 +599,23 @@ class FleetScheduler:
                     if k in self._evicting and v.demand_key == head.demand_key)
         if need <= 0:
             return []
-        # Within a priority band, gangs already running SHRUNK (straggler
-        # shed, tight admission grant) go first: they are degraded
-        # already, their restart is billed to the infra budget either
-        # way, and sparing a healthy full-width gang preserves strictly
-        # more goodput. Newest-admitted breaks the remaining ties.
+        # Within a priority band, a serving fleet at its replica floor
+        # goes LAST: it has no slack to give back — eviction takes live
+        # traffic to zero, where a fresh-checkpoint training gang merely
+        # resumes (serve-at-min outranks the shrunk reading exactly
+        # because a fleet scaled down to minReplicas LOOKS shrunk).
+        # Among the rest, gangs already running SHRUNK (straggler shed,
+        # tight admission grant) go first: they are degraded already,
+        # their restart is billed to the infra budget either way, and
+        # sparing a healthy full-width gang preserves strictly more
+        # goodput. Newest-admitted breaks the remaining ties.
         candidates = sorted(
             (v for k, v in self._admitted.items()
              if k not in self._evicting
              and v.demand_key == head.demand_key
              and v.priority < head.priority),
-            key=lambda v: (v.priority, not v.shrunk(), -v.admit_seq))
+            key=lambda v: (v.priority, v.serve_at_min(), not v.shrunk(),
+                           -v.admit_seq))
         chosen: List[_Entry] = []
         freed = 0
         for victim in candidates:

@@ -47,6 +47,7 @@ from tpu_operator.apis.tpujob import helper
 from tpu_operator.apis.tpujob.v1alpha1.types import (
     DEFAULT_CONTAINER_NAME,
     DEFAULT_SERVE_RELOAD_POLL,
+    DEFAULT_TPU_PORT,
     CacheMedium,
     FailureKind,
     JobMode,
@@ -279,14 +280,21 @@ def build_replica_env(
             # N verified snapshots remotely (payload/warmstore.py reads).
             env["TPUJOB_STORE_KEEP"] = str(store.keep_snapshots)
     if spec.mode == JobMode.SERVE:
-        # Serving mode (payload/serve.py consumes): the mode flag and the
-        # hot-reload watch cadence. Scaling knobs (min/max/target) stay
-        # controller-side — the payload only reports traffic.
+        # Serving mode (payload/serve.py consumes): the mode flag, the
+        # hot-reload watch cadence, and the HTTP ingress port — the SAME
+        # port the replica's readiness-gated Service targets, so routed
+        # traffic lands on the payload's POST /v1/decode endpoint (serve
+        # replicas form no jax.distributed group, so the port the trainer
+        # would spend on the coordinator is free for ingress). Scaling
+        # knobs (min/max/target) stay controller-side — the payload only
+        # reports traffic.
         env["TPUJOB_SERVE"] = "1"
         sv = spec.serving
         env["TPUJOB_SERVE_RELOAD_POLL"] = str(
             sv.reload_poll_seconds if sv is not None
             else DEFAULT_SERVE_RELOAD_POLL)
+        env["TPUJOB_SERVE_PORT"] = str(
+            table[process_id][3] or DEFAULT_TPU_PORT)
     trace = spec.step_trace
     if trace is not None:
         # Data-plane flight recorder (payload/steptrace.py consumes): the

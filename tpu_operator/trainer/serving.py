@@ -127,13 +127,25 @@ def sched_kwargs(spec: TPUJobSpec,
     CURRENT scale — the live admission gate and the controller's restart
     rebuild must both re-reserve what the job actually runs, never the
     spec's original count (the elastic ``sched_kwargs`` discipline, one
-    home for the derivation). Non-serve / non-slice-per-replica jobs
-    pass through unchanged."""
-    if not is_serve(spec) or demand is None or not slice_per_replica(spec):
+    home for the derivation). Non-serve jobs pass through unchanged.
+
+    Every serve job additionally tags its scheduler entry ``serve`` with
+    its minimum slice footprint (``serve_min_slices``): victim selection
+    ranks a serve fleet already at ``minReplicas`` as a WORSE preemption
+    victim than a training gang — the fleet has no capacity left to give
+    back without going dark, while a fresh-checkpoint training gang
+    resumes where it left off. Slice-per-replica fleets above their
+    floor rank normally (they can shrink back toward it first); fixed-
+    footprint serve jobs are always at their floor."""
+    if not is_serve(spec) or demand is None:
         return demand, {}
     key, slices = demand
+    if not slice_per_replica(spec):
+        return demand, {"serve": True, "serve_min_slices": slices}
     cur = int((status_serving or {}).get("replicas") or 0) or slices
-    return (key, cur), {"held_slices": cur}
+    lo, _hi = replica_range(spec)
+    return (key, cur), {"held_slices": cur, "serve": True,
+                        "serve_min_slices": lo}
 
 
 def ready_indices(spec: TPUJobSpec, ready_pids: Set[int]) -> Set[int]:
